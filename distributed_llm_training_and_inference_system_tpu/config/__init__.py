@@ -1,0 +1,29 @@
+"""Typed config layer: schemas, validation, presets, layered loading.
+
+Implements for real what the reference's empty ``llmctl/config`` package
+promises ("schema validation, presets" — reference llmctl/config/__init__.py:1).
+"""
+
+from .schema import (  # noqa: F401
+    CheckpointConfig,
+    ConfigError,
+    DataConfig,
+    HardwareConfig,
+    ModelConfig,
+    MoEConfig,
+    OptimizerConfig,
+    ParallelConfig,
+    RopeConfig,
+    RunConfig,
+    SchedulerConfig,
+    ServeConfig,
+    TrainingConfig,
+)
+from .presets import (  # noqa: F401
+    HARDWARE_PRESETS,
+    MODEL_TEMPLATES,
+    TEST_TEMPLATES,
+    get_hardware_preset,
+    get_model_config,
+)
+from .loader import deep_merge, env_overrides, load_run_config  # noqa: F401

@@ -1,0 +1,144 @@
+"""Built-in model templates and TPU hardware presets.
+
+Parity: the reference ships MODEL_TEMPLATES for gpt-7b/gpt-13b/llama-7b
+(reference llmctl/cli/commands/init.py:16-51) and an 8xA100 hardware preset
+(reference configs/presets/a100x8.toml). Here the template set is wider
+(125m..13b for single-chip through pod-scale work) and hardware presets are
+TPU slices.
+"""
+
+from __future__ import annotations
+
+from .schema import HardwareConfig, ModelConfig, MoEConfig, RopeConfig
+
+# ---------------------------------------------------------------------------
+# Model templates. vocab_size padded to a multiple of 128 (MXU lane width)
+# except llama-7b which keeps its canonical 32000 vocab for checkpoint parity.
+# ---------------------------------------------------------------------------
+
+MODEL_TEMPLATES: dict[str, ModelConfig] = {
+    "gpt-125m": ModelConfig(
+        name="gpt-125m", num_layers=12, hidden_size=768, ffn_size=2048,
+        num_heads=12, num_kv_heads=12, head_dim=64, vocab_size=50304,
+        max_position_embeddings=2048, activation="silu",
+        tie_word_embeddings=True,
+    ),
+    "gpt-350m": ModelConfig(
+        name="gpt-350m", num_layers=24, hidden_size=1024, ffn_size=2816,
+        num_heads=16, num_kv_heads=16, head_dim=64, vocab_size=50304,
+        max_position_embeddings=2048, activation="silu",
+        tie_word_embeddings=True,
+    ),
+    "gpt-1b": ModelConfig(
+        name="gpt-1b", num_layers=24, hidden_size=2048, ffn_size=5632,
+        num_heads=16, num_kv_heads=16, head_dim=128, vocab_size=50304,
+        max_position_embeddings=4096, activation="silu",
+    ),
+    # gpt-7b mirrors the reference template (init.py:17-28): 32L, 4096h,
+    # 32 heads — llama-7b-shaped.
+    "gpt-7b": ModelConfig(
+        name="gpt-7b", num_layers=32, hidden_size=4096, ffn_size=11008,
+        num_heads=32, num_kv_heads=32, head_dim=128, vocab_size=50304,
+        max_position_embeddings=4096, activation="silu",
+    ),
+    # gpt-13b mirrors reference init.py:29-39: 40L, 5120h, 40 heads.
+    "gpt-13b": ModelConfig(
+        name="gpt-13b", num_layers=40, hidden_size=5120, ffn_size=13824,
+        num_heads=40, num_kv_heads=40, head_dim=128, vocab_size=50304,
+        max_position_embeddings=4096, activation="silu",
+    ),
+    # llama-7b mirrors reference configs/models/llama-7b.json:1-24 exactly.
+    "llama-7b": ModelConfig(
+        name="llama-7b", num_layers=32, hidden_size=4096, ffn_size=11008,
+        num_heads=32, num_kv_heads=32, head_dim=128, vocab_size=32000,
+        max_position_embeddings=4096, activation="silu", norm_eps=1e-5,
+        rope=RopeConfig(base=10000.0, scaling="linear"),
+        tie_word_embeddings=False,
+    ),
+    # GQA + long-context flavour (llama-2/3 style) for serve benchmarks.
+    "llama-8b-gqa": ModelConfig(
+        name="llama-8b-gqa", num_layers=32, hidden_size=4096, ffn_size=14336,
+        num_heads=32, num_kv_heads=8, head_dim=128, vocab_size=128256,
+        max_position_embeddings=8192, activation="silu", norm_eps=1e-5,
+        rope=RopeConfig(base=500000.0),
+    ),
+    # MoE template exercising the expert-parallel mesh axis (no reference
+    # equivalent; SURVEY §2.2 row EP).
+    "gpt-moe-8x1b": ModelConfig(
+        name="gpt-moe-8x1b", num_layers=16, hidden_size=2048, ffn_size=5632,
+        num_heads=16, num_kv_heads=16, head_dim=128, vocab_size=50304,
+        max_position_embeddings=4096, activation="silu",
+        moe=MoEConfig(num_experts=8, experts_per_token=2),
+    ),
+}
+
+# Tiny models for tests/CI (not listed in user-facing templates).
+TEST_TEMPLATES: dict[str, ModelConfig] = {
+    "gpt-test": ModelConfig(
+        name="gpt-test", num_layers=2, hidden_size=64, ffn_size=128,
+        num_heads=4, num_kv_heads=2, head_dim=16, vocab_size=256,
+        max_position_embeddings=128, activation="silu", dtype="float32",
+    ),
+    "gpt-test-moe": ModelConfig(
+        name="gpt-test-moe", num_layers=2, hidden_size=64, ffn_size=128,
+        num_heads=4, num_kv_heads=4, head_dim=16, vocab_size=256,
+        max_position_embeddings=128, activation="silu", dtype="float32",
+        moe=MoEConfig(num_experts=4, experts_per_token=2),
+    ),
+}
+
+
+def get_model_config(name: str) -> ModelConfig:
+    """Look up a template by name (also accepts test templates).
+
+    Returns a deep copy so callers can mutate freely without corrupting the
+    global template table.
+    """
+    import copy
+    if name in MODEL_TEMPLATES:
+        return copy.deepcopy(MODEL_TEMPLATES[name])
+    if name in TEST_TEMPLATES:
+        return copy.deepcopy(TEST_TEMPLATES[name])
+    raise KeyError(
+        f"unknown model template {name!r}; available: "
+        f"{sorted(MODEL_TEMPLATES)} (+test: {sorted(TEST_TEMPLATES)})")
+
+
+# ---------------------------------------------------------------------------
+# TPU hardware presets — the analog of configs/presets/a100x8.toml in the
+# reference. Numbers are public v4/v5e/v5p datasheet figures.
+# ---------------------------------------------------------------------------
+
+HARDWARE_PRESETS: dict[str, HardwareConfig] = {
+    "v5e-1": HardwareConfig(chip_type="v5e", num_chips=1, num_hosts=1,
+                            hbm_gb_per_chip=16, peak_bf16_tflops=197,
+                            hbm_bw_gbps=819, ici_bw_gbps=186, topology="1x1"),
+    "v5e-4": HardwareConfig(chip_type="v5e", num_chips=4, num_hosts=1,
+                            hbm_gb_per_chip=16, peak_bf16_tflops=197,
+                            hbm_bw_gbps=819, ici_bw_gbps=186, topology="2x2"),
+    "v5e-8": HardwareConfig(chip_type="v5e", num_chips=8, num_hosts=1,
+                            hbm_gb_per_chip=16, peak_bf16_tflops=197,
+                            hbm_bw_gbps=819, ici_bw_gbps=186, topology="2x4"),
+    "v5e-64": HardwareConfig(chip_type="v5e", num_chips=64, num_hosts=8,
+                             hbm_gb_per_chip=16, peak_bf16_tflops=197,
+                             hbm_bw_gbps=819, ici_bw_gbps=186, topology="8x8"),
+    "v5e-256": HardwareConfig(chip_type="v5e", num_chips=256, num_hosts=32,
+                              hbm_gb_per_chip=16, peak_bf16_tflops=197,
+                              hbm_bw_gbps=819, ici_bw_gbps=186, topology="16x16"),
+    "v4-8": HardwareConfig(chip_type="v4", num_chips=4, num_hosts=1,
+                           hbm_gb_per_chip=32, peak_bf16_tflops=275,
+                           hbm_bw_gbps=1228, ici_bw_gbps=448, topology="2x2x1"),
+    "v5p-8": HardwareConfig(chip_type="v5p", num_chips=4, num_hosts=1,
+                            hbm_gb_per_chip=95, peak_bf16_tflops=459,
+                            hbm_bw_gbps=2765, ici_bw_gbps=600, topology="2x2x1"),
+    "cpu-8": HardwareConfig(platform="cpu", chip_type="cpu-fake", num_chips=8,
+                            num_hosts=1, hbm_gb_per_chip=4, peak_bf16_tflops=0.2,
+                            hbm_bw_gbps=50, ici_bw_gbps=10, topology="8"),
+}
+
+
+def get_hardware_preset(name: str) -> HardwareConfig:
+    if name not in HARDWARE_PRESETS:
+        raise KeyError(f"unknown hardware preset {name!r}; available: "
+                       f"{sorted(HARDWARE_PRESETS)}")
+    return HARDWARE_PRESETS[name]

@@ -1,0 +1,518 @@
+"""Typed configuration schemas with validation.
+
+The reference declares an (empty) ``llmctl/config`` package whose docstring
+promises "schema validation, presets" (reference llmctl/config/__init__.py:1)
+and parses TOML/JSON ad-hoc at each call site with zero validation
+(reference plan.py:220-237, train_script.py:100-131). This module is the real
+thing: every config is a dataclass with types, defaults, ``validate()``, and
+tolerant ``from_dict`` constructors that accept the reference's on-disk file
+shapes (configs/models/llama-7b.json, configs/presets/llama-7b-a100x8.toml).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any
+
+
+class ConfigError(ValueError):
+    """Raised when a config file or value fails validation."""
+
+
+def _take(d: dict, *names, default=None):
+    """First present key among *names* (tolerates schema synonyms)."""
+    for n in names:
+        if n in d and d[n] is not None:
+            return d[n]
+    return default
+
+
+@dataclass
+class RopeConfig:
+    base: float = 10000.0
+    scaling: str = "none"       # none | linear | ntk
+    scaling_factor: float = 1.0
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any] | None) -> "RopeConfig":
+        if not d:
+            return cls()
+        return cls(
+            base=float(_take(d, "base", "theta", default=10000.0)),
+            scaling=str(_take(d, "scaling", default="none")),
+            scaling_factor=float(_take(d, "scaling_factor", "factor", default=1.0)),
+        )
+
+
+@dataclass
+class MoEConfig:
+    """Mixture-of-experts settings (expert parallelism axis).
+
+    Absent from the reference entirely (SURVEY §2.2 row EP); present here
+    because the mesh has a first-class expert axis.
+    """
+    num_experts: int = 0            # 0 = dense model
+    experts_per_token: int = 2
+    router_aux_loss_weight: float = 0.01
+    capacity_factor: float = 1.25
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any] | None) -> "MoEConfig":
+        if not d:
+            return cls()
+        return cls(
+            num_experts=int(_take(d, "num_experts", "experts", default=0)),
+            experts_per_token=int(_take(d, "experts_per_token", "top_k", default=2)),
+            router_aux_loss_weight=float(_take(d, "router_aux_loss_weight", default=0.01)),
+            capacity_factor=float(_take(d, "capacity_factor", default=1.25)),
+        )
+
+
+@dataclass
+class ModelConfig:
+    """Decoder-only transformer architecture.
+
+    Field names follow the reference's model JSON
+    (reference configs/models/llama-7b.json:1-24): layers/hidden/ffn/heads/
+    head_dim/vocab_size/..., with TPU-relevant additions (num_kv_heads for
+    GQA, dtype, MoE).
+    """
+    name: str = "gpt-125m"
+    arch: str = "decoder-only"
+    num_layers: int = 12
+    hidden_size: int = 768
+    ffn_size: int = 3072
+    num_heads: int = 12
+    num_kv_heads: int = 12          # < num_heads ⇒ grouped-query attention
+    head_dim: int = 64
+    vocab_size: int = 50304         # padded to a multiple of 128 for the MXU
+    max_position_embeddings: int = 2048
+    rope: RopeConfig = field(default_factory=RopeConfig)
+    activation: str = "silu"        # silu (SwiGLU) | gelu (GeGLU) | relu
+    norm_eps: float = 1e-5
+    tie_word_embeddings: bool = False
+    attention_bias: bool = False
+    dropout: float = 0.0
+    dtype: str = "bfloat16"         # activations/weights compute dtype
+    moe: MoEConfig = field(default_factory=MoEConfig)
+
+    @property
+    def is_moe(self) -> bool:
+        return self.moe.num_experts > 0
+
+    def validate(self) -> None:
+        # hidden_size need not equal num_heads*head_dim (projections go
+        # hidden -> q_dim and back), but every dimension must be positive
+        # and heads must group evenly over kv heads.
+        if self.num_kv_heads < 1 or self.num_heads < 1 or self.head_dim < 1:
+            raise ConfigError("num_heads, num_kv_heads, head_dim must be >= 1")
+        if self.num_heads % self.num_kv_heads != 0:
+            raise ConfigError(
+                f"num_heads ({self.num_heads}) must be a multiple of "
+                f"num_kv_heads ({self.num_kv_heads})")
+        if self.vocab_size <= 0 or self.num_layers <= 0:
+            raise ConfigError("vocab_size and num_layers must be positive")
+        if self.activation not in ("silu", "gelu", "relu"):
+            raise ConfigError(f"unknown activation {self.activation!r}")
+        if self.arch != "decoder-only":
+            raise ConfigError(f"unsupported arch {self.arch!r} (decoder-only only)")
+
+    @property
+    def param_count(self) -> int:
+        """Analytic parameter count (embedding + blocks + head).
+
+        Mirrors the planner's estimate_parameters
+        (reference plan.py:40-58) but exact for this architecture.
+        """
+        h, f, v = self.hidden_size, self.ffn_size, self.vocab_size
+        kv_dim = self.num_kv_heads * self.head_dim
+        q_dim = self.num_heads * self.head_dim
+        attn = h * q_dim + 2 * h * kv_dim + q_dim * h
+        if self.activation in ("silu", "gelu"):    # gated: w_gate, w_up, w_down
+            mlp_dense = 3 * h * f
+        else:
+            mlp_dense = 2 * h * f
+        if self.is_moe:
+            mlp = self.moe.num_experts * mlp_dense + h * self.moe.num_experts
+        else:
+            mlp = mlp_dense
+        norms = 2 * h
+        per_layer = attn + mlp + norms
+        emb = v * h
+        head = 0 if self.tie_word_embeddings else v * h
+        final_norm = h
+        return emb + self.num_layers * per_layer + final_norm + head
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "ModelConfig":
+        attn = d.get("attention", {}) or {}
+        num_heads = int(_take(d, "heads", "num_heads", "num_attention_heads", default=12))
+        hidden = int(_take(d, "hidden", "hidden_size", "d_model", default=768))
+        cfg = cls(
+            name=str(_take(d, "name", default="custom")),
+            arch=str(_take(d, "arch", default="decoder-only")),
+            num_layers=int(_take(d, "layers", "num_layers", "num_hidden_layers", default=12)),
+            hidden_size=hidden,
+            ffn_size=int(_take(d, "ffn", "ffn_size", "intermediate_size", default=4 * hidden)),
+            num_heads=num_heads,
+            num_kv_heads=int(_take(d, "kv_heads", "num_kv_heads", "num_key_value_heads",
+                                   default=num_heads)),
+            head_dim=int(_take(d, "head_dim", default=hidden // num_heads)),
+            vocab_size=int(_take(d, "vocab_size", default=50304)),
+            max_position_embeddings=int(_take(d, "max_position_embeddings", "max_seq_len",
+                                              default=2048)),
+            rope=RopeConfig.from_dict(d.get("rope")),
+            activation=str(_take(d, "activation", "hidden_act", default="silu")),
+            norm_eps=float(_take(d, "layer_norm_eps", "norm_eps", "rms_norm_eps", default=1e-5)),
+            tie_word_embeddings=bool(_take(d, "tie_word_embeddings", default=False)),
+            attention_bias=bool(attn.get("bias", _take(d, "attention_bias", default=False))),
+            dropout=float(attn.get("dropout", _take(d, "dropout", default=0.0))),
+            dtype=str(_take(d, "dtype", default="bfloat16")),
+            moe=MoEConfig.from_dict(d.get("moe")),
+        )
+        cfg.validate()
+        return cfg
+
+    def to_dict(self) -> dict[str, Any]:
+        d = dataclasses.asdict(self)
+        return d
+
+
+@dataclass
+class SchedulerConfig:
+    type: str = "cosine"            # cosine | linear | constant
+    warmup_steps: int = 100
+    total_steps: int = 10000
+    min_lr_ratio: float = 0.1
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any] | None) -> "SchedulerConfig":
+        if not d:
+            return cls()
+        return cls(
+            type=str(_take(d, "type", default="cosine")),
+            warmup_steps=int(_take(d, "warmup_steps", "warmup", default=100)),
+            total_steps=int(_take(d, "total_steps", default=10000)),
+            min_lr_ratio=float(_take(d, "min_lr_ratio", default=0.1)),
+        )
+
+
+@dataclass
+class OptimizerConfig:
+    """AdamW + schedule (parity: reference engine.py:217-256, preset [optimizer])."""
+    type: str = "adamw"
+    lr: float = 3e-4
+    betas: tuple[float, float] = (0.9, 0.95)
+    eps: float = 1e-8
+    weight_decay: float = 0.1
+    grad_clip: float = 1.0
+    scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
+
+    def validate(self) -> None:
+        if self.type not in ("adamw", "adam", "sgd", "adafactor", "lion"):
+            raise ConfigError(f"unknown optimizer {self.type!r}")
+        if not (0 < self.lr < 1):
+            raise ConfigError(f"suspicious learning rate {self.lr}")
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any] | None) -> "OptimizerConfig":
+        if not d:
+            return cls()
+        betas = _take(d, "betas", default=(0.9, 0.95))
+        cfg = cls(
+            type=str(_take(d, "type", default="adamw")),
+            lr=float(_take(d, "lr", "learning_rate", default=3e-4)),
+            betas=(float(betas[0]), float(betas[1])),
+            eps=float(_take(d, "eps", default=1e-8)),
+            weight_decay=float(_take(d, "weight_decay", default=0.1)),
+            grad_clip=float(_take(d, "grad_clip", "gradient_clipping", default=1.0)),
+            scheduler=SchedulerConfig.from_dict(d.get("scheduler")),
+        )
+        cfg.validate()
+        return cfg
+
+
+@dataclass
+class ParallelConfig:
+    """Parallelism plan — the mesh axes.
+
+    Mirrors the reference's ``[parallel]`` table
+    (reference init.py:132-141, preset llama-7b-a100x8.toml:32-41) but every
+    field here is *executed* (mesh construction in parallel/mesh.py), not
+    planned-only. ``sequence_parallel`` is an int degree (the reference's
+    dead bool, SURVEY §5.7, becomes a real context-parallel axis).
+    """
+    strategy: str = "auto"          # auto | manual
+    data_parallel: int = 1
+    fsdp: int = 1                   # ZeRO-3-style param sharding axis
+    tensor_parallel: int = 1
+    pipeline_parallel: int = 1
+    sequence_parallel: int = 1      # context parallel (ring attention) degree
+    expert_parallel: int = 1
+    zero_stage: int = 0             # 0..3 (1 = shard optimizer state only)
+    activation_checkpoint: str = "selective"   # none | selective | full
+    micro_batch_size: int = 1
+    global_batch_size: int = 8
+    gradient_accumulation_steps: int = 1
+    num_microbatches: int = 1       # pipeline microbatches per step
+
+    def validate(self) -> None:
+        for f_ in ("data_parallel", "fsdp", "tensor_parallel", "pipeline_parallel",
+                   "sequence_parallel", "expert_parallel"):
+            if getattr(self, f_) < 1:
+                raise ConfigError(f"{f_} must be >= 1")
+        if self.zero_stage not in (0, 1, 2, 3):
+            raise ConfigError("zero_stage must be 0..3")
+        if self.activation_checkpoint not in ("none", "selective", "full"):
+            raise ConfigError("activation_checkpoint must be none|selective|full")
+        if self.pipeline_parallel > 1 and self.num_microbatches < self.pipeline_parallel:
+            raise ConfigError(
+                "num_microbatches must be >= pipeline_parallel for a full pipeline")
+
+    @property
+    def total_devices(self) -> int:
+        return (self.data_parallel * self.fsdp * self.tensor_parallel *
+                self.pipeline_parallel * self.sequence_parallel * self.expert_parallel)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any] | None) -> "ParallelConfig":
+        if not d:
+            return cls()
+        sp = _take(d, "sequence_parallel", "context_parallel", default=1)
+        if isinstance(sp, bool):    # reference's dead bool flag
+            sp = 1
+        cfg = cls(
+            strategy=str(_take(d, "strategy", default="auto")),
+            data_parallel=int(_take(d, "data_parallel", "dp", default=1)),
+            fsdp=int(_take(d, "fsdp", default=1)),
+            tensor_parallel=int(_take(d, "tensor_parallel", "tp", default=1)),
+            pipeline_parallel=int(_take(d, "pipeline_parallel", "pp", default=1)),
+            sequence_parallel=int(sp),
+            expert_parallel=int(_take(d, "expert_parallel", "ep", default=1)),
+            zero_stage=int(_take(d, "zero_stage", default=0)),
+            activation_checkpoint=str(_take(d, "activation_checkpoint", default="selective")),
+            micro_batch_size=int(_take(d, "micro_batch_size", default=1)),
+            global_batch_size=int(_take(d, "global_batch_size", default=8)),
+            gradient_accumulation_steps=int(_take(d, "gradient_accumulation_steps", default=1)),
+            num_microbatches=int(_take(d, "num_microbatches",
+                                       default=_take(d, "pipeline_parallel", "pp", default=1))),
+        )
+        cfg.validate()
+        return cfg
+
+
+@dataclass
+class DataConfig:
+    """Dataset streaming (reference's [data] table, preset :16-22).
+
+    The reference ignores dataset_path and trains on a hardcoded dummy
+    (defect SURVEY §2.4.4, engine.py:147-171); here train/val paths point at
+    tokenized .bin shards consumed by io/data.py, with a synthetic fallback.
+    """
+    train: str = "synthetic"
+    val: str = "synthetic"
+    tokenizer: str = "gpt2"
+    max_length: int = 2048
+    pack_sequences: bool = True
+    num_workers: int = 2
+    prefetch_factor: int = 2
+    seed: int = 0
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any] | None) -> "DataConfig":
+        if not d:
+            return cls()
+        return cls(
+            train=str(_take(d, "train", "train_path", "dataset_path", default="synthetic")),
+            val=str(_take(d, "val", "val_path", default="synthetic")),
+            tokenizer=str(_take(d, "tokenizer", default="gpt2")),
+            max_length=int(_take(d, "max_length", "seq_len", default=2048)),
+            pack_sequences=bool(_take(d, "pack_sequences", default=True)),
+            num_workers=int(_take(d, "num_workers", default=2)),
+            prefetch_factor=int(_take(d, "prefetch_factor", default=2)),
+            seed=int(_take(d, "seed", default=0)),
+        )
+
+
+@dataclass
+class CheckpointConfig:
+    """Sharded/async checkpointing — real, unlike the reference's aspiration
+    (init.py:147-152 promises sharded/async; engine.py:363-394 is sync
+    whole-model; defect SURVEY §2.4.9)."""
+    path: str = "checkpoints"
+    interval_steps: int = 1000
+    sharded: bool = True
+    async_save: bool = True
+    keep_latest: int = 5
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any] | None) -> "CheckpointConfig":
+        if not d:
+            return cls()
+        return cls(
+            path=str(_take(d, "path", default="checkpoints")),
+            interval_steps=int(_take(d, "interval_steps", "save_interval", default=1000)),
+            sharded=bool(_take(d, "sharded", default=True)),
+            async_save=bool(_take(d, "async", "async_save", default=True)),
+            keep_latest=int(_take(d, "keep_latest", "save_total_limit", default=5)),
+        )
+
+
+@dataclass
+class TrainingConfig:
+    """Top-level training run config (reference TrainingConfig engine.py:30-70
+    + [training] table preset :55-61)."""
+    max_steps: int = 1000
+    eval_interval: int = 500
+    save_interval: int = 1000
+    log_interval: int = 10
+    seed: int = 42
+    mixed_precision: str = "bf16"   # bf16 | fp32
+    deterministic: bool = False
+    profile: bool = False
+    profile_dir: str = "traces"
+    eval_steps: int = 20            # batches per eval
+
+    def validate(self) -> None:
+        if self.mixed_precision not in ("bf16", "fp32", "no"):
+            raise ConfigError("mixed_precision must be bf16|fp32|no")
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any] | None) -> "TrainingConfig":
+        if not d:
+            return cls()
+        cfg = cls(
+            max_steps=int(_take(d, "max_steps", default=1000)),
+            eval_interval=int(_take(d, "eval_interval", default=500)),
+            save_interval=int(_take(d, "save_interval", default=1000)),
+            log_interval=int(_take(d, "log_interval", default=10)),
+            seed=int(_take(d, "seed", default=42)),
+            mixed_precision=str(_take(d, "mixed_precision", default="bf16")),
+            deterministic=bool(_take(d, "deterministic", default=False)),
+            profile=bool(_take(d, "profile", default=False)),
+            profile_dir=str(_take(d, "profile_dir", default="traces")),
+            eval_steps=int(_take(d, "eval_steps", default=20)),
+        )
+        cfg.validate()
+        return cfg
+
+
+@dataclass
+class HardwareConfig:
+    """A hardware profile (reference [hardware]/[limits] + hw probe output,
+    reference hw.py:133-282) reshaped for TPU: chips not GPUs, ICI/DCN not
+    NVLink/IB."""
+    platform: str = "tpu"           # tpu | cpu (fake mesh)
+    chip_type: str = "v5e"
+    num_chips: int = 1
+    num_hosts: int = 1
+    hbm_gb_per_chip: float = 16.0
+    peak_bf16_tflops: float = 197.0     # v5e MXU peak
+    hbm_bw_gbps: float = 819.0          # v5e HBM bandwidth GB/s
+    ici_bw_gbps: float = 186.0          # per-link ICI bandwidth GB/s (v5e 1.86e11 * ?)
+    dcn_bw_gbps: float = 25.0
+    topology: str = ""                  # e.g. "2x4", "16x16"
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any] | None) -> "HardwareConfig":
+        if not d:
+            return cls()
+        return cls(
+            platform=str(_take(d, "platform", default="tpu")),
+            chip_type=str(_take(d, "chip_type", "chip", "gpu", default="v5e")),
+            num_chips=int(_take(d, "num_chips", "chips", "gpu_count", "gpus_per_node", default=1)),
+            num_hosts=int(_take(d, "num_hosts", "nodes", default=1)),
+            hbm_gb_per_chip=float(_take(d, "hbm_gb_per_chip", "memory_gb", default=16.0)),
+            peak_bf16_tflops=float(_take(d, "peak_bf16_tflops", default=197.0)),
+            hbm_bw_gbps=float(_take(d, "hbm_bw_gbps", default=819.0)),
+            ici_bw_gbps=float(_take(d, "ici_bw_gbps", "intra_node_bw", default=186.0)),
+            dcn_bw_gbps=float(_take(d, "dcn_bw_gbps", "inter_node_bw", default=25.0)),
+            topology=str(_take(d, "topology", default="")),
+        )
+
+
+@dataclass
+class ServeConfig:
+    """Inference server config (reference serve/server.py:253-284 ctor args,
+    plus paged-KV parameters the reference lacks)."""
+    model: str = "gpt-125m"
+    artifact: str = ""              # checkpoint dir
+    host: str = "0.0.0.0"
+    port: int = 8080
+    max_batch_size: int = 8
+    max_seq_len: int = 2048
+    prefill_chunk: int = 512        # prefill length bucketing granularity
+    kv_block_size: int = 16         # tokens per KV-cache page
+    kv_num_blocks: int = 0          # 0 = auto-size from HBM budget
+    kv_hbm_budget_gb: float = 4.0
+    max_queue: int = 256
+    dtype: str = "bfloat16"
+    scheduler: str = "continuous"   # continuous | static
+    temperature: float = 1.0
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any] | None) -> "ServeConfig":
+        if not d:
+            return cls()
+        kw = {}
+        for f_ in dataclasses.fields(cls):
+            if f_.name in d:
+                kw[f_.name] = type(f_.default)(d[f_.name]) if f_.default is not None else d[f_.name]
+        return cls(**kw)
+
+
+@dataclass
+class RunConfig:
+    """The full training-run preset: everything in one file.
+
+    Matches the shape generated by ``llmctl init scaffold``
+    (reference init.py:104-163) and the shipped preset
+    (reference configs/presets/llama-7b-a100x8.toml).
+    """
+    model: ModelConfig = field(default_factory=ModelConfig)
+    optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
+    data: DataConfig = field(default_factory=DataConfig)
+    parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    training: TrainingConfig = field(default_factory=TrainingConfig)
+    hardware: HardwareConfig = field(default_factory=HardwareConfig)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any], base_dir=None) -> "RunConfig":
+        model_d = d.get("model", {}) or {}
+        # Presets may point at an external model JSON via config_file
+        # (reference preset llama-7b-a100x8.toml:5 uses a repo-root-relative
+        # path from a file in configs/presets/, so search upward from the
+        # preset's own directory too). A declared-but-missing file is an
+        # error, never a silent fallback to defaults.
+        if "config_file" in model_d:
+            from pathlib import Path
+            from ..utils.tomlio import load_config_file
+            rel = Path(model_d["config_file"])
+            candidates = [rel] if rel.is_absolute() else []
+            if base_dir is not None and not rel.is_absolute():
+                b = Path(base_dir).resolve()
+                candidates += [b / rel, b.parent / rel, b.parent.parent / rel]
+            if not rel.is_absolute():
+                candidates.append(Path.cwd() / rel)
+            found = next((p for p in candidates if p.exists()), None)
+            if found is None:
+                raise ConfigError(
+                    f"model.config_file {model_d['config_file']!r} not found "
+                    f"(searched {[str(c) for c in candidates]})")
+            loaded = load_config_file(found)
+            loaded.update({k: v for k, v in model_d.items() if k != "config_file"})
+            model_d = loaded
+        return cls(
+            model=ModelConfig.from_dict(model_d) if model_d else ModelConfig(),
+            optimizer=OptimizerConfig.from_dict(d.get("optimizer")),
+            data=DataConfig.from_dict(d.get("data")),
+            parallel=ParallelConfig.from_dict(d.get("parallel")),
+            checkpoint=CheckpointConfig.from_dict(d.get("checkpoint")),
+            training=TrainingConfig.from_dict(d.get("training")),
+            hardware=HardwareConfig.from_dict(d.get("hardware")),
+        )
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)

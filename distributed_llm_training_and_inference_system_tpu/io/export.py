@@ -1,0 +1,109 @@
+"""Checkpoint export: safetensors (self-contained writer/reader) + quantized.
+
+Parity: reference ``llmctl export convert`` is a stub
+(reference cli/commands/export.py:29, SURVEY §2 row 18). This implements the
+safetensors container format from its public spec (an 8-byte little-endian
+header length, a JSON header mapping tensor name -> {dtype, shape,
+data_offsets}, then raw row-major bytes) with no external dependency, plus
+int8-quantized export via ops/quantization.py.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+_DTYPE_TO_ST = {
+    "float32": "F32", "float16": "F16", "bfloat16": "BF16",
+    "int64": "I64", "int32": "I32", "int16": "I16", "int8": "I8",
+    "uint8": "U8", "bool": "BOOL", "float64": "F64",
+}
+_ST_TO_DTYPE = {v: k for k, v in _DTYPE_TO_ST.items()}
+
+
+def _np_view(arr) -> np.ndarray:
+    """numpy view of a (possibly jax, possibly bfloat16) array."""
+    a = np.asarray(arr)
+    return a
+
+
+def save_safetensors(tensors: dict[str, Any], path: str | Path,
+                     metadata: dict[str, str] | None = None) -> None:
+    """Write a {name: array} dict as a .safetensors file."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    header: dict[str, Any] = {}
+    if metadata:
+        header["__metadata__"] = {k: str(v) for k, v in metadata.items()}
+    offset = 0
+    blobs: list[bytes] = []
+    for name in sorted(tensors):
+        a = _np_view(tensors[name])
+        dt = str(a.dtype)
+        if dt not in _DTYPE_TO_ST:
+            raise ValueError(f"dtype {dt} of tensor {name!r} unsupported by safetensors")
+        blob = np.ascontiguousarray(a).tobytes()
+        header[name] = {
+            "dtype": _DTYPE_TO_ST[dt],
+            "shape": list(a.shape),
+            "data_offsets": [offset, offset + len(blob)],
+        }
+        blobs.append(blob)
+        offset += len(blob)
+    hjson = json.dumps(header, separators=(",", ":")).encode()
+    pad = (-len(hjson)) % 8
+    hjson += b" " * pad
+    with open(path, "wb") as f:
+        f.write(struct.pack("<Q", len(hjson)))
+        f.write(hjson)
+        for blob in blobs:
+            f.write(blob)
+
+
+def load_safetensors(path: str | Path) -> tuple[dict[str, np.ndarray], dict[str, str]]:
+    """Read a .safetensors file -> ({name: array}, metadata)."""
+    path = Path(path)
+    with open(path, "rb") as f:
+        (hlen,) = struct.unpack("<Q", f.read(8))
+        header = json.loads(f.read(hlen))
+        data = f.read()
+    meta = header.pop("__metadata__", {})
+    out = {}
+    for name, info in header.items():
+        start, end = info["data_offsets"]
+        dt = _ST_TO_DTYPE[info["dtype"]]
+        if dt == "bfloat16":
+            import ml_dtypes
+            np_dt = ml_dtypes.bfloat16
+        else:
+            np_dt = np.dtype(dt)
+        arr = np.frombuffer(data[start:end], dtype=np_dt).reshape(info["shape"])
+        out[name] = arr
+    return out, meta
+
+
+def export_params(params: Any, out_path: str | Path, fmt: str = "safetensors",
+                  quant: str | None = None, metadata: dict | None = None) -> Path:
+    """Export a param pytree. fmt: safetensors | npz. quant: None | int8."""
+    from ..utils.tree import flatten_with_paths
+    out_path = Path(out_path)
+    meta = dict(metadata or {})
+    meta["format"] = fmt
+    if quant:
+        from ..ops.quantization import quantize_tree_int8
+        meta["quant"] = quant
+        if quant != "int8":
+            raise ValueError(f"unsupported quant {quant!r} (int8 only for now)")
+        params = quantize_tree_int8(params)
+    flat = dict(flatten_with_paths(params))
+    if fmt == "safetensors":
+        save_safetensors(flat, out_path, metadata=meta)
+    elif fmt == "npz":
+        np.savez(out_path, **{k: _np_view(v) for k, v in flat.items()})
+    else:
+        raise ValueError(f"unsupported export format {fmt!r}")
+    return out_path

@@ -1,0 +1,101 @@
+"""Quantization ops: absmax int8 and blockwise int4 (pack/unpack).
+
+Parity: the reference's export command advertises int8-awq / int4-gptq
+quantization but is a "coming soon" stub (reference cli/commands/export.py:29,
+SURVEY §2 row 18). These are real, XLA-compilable quantizers used by
+``llmctl export`` and the serving KV cache.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def quantize_int8(x: jax.Array, axis: int = -1) -> tuple[jax.Array, jax.Array]:
+    """Symmetric absmax int8 quantization along *axis*.
+
+    Returns (values int8, scales float32) with x ≈ values * scales.
+    """
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis, keepdims=True)
+    scale = jnp.maximum(absmax / 127.0, 1e-12)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def dequantize_int8(q: jax.Array, scale: jax.Array, dtype=jnp.bfloat16) -> jax.Array:
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+def quantize_int4_blockwise(x: jax.Array, block: int = 32) -> tuple[jax.Array, jax.Array]:
+    """Blockwise symmetric int4, packed two nibbles per uint8.
+
+    The trailing axis must be divisible by *block*. Returns
+    (packed uint8 of shape [..., n/2], scales float32 of shape [..., n/block]).
+    """
+    n = x.shape[-1]
+    if n % block != 0:
+        raise ValueError(f"last dim {n} not divisible by block {block}")
+    xb = x.astype(jnp.float32).reshape(*x.shape[:-1], n // block, block)
+    absmax = jnp.max(jnp.abs(xb), axis=-1, keepdims=True)
+    scale = jnp.maximum(absmax / 7.0, 1e-12)
+    q = jnp.clip(jnp.round(xb / scale), -7, 7).astype(jnp.int8)
+    q = q.reshape(*x.shape[:-1], n)
+    # pack pairs: low nibble = even index, high nibble = odd index
+    lo = (q[..., 0::2] & 0xF).astype(jnp.uint8)
+    hi = (q[..., 1::2] & 0xF).astype(jnp.uint8)
+    packed = (lo | (hi << 4)).astype(jnp.uint8)
+    return packed, scale[..., 0].astype(jnp.float32)
+
+
+def dequantize_int4_blockwise(packed: jax.Array, scale: jax.Array,
+                              block: int = 32, dtype=jnp.bfloat16) -> jax.Array:
+    def unnibble(v):
+        # sign-extend a 4-bit two's-complement nibble
+        v = v.astype(jnp.int8)
+        return jnp.where(v >= 8, v - 16, v)
+    lo = unnibble(packed & 0xF)
+    hi = unnibble(packed >> 4)
+    n = packed.shape[-1] * 2
+    q = jnp.stack([lo, hi], axis=-1).reshape(*packed.shape[:-1], n)
+    qb = q.reshape(*q.shape[:-1], n // block, block).astype(jnp.float32)
+    out = qb * scale[..., None]
+    return out.reshape(*q.shape[:-1], n).astype(dtype)
+
+
+def quantize_tree_int8(params: Any, min_size: int = 4096) -> Any:
+    """Quantize every large float leaf of a param pytree to (int8, scale).
+
+    Small leaves (norm scales, biases) stay in their original dtype.
+    """
+    def q(x):
+        if (hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+                and x.size >= min_size and x.ndim >= 2):
+            values, scale = quantize_int8(x)
+            return {"__quant__": "int8", "values": values, "scale": scale}
+        return x
+    return jax.tree_util.tree_map(q, params)
+
+
+def dequantize_tree(params: Any, dtype=jnp.bfloat16) -> Any:
+    def is_qleaf(x):
+        return isinstance(x, dict) and x.get("__quant__") == "int8"
+
+    def dq(x):
+        if is_qleaf(x):
+            return dequantize_int8(x["values"], x["scale"], dtype)
+        return x
+    return jax.tree_util.tree_map(dq, params, is_leaf=is_qleaf)
+
+
+def quantization_error(x: np.ndarray, block: int | None = None) -> float:
+    """Relative L2 error of int8 round-trip (used by `llmctl export --verify`)."""
+    xj = jnp.asarray(x)
+    q, s = quantize_int8(xj)
+    back = dequantize_int8(q, s, jnp.float32)
+    num = float(jnp.linalg.norm((back - xj.astype(jnp.float32))))
+    den = float(jnp.linalg.norm(xj.astype(jnp.float32))) + 1e-12
+    return num / den

@@ -1,0 +1,224 @@
+"""Continuous-batching request scheduler.
+
+Fixes the reference's core serving defect: its DynamicBatchScheduler pops
+requests once and never re-enqueues unfinished ones, so any request needing
+more than one generated token hangs forever
+(reference serve/server.py:102-125 + :372-386, defect SURVEY §2.4.1).
+
+Here the scheduler owns a fixed set of decode *slots* (XLA-friendly static
+batch shape). Requests join a slot after prefill, stay resident across decode
+steps, and release the slot (and their KV pages) when finished. Admission is
+gated on both a free slot and KV-page availability, with FCFS order.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Callable, Optional
+
+
+class RequestState(str, Enum):
+    QUEUED = "queued"
+    PREFILLING = "prefilling"
+    RUNNING = "running"          # resident in a decode slot
+    FINISHED = "finished"
+    FAILED = "failed"
+    CANCELLED = "cancelled"
+
+
+@dataclass
+class SamplingParams:
+    """Per-request sampling knobs (parity: reference server.py:209-235)."""
+    temperature: float = 1.0
+    top_k: int = 0               # 0 = disabled
+    top_p: float = 1.0
+    max_tokens: int = 64
+    stop_token_ids: tuple[int, ...] = ()
+    seed: Optional[int] = None
+
+
+@dataclass
+class Request:
+    request_id: str
+    prompt_tokens: list[int]
+    sampling: SamplingParams = field(default_factory=SamplingParams)
+    state: RequestState = RequestState.QUEUED
+    generated_tokens: list[int] = field(default_factory=list)
+    slot: Optional[int] = None
+    arrival_time: float = field(default_factory=time.monotonic)
+    first_token_time: Optional[float] = None   # for TTFT
+    finish_time: Optional[float] = None
+    finish_reason: Optional[str] = None
+    error: Optional[str] = None
+
+    @property
+    def num_prompt_tokens(self) -> int:
+        return len(self.prompt_tokens)
+
+    @property
+    def total_len(self) -> int:
+        return len(self.prompt_tokens) + len(self.generated_tokens)
+
+    @property
+    def ttft_ms(self) -> Optional[float]:
+        if self.first_token_time is None:
+            return None
+        return (self.first_token_time - self.arrival_time) * 1000.0
+
+    def record_token(self, token: int) -> None:
+        if self.first_token_time is None:
+            self.first_token_time = time.monotonic()
+        self.generated_tokens.append(token)
+
+    def should_stop(self, eos_token_id: Optional[int]) -> Optional[str]:
+        if self.generated_tokens:
+            last = self.generated_tokens[-1]
+            if eos_token_id is not None and last == eos_token_id:
+                return "stop"
+            if last in self.sampling.stop_token_ids:
+                return "stop"
+        if len(self.generated_tokens) >= self.sampling.max_tokens:
+            return "length"
+        return None
+
+
+class ContinuousBatchingScheduler:
+    """Slot-based continuous batching with KV-page-aware admission.
+
+    ``can_allocate(request) -> bool`` and ``on_release(request)`` hooks let
+    the paged KV cache veto admission / reclaim pages without the scheduler
+    knowing cache internals.
+    """
+
+    def __init__(
+        self,
+        max_batch_size: int = 8,
+        max_queue: int = 256,
+        max_seq_len: int = 2048,
+        can_allocate: Optional[Callable[[Request], bool]] = None,
+        on_release: Optional[Callable[[Request], None]] = None,
+    ):
+        self.max_batch_size = max_batch_size
+        self.max_queue = max_queue
+        self.max_seq_len = max_seq_len
+        self.waiting: deque[Request] = deque()
+        self.slots: list[Optional[Request]] = [None] * max_batch_size
+        self._can_allocate = can_allocate or (lambda r: True)
+        self._on_release = on_release or (lambda r: None)
+        self.completed: deque[Request] = deque(maxlen=1024)
+        # counters for metrics
+        self.total_admitted = 0
+        self.total_finished = 0
+        self.total_rejected = 0
+
+    # -- admission ----------------------------------------------------------
+
+    def add_request(self, request: Request) -> bool:
+        """Enqueue; False if the queue is full (HTTP 503 upstream,
+        parity: reference server.py:315-316)."""
+        if len(self.waiting) >= self.max_queue:
+            self.total_rejected += 1
+            return False
+        if request.num_prompt_tokens + request.sampling.max_tokens > self.max_seq_len:
+            request.state = RequestState.FAILED
+            request.error = (
+                f"prompt+max_tokens ({request.num_prompt_tokens}+"
+                f"{request.sampling.max_tokens}) exceeds max_seq_len {self.max_seq_len}")
+            self.completed.append(request)
+            self.total_rejected += 1
+            return False
+        request.state = RequestState.QUEUED
+        self.waiting.append(request)
+        return True
+
+    def cancel(self, request_id: str) -> bool:
+        for r in list(self.waiting):
+            if r.request_id == request_id:
+                self.waiting.remove(r)
+                r.state = RequestState.CANCELLED
+                self.completed.append(r)
+                return True
+        for i, r in enumerate(self.slots):
+            if r is not None and r.request_id == request_id:
+                self._release_slot(i, "cancelled")
+                return True
+        return False
+
+    # -- scheduling ---------------------------------------------------------
+
+    def free_slots(self) -> list[int]:
+        return [i for i, r in enumerate(self.slots) if r is None]
+
+    def admit(self) -> list[Request]:
+        """Move waiting requests into free slots (FCFS, KV-gated).
+
+        Returns the newly admitted requests, which need prefill before they
+        produce tokens.
+        """
+        admitted = []
+        free = self.free_slots()
+        while free and self.waiting:
+            req = self.waiting[0]
+            if not self._can_allocate(req):
+                break  # head-of-line blocks until pages free up (FCFS, no starvation)
+            self.waiting.popleft()
+            slot = free.pop(0)
+            req.slot = slot
+            req.state = RequestState.PREFILLING
+            self.slots[slot] = req
+            admitted.append(req)
+            self.total_admitted += 1
+        return admitted
+
+    def running(self) -> list[Request]:
+        return [r for r in self.slots if r is not None and r.state == RequestState.RUNNING]
+
+    def step_finished(self, eos_token_id: Optional[int]) -> list[Request]:
+        """After a decode step: retire finished requests, free their slots."""
+        done = []
+        for i, r in enumerate(self.slots):
+            if r is None or r.state != RequestState.RUNNING:
+                continue
+            reason = r.should_stop(eos_token_id)
+            if reason is not None:
+                done.append(r)
+                self._release_slot(i, reason)
+        return done
+
+    def _release_slot(self, slot: int, reason: str) -> None:
+        r = self.slots[slot]
+        if r is None:
+            return
+        self.slots[slot] = None
+        r.slot = None
+        r.finish_time = time.monotonic()
+        r.finish_reason = reason
+        r.state = (RequestState.CANCELLED if reason == "cancelled"
+                   else RequestState.FINISHED)
+        self._on_release(r)
+        self.completed.append(r)
+        if reason != "cancelled":
+            self.total_finished += 1
+
+    # -- introspection ------------------------------------------------------
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.waiting)
+
+    @property
+    def active_count(self) -> int:
+        return sum(1 for r in self.slots if r is not None)
+
+    def stats(self) -> dict:
+        return {
+            "queue_depth": self.queue_depth,
+            "active": self.active_count,
+            "slots": self.max_batch_size,
+            "admitted": self.total_admitted,
+            "finished": self.total_finished,
+            "rejected": self.total_rejected,
+        }

@@ -1,0 +1,18 @@
+"""Shared utilities: TOML IO, pytree helpers, logging, timing.
+
+``tree`` (which imports jax) is loaded lazily so pure-config operations —
+CLI commands that only parse/validate files — never pay the jax import.
+"""
+
+from .tomlio import load_config_file, dump_toml, loads_toml  # noqa: F401
+
+_TREE_EXPORTS = (
+    "param_count", "param_bytes", "global_norm", "tree_cast", "flatten_with_paths",
+)
+
+
+def __getattr__(name):
+    if name in _TREE_EXPORTS:
+        from . import tree
+        return getattr(tree, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

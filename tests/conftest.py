@@ -1,0 +1,27 @@
+"""Test configuration: force an 8-fake-device CPU platform BEFORE jax import.
+
+This is the idiomatic TPU-stack answer to "test multi-node without a
+cluster" (SURVEY §4): XLA exposes N virtual CPU devices so every mesh/
+sharding/collective test runs the real SPMD code path. The reference has no
+equivalent — its SLURM/MPI/torchrun paths are untested.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 fake devices, got {len(devs)}"
+    return devs[:8]

@@ -1,0 +1,90 @@
+"""Config layer unit tests (schema validation the reference lacks, SURVEY §5.6)."""
+
+import pytest
+
+import distributed_llm_training_and_inference_system_tpu.config as cfg
+from distributed_llm_training_and_inference_system_tpu.utils.tomlio import (
+    dump_toml, loads_toml)
+
+
+def test_model_templates_validate():
+    for name, mc in {**cfg.MODEL_TEMPLATES, **cfg.TEST_TEMPLATES}.items():
+        mc.validate()
+        assert mc.param_count > 0, name
+
+
+def test_llama7b_param_count_close_to_reference():
+    # reference configs/models/llama-7b.json: estimated_params = 6738415616
+    mc = cfg.get_model_config("llama-7b")
+    assert abs(mc.param_count - 6_738_415_616) / 6_738_415_616 < 0.01
+
+
+def test_reference_preset_shape_loads(tmp_path):
+    # A [parallel]/[optimizer]/[training] TOML in the reference's preset shape
+    # (reference configs/presets/llama-7b-a100x8.toml) must load.
+    text = """
+[model]
+name = "gpt-125m"
+layers = 12
+hidden = 768
+ffn = 2048
+heads = 12
+vocab_size = 50304
+
+[optimizer]
+type = "adamw"
+lr = 2e-4
+betas = [0.9, 0.95]
+scheduler = { type = "cosine", warmup_steps = 200, total_steps = 1000 }
+
+[parallel]
+tensor_parallel = 2
+pipeline_parallel = 1
+sequence_parallel = false
+zero_stage = 2
+micro_batch_size = 4
+global_batch_size = 64
+
+[training]
+max_steps = 100
+gradient_clipping = 1.0
+"""
+    p = tmp_path / "preset.toml"
+    p.write_text(text)
+    rc = cfg.load_run_config(p)
+    assert rc.model.num_layers == 12
+    assert rc.optimizer.scheduler.warmup_steps == 200
+    assert rc.parallel.tensor_parallel == 2
+    assert rc.parallel.sequence_parallel == 1  # dead bool coerced to degree 1
+
+
+def test_env_and_cli_precedence(tmp_path):
+    p = tmp_path / "c.toml"
+    p.write_text("[training]\nmax_steps = 10\nseed = 1\n")
+    rc = cfg.load_run_config(
+        p,
+        cli_overrides={"training": {"max_steps": 99}},
+        environ={"LLMCTL_TRAINING__MAX_STEPS": "50", "LLMCTL_TRAINING__SEED": "7"},
+    )
+    assert rc.training.max_steps == 99   # CLI beats env
+    assert rc.training.seed == 7         # env beats file
+
+
+def test_validation_errors():
+    with pytest.raises(cfg.ConfigError):
+        cfg.ModelConfig(num_heads=6, num_kv_heads=4).validate()
+    with pytest.raises(cfg.ConfigError):
+        cfg.ParallelConfig(zero_stage=5).validate()
+    with pytest.raises(cfg.ConfigError):
+        cfg.ParallelConfig(pipeline_parallel=4, num_microbatches=2).validate()
+
+
+def test_toml_roundtrip():
+    d = {
+        "a": 1, "b": 2.5, "c": "hi", "d": [1, 2, 3], "e": True,
+        "tbl": {"x": "y", "nested": {"z": 4}},
+        "inline": {"lst": ["a", "b"]},
+    }
+    text = dump_toml(d)
+    back = loads_toml(text)
+    assert back == d
