@@ -1,0 +1,10 @@
+"""Execution layer: train/eval steps, optimizers, schedules.
+
+The real implementation of the reference's empty ``llmctl/exec`` package
+(docstring "kernels, training engine" — reference llmctl/exec/__init__.py:1).
+Kernels live in ops/; the training engine orchestration is runtime/engine.py.
+"""
+
+from .optimizer import make_optimizer  # noqa: F401
+from .schedules import make_schedule  # noqa: F401
+from .train_step import TrainState, make_eval_step, make_train_step  # noqa: F401

@@ -1,0 +1,61 @@
+"""Optimizer factory: AdamW (fp32 master), SGD, Adafactor, Lion.
+
+Parity: the reference hardcodes torch AdamW with a linear schedule
+(reference engine.py:217-256). Here the optimizer is an optax gradient
+transformation built from OptimizerConfig, with the schedule injected so the
+lr is visible in metrics, and weight-decay masking (no decay on norms /
+embeddings / biases) which the reference omits.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..config.schema import OptimizerConfig
+from .schedules import make_schedule
+
+
+def _decay_mask(params: Any) -> Any:
+    """True where weight decay applies: 2D+ matmul kernels only."""
+    def mask(path, leaf):
+        names = [getattr(p, "key", getattr(p, "name", "")) for p in path]
+        if any(n in ("scale", "bias", "embedding") for n in names):
+            return False
+        return leaf.ndim >= 2
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    return jax.tree_util.tree_unflatten(treedef, [mask(p, l) for p, l in flat])
+
+
+def make_optimizer(cfg: OptimizerConfig) -> tuple[optax.GradientTransformation,
+                                                  Callable[[jax.Array], jax.Array]]:
+    """Returns (tx, schedule_fn). Grad clipping lives in the train step (so
+    the pre-clip global norm can be logged), not in the chain."""
+    schedule = make_schedule(cfg.scheduler, cfg.lr)
+
+    if cfg.type in ("adamw", "adam"):
+        wd = cfg.weight_decay if cfg.type == "adamw" else 0.0
+        tx = optax.chain(
+            optax.scale_by_adam(b1=cfg.betas[0], b2=cfg.betas[1], eps=cfg.eps),
+            optax.add_decayed_weights(wd, mask=_decay_mask) if wd else optax.identity(),
+            optax.scale_by_learning_rate(schedule),
+        )
+    elif cfg.type == "lion":
+        tx = optax.chain(
+            optax.scale_by_lion(b1=cfg.betas[0], b2=cfg.betas[1]),
+            optax.add_decayed_weights(cfg.weight_decay, mask=_decay_mask),
+            optax.scale_by_learning_rate(schedule),
+        )
+    elif cfg.type == "adafactor":
+        tx = optax.adafactor(learning_rate=schedule)
+    elif cfg.type == "sgd":
+        tx = optax.chain(
+            optax.trace(decay=cfg.betas[0]),
+            optax.scale_by_learning_rate(schedule),
+        )
+    else:
+        raise ValueError(f"unknown optimizer {cfg.type!r}")
+    return tx, schedule

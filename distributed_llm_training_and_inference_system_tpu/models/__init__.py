@@ -1,0 +1,10 @@
+"""Model zoo: decoder-only transformers in pure-functional JAX.
+
+Replaces the reference's HF AutoModelForCausalLM passthrough
+(reference engine.py:119-140) with native implementations of the
+architectures its configs describe.
+"""
+
+from . import gpt  # noqa: F401
+from .gpt import flops_per_token, forward, init, init_kv_cache  # noqa: F401
+from .loss import cross_entropy, next_token_loss, perplexity  # noqa: F401

@@ -1,0 +1,253 @@
+"""Decoder-only GPT/Llama model: init + forward over a stacked-layer pytree.
+
+TPU-first design choices (vs. the reference's per-module torch graph):
+
+- **Stacked layer params.** All transformer blocks live in one pytree whose
+  leaves carry a leading ``num_layers`` axis, consumed with ``jax.lax.scan``.
+  One trace/compile of the block regardless of depth, and the leading axis
+  is exactly what pipeline parallelism shards into stages
+  (parallel/pipeline.py) — no per-layer Python objects to re-partition.
+- **Explicit PRNG, pure functions.** `init(cfg, key)` -> params;
+  `forward(params, tokens, cfg, ...)` -> logits. Determinism is structural
+  (SURVEY §5.2: the reference plumbs a seed it never applies).
+- **bf16 compute / fp32 master.** Params are created fp32; `forward` casts
+  to ``cfg.dtype`` for compute; logits and softmax statistics stay fp32.
+
+Capability parity: replaces HF AutoModelForCausalLM usage at reference
+engine.py:119-140 and server.py:146-170 for the architectures the reference
+configures (configs/models/llama-7b.json, init.py MODEL_TEMPLATES).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..config.schema import ModelConfig
+from .layers import (
+    attention_block,
+    mlp_block,
+    moe_block,
+    rms_norm,
+    rope_frequencies,
+)
+
+Params = Any
+
+
+# ---------------------------------------------------------------------------
+# Initialisation
+# ---------------------------------------------------------------------------
+
+def init(cfg: ModelConfig, key: jax.Array, dtype=jnp.float32) -> Params:
+    """Create the parameter pytree. Truncated-normal(0.02) init, output
+    projections scaled 1/sqrt(2L) (GPT-2 style residual scaling)."""
+    H, D = cfg.hidden_size, cfg.head_dim
+    Nq, Nkv, F, V, L = (cfg.num_heads, cfg.num_kv_heads, cfg.ffn_size,
+                        cfg.vocab_size, cfg.num_layers)
+    std = 0.02
+    resid_std = std / jnp.sqrt(2.0 * L)
+
+    keys = iter(jax.random.split(key, 32))
+
+    def norm_init(*shape):
+        return jnp.zeros(shape, dtype)  # scale stored as (1 + s)
+
+    def dense(key_, *shape, scale=std):
+        return (jax.random.truncated_normal(key_, -3, 3, shape, jnp.float32)
+                * scale).astype(dtype)
+
+    blocks = {
+        "attn_norm": {"scale": norm_init(L, H)},
+        "q": {"kernel": dense(next(keys), L, H, Nq * D)},
+        "k": {"kernel": dense(next(keys), L, H, Nkv * D)},
+        "v": {"kernel": dense(next(keys), L, H, Nkv * D)},
+        "o": {"kernel": dense(next(keys), L, Nq * D, H, scale=resid_std)},
+        "mlp_norm": {"scale": norm_init(L, H)},
+    }
+    if cfg.attention_bias:
+        blocks["q"]["bias"] = jnp.zeros((L, Nq * D), dtype)
+        blocks["k"]["bias"] = jnp.zeros((L, Nkv * D), dtype)
+        blocks["v"]["bias"] = jnp.zeros((L, Nkv * D), dtype)
+    if cfg.is_moe:
+        E = cfg.moe.num_experts
+        blocks["moe"] = {
+            "router": {"kernel": dense(next(keys), L, H, E)},
+            "gate": {"kernel": dense(next(keys), L, E, H, F)},
+            "up": {"kernel": dense(next(keys), L, E, H, F)},
+            "down": {"kernel": dense(next(keys), L, E, F, H, scale=resid_std)},
+        }
+    else:
+        blocks["mlp"] = {
+            "gate": {"kernel": dense(next(keys), L, H, F)},
+            "up": {"kernel": dense(next(keys), L, H, F)},
+            "down": {"kernel": dense(next(keys), L, F, H, scale=resid_std)},
+        }
+
+    params = {
+        "embed": {"embedding": dense(next(keys), V, H)},
+        "blocks": blocks,
+        "final_norm": {"scale": norm_init(H)},
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = {"kernel": dense(next(keys), H, V)}
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _block_fn(cfg: ModelConfig, attn_impl: str, norm_impl: str,
+              x, layer, positions, segment_ids, inv_freq,
+              kv_cache=None, cache_offset=None):
+    """One transformer block (pre-norm). Returns (x, new_kv_cache, aux_loss)."""
+    h = rms_norm(x, layer["attn_norm"]["scale"], cfg.norm_eps, impl=norm_impl)
+    attn_out, new_cache = attention_block(
+        h, layer, cfg, positions, segment_ids, inv_freq,
+        kv_cache=kv_cache, cache_offset=cache_offset, attn_impl=attn_impl)
+    x = x + attn_out
+    h = rms_norm(x, layer["mlp_norm"]["scale"], cfg.norm_eps, impl=norm_impl)
+    if cfg.is_moe:
+        ffn_out, aux = moe_block(h, layer["moe"], cfg)
+    else:
+        ffn_out, aux = mlp_block(h, layer["mlp"], cfg), jnp.float32(0.0)
+    return x + ffn_out, new_cache, aux
+
+
+def _remat_wrap(fn, policy: str):
+    """Wrap the block in jax.checkpoint per the activation-checkpoint policy
+    (the reference's `activation_checkpoint: "selective"` flag that no code
+    reads — reference init.py:138, SURVEY §2.2 row act-ckpt)."""
+    if policy == "none":
+        return fn
+    if policy == "full":
+        return jax.checkpoint(fn)
+    # selective: keep matmul outputs resident, recompute the cheap stuff
+    return jax.checkpoint(
+        fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+
+
+def forward(
+    params: Params,
+    tokens: jax.Array,
+    cfg: ModelConfig,
+    *,
+    positions: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,
+    kv_cache: Optional[tuple[jax.Array, jax.Array]] = None,
+    cache_offset: Optional[jax.Array] = None,
+    attn_impl: str = "xla",          # xla | flash | ring
+    norm_impl: str = "xla",          # xla | pallas
+    remat: str = "none",             # none | selective | full
+    return_aux: bool = False,
+):
+    """Compute logits [B, S, V] (fp32).
+
+    - ``segment_ids`` [B,S] enables packed sequences (0 = pad).
+    - ``kv_cache`` ([L,B,Smax,Nkv,D], [L,B,Smax,Nkv,D]) + ``cache_offset``
+      [B] enable incremental decoding; the updated cache is returned.
+    - ``attn_impl='ring'`` runs context-parallel ring attention over the
+      'sp' mesh axis (sequence must be sharded on 'sp').
+    """
+    compute_dtype = jnp.dtype(cfg.dtype)
+    B, S = tokens.shape
+    if positions is None:
+        positions = jnp.arange(S, dtype=jnp.int32)[None, :].repeat(B, axis=0)
+        if cache_offset is not None:
+            positions = positions + cache_offset[:, None]
+
+    emb = params["embed"]["embedding"]
+    x = emb[tokens].astype(compute_dtype)
+
+    inv_freq = rope_frequencies(cfg.head_dim, cfg.rope.base,
+                                cfg.rope.scaling, cfg.rope.scaling_factor)
+
+    block = functools.partial(_block_fn, cfg, attn_impl, norm_impl)
+    block = _remat_wrap(block, remat)
+
+    if kv_cache is None:
+        def body(carry, layer):
+            x, aux = carry
+            x, _, aux_l = block(x.astype(compute_dtype), layer, positions,
+                                segment_ids, inv_freq)
+            return (x, aux + aux_l), None
+
+        (x, aux_total), _ = jax.lax.scan(
+            body, (x, jnp.float32(0.0)),
+            jax.tree_util.tree_map(lambda p: p.astype(compute_dtype),
+                                   params["blocks"]))
+        new_cache = None
+    else:
+        k_cache, v_cache = kv_cache
+
+        def body(carry, layer_and_cache):
+            x, aux = carry
+            layer, kc, vc = layer_and_cache
+            x, new_kv, aux_l = block(x.astype(compute_dtype), layer, positions,
+                                     segment_ids, inv_freq,
+                                     kv_cache=(kc, vc), cache_offset=cache_offset)
+            return (x, aux + aux_l), new_kv
+
+        (x, aux_total), new_kvs = jax.lax.scan(
+            body, (x, jnp.float32(0.0)),
+            (jax.tree_util.tree_map(lambda p: p.astype(compute_dtype),
+                                    params["blocks"]), k_cache, v_cache))
+        new_cache = new_kvs
+
+    x = rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps, impl=norm_impl)
+
+    if cfg.tie_word_embeddings:
+        logits = jnp.einsum("bsh,vh->bsv", x, emb.astype(compute_dtype),
+                            preferred_element_type=jnp.float32)
+    else:
+        logits = jnp.einsum("bsh,hv->bsv", x,
+                            params["lm_head"]["kernel"].astype(compute_dtype),
+                            preferred_element_type=jnp.float32)
+
+    out = logits.astype(jnp.float32)
+    result = [out]
+    if kv_cache is not None:
+        result.append(new_cache)
+    if return_aux:
+        result.append(aux_total)
+    return tuple(result) if len(result) > 1 else result[0]
+
+
+# ---------------------------------------------------------------------------
+# KV cache helpers (dense cache for the simple generate/eval path; the paged
+# cache for serving lives in serve/kv_cache.py)
+# ---------------------------------------------------------------------------
+
+def init_kv_cache(cfg: ModelConfig, batch: int, max_len: int,
+                  dtype=jnp.bfloat16) -> tuple[jax.Array, jax.Array]:
+    shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def flops_per_token(cfg: ModelConfig, seq_len: int) -> float:
+    """Training FLOPs per token: 6*N_active + attention O(S) term.
+
+    Honest accounting (SURVEY §7.3.4): the reference's planner uses
+    2*P*B*S for a fwd+bwd step (reference plan.py:97-102), a 3x
+    underestimate that also ignores attention FLOPs. Used by MFU metrics
+    and bench.py.
+    """
+    # active params exclude embedding lookup (no matmul) but include lm_head
+    H, V, L = cfg.hidden_size, cfg.vocab_size, cfg.num_layers
+    D, Nq, Nkv, F = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads, cfg.ffn_size
+    attn_proj = H * Nq * D + 2 * H * Nkv * D + Nq * D * H
+    if cfg.is_moe:
+        ffn = 3 * H * F * cfg.moe.experts_per_token  # active experts only
+    else:
+        ffn = 3 * H * F if cfg.activation in ("silu", "gelu") else 2 * H * F
+    head = H * V
+    matmul_params = L * (attn_proj + ffn) + head
+    # fwd 2 flops/param/token, bwd 4
+    dense_flops = 6.0 * matmul_params
+    # attention scores+values: 2 * 2 * Nq * D * S per token fwd, x3 with bwd
+    attn_flops = 12.0 * L * Nq * D * seq_len
+    return dense_flops + attn_flops

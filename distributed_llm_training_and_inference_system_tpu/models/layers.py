@@ -1,0 +1,258 @@
+"""Transformer building blocks, pure-functional JAX.
+
+The reference delegates all modeling to HuggingFace AutoModelForCausalLM
+(reference runtime/engine.py:119-140, serve/server.py:146-170); this module
+implements the architecture described by its model configs
+(reference configs/models/llama-7b.json: RMSNorm, RoPE, multi-head attention,
+SwiGLU) natively: functions over explicit param pytrees, bf16-compute/
+fp32-master friendly, XLA-fusable, with hooks for Pallas kernels in ops/.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..config.schema import ModelConfig
+
+Params = Any  # nested dict pytree of jnp arrays
+
+
+# ---------------------------------------------------------------------------
+# Normalisation
+# ---------------------------------------------------------------------------
+
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float = 1e-5,
+             impl: str = "xla") -> jax.Array:
+    """RMSNorm. Reduction in fp32 regardless of activation dtype."""
+    if impl == "pallas":
+        from ..ops.rmsnorm import rms_norm_pallas
+        return rms_norm_pallas(x, scale, eps)
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    normed = xf * jax.lax.rsqrt(var + eps)
+    return (normed * (1.0 + scale.astype(jnp.float32))).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Rotary position embeddings
+# ---------------------------------------------------------------------------
+
+def rope_frequencies(head_dim: int, base: float = 10000.0,
+                     scaling: str = "none", factor: float = 1.0) -> jax.Array:
+    """Inverse frequencies for RoPE [head_dim//2], fp32."""
+    exponent = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    inv_freq = 1.0 / (base ** exponent)
+    if scaling == "linear" and factor != 1.0:
+        inv_freq = inv_freq / factor
+    elif scaling == "ntk" and factor != 1.0:
+        # NTK-aware: stretch the base instead of the positions
+        adjusted = base * (factor ** (head_dim / max(head_dim - 2, 1)))
+        inv_freq = 1.0 / (adjusted ** exponent)
+    return inv_freq
+
+
+def apply_rope(x: jax.Array, positions: jax.Array, inv_freq: jax.Array) -> jax.Array:
+    """Rotate [..., S, N, D] by position. positions: [..., S] int32."""
+    angles = positions[..., :, None].astype(jnp.float32) * inv_freq  # [...,S,D/2]
+    cos = jnp.cos(angles)[..., :, None, :]   # [...,S,1,D/2]
+    sin = jnp.sin(angles)[..., :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Attention
+# ---------------------------------------------------------------------------
+
+NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def attention_mask(q_positions: jax.Array, kv_positions: jax.Array,
+                   q_segments: Optional[jax.Array] = None,
+                   kv_segments: Optional[jax.Array] = None,
+                   causal: bool = True) -> jax.Array:
+    """Boolean [B, Sq, Skv] mask: True = attend.
+
+    Packed-sequence aware: tokens attend only within their own segment
+    (segment id 0 = padding, never attended).
+    """
+    mask = jnp.ones(q_positions.shape[:-1] + (q_positions.shape[-1],
+                    kv_positions.shape[-1]), dtype=bool)
+    if causal:
+        mask = q_positions[..., :, None] >= kv_positions[..., None, :]
+    if q_segments is not None and kv_segments is not None:
+        same = q_segments[..., :, None] == kv_segments[..., None, :]
+        valid = kv_segments[..., None, :] != 0
+        mask = mask & same & valid
+    return mask
+
+
+def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                          mask: Optional[jax.Array] = None) -> jax.Array:
+    """Reference XLA attention. q:[B,Sq,Nq,D] k,v:[B,Skv,Nkv,D] -> [B,Sq,Nq,D].
+
+    GQA: Nq must be a multiple of Nkv; kv heads are broadcast per group.
+    Softmax in fp32 (the flash/pallas path in ops/attention.py matches these
+    numerics and is validated against this function in tests).
+    """
+    B, Sq, Nq, D = q.shape
+    Nkv = k.shape[2]
+    groups = Nq // Nkv
+    qg = q.reshape(B, Sq, Nkv, groups, D)
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores / jnp.sqrt(jnp.float32(D))
+    if mask is not None:
+        scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(B, Sq, Nq, D).astype(q.dtype)
+
+
+def attention_block(
+    x: jax.Array,
+    layer: Params,
+    cfg: ModelConfig,
+    positions: jax.Array,
+    segment_ids: Optional[jax.Array],
+    inv_freq: jax.Array,
+    kv_cache: Optional[tuple[jax.Array, jax.Array]] = None,
+    cache_offset: Optional[jax.Array] = None,
+    attn_impl: str = "xla",
+) -> tuple[jax.Array, Optional[tuple[jax.Array, jax.Array]]]:
+    """Self-attention sublayer (pre-norm residual outside).
+
+    With ``kv_cache=(k_cache, v_cache)`` of shape [B, S_max, Nkv, D] and
+    ``cache_offset`` [B] (current lengths), the new K/V are written at the
+    offset and attention runs over the cache — the decode path the
+    reference's KVCacheManager never actually implements
+    (defect SURVEY §2.4.2, reference server.py:199-204).
+    """
+    B, S, H = x.shape
+    D, Nq, Nkv = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
+
+    q = jnp.einsum("bsh,hd->bsd", x, layer["q"]["kernel"]).reshape(B, S, Nq, D)
+    k = jnp.einsum("bsh,hd->bsd", x, layer["k"]["kernel"]).reshape(B, S, Nkv, D)
+    v = jnp.einsum("bsh,hd->bsd", x, layer["v"]["kernel"]).reshape(B, S, Nkv, D)
+    if cfg.attention_bias:
+        q = q + layer["q"]["bias"].reshape(Nq, D)
+        k = k + layer["k"]["bias"].reshape(Nkv, D)
+        v = v + layer["v"]["bias"].reshape(Nkv, D)
+
+    q = apply_rope(q, positions, inv_freq)
+    k = apply_rope(k, positions, inv_freq)
+
+    new_cache = None
+    if kv_cache is not None:
+        k_cache, v_cache = kv_cache
+        S_max = k_cache.shape[1]
+        assert cache_offset is not None
+        # scatter new tokens at each row's offset
+        write_idx = cache_offset[:, None] + jnp.arange(S)[None, :]      # [B,S]
+        b_idx = jnp.arange(B)[:, None].repeat(S, axis=1)
+        k_cache = k_cache.at[b_idx, write_idx].set(k.astype(k_cache.dtype))
+        v_cache = v_cache.at[b_idx, write_idx].set(v.astype(v_cache.dtype))
+        new_cache = (k_cache, v_cache)
+        kv_positions = jnp.arange(S_max)[None, :].repeat(B, axis=0)
+        valid = kv_positions < (cache_offset[:, None] + S)
+        mask = (positions[..., :, None] >= kv_positions[..., None, :]) & valid[:, None, :]
+        out = dot_product_attention(q, k_cache.astype(q.dtype),
+                                    v_cache.astype(q.dtype), mask)
+    elif attn_impl == "flash":
+        from ..ops.attention import flash_attention
+        out = flash_attention(q, k, v, segment_ids=segment_ids, causal=True)
+    elif attn_impl == "ring":
+        from ..ops.ring_attention import ring_attention
+        out = ring_attention(q, k, v, positions=positions,
+                             segment_ids=segment_ids, axis_name="sp")
+    else:
+        mask = attention_mask(positions, positions, segment_ids, segment_ids)
+        out = dot_product_attention(q, k, v, mask)
+
+    out = out.reshape(B, S, Nq * D)
+    out = jnp.einsum("bsd,dh->bsh", out, layer["o"]["kernel"])
+    return out.astype(x.dtype), new_cache
+
+
+# ---------------------------------------------------------------------------
+# Dense / MoE feed-forward
+# ---------------------------------------------------------------------------
+
+def _activate(x: jax.Array, activation: str) -> jax.Array:
+    if activation == "silu":
+        return jax.nn.silu(x)
+    if activation == "gelu":
+        return jax.nn.gelu(x)
+    return jax.nn.relu(x)
+
+
+def mlp_block(x: jax.Array, layer: Params, cfg: ModelConfig) -> jax.Array:
+    """Gated FFN (SwiGLU for silu — reference llama-7b.json activation)."""
+    gate = jnp.einsum("bsh,hf->bsf", x, layer["gate"]["kernel"])
+    up = jnp.einsum("bsh,hf->bsf", x, layer["up"]["kernel"])
+    h = _activate(gate, cfg.activation) * up
+    return jnp.einsum("bsf,fh->bsh", h, layer["down"]["kernel"]).astype(x.dtype)
+
+
+def moe_block(x: jax.Array, layer: Params, cfg: ModelConfig,
+              router_key: Optional[jax.Array] = None) -> tuple[jax.Array, jax.Array]:
+    """Token-choice top-k MoE with GShard-style capacity dispatch.
+
+    Static shapes throughout (XLA requirement): tokens are dispatched into a
+    fixed per-expert capacity C via one-hot einsums; overflow tokens fall
+    back to the residual stream. Experts carry a leading E axis that the
+    mesh shards on 'ep' (SURVEY §2.2: EP absent from the reference).
+
+    Returns (output, aux_loss).
+    """
+    B, S, H = x.shape
+    E = cfg.moe.num_experts
+    K = cfg.moe.experts_per_token
+    N = B * S
+    C = max(int(cfg.moe.capacity_factor * K * N / E), 1)
+
+    xt = x.reshape(N, H)
+    logits = jnp.einsum("nh,he->ne", xt.astype(jnp.float32),
+                        layer["router"]["kernel"].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)                      # [N,E]
+
+    # top-k expert choice per token
+    top_p, top_e = jax.lax.top_k(probs, K)                       # [N,K]
+    top_p = top_p / jnp.maximum(jnp.sum(top_p, axis=-1, keepdims=True), 1e-9)
+
+    # position of each (token, choice) in its expert's buffer
+    onehot = jax.nn.one_hot(top_e, E, dtype=jnp.int32)           # [N,K,E]
+    pos_in_expert = jnp.cumsum(onehot.reshape(N * K, E), axis=0) - onehot.reshape(N * K, E)
+    pos_in_expert = jnp.sum(pos_in_expert.reshape(N, K, E) * onehot, axis=-1)  # [N,K]
+    fits = pos_in_expert < C
+
+    # dispatch tensor [N, E, C]
+    disp = (jax.nn.one_hot(top_e, E, dtype=x.dtype)[..., None]
+            * jax.nn.one_hot(jnp.where(fits, pos_in_expert, C), C + 1,
+                             dtype=x.dtype)[..., None, :-1])     # [N,K,E,C]
+    combine = disp * top_p[..., None, None].astype(x.dtype)      # weightings
+    disp = jnp.sum(disp, axis=1)                                  # [N,E,C]
+    combine = jnp.sum(combine, axis=1)                            # [N,E,C]
+
+    xe = jnp.einsum("nec,nh->ech", disp, xt)                      # [E,C,H]
+
+    def expert_ffn(w, xe_):
+        g = jnp.einsum("ch,hf->cf", xe_, w["gate"])
+        u = jnp.einsum("ch,hf->cf", xe_, w["up"])
+        return jnp.einsum("cf,fh->ch", _activate(g, cfg.activation) * u, w["down"])
+
+    he = jax.vmap(expert_ffn)(
+        {"gate": layer["gate"]["kernel"], "up": layer["up"]["kernel"],
+         "down": layer["down"]["kernel"]}, xe)                    # [E,C,H]
+    out = jnp.einsum("nec,ech->nh", combine, he).reshape(B, S, H)
+
+    # load-balancing aux loss (Switch-style): E * mean(f_e * p_e)
+    f = jnp.mean(jnp.sum(jax.nn.one_hot(top_e, E, dtype=jnp.float32), axis=1), axis=0)
+    p = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(f * p) * cfg.moe.router_aux_loss_weight
+    return out.astype(x.dtype), aux

@@ -1,0 +1,62 @@
+"""Loss functions for causal LM training/eval.
+
+Parity: the reference relies on HF's internal loss (labels=input_ids,
+reference engine.py:206-215, :284). Implemented explicitly here: shifted
+next-token cross-entropy in fp32 with padding masks and optional z-loss
+(stabilises bf16 training at scale).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def cross_entropy(
+    logits: jax.Array,           # [B, S, V] fp32
+    targets: jax.Array,          # [B, S] int
+    weights: Optional[jax.Array] = None,   # [B, S] 0/1 mask
+    z_loss_weight: float = 0.0,
+) -> tuple[jax.Array, jax.Array]:
+    """Mean token cross-entropy. Returns (loss, token_count)."""
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)                    # [B,S]
+    target_logit = jnp.take_along_axis(
+        logits, targets[..., None], axis=-1).squeeze(-1)        # [B,S]
+    nll = logz - target_logit
+    if z_loss_weight > 0.0:
+        nll = nll + z_loss_weight * jnp.square(logz)
+    if weights is None:
+        weights = jnp.ones_like(nll)
+    weights = weights.astype(jnp.float32)
+    total = jnp.sum(nll * weights)
+    count = jnp.maximum(jnp.sum(weights), 1.0)
+    return total / count, count
+
+
+def next_token_loss(
+    logits: jax.Array,           # [B, S, V]
+    tokens: jax.Array,           # [B, S] the input tokens
+    segment_ids: Optional[jax.Array] = None,
+    z_loss_weight: float = 0.0,
+) -> tuple[jax.Array, jax.Array]:
+    """Shifted LM loss: predict tokens[:, 1:] from logits[:, :-1].
+
+    With packed sequences, positions where the *target* starts a new segment
+    (or is padding) are masked out.
+    """
+    shift_logits = logits[:, :-1]
+    shift_targets = tokens[:, 1:]
+    if segment_ids is not None:
+        same_seg = segment_ids[:, 1:] == segment_ids[:, :-1]
+        not_pad = segment_ids[:, 1:] != 0
+        weights = (same_seg & not_pad).astype(jnp.float32)
+    else:
+        weights = None
+    return cross_entropy(shift_logits, shift_targets, weights, z_loss_weight)
+
+
+def perplexity(loss: jax.Array) -> jax.Array:
+    return jnp.exp(loss)
