@@ -1,0 +1,10 @@
+"""Communication layer: named collectives + overlap engine + real comm bench.
+
+The real implementation of the reference's empty ``llmctl/comms`` package
+("collectives, overlap engine" — reference llmctl/comms/__init__.py:1).
+"""
+
+from .collectives import (  # noqa: F401
+    all_gather, all_to_all, allreduce_mean, allreduce_sum, axis_index,
+    axis_size, barrier, overlap_flags, reduce_scatter, ring_shift)
+from .bench import bench_all, bench_collective  # noqa: F401

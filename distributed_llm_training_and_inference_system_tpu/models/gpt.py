@@ -115,7 +115,10 @@ def _block_fn(cfg: ModelConfig, attn_impl: str, norm_impl: str,
         ffn_out, aux = moe_block(h, layer["moe"], cfg)
     else:
         ffn_out, aux = mlp_block(h, layer["mlp"], cfg), jnp.float32(0.0)
-    return x + ffn_out, new_cache, aux
+    x = x + ffn_out
+    # anchor GSPMD propagation at the block boundary (no-op off-mesh)
+    from ..parallel.sharding import constrain
+    return constrain(x, "activations"), new_cache, aux
 
 
 def _remat_wrap(fn, policy: str):
@@ -160,8 +163,9 @@ def forward(
         if cache_offset is not None:
             positions = positions + cache_offset[:, None]
 
+    from ..parallel.sharding import constrain
     emb = params["embed"]["embedding"]
-    x = emb[tokens].astype(compute_dtype)
+    x = constrain(emb[tokens].astype(compute_dtype), "activations")
 
     inv_freq = rope_frequencies(cfg.head_dim, cfg.rope.base,
                                 cfg.rope.scaling, cfg.rope.scaling_factor)

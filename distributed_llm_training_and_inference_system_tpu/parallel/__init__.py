@@ -1,0 +1,14 @@
+"""Parallelism layer: mesh, sharding rules, ZeRO, planner, pipeline.
+
+The real implementation of the reference's empty ``llmctl/partition``
+package ("parallelism planning, memory models" —
+reference llmctl/partition/__init__.py:1) plus the execution half the
+reference never had (SURVEY §2.2: TP/PP/SP planned-only).
+"""
+
+from .mesh import AXES, build_mesh, infer_data_parallel, single_device_mesh  # noqa: F401
+from .sharding import (  # noqa: F401
+    constrain, param_specs, param_shardings, shard_batch, shard_params, use_mesh)
+from .zero import opt_state_specs, opt_state_shardings  # noqa: F401
+from .planner import MeshPlanner, Plan, PlanEstimate, manual_plan  # noqa: F401
+from .api import ShardedTrainer, state_specs  # noqa: F401

@@ -1,0 +1,82 @@
+"""Device mesh construction: the spine of every parallelism strategy.
+
+The reference reaches distribution through env vars + torchrun process
+groups (reference launcher.py:73-105); here ALL strategies are axes of one
+``jax.sharding.Mesh`` over which pjit partitions a single program:
+
+    axis   meaning                              collective traffic
+    ----   -----------------------------------  -------------------
+    pp     pipeline stage                       ppermute (p2p)
+    dp     pure data parallel                   psum (grad allreduce)
+    fsdp   data parallel + param/opt sharding   all_gather / reduce_scatter
+    ep     expert parallel (MoE experts)        all_to_all (dispatch)
+    sp     sequence/context parallel            ppermute (ring attention)
+    tp     tensor (Megatron) parallel           all_gather / psum per layer
+
+Axis order puts tp (highest-frequency, per-layer collectives) innermost so
+it maps to physically adjacent chips on the ICI torus, and pp (lowest-
+frequency, smallest messages) outermost where DCN hops are tolerable —
+the layout recipe of the scaling-book/GSPMD school.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..config.schema import ParallelConfig
+
+AXES = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+
+
+def mesh_shape_from_config(par: ParallelConfig) -> dict[str, int]:
+    return {
+        "pp": par.pipeline_parallel,
+        "dp": par.data_parallel,
+        "fsdp": par.fsdp,
+        "ep": par.expert_parallel,
+        "sp": par.sequence_parallel,
+        "tp": par.tensor_parallel,
+    }
+
+
+def build_mesh(par: ParallelConfig,
+               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build the mesh. Total axis product must equal the device count."""
+    devices = list(devices if devices is not None else jax.devices())
+    shape = mesh_shape_from_config(par)
+    total = int(np.prod(list(shape.values())))
+    if total != len(devices):
+        raise ValueError(
+            f"parallel config needs {total} devices "
+            f"({shape}), but {len(devices)} are available")
+    dev_array = np.asarray(devices).reshape(tuple(shape[a] for a in AXES))
+    return Mesh(dev_array, AXES)
+
+
+def infer_data_parallel(par: ParallelConfig, num_devices: int) -> ParallelConfig:
+    """Fill in data_parallel so the mesh covers all devices (the reference
+    derives dp = gpus // (tp*pp) the same way — plan.py:155)."""
+    import dataclasses
+    other = (par.fsdp * par.tensor_parallel * par.pipeline_parallel *
+             par.sequence_parallel * par.expert_parallel)
+    if num_devices % other != 0:
+        raise ValueError(
+            f"device count {num_devices} not divisible by "
+            f"fsdp*tp*pp*sp*ep = {other}")
+    return dataclasses.replace(par, data_parallel=num_devices // other)
+
+
+def batch_axes() -> tuple[str, ...]:
+    """Mesh axes the global batch dimension is sharded over."""
+    return ("dp", "fsdp")
+
+
+def single_device_mesh() -> Mesh:
+    """1-device mesh with all axes size 1 (lets the same pjit code run
+    unsharded, e.g. on the single benchmark chip)."""
+    dev = np.asarray(jax.devices()[:1]).reshape((1,) * len(AXES))
+    return Mesh(dev, AXES)

@@ -1,0 +1,172 @@
+"""Sharding rules: param-pytree path -> PartitionSpec.
+
+This is the executable form of what the reference only *plans*
+(SURVEY §2.2: TP/PP/ZeRO exist solely as cost-model dimensions in
+plan.py:73-125). Megatron-style tensor parallelism as data layout:
+
+- column-parallel kernels (q/k/v, mlp gate/up, lm_head): output dim on tp
+- row-parallel kernels (o, mlp down): input dim on tp
+- embedding: vocab dim on tp (logits psum'd by XLA), hidden on fsdp
+- every 2D kernel additionally shards its other dim on fsdp (ZeRO-3-style)
+- MoE expert kernels put their leading E axis on ep
+- stacked-layer leading axis goes on pp (when pipeline_parallel > 1 the
+  pipeline runner re-slices it; for pp=1 it is just unsharded)
+
+XLA/GSPMD then inserts the all-gathers/psums the reference would have had
+to hand-write with NCCL.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# (path regex, spec WITHOUT the stacked-layer axis). First match wins.
+# Paths are dotted: e.g. "blocks.q.kernel", "embed.embedding".
+PARAM_RULES: list[tuple[str, P]] = [
+    # Embedding shards HIDDEN, not vocab: a vocab-sharded table turns the
+    # token gather into an involuntary full rematerialization under GSPMD
+    # (observed on the 8-device mesh); hidden-sharded partitions the gather
+    # trivially, and tied logits become a psum over the contracted dim.
+    (r"embed\.embedding$",        P(None, ("fsdp", "tp"))),
+    (r"lm_head\.kernel$",         P("fsdp", "tp")),
+    (r"final_norm\.scale$",       P(None)),
+    (r"blocks\.(q|k|v)\.kernel$", P("fsdp", "tp")),
+    (r"blocks\.(q|k|v)\.bias$",   P("tp")),
+    (r"blocks\.o\.kernel$",       P("tp", "fsdp")),
+    (r"blocks\.mlp\.(gate|up)\.kernel$", P("fsdp", "tp")),
+    (r"blocks\.mlp\.down\.kernel$",      P("tp", "fsdp")),
+    (r"blocks\.moe\.router\.kernel$",    P("fsdp", None)),
+    (r"blocks\.moe\.(gate|up)\.kernel$", P("ep", "fsdp", "tp")),
+    (r"blocks\.moe\.down\.kernel$",      P("ep", "tp", "fsdp")),
+    (r"blocks\..*norm\.scale$",   P(None)),
+    (r".*", P(None)),  # fallback: replicate
+]
+
+# Activation specs (logical names used by sharding constraints).
+ACTIVATION_RULES: dict[str, P] = {
+    # [B, S, H]: batch over dp+fsdp, sequence over sp
+    "activations": P(("dp", "fsdp"), "sp", None),
+    # [B, S, V]: logits vocab dim over tp
+    "logits": P(("dp", "fsdp"), "sp", "tp"),
+    # [B, S] token/segment arrays
+    "tokens": P(("dp", "fsdp"), "sp"),
+}
+
+
+def spec_for_path(path: str, stacked: bool = False) -> P:
+    """PartitionSpec for a dotted param path. ``stacked`` prepends the
+    layer axis (sharded on pp)."""
+    for pattern, spec in PARAM_RULES:
+        if re.search(pattern, path):
+            if stacked and path.startswith("blocks."):
+                return P("pp", *spec)
+            return spec
+    raise AssertionError("unreachable: catch-all rule")
+
+
+def _shrink_to_fit(spec: P, shape: tuple[int, ...], mesh: Mesh) -> P:
+    """Drop axis assignments that don't divide the dim (e.g. tp=4 on a
+    3-dim) so tiny test models still shard cleanly."""
+    out = []
+    for i, entry in enumerate(spec):
+        if entry is None:
+            out.append(None)
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        size = 1
+        keep = []
+        for a in axes:
+            asize = mesh.shape[a]
+            if shape[i] % (size * asize) == 0:
+                keep.append(a)
+                size *= asize
+        out.append(tuple(keep) if len(keep) > 1 else (keep[0] if keep else None))
+    # trailing Nones are implicit
+    return P(*out)
+
+
+def param_specs(params: Any, mesh: Mesh) -> Any:
+    """PartitionSpec pytree matching *params* (stacked-layer layout)."""
+    from ..utils.tree import flatten_with_paths
+    flat = flatten_with_paths(params)
+    specs = {}
+    for path, leaf in flat:
+        spec = spec_for_path(path, stacked=True)
+        specs[path] = _shrink_to_fit(spec, leaf.shape, mesh)
+    # rebuild tree with same structure
+    treedef = jax.tree_util.tree_structure(params)
+    return jax.tree_util.tree_unflatten(treedef, [specs[p] for p, _ in flat])
+
+
+def param_shardings(params: Any, mesh: Mesh) -> Any:
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), param_specs(params, mesh))
+
+
+def shard_params(params: Any, mesh: Mesh) -> Any:
+    """Place a param pytree onto the mesh per the rules."""
+    return jax.device_put(params, param_shardings(params, mesh))
+
+
+def batch_specs(batch: Any, mesh: Mesh) -> Any:
+    """Shard batch arrays: [B, S, ...] over (dp,fsdp) x sp; rank-1 [B]
+    arrays (e.g. cache offsets) over (dp,fsdp) only; scalars replicated."""
+    def spec(x):
+        if x.ndim == 0:
+            return P()
+        if x.ndim == 1:
+            return _shrink_to_fit(P(("dp", "fsdp")), x.shape, mesh)
+        s = ACTIVATION_RULES["tokens"]
+        return _shrink_to_fit(P(*s, *(None,) * (x.ndim - 2)), x.shape, mesh)
+    return jax.tree_util.tree_map(spec, batch)
+
+
+def shard_batch(batch: Any, mesh: Mesh) -> Any:
+    return jax.device_put(
+        batch,
+        jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s),
+                               batch_specs(batch, mesh)))
+
+
+def constrain(x: jax.Array, name: str, mesh: Optional[Mesh] = None) -> jax.Array:
+    """Apply a named activation sharding constraint (no-op outside a mesh).
+
+    Used inside model forward to anchor GSPMD propagation at block
+    boundaries — the TPU replacement for hand-placed NCCL calls.
+    """
+    mesh = mesh or _current_mesh()
+    if mesh is None or mesh.empty or mesh.size == 1:
+        return x
+    spec = ACTIVATION_RULES[name]
+    spec = P(*spec[: x.ndim])
+    spec = _shrink_to_fit(spec, x.shape, mesh)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+# -- ambient mesh (context) --------------------------------------------------
+
+import contextlib
+import threading
+
+_ctx = threading.local()
+
+
+def _current_mesh() -> Optional[Mesh]:
+    return getattr(_ctx, "mesh", None)
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh):
+    """Make *mesh* ambient so models/ops can place sharding constraints
+    without threading a mesh argument through every call."""
+    prev = _current_mesh()
+    _ctx.mesh = mesh
+    try:
+        with mesh:
+            yield mesh
+    finally:
+        _ctx.mesh = prev

@@ -1,0 +1,100 @@
+"""ZeRO-style optimizer-state sharding.
+
+Parity mapping (SURVEY §2.2 row ZeRO; reference plan.py:82-86 models stages
+with 0.6x/0.3x memory factors but never executes them; deepspeed is imported
+and unused — reference engine.py:25):
+
+- stage 0: optimizer state replicated exactly like its params
+- stage 1/2: optimizer moments sharded over the data axes (dp+fsdp) even
+  where params are replicated — the jax expression of ZeRO-1 (stage 2's
+  gradient sharding is subsumed by XLA, which materialises reduce-scattered
+  gradients when the consumer (the update) is sharded this way)
+- stage 3: fully-sharded *params* — that is the fsdp mesh axis in
+  sharding.PARAM_RULES, orthogonal to this module
+
+Matching opt-state leaves to params is structural: optax states embed copies
+of the param tree, so each opt-state leaf path ends with a param path.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..utils.tree import flatten_with_paths
+
+
+def _used_axes(spec: P) -> set[str]:
+    used = set()
+    for entry in spec:
+        if entry is None:
+            continue
+        for a in (entry if isinstance(entry, tuple) else (entry,)):
+            used.add(a)
+    return used
+
+
+def _zero_shard(spec: P, shape: tuple[int, ...], mesh: Mesh) -> P:
+    """Additionally shard an opt-state leaf over unused data axes (dp,fsdp),
+    on the first dim that divides evenly."""
+    used = _used_axes(spec)
+    extra = [a for a in ("fsdp", "dp") if a not in used and mesh.shape[a] > 1]
+    if not extra:
+        return spec
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    for i, dim in enumerate(shape):
+        cur = entries[i]
+        cur_axes = () if cur is None else (cur if isinstance(cur, tuple) else (cur,))
+        cur_size = 1
+        for a in cur_axes:
+            cur_size *= mesh.shape[a]
+        extra_size = 1
+        for a in extra:
+            extra_size *= mesh.shape[a]
+        if dim % (cur_size * extra_size) == 0:
+            entries[i] = tuple(list(cur_axes) + extra) if (cur_axes or len(extra) > 1) \
+                else extra[0]
+            return P(*entries)
+    return spec
+
+
+def opt_state_specs(opt_state_shapes: Any, params: Any, p_specs: Any,
+                    mesh: Mesh, zero_stage: int) -> Any:
+    """PartitionSpec pytree for an optax opt_state.
+
+    *opt_state_shapes* comes from ``jax.eval_shape(tx.init, params)``.
+    Param-shaped leaves inherit the param's spec (+ ZeRO sharding for
+    stage>=1); scalars/counters are replicated.
+    """
+    param_paths = {path: spec for (path, _), spec in
+                   zip(flatten_with_paths(params),
+                       jax.tree_util.tree_leaves(p_specs, is_leaf=lambda x: isinstance(x, P)))}
+
+    def match(path: str) -> P | None:
+        for ppath, spec in param_paths.items():
+            if path.endswith(ppath):
+                return spec
+        return None
+
+    flat = flatten_with_paths(opt_state_shapes)
+    out = []
+    for path, leaf in flat:
+        spec = match(path)
+        if spec is None or len(leaf.shape) == 0:
+            out.append(P())
+            continue
+        if zero_stage >= 1:
+            spec = _zero_shard(spec, leaf.shape, mesh)
+        out.append(spec)
+    treedef = jax.tree_util.tree_structure(opt_state_shapes)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def opt_state_shardings(opt_state_shapes: Any, params: Any, p_specs: Any,
+                        mesh: Mesh, zero_stage: int) -> Any:
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s),
+        opt_state_specs(opt_state_shapes, params, p_specs, mesh, zero_stage),
+        is_leaf=lambda x: isinstance(x, P))

@@ -8,7 +8,9 @@ equivalent — its SLURM/MPI/torchrun paths are untested.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the ambient environment pins JAX_PLATFORMS=axon (the tunneled
+# TPU chip), but tests always run on 8 fake CPU devices for mesh coverage.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,6 +18,11 @@ if "xla_force_host_platform_device_count" not in _flags:
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 import jax  # noqa: E402
+
+# jax was already imported by the environment's sitecustomize (axon TPU
+# plugin), which latched JAX_PLATFORMS=axon — override via the live config
+# (backends are created lazily, so this still wins before first use).
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

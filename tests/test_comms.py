@@ -1,0 +1,65 @@
+"""Comms layer: collective semantics + measured (not simulated) benchmarks."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_llm_training_and_inference_system_tpu.comms import (
+    all_gather, all_to_all, allreduce_sum, bench_all, reduce_scatter,
+    ring_shift)
+
+
+def _mesh(devices8):
+    import numpy as np
+    return Mesh(np.asarray(devices8).reshape(8), ("x",))
+
+
+def test_collective_semantics(devices8):
+    mesh = _mesh(devices8)
+    x = jnp.arange(16, dtype=jnp.float32).reshape(8, 2)
+
+    def body(v):
+        return (allreduce_sum(v, "x"), all_gather(v, "x"),
+                reduce_scatter(all_gather(v, "x"), "x"),
+                ring_shift(v, "x"))
+
+    fn = jax.jit(shard_map(body, mesh=mesh, in_specs=(P("x", None),),
+                           out_specs=(P("x", None), P(None, None),
+                                      P("x", None), P("x", None)),
+                           check_vma=False))
+    ar, ag, rs, perm = fn(x)
+    np.testing.assert_allclose(np.asarray(ar)[0], x.sum(0))      # psum
+    np.testing.assert_allclose(np.asarray(ag), x)                # gather = identity
+    np.testing.assert_allclose(np.asarray(rs), 8 * x)            # rs(ag) = n*x... no:
+    # reduce_scatter over the gathered copy sums 8 identical rows blocks
+
+
+def test_ring_shift_rotates(devices8):
+    mesh = _mesh(devices8)
+    x = jnp.arange(8, dtype=jnp.float32).reshape(8, 1)
+    fn = jax.jit(shard_map(lambda v: ring_shift(v, "x"), mesh=mesh,
+                           in_specs=(P("x", None),), out_specs=P("x", None)))
+    out = np.asarray(fn(x)).ravel()
+    np.testing.assert_allclose(out, np.roll(np.arange(8), 1))
+
+
+def test_all_to_all_transposes(devices8):
+    mesh = _mesh(devices8)
+    x = jnp.arange(64, dtype=jnp.float32).reshape(8, 8)
+    fn = jax.jit(shard_map(
+        lambda v: all_to_all(v, "x", split_dim=1, concat_dim=0),
+        mesh=mesh, in_specs=(P("x", None),), out_specs=P(None, "x")))
+    out = np.asarray(fn(x))
+    np.testing.assert_allclose(out, x.T.reshape(8, 8).T)  # shape preserved
+    assert out.shape == (8, 8)
+
+
+def test_bench_measures_real_time(devices8):
+    mesh = _mesh(devices8)
+    results = bench_all(mesh, "x", size_mb=1.0)
+    assert len(results) == 5
+    for r in results:
+        assert r["time_ms"] > 0.0
+        assert np.isfinite(r["bus_bandwidth_gbps"])
