@@ -373,10 +373,13 @@ class TrainingConfig:
     profile: bool = False
     profile_dir: str = "traces"
     eval_steps: int = 20            # batches per eval
+    attn_impl: str = "auto"         # auto | xla | flash | ring
 
     def validate(self) -> None:
         if self.mixed_precision not in ("bf16", "fp32", "no"):
             raise ConfigError("mixed_precision must be bf16|fp32|no")
+        if self.attn_impl not in ("auto", "xla", "flash", "ring"):
+            raise ConfigError("attn_impl must be auto|xla|flash|ring")
 
     @classmethod
     def from_dict(cls, d: dict[str, Any] | None) -> "TrainingConfig":
@@ -393,6 +396,7 @@ class TrainingConfig:
             profile=bool(_take(d, "profile", default=False)),
             profile_dir=str(_take(d, "profile_dir", default="traces")),
             eval_steps=int(_take(d, "eval_steps", default=20)),
+            attn_impl=str(_take(d, "attn_impl", "attention_impl", default="auto")),
         )
         cfg.validate()
         return cfg

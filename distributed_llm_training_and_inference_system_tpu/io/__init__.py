@@ -1,0 +1,10 @@
+"""IO layer: dataset streaming, sharded checkpointing, export.
+
+The real implementation of the reference's empty ``llmctl/io`` package
+("dataset streaming, checkpointing" — reference llmctl/io/__init__.py:1).
+"""
+
+from .checkpoint import CheckpointManager  # noqa: F401
+from .data import (  # noqa: F401
+    MemmapDataset, SyntheticDataset, make_dataset, write_token_shard)
+from .export import export_params, load_safetensors, save_safetensors  # noqa: F401

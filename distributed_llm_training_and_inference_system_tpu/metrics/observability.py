@@ -1,0 +1,344 @@
+"""Observability: metrics collection + Prometheus/OTLP export, WIRED IN.
+
+Parity: reference metrics/observability.py (MetricsCollector :63,
+PrometheusExporter :230, OpenTelemetryExporter :276, ObservabilityManager
+:331) — with the crucial difference that the reference never connects any
+of it to the engine/server (SURVEY §5.5: "nothing in engine/server feeds
+the collector"). Here runtime/engine.py and serve/server.py call
+``engine_observer()`` / ``record_inference`` on every step.
+
+TPU specifics: device memory comes from jax device.memory_stats() (HBM
+bytes in use/limit) instead of torch.cuda; MFU/tokens-per-sec-per-chip are
+first-class gauges (the BASELINE.json metrics).
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+logger = logging.getLogger("llmctl.metrics")
+
+
+@dataclass
+class SystemSample:
+    timestamp: float
+    cpu_percent: float
+    mem_percent: float
+    mem_used_gb: float
+    net_sent_mbps: float
+    net_recv_mbps: float
+    disk_read_mbps: float
+    disk_write_mbps: float
+    hbm_used_gb: dict[int, float] = field(default_factory=dict)
+    hbm_limit_gb: dict[int, float] = field(default_factory=dict)
+
+
+class MetricsCollector:
+    """Background sampler: psutil system stats + per-device HBM, 1s cadence,
+    bounded history (reference MetricsCollector observability.py:63-228)."""
+
+    def __init__(self, interval: float = 1.0, history: int = 1000):
+        self.interval = interval
+        self.history: collections.deque[SystemSample] = collections.deque(
+            maxlen=history)
+        self.training: collections.deque[dict] = collections.deque(maxlen=history)
+        self.inference: collections.deque[dict] = collections.deque(maxlen=history)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_net = None
+        self._last_disk = None
+
+    def sample_once(self) -> SystemSample:
+        import psutil
+        now = time.time()
+        net = psutil.net_io_counters()
+        disk = psutil.disk_io_counters()
+        net_sent = net_recv = disk_r = disk_w = 0.0
+        if self._last_net is not None:
+            t0, n0 = self._last_net
+            dt = max(now - t0, 1e-3)
+            net_sent = (net.bytes_sent - n0.bytes_sent) / dt / 1e6 * 8
+            net_recv = (net.bytes_recv - n0.bytes_recv) / dt / 1e6 * 8
+        if disk is not None and self._last_disk is not None:
+            t0, d0 = self._last_disk
+            dt = max(now - t0, 1e-3)
+            disk_r = (disk.read_bytes - d0.read_bytes) / dt / 1e6
+            disk_w = (disk.write_bytes - d0.write_bytes) / dt / 1e6
+        self._last_net = (now, net)
+        if disk is not None:
+            self._last_disk = (now, disk)
+
+        hbm_used, hbm_limit = {}, {}
+        try:
+            import jax
+            for i, dev in enumerate(jax.local_devices()):
+                stats = dev.memory_stats() or {}
+                if "bytes_in_use" in stats:
+                    hbm_used[i] = stats["bytes_in_use"] / 1e9
+                if "bytes_limit" in stats:
+                    hbm_limit[i] = stats["bytes_limit"] / 1e9
+        except Exception:  # device backend may not expose stats (CPU)
+            pass
+
+        vm = psutil.virtual_memory()
+        sample = SystemSample(
+            timestamp=now, cpu_percent=psutil.cpu_percent(interval=None),
+            mem_percent=vm.percent, mem_used_gb=vm.used / 1e9,
+            net_sent_mbps=net_sent, net_recv_mbps=net_recv,
+            disk_read_mbps=disk_r, disk_write_mbps=disk_w,
+            hbm_used_gb=hbm_used, hbm_limit_gb=hbm_limit)
+        self.history.append(sample)
+        return sample
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.interval):
+                try:
+                    self.sample_once()
+                except Exception as e:  # keep the sampler alive
+                    logger.debug("metrics sample failed: %s", e)
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="llmctl-metrics")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    def record_training(self, payload: dict) -> None:
+        self.training.append({"timestamp": time.time(), **payload})
+
+    def record_inference(self, payload: dict) -> None:
+        self.inference.append({"timestamp": time.time(), **payload})
+
+    def summary(self) -> dict:
+        out: dict[str, Any] = {}
+        if self.history:
+            s = self.history[-1]
+            out["system"] = {
+                "cpu_percent": s.cpu_percent, "mem_percent": s.mem_percent,
+                "hbm_used_gb": s.hbm_used_gb, "hbm_limit_gb": s.hbm_limit_gb,
+            }
+        if self.training:
+            out["training"] = dict(self.training[-1])
+        if self.inference:
+            recent = list(self.inference)[-100:]
+            lat = sorted(r.get("latency_ms", 0.0) for r in recent)
+            out["inference"] = {
+                "requests": len(recent),
+                "p50_latency_ms": lat[len(lat) // 2] if lat else 0.0,
+                "p99_latency_ms": lat[int(len(lat) * 0.99)] if lat else 0.0,
+            }
+        return out
+
+
+class PrometheusExporter:
+    """llmctl_* gauges/counters/histograms on a scrape port (reference
+    PrometheusExporter observability.py:230-274)."""
+
+    def __init__(self, port: int = 9100):
+        from prometheus_client import (Counter, Gauge, Histogram,
+                                       start_http_server)
+        self.port = port
+        self._start_http_server = start_http_server
+        g, c, h = Gauge, Counter, Histogram
+        self.train_loss = g("llmctl_train_loss", "Training loss")
+        self.train_mfu = g("llmctl_train_mfu", "Model FLOPs utilisation")
+        self.tokens_per_sec = g("llmctl_train_tokens_per_sec", "Global tokens/s")
+        self.tokens_per_sec_chip = g("llmctl_train_tokens_per_sec_per_chip",
+                                     "Tokens/s per chip")
+        self.grad_norm = g("llmctl_train_grad_norm", "Gradient global norm")
+        self.lr = g("llmctl_train_lr", "Learning rate")
+        self.steps = g("llmctl_train_step", "Current optimizer step")
+        self.eval_loss = g("llmctl_eval_loss", "Eval loss")
+        self.hbm_used = g("llmctl_hbm_used_gb", "HBM in use", ["device"])
+        self.cpu = g("llmctl_cpu_percent", "Host CPU percent")
+        self.mem = g("llmctl_mem_percent", "Host memory percent")
+        self.infer_requests = c("llmctl_inference_requests_total",
+                                "Completed inference requests")
+        self.infer_latency = h("llmctl_inference_latency_seconds",
+                               "Request latency",
+                               buckets=(.01, .025, .05, .1, .2, .5, 1, 2, 5, 10))
+        self.infer_ttft = h("llmctl_inference_ttft_seconds",
+                            "Time to first token",
+                            buckets=(.01, .025, .05, .1, .15, .2, .3, .5, 1, 2))
+        self.infer_queue = g("llmctl_inference_queue_depth", "Queued requests")
+        self.decode_tokens_per_sec = g("llmctl_decode_tokens_per_sec",
+                                       "Decode throughput")
+        self._server_started = False
+
+    def serve(self) -> None:
+        if not self._server_started:
+            self._start_http_server(self.port)
+            self._server_started = True
+
+    def export_system(self, sample: SystemSample) -> None:
+        self.cpu.set(sample.cpu_percent)
+        self.mem.set(sample.mem_percent)
+        for dev, used in sample.hbm_used_gb.items():
+            self.hbm_used.labels(device=str(dev)).set(used)
+
+    def export_training(self, m: dict) -> None:
+        if "loss" in m:
+            self.train_loss.set(m["loss"])
+        if "mfu" in m:
+            self.train_mfu.set(m["mfu"])
+        if "tokens_per_sec" in m:
+            self.tokens_per_sec.set(m["tokens_per_sec"])
+        if "tokens_per_sec_per_chip" in m:
+            self.tokens_per_sec_chip.set(m["tokens_per_sec_per_chip"])
+        if "grad_norm" in m:
+            self.grad_norm.set(m["grad_norm"])
+        if "lr" in m:
+            self.lr.set(m["lr"])
+        if "step" in m:   # true optimizer step (events fire at log_interval)
+            self.steps.set(m["step"])
+
+    def export_inference(self, m: dict) -> None:
+        self.infer_requests.inc()
+        if "latency_ms" in m:
+            self.infer_latency.observe(m["latency_ms"] / 1e3)
+        if "ttft_ms" in m and m["ttft_ms"] is not None:
+            self.infer_ttft.observe(m["ttft_ms"] / 1e3)
+        if "queue_depth" in m:
+            self.infer_queue.set(m["queue_depth"])
+        if "decode_tokens_per_sec" in m:
+            self.decode_tokens_per_sec.set(m["decode_tokens_per_sec"])
+
+
+class OTLPExporter:
+    """OpenTelemetry spans + histograms for train/inference events
+    (reference OpenTelemetryExporter observability.py:276-329)."""
+
+    def __init__(self, endpoint: str, service: str = "llmctl"):
+        from opentelemetry import metrics as om, trace
+        from opentelemetry.sdk.resources import Resource
+        from opentelemetry.sdk.trace import TracerProvider
+        from opentelemetry.sdk.trace.export import BatchSpanProcessor
+        from opentelemetry.exporter.otlp.proto.http.trace_exporter import (
+            OTLPSpanExporter)
+        resource = Resource.create({"service.name": service})
+        provider = TracerProvider(resource=resource)
+        provider.add_span_processor(BatchSpanProcessor(
+            OTLPSpanExporter(endpoint=f"{endpoint}/v1/traces")))
+        trace.set_tracer_provider(provider)
+        self.tracer = trace.get_tracer("llmctl")
+
+    def record_training_step(self, m: dict) -> None:
+        with self.tracer.start_as_current_span("training_step") as span:
+            for k, v in m.items():
+                if isinstance(v, (int, float)):
+                    span.set_attribute(f"train.{k}", v)
+
+    def record_inference_request(self, m: dict) -> None:
+        with self.tracer.start_as_current_span("inference_request") as span:
+            for k, v in m.items():
+                if isinstance(v, (int, float)):
+                    span.set_attribute(f"inference.{k}", v)
+
+
+class ObservabilityManager:
+    """Composition + export pump (reference ObservabilityManager
+    observability.py:331-415)."""
+
+    def __init__(self, prometheus_port: Optional[int] = None,
+                 otlp_endpoint: Optional[str] = None,
+                 collect_interval: float = 1.0):
+        self.collector = MetricsCollector(interval=collect_interval)
+        self.prometheus: Optional[PrometheusExporter] = None
+        self.otlp: Optional[OTLPExporter] = None
+        if prometheus_port:
+            try:
+                self.prometheus = PrometheusExporter(prometheus_port)
+                self.prometheus.serve()
+            except Exception as e:
+                logger.warning("prometheus exporter disabled: %s", e)
+        if otlp_endpoint:
+            try:
+                self.otlp = OTLPExporter(otlp_endpoint)
+            except Exception as e:
+                logger.warning("otlp exporter disabled: %s", e)
+        self._export_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def start(self) -> None:
+        self.collector.start()
+        if self.prometheus and self._export_thread is None:
+            def pump():
+                while not self._stop.wait(5.0):
+                    if self.collector.history:
+                        self.prometheus.export_system(self.collector.history[-1])
+            self._export_thread = threading.Thread(target=pump, daemon=True)
+            self._export_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.collector.stop()
+
+    def record_training_step(self, m: dict) -> None:
+        self.collector.record_training(m)
+        if self.prometheus:
+            self.prometheus.export_training(m)
+        if self.otlp:
+            self.otlp.record_training_step(m)
+
+    def record_eval(self, m: dict) -> None:
+        self.collector.record_training({"eval": True, **m})
+        if self.prometheus and "loss" in m:
+            self.prometheus.eval_loss.set(m["loss"])
+
+    def record_inference(self, m: dict) -> None:
+        self.collector.record_inference(m)
+        if self.prometheus:
+            self.prometheus.export_inference(m)
+        if self.otlp:
+            self.otlp.record_inference_request(m)
+
+
+# -- global singleton (reference setup_observability observability.py:417) ----
+
+_manager: Optional[ObservabilityManager] = None
+
+
+def setup_observability(prometheus_port: Optional[int] = None,
+                        otlp_endpoint: Optional[str] = None) -> ObservabilityManager:
+    global _manager
+    if _manager is None:
+        import os
+        if prometheus_port is None:
+            port = os.environ.get("LLMCTL_METRICS_PORT")
+            prometheus_port = int(port) if port else None
+        if otlp_endpoint is None:
+            otlp_endpoint = os.environ.get("LLMCTL_OTLP_ENDPOINT")
+        _manager = ObservabilityManager(prometheus_port, otlp_endpoint)
+        _manager.start()
+    return _manager
+
+
+def get_observability() -> Optional[ObservabilityManager]:
+    return _manager
+
+
+def engine_observer() -> Callable[[str, dict], None]:
+    """The hook runtime/engine.py feeds — this closes the reference's
+    metrics-not-wired gap (SURVEY §5.5)."""
+    mgr = setup_observability()
+
+    def observe(event: str, payload: dict) -> None:
+        if event == "train_step":
+            mgr.record_training_step(payload)
+        elif event == "eval":
+            mgr.record_eval(payload)
+    return observe

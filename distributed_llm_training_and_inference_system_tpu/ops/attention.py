@@ -1,0 +1,367 @@
+"""Flash (block-streaming) causal attention as a Pallas TPU kernel.
+
+The reference has no attention kernel at all: its `flash_attention` flag is
+dead config (reference autotuning.py:140 validates-but-ignores it; preset
+llama-7b-a100x8.toml:62 is read by nothing — SURVEY §5.7), and its serve
+path recomputes full-prefix attention every token (server.py:199-204). This
+module supplies the real thing, TPU-shaped:
+
+- **Forward**: q-block x kv-block streaming with online softmax; scores/
+  accumulators live in VMEM fp32 scratch; the [S, S] matrix is never
+  materialised in HBM. Causal block-skipping prunes the upper triangle at
+  grid level (index_map), so skipped blocks cost nothing.
+- **Backward**: the standard two-pass flash backward (delta = rowsum(dO*O)
+  precomputed; one kernel for dq, one for dk/dv), wired via jax.custom_vjp,
+  so 32k-context training is S-linear in memory.
+- **Packing**: segment ids mask cross-document attention inside the kernel
+  (the input contract of io/data.py's packed batches).
+- Numerics are validated against models.layers.dot_product_attention in
+  tests (interpret mode on CPU, compiled on TPU).
+
+Layout notes: heads are folded into the grid's batch dimension; tiles are
+[block, head_dim] with head_dim typically 64/128 — lane-dim aligned for the
+MXU; fp32 accumulation per the guide's preferred_element_type rule.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref,
+                o_ref, lse_ref,
+                acc_scratch, m_scratch, l_scratch,
+                *, causal: bool, block_q: int, block_k: int,
+                seq_len: int, scale: float):
+    qi = pl.program_id(1)   # q block index
+    ki = pl.program_id(2)   # kv block index
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_scratch[:] = jnp.zeros_like(acc_scratch)
+        m_scratch[:] = jnp.full_like(m_scratch, NEG_INF)
+        l_scratch[:] = jnp.zeros_like(l_scratch)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    run = True
+    if causal:
+        # skip blocks fully above the diagonal
+        run = k_start <= q_start + block_q - 1
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[...].astype(jnp.float32)           # [bq, d]
+        k = k_ref[...].astype(jnp.float32)           # [bk, d]
+        v = v_ref[...].astype(jnp.float32)           # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [bq, bk]
+
+        q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32,
+                                                   (block_q, block_k), 0)
+        k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32,
+                                                   (block_q, block_k), 1)
+        mask = k_pos < seq_len
+        if causal:
+            mask = mask & (q_pos >= k_pos)
+        qseg = qseg_ref[0, :]                         # [bq]
+        kseg = kseg_ref[0, :]                         # [bk]
+        mask = mask & (qseg[:, None] == kseg[None, :]) & (kseg[None, :] != 0)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scratch[...]                       # [bq, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        # guard fully-masked rows: exp(NEG_INF - NEG_INF) would be 1
+        p = jnp.exp(jnp.where(m_new > NEG_INF / 2, s - m_new, NEG_INF))
+        alpha = jnp.exp(jnp.where(m_new > NEG_INF / 2, m_prev - m_new, 0.0))
+        l_new = alpha * l_scratch[...] + jnp.sum(p, axis=1, keepdims=True)
+        acc_scratch[...] = acc_scratch[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_scratch[...] = m_new
+        l_scratch[...] = l_new
+
+    @pl.when(ki == pl.num_programs(2) - 1)
+    def _finalize():
+        l = l_scratch[...]
+        safe_l = jnp.where(l > 0, l, 1.0)
+        o_ref[...] = (acc_scratch[...] / safe_l).astype(o_ref.dtype)
+        lse = m_scratch[...] + jnp.log(safe_l)
+        lse_ref[...] = jnp.where(l > 0, lse, NEG_INF).astype(jnp.float32)
+
+
+def _fwd(q, k, v, q_segments, kv_segments, causal, block_q, block_k, scale):
+    """q: [BH, S, D] (heads folded into batch), segments: [BH, S]."""
+    BH, S, D = q.shape
+    Skv = k.shape[1]
+    bq = min(block_q, S)
+    bk = min(block_k, Skv)
+    grid = (BH, pl.cdiv(S, bq), pl.cdiv(Skv, bk))
+
+    kernel = functools.partial(
+        _fwd_kernel, causal=causal, block_q=bq, block_k=bk,
+        seq_len=Skv, scale=scale)
+
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, 1, bq), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((None, 1, bk), lambda b, i, j: (b, 0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, bq, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, S, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, D), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, q_segments, kv_segments)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels (two-pass flash backward)
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, do_ref, lse_ref,
+                   delta_ref, dq_ref, dq_scratch,
+                   *, causal, block_q, block_k, seq_len, scale):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scratch[...] = jnp.zeros_like(dq_scratch)
+
+    q_start, k_start = qi * block_q, ki * block_k
+    run = True
+    if causal:
+        run = k_start <= q_start + block_q - 1
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[...].astype(jnp.float32)
+        k = k_ref[...].astype(jnp.float32)
+        v = v_ref[...].astype(jnp.float32)
+        do = do_ref[...].astype(jnp.float32)
+        lse = lse_ref[...]                            # [bq, 1]
+        delta = delta_ref[...]                        # [bq, 1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        mask = k_pos < seq_len
+        if causal:
+            mask = mask & (q_pos >= k_pos)
+        qseg, kseg = qseg_ref[0, :], kseg_ref[0, :]
+        mask = mask & (qseg[:, None] == kseg[None, :]) & (kseg[None, :] != 0)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)    # [bq, bk]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_scratch[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(ki == pl.num_programs(2) - 1)
+    def _finalize():
+        dq_ref[...] = dq_scratch[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, dk_scratch, dv_scratch,
+                    *, causal, block_q, block_k, seq_len, scale):
+    ki = pl.program_id(1)   # kv block (outer)
+    qi = pl.program_id(2)   # q block (inner loop dim)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scratch[...] = jnp.zeros_like(dk_scratch)
+        dv_scratch[...] = jnp.zeros_like(dv_scratch)
+
+    q_start, k_start = qi * block_q, ki * block_k
+    run = True
+    if causal:
+        run = q_start + block_q - 1 >= k_start
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[...].astype(jnp.float32)
+        k = k_ref[...].astype(jnp.float32)
+        v = v_ref[...].astype(jnp.float32)
+        do = do_ref[...].astype(jnp.float32)
+        lse = lse_ref[...]
+        delta = delta_ref[...]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        mask = k_pos < seq_len
+        if causal:
+            mask = mask & (q_pos >= k_pos)
+        qseg, kseg = qseg_ref[0, :], kseg_ref[0, :]
+        mask = mask & (qseg[:, None] == kseg[None, :]) & (kseg[None, :] != 0)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dv_scratch[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk_scratch[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(qi == pl.num_programs(2) - 1)
+    def _finalize():
+        dk_ref[...] = dk_scratch[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_scratch[...].astype(dv_ref.dtype)
+
+
+def _bwd(causal, block_q, block_k, scale, residuals, dout):
+    q, k, v, q_segments, kv_segments, out, lse = residuals
+    BH, S, D = q.shape
+    Skv = k.shape[1]
+    bq = min(block_q, S)
+    bk = min(block_k, Skv)
+    do = dout.astype(jnp.float32)
+    delta = jnp.sum(do * out.astype(jnp.float32), axis=-1, keepdims=True)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, causal=causal, block_q=bq,
+                          block_k=bk, seq_len=Skv, scale=scale),
+        grid=(BH, pl.cdiv(S, bq), pl.cdiv(Skv, bk)),
+        in_specs=[
+            pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((None, 1, bq), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((None, 1, bk), lambda b, i, j: (b, 0, j)),
+            pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, bq, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((None, bq, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, q_segments, kv_segments, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, causal=causal, block_q=bq,
+                          block_k=bk, seq_len=Skv, scale=scale),
+        grid=(BH, pl.cdiv(Skv, bk), pl.cdiv(S, bq)),
+        in_specs=[
+            pl.BlockSpec((None, bq, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((None, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((None, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((None, 1, bq), lambda b, j, i: (b, 0, i)),
+            pl.BlockSpec((None, 1, bk), lambda b, j, i: (b, 0, j)),
+            pl.BlockSpec((None, bq, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((None, bq, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((None, bq, 1), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((None, bk, D), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Skv, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, Skv, D), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                        pltpu.VMEM((bk, D), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, q_segments, kv_segments, do, lse, delta)
+
+    return dq, dk, dv, None, None
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash(q, k, v, q_segments, kv_segments, causal, block_q, block_k, scale):
+    out, _ = _fwd(q, k, v, q_segments, kv_segments, causal, block_q,
+                  block_k, scale)
+    return out
+
+
+def _flash_fwd(q, k, v, q_segments, kv_segments, causal, block_q, block_k,
+               scale):
+    out, lse = _fwd(q, k, v, q_segments, kv_segments, causal, block_q,
+                    block_k, scale)
+    return out, (q, k, v, q_segments, kv_segments, out, lse)
+
+
+_flash.defvjp(_flash_fwd,
+              lambda causal, bq, bk, scale, res, g:
+              _bwd(causal, bq, bk, scale, res, g))
+
+
+def flash_attention(
+    q: jax.Array,                      # [B, S, Nq, D]
+    k: jax.Array,                      # [B, Skv, Nkv, D]
+    v: jax.Array,
+    segment_ids: Optional[jax.Array] = None,   # [B, S]
+    causal: bool = True,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+) -> jax.Array:
+    """Flash attention with GQA and packed-segment support.
+
+    Matches models.layers.dot_product_attention numerics (fp32 softmax).
+    """
+    B, S, Nq, D = q.shape
+    Skv, Nkv = k.shape[1], k.shape[2]
+    groups = Nq // Nkv
+    if groups > 1:   # GQA: repeat kv heads (kernel-side dedup is a TODO)
+        k = jnp.repeat(k, groups, axis=2)
+        v = jnp.repeat(v, groups, axis=2)
+
+    # fold heads into batch: [B, S, N, D] -> [B*N, S, D]
+    def fold(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * Nq, x.shape[1], D)
+
+    if segment_ids is None:
+        segs = jnp.ones((B, S), jnp.int32)
+    else:
+        segs = segment_ids.astype(jnp.int32)
+    segs_q = jnp.repeat(segs, Nq, axis=0)[:, None, :]   # [B*N, 1, S]
+    segs_kv = segs_q if Skv == S else jnp.repeat(
+        jnp.ones((B, Skv), jnp.int32), Nq, axis=0)[:, None, :]
+
+    scale = 1.0 / float(D) ** 0.5
+    out = _flash(fold(q), fold(k), fold(v), segs_q, segs_kv, causal,
+                 block_q, block_k, scale)
+    return out.reshape(B, Nq, S, D).transpose(0, 2, 1, 3).astype(q.dtype)

@@ -1,0 +1,6 @@
+"""Runtime layer: engine orchestration + multi-host launchers."""
+
+from .engine import TrainingEngine  # noqa: F401
+from .launcher import (  # noqa: F401
+    BaseLauncher, K8sLauncher, LaunchConfig, LocalLauncher, MPILauncher,
+    ProcessOrchestrator, SlurmLauncher, create_launcher)

@@ -1,0 +1,71 @@
+"""Per-host training entrypoint (reference runtime/train_script.py:96-162).
+
+Run by every launcher as ``python -m ...runtime.train_entry --config f.toml
+[overrides]``. Initialises jax.distributed when the launcher provided a
+coordinator (multi-host), builds the engine, trains. Config precedence is
+file < env (LLMCTL_*) < CLI flags via config.loader.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+
+def parse_overrides(pairs: list[str]) -> dict:
+    """--set section.field=value overrides."""
+    out: dict = {}
+    for p in pairs:
+        key, _, val = p.partition("=")
+        section, _, field_ = key.partition(".")
+        from ..config.loader import _coerce
+        out.setdefault(section, {})[field_] = _coerce(val)
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser("llmctl-train-entry")
+    ap.add_argument("--config", default=None, help="run config TOML/JSON")
+    ap.add_argument("--model", default=None, help="model template name")
+    ap.add_argument("--max-steps", type=int, default=None)
+    ap.add_argument("--no-resume", action="store_true")
+    ap.add_argument("--set", action="append", default=[], metavar="SEC.KEY=V",
+                    help="config override, repeatable")
+    args = ap.parse_args(argv)
+
+    logging.basicConfig(
+        level=os.environ.get("LLMCTL_LOG_LEVEL", "INFO"),
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    # multi-host rendezvous (set by runtime/launcher.py)
+    coord = os.environ.get("LLMCTL_COORDINATOR")
+    if coord:
+        import jax
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ["LLMCTL_NUM_HOSTS"]),
+            process_id=int(os.environ.get(
+                "LLMCTL_HOST_ID",
+                os.environ.get("OMPI_COMM_WORLD_RANK", "0"))))
+
+    from ..config.loader import load_run_config
+    overrides = parse_overrides(args.set)
+    if args.max_steps is not None:
+        overrides.setdefault("training", {})["max_steps"] = args.max_steps
+    cfg = load_run_config(args.config, cli_overrides=overrides)
+    if args.model:
+        from ..config.presets import get_model_config
+        cfg.model = get_model_config(args.model)
+
+    from ..metrics.observability import engine_observer
+    from .engine import TrainingEngine
+    engine = TrainingEngine(cfg, observer=engine_observer())
+    final = engine.train(resume=not args.no_resume)
+    logging.getLogger("llmctl.train").info("finished: %s", final)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

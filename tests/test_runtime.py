@@ -1,0 +1,77 @@
+"""Runtime: engine end-to-end train on 8 fake devices, resume, launchers."""
+
+import numpy as np
+
+from distributed_llm_training_and_inference_system_tpu.config import (
+    RunConfig, get_model_config)
+from distributed_llm_training_and_inference_system_tpu.runtime import (
+    LaunchConfig, ProcessOrchestrator, TrainingEngine, create_launcher)
+
+
+def _cfg(tmp_path, max_steps=6):
+    rc = RunConfig()
+    rc.model = get_model_config("gpt-test")
+    rc.data.max_length = 32
+    rc.data.train = "synthetic"
+    rc.data.val = "synthetic"
+    rc.parallel.global_batch_size = 8
+    rc.parallel.micro_batch_size = 1
+    rc.training.max_steps = max_steps
+    rc.training.log_interval = 2
+    rc.training.eval_interval = 4
+    rc.training.eval_steps = 2
+    rc.checkpoint.path = str(tmp_path / "ckpt")
+    rc.checkpoint.interval_steps = 3
+    rc.optimizer.lr = 1e-2
+    return rc
+
+
+def test_engine_end_to_end_with_resume(tmp_path, devices8):
+    events = []
+    eng = TrainingEngine(_cfg(tmp_path), devices=devices8,
+                         observer=lambda ev, p: events.append((ev, p)))
+    final = eng.train()
+    assert final["step"] == 6
+    assert np.isfinite(final["loss"])
+    # observer wired: train_step + eval + save all fired (SURVEY §5.5 gap)
+    kinds = {e for e, _ in events}
+    assert {"train_step", "eval", "save"} <= kinds
+    # checkpoints: interval 3 with keep_latest default
+    assert eng.ckpt.latest_step() == 6
+
+    # resume continues from step 6 and trains further without reinit
+    eng2 = TrainingEngine(_cfg(tmp_path, max_steps=8), devices=devices8)
+    final2 = eng2.train()
+    assert final2["step"] == 8
+    # the resumed run should start from trained params (loss stays low-ish)
+    assert final2["loss"] <= final["loss"] * 1.5
+
+
+def test_launcher_factory_and_dryrun(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    for kind in ("local", "slurm", "mpi", "k8s", "gke"):
+        lc = LaunchConfig(launcher=kind, num_hosts=4, dry_run=True,
+                          config_file="run.toml")
+        launcher = create_launcher(lc)
+        assert launcher.launch() is None  # dry run spawns nothing
+        assert launcher.describe()
+    # slurm script carries the jax.distributed rendezvous env
+    from distributed_llm_training_and_inference_system_tpu.runtime import (
+        SlurmLauncher)
+    script = SlurmLauncher(LaunchConfig(launcher="slurm", num_hosts=4)).script()
+    assert "LLMCTL_COORDINATOR" in script and "--nodes=4" in script
+    # k8s manifest is valid-ish yaml with the jobset worker count
+    from distributed_llm_training_and_inference_system_tpu.runtime import (
+        K8sLauncher)
+    manifest = K8sLauncher(LaunchConfig(launcher="k8s", num_hosts=8)).manifest()
+    assert "parallelism: 8" in manifest and "LLMCTL_COORDINATOR" in manifest
+    import pytest
+    with pytest.raises(ValueError):
+        create_launcher(LaunchConfig(launcher="ray"))
+
+
+def test_orchestrator_status(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    orch = ProcessOrchestrator(LaunchConfig(launcher="local", dry_run=True))
+    assert orch.status() == {"state": "not_started"}
+    assert orch.start() == 0
