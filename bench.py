@@ -1,0 +1,88 @@
+"""Headline benchmark: training throughput + MFU on the local chip(s).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": tokens/sec/chip, "unit": ..., "vs_baseline": ...}
+
+The reference publishes no measured numbers (BASELINE.md: bench is
+"coming soon" at reference cli/commands/bench.py:33-75), so the comparison
+base is the BASELINE.json north-star target: >=50% MFU for training.
+``vs_baseline`` = measured_MFU / 0.50 — 1.0 means the target is met.
+
+Model: gpt-350m (the largest template whose AdamW state + activations fit
+one 16 GB v5e chip at seq 2048 with headroom), bf16 compute, flash
+attention Pallas kernel, selective remat — the same code path `llmctl
+train` uses. Runs anywhere jax runs; on CPU it just reports CPU numbers.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_llm_training_and_inference_system_tpu.config import (
+        OptimizerConfig, ParallelConfig, get_model_config)
+    from distributed_llm_training_and_inference_system_tpu.exec import (
+        TrainState, make_train_step)
+    from distributed_llm_training_and_inference_system_tpu.models import init
+    from distributed_llm_training_and_inference_system_tpu.models.gpt import (
+        flops_per_token)
+
+    backend = jax.default_backend()
+    on_tpu = backend == "tpu"
+    model_name = "gpt-350m" if on_tpu else "gpt-test"
+    seq_len = 2048 if on_tpu else 128
+    batch = 4
+    peak_tflops = 197.0 if on_tpu else 0.2   # v5e bf16 peak
+
+    cfg = get_model_config(model_name)
+    par = ParallelConfig(activation_checkpoint="selective",
+                         micro_batch_size=batch, global_batch_size=batch)
+    step_fn, tx, _ = make_train_step(
+        cfg, OptimizerConfig(lr=1e-4), par,
+        attn_impl="flash" if on_tpu else "xla")
+    params = init(cfg, jax.random.PRNGKey(0))
+    state = TrainState.create(params, tx)
+    jstep = jax.jit(step_fn, donate_argnums=(0,))
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq_len), 1,
+                                cfg.vocab_size)
+    b = {"tokens": tokens}
+
+    # warmup (compile). Sync via host transfer: on the tunneled remote
+    # backend block_until_ready returns before execution finishes, so the
+    # only trustworthy fence is fetching a value that depends on the step.
+    state, m = jstep(state, b)
+    float(m["loss"])
+
+    iters = 20 if on_tpu else 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, m = jstep(state, b)
+    final_loss = float(m["loss"])   # forces the whole dependency chain
+    dt = time.perf_counter() - t0
+
+    steps_per_sec = iters / dt
+    tokens_per_sec = steps_per_sec * batch * seq_len
+    fpt = flops_per_token(cfg, seq_len)
+    mfu = tokens_per_sec * fpt / (peak_tflops * 1e12)
+
+    print(json.dumps({
+        "metric": f"{model_name} train tokens/sec/chip (seq {seq_len}, "
+                  f"bf16, flash-attn, {backend})",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(mfu / 0.50, 4),
+        "mfu": round(mfu, 4),
+        "step_time_ms": round(dt / iters * 1e3, 2),
+        "loss": round(final_loss, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

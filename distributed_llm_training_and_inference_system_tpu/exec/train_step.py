@@ -61,6 +61,7 @@ def make_train_step(
     opt_cfg: OptimizerConfig,
     par_cfg: Optional[ParallelConfig] = None,
     attn_impl: str = "xla",
+    loss_fn: Optional[Callable] = None,
 ) -> tuple[Callable, optax.GradientTransformation, Callable]:
     """Build (train_step, tx, schedule).
 
@@ -68,13 +69,18 @@ def make_train_step(
     [accum*mb, S]; with gradient_accumulation_steps>1 the leading dim is
     split and scanned, averaging grads — semantics of the reference's
     accumulation boundary (engine.py:294-305) in one compiled program.
+
+    A custom ``loss_fn(params, batch) -> (total, (loss, count))`` overrides
+    the default forward (used by the pipeline-parallel runner, which packs
+    its own microbatching — accumulation is then forced to 1).
     """
     par_cfg = par_cfg or ParallelConfig()
     tx, schedule = make_optimizer(opt_cfg)
-    accum = max(par_cfg.gradient_accumulation_steps, 1)
+    accum = max(par_cfg.gradient_accumulation_steps, 1) if loss_fn is None else 1
     remat = par_cfg.activation_checkpoint
-    loss_fn = functools.partial(_loss_fn, model_cfg=model_cfg,
-                                attn_impl=attn_impl, remat=remat)
+    if loss_fn is None:
+        loss_fn = functools.partial(_loss_fn, model_cfg=model_cfg,
+                                    attn_impl=attn_impl, remat=remat)
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
     def train_step(state: TrainState, batch: dict[str, jax.Array]):

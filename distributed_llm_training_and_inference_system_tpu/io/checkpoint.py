@@ -39,6 +39,22 @@ from ..utils.tree import flatten_with_paths
 _COMMIT = "COMMIT"
 
 
+def _to_savable(a: np.ndarray) -> np.ndarray:
+    """np.savez cannot represent ml_dtypes.bfloat16 (it silently stores
+    void bytes that cannot be cast back) — store the raw bits as uint16;
+    the true dtype is recorded in index.json."""
+    if a.dtype.name == "bfloat16":
+        return a.view(np.uint16)
+    return a
+
+
+def _from_saved(a: np.ndarray, dtype_name: str) -> np.ndarray:
+    if dtype_name == "bfloat16" and a.dtype.name != "bfloat16":
+        import ml_dtypes
+        return a.view(ml_dtypes.bfloat16)
+    return a
+
+
 def _slice_key(index: tuple[slice, ...], shape: tuple[int, ...]) -> str:
     # unsharded dims come back as slice(None); resolve against global shape
     return "/".join(
@@ -94,10 +110,10 @@ class CheckpointManager:
                     if shard.replica_id != 0:
                         continue  # another device holds an identical copy
                     key = f"{path}@{_slice_key(shard.index, arr.shape)}"
-                    blobs[key] = np.asarray(shard.data)
+                    blobs[key] = _to_savable(np.asarray(shard.data))
             else:
                 if self.host_id == 0:
-                    blobs[f"{path}@"] = np.asarray(arr)
+                    blobs[f"{path}@"] = _to_savable(np.asarray(arr))
 
         tmp = self.directory / f"step_{step}.tmp"
         final = self.directory / f"step_{step}"
@@ -192,7 +208,7 @@ class CheckpointManager:
             if path not in pieces:
                 raise ValueError(f"checkpoint missing leaf {path}")
             if len(pieces[path]) == 1 and pieces[path][0][0] == "":
-                assembled[path] = pieces[path][0][1]
+                assembled[path] = _from_saved(pieces[path][0][1], dtype)
                 continue
             if dtype == "bfloat16":
                 import ml_dtypes
@@ -203,7 +219,7 @@ class CheckpointManager:
             covered = np.zeros(shape, bool)
             for skey, blob in pieces[path]:
                 idx = _parse_slice_key(skey, shape)
-                full[idx] = blob
+                full[idx] = _from_saved(blob, dtype)
                 covered[idx] = True
             if not covered.all():
                 # never silently zero-fill missing shards (a torn multi-host

@@ -134,6 +134,26 @@ def _remat_wrap(fn, policy: str):
         fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
 
 
+def unembed(params: Params, x: jax.Array, cfg: ModelConfig,
+            norm_impl: str = "xla") -> jax.Array:
+    """Final RMSNorm + LM head logits (tied or untied), fp32 output.
+
+    Shared by the plain forward and the pipeline-parallel runner so the
+    head semantics can never diverge between them.
+    """
+    x = rms_norm(x, params["final_norm"]["scale"].astype(x.dtype),
+                 cfg.norm_eps, impl=norm_impl)
+    if cfg.tie_word_embeddings:
+        logits = jnp.einsum(
+            "bsh,vh->bsv", x, params["embed"]["embedding"].astype(x.dtype),
+            preferred_element_type=jnp.float32)
+    else:
+        logits = jnp.einsum(
+            "bsh,hv->bsv", x, params["lm_head"]["kernel"].astype(x.dtype),
+            preferred_element_type=jnp.float32)
+    return logits.astype(jnp.float32)
+
+
 def forward(
     params: Params,
     tokens: jax.Array,
@@ -202,17 +222,7 @@ def forward(
                                     params["blocks"]), k_cache, v_cache))
         new_cache = new_kvs
 
-    x = rms_norm(x, params["final_norm"]["scale"], cfg.norm_eps, impl=norm_impl)
-
-    if cfg.tie_word_embeddings:
-        logits = jnp.einsum("bsh,vh->bsv", x, emb.astype(compute_dtype),
-                            preferred_element_type=jnp.float32)
-    else:
-        logits = jnp.einsum("bsh,hv->bsv", x,
-                            params["lm_head"]["kernel"].astype(compute_dtype),
-                            preferred_element_type=jnp.float32)
-
-    out = logits.astype(jnp.float32)
+    out = unembed(params, x, cfg, norm_impl=norm_impl)
     result = [out]
     if kv_cache is not None:
         result.append(new_cache)

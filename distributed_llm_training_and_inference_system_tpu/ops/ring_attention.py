@@ -1,0 +1,143 @@
+"""Ring attention: context-parallel attention over the 'sp' mesh axis.
+
+The long-context capability the reference only names (`sequence_parallel`
+is a dead boolean — reference init.py:136, preset llama-7b-a100x8.toml:36;
+zero grep hits for ring/ulysses/context-parallel — SURVEY §5.7).
+
+Mechanism (blockwise ring, the natural ICI topology):
+- the sequence axis is sharded over 'sp'; each device holds q/k/v for its
+  local S/sp tokens,
+- sp ring steps: attend local q against the currently-held kv chunk (with
+  its true global positions/segments for causal masking); each chunk yields
+  a normalised partial output r_c and its log-sum-exp weight lse_c, merged
+  across steps as out = Σ_c exp(lse_c)·r_c / Σ_c exp(lse_c) with a running
+  max for stability,
+- between steps, kv (+ positions/segments) rotates to the ring neighbour
+  via ppermute — KV movement rides ICI neighbour links and overlaps with
+  the current chunk's compute under the async-collective XLA flags.
+
+Implemented with shard_map inside the ambient mesh so it composes under the
+same pjit train step as every other layer; lax.scan keeps it reverse-mode
+differentiable (ppermute transposes to the reverse rotation), so the
+backward pass is also a ring — no S^2 memory anywhere.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _chunk_attention(q, k, v, q_pos, k_pos, q_seg, k_seg, scale):
+    """Local q vs one kv chunk -> (r, lse): r is the chunk-softmax-normalised
+    output [B,Nkv,G,Sq,D] fp32; lse [B,Nkv,G,Sq,1] is its log total weight
+    (NEG_INF where the chunk is fully masked for that row)."""
+    B, Sq, Nq, D = q.shape
+    Nkv = k.shape[2]
+    groups = Nq // Nkv
+    qg = q.astype(jnp.float32).reshape(B, Sq, Nkv, groups, D)
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * scale
+    mask = (q_pos[:, :, None] >= k_pos[:, None, :])          # causal
+    mask = mask & (q_seg[:, :, None] == k_seg[:, None, :]) & \
+        (k_seg[:, None, :] != 0)
+    s = jnp.where(mask[:, None, None, :, :], s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    dead = m <= NEG_INF / 2
+    m_safe = jnp.where(dead, 0.0, m)
+    p = jnp.where(dead, 0.0, jnp.exp(s - m_safe))
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    r = jnp.einsum("bhgqk,bkhd->bhgqd", p, v.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) / jnp.maximum(l, 1e-30)
+    lse = jnp.where(dead, NEG_INF, m_safe + jnp.log(jnp.maximum(l, 1e-30)))
+    return r, lse
+
+
+def _merge(acc, w, m_run, r, lse):
+    """Online merge of a normalised chunk (r, lse) into (acc, w, m_run):
+    invariant out_so_far = acc / w with weights rescaled by exp(-m_run)."""
+    m_new = jnp.maximum(m_run, lse)
+    m_safe = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+    alpha = jnp.where(m_run <= NEG_INF / 2, 0.0, jnp.exp(m_run - m_safe))
+    beta = jnp.where(lse <= NEG_INF / 2, 0.0, jnp.exp(lse - m_safe))
+    return acc * alpha + r * beta, w * alpha + beta, m_new
+
+
+def _finalize(acc, w, B, Sq, Nq, D, dtype):
+    out = acc / jnp.maximum(w, 1e-30)
+    out = out.transpose(0, 3, 1, 2, 4).reshape(B, Sq, Nq, D)
+    return out.astype(dtype)
+
+
+def _ring_body(q, k, v, q_pos, k_pos, q_seg, k_seg, axis_name, scale):
+    sp = lax.axis_size(axis_name)
+    B, Sq, Nq, D = q.shape
+    Nkv = k.shape[2]
+    groups = Nq // Nkv
+    shape = (B, Nkv, groups, Sq, 1)
+    acc0 = jnp.zeros((B, Nkv, groups, Sq, D), jnp.float32)
+    w0 = jnp.zeros(shape, jnp.float32)
+    m0 = jnp.full(shape, NEG_INF, jnp.float32)
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    def step(carry, _):
+        acc, w, m_run, k_c, v_c, kp_c, ks_c = carry
+        r, lse = _chunk_attention(q, k_c, v_c, q_pos, kp_c, q_seg, ks_c, scale)
+        acc, w, m_run = _merge(acc, w, m_run, r, lse)
+        k_n = lax.ppermute(k_c, axis_name, perm)
+        v_n = lax.ppermute(v_c, axis_name, perm)
+        kp_n = lax.ppermute(kp_c, axis_name, perm)
+        ks_n = lax.ppermute(ks_c, axis_name, perm)
+        return (acc, w, m_run, k_n, v_n, kp_n, ks_n), None
+
+    (acc, w, _, *_), _ = lax.scan(
+        step, (acc0, w0, m0, k, v, k_pos, k_seg), None, length=sp)
+    return _finalize(acc, w, B, Sq, Nq, D, q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,                      # [B, S_local, Nq, D] (seq on 'sp')
+    k: jax.Array,
+    v: jax.Array,
+    positions: Optional[jax.Array] = None,    # [B, S_local] GLOBAL positions
+    segment_ids: Optional[jax.Array] = None,
+    axis_name: str = "sp",
+) -> jax.Array:
+    """Causal ring attention. Runs under the ambient mesh (use_mesh); with
+    no mesh or sp == 1 it reduces to single-chunk blockwise attention."""
+    from ..parallel.sharding import _current_mesh
+
+    B, S, Nq, D = q.shape
+    scale = 1.0 / float(D) ** 0.5
+    if positions is None:
+        positions = jnp.arange(S, dtype=jnp.int32)[None, :].repeat(B, axis=0)
+    if segment_ids is None:
+        segment_ids = jnp.ones((B, S), jnp.int32)
+    segment_ids = segment_ids.astype(jnp.int32)
+    positions = positions.astype(jnp.int32)
+
+    mesh = _current_mesh()
+    if mesh is None or mesh.shape.get(axis_name, 1) == 1:
+        r, lse = _chunk_attention(q, k, v, positions, positions,
+                                  segment_ids, segment_ids, scale)
+        w = jnp.where(lse <= NEG_INF / 2, 0.0, 1.0)
+        return _finalize(r * w, w, B, S, Nq, D, q.dtype)
+
+    qspec = P(("dp", "fsdp"), axis_name, None, None)
+    sspec = P(("dp", "fsdp"), axis_name)
+
+    def body(q_, k_, v_, pos_, seg_):
+        return _ring_body(q_, k_, v_, pos_, pos_, seg_, seg_,
+                          axis_name, scale)
+
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(qspec, qspec, qspec, sspec, sspec),
+        out_specs=qspec, check_vma=False)
+    return fn(q, k, v, positions, segment_ids)

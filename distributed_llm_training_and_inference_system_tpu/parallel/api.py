@@ -59,8 +59,14 @@ class ShardedTrainer:
         self.model_cfg = model_cfg
         self.par_cfg = par_cfg
         self.mesh = build_mesh(par_cfg, devices)
+        self.pipelined = par_cfg.pipeline_parallel > 1
+        custom_loss = None
+        if self.pipelined:
+            from .pipeline import make_pipeline_loss_fn
+            custom_loss = make_pipeline_loss_fn(model_cfg, par_cfg, attn_impl)
         step_fn, tx, schedule = make_train_step(
-            model_cfg, opt_cfg, par_cfg, attn_impl=attn_impl)
+            model_cfg, opt_cfg, par_cfg, attn_impl=attn_impl,
+            loss_fn=custom_loss)
         self.tx, self.schedule = tx, schedule
         self._specs, self._abstract = state_specs(
             model_cfg, tx, self.mesh, par_cfg.zero_stage)
@@ -72,8 +78,14 @@ class ShardedTrainer:
             out_shardings=(self._state_shardings, None),
             donate_argnums=(0,),
         )
-        self.eval_step = jax.jit(make_eval_step(model_cfg, attn_impl))
-        self._batch_spec_fn = functools.partial(batch_specs, mesh=self.mesh)
+        self.eval_step = jax.jit(make_eval_step(
+            model_cfg, attn_impl if attn_impl != "ring" else "xla"))
+        if self.pipelined:
+            from .pipeline import pipeline_batch_specs
+            self._batch_spec_fn = functools.partial(pipeline_batch_specs,
+                                                    mesh=self.mesh)
+        else:
+            self._batch_spec_fn = functools.partial(batch_specs, mesh=self.mesh)
         self.state: Optional[TrainState] = None
 
     # -- state ---------------------------------------------------------------
@@ -92,7 +104,18 @@ class ShardedTrainer:
         return self.state
 
     def shard_batch(self, batch: Any) -> Any:
+        if self.pipelined and batch["tokens"].ndim == 2:
+            from .pipeline import reshape_batch_for_pipeline
+            batch = reshape_batch_for_pipeline(
+                batch, self.par_cfg.num_microbatches)
         shardings = _to_shardings(self._batch_spec_fn(batch), self.mesh)
+        if jax.process_count() > 1:
+            # each host holds a disjoint stripe of the global batch
+            # (io/data.py host striping) — assemble the global array from
+            # per-process local shards
+            return jax.tree_util.tree_map(
+                lambda x, s: jax.make_array_from_process_local_data(s, x),
+                batch, shardings)
         return jax.device_put(batch, shardings)
 
     def step(self, batch: Any):
@@ -104,7 +127,10 @@ class ShardedTrainer:
     def evaluate(self, batch: Any):
         assert self.state is not None, "call init_state() first"
         with use_mesh(self.mesh):
-            return self.eval_step(self.state.params, self.shard_batch(batch))
+            # eval always runs the plain (non-pipelined) forward on [B, S]
+            shardings = _to_shardings(batch_specs(batch, self.mesh), self.mesh)
+            return self.eval_step(self.state.params,
+                                  jax.device_put(batch, shardings))
 
     # -- introspection -------------------------------------------------------
 

@@ -63,8 +63,10 @@ def _train_env(cfg: LaunchConfig, host_id: int = 0,
     return env
 
 
-def _train_cmd(cfg: LaunchConfig) -> list[str]:
-    cmd = [sys.executable, "-m",
+def _train_cmd(cfg: LaunchConfig, python: Optional[str] = None) -> list[str]:
+    """*python* overrides the interpreter — containers must use their own
+    'python', never this machine's sys.executable path."""
+    cmd = [python or sys.executable, "-m",
            "distributed_llm_training_and_inference_system_tpu.runtime.train_entry"]
     if cfg.config_file:
         cmd += ["--config", str(cfg.config_file)]
@@ -76,23 +78,30 @@ class BaseLauncher:
     def __init__(self, cfg: LaunchConfig):
         self.cfg = cfg
 
-    def launch(self) -> Optional[subprocess.Popen]:
+    def launch(self, capture_output: bool = True) -> Optional[subprocess.Popen]:
         raise NotImplementedError
 
     def describe(self) -> str:
         raise NotImplementedError
 
+    @staticmethod
+    def _pipe(capture_output: bool):
+        # with nothing draining the pipe a chatty child would deadlock
+        # against a full OS pipe buffer — inherit stdout when not capturing
+        return subprocess.PIPE if capture_output else None
+
 
 class LocalLauncher(BaseLauncher):
     """One training process on this host (all local chips, SPMD)."""
 
-    def launch(self) -> Optional[subprocess.Popen]:
+    def launch(self, capture_output: bool = True) -> Optional[subprocess.Popen]:
         cmd = _train_cmd(self.cfg)
         if self.cfg.dry_run:
             return None
         return subprocess.Popen(
-            cmd, env=_train_env(self.cfg), stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT, text=True)
+            cmd, env=_train_env(self.cfg),
+            stdout=self._pipe(capture_output),
+            stderr=subprocess.STDOUT if capture_output else None, text=True)
 
     def describe(self) -> str:
         return shlex.join(_train_cmd(self.cfg))
@@ -122,13 +131,15 @@ export XLA_FLAGS="$XLA_FLAGS {overlap_flags()}"
 srun bash -c 'export LLMCTL_HOST_ID=$SLURM_PROCID; exec {cmd}'
 """
 
-    def launch(self) -> Optional[subprocess.Popen]:
+    def launch(self, capture_output: bool = True) -> Optional[subprocess.Popen]:
         path = Path(f"{self.cfg.job_name}.sbatch")
         path.write_text(self.script())
         if self.cfg.dry_run:
             return None
-        return subprocess.Popen(["sbatch", str(path)], stdout=subprocess.PIPE,
-                                stderr=subprocess.STDOUT, text=True)
+        return subprocess.Popen(["sbatch", str(path)],
+                                stdout=self._pipe(capture_output),
+                                stderr=subprocess.STDOUT if capture_output else None,
+                                text=True)
 
     def describe(self) -> str:
         return f"sbatch {self.cfg.job_name}.sbatch ({self.cfg.num_hosts} hosts)"
@@ -138,7 +149,7 @@ class MPILauncher(BaseLauncher):
     """mpirun one process per host; host id from OMPI rank env at runtime
     (reference MPILauncher launcher.py:194-236)."""
 
-    def launch(self) -> Optional[subprocess.Popen]:
+    def launch(self, capture_output: bool = True) -> Optional[subprocess.Popen]:
         c = self.cfg
         cmd = ["mpirun", "-np", str(c.num_hosts), "--map-by", "ppr:1:node",
                "-x", "LLMCTL_COORDINATOR", "-x", "LLMCTL_NUM_HOSTS",
@@ -147,8 +158,10 @@ class MPILauncher(BaseLauncher):
             return None
         env = _train_env(c, coordinator=os.environ.get("LLMCTL_COORD_HOST",
                                                        "localhost"))
-        return subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
-                                stderr=subprocess.STDOUT, text=True)
+        return subprocess.Popen(cmd, env=env,
+                                stdout=self._pipe(capture_output),
+                                stderr=subprocess.STDOUT if capture_output else None,
+                                text=True)
 
     def describe(self) -> str:
         return f"mpirun -np {self.cfg.num_hosts} --map-by ppr:1:node <train>"
@@ -161,7 +174,7 @@ class K8sLauncher(BaseLauncher):
 
     def manifest(self) -> str:
         c = self.cfg
-        cmd = _train_cmd(c)
+        cmd = _train_cmd(c, python="python")
         topo = f'\n            cloud.google.com/gke-tpu-topology: "{c.tpu_topology}"' \
             if c.tpu_topology else ""
         return f"""apiVersion: jobset.x-k8s.io/v1alpha2
@@ -200,14 +213,15 @@ spec:
                 value: "{overlap_flags().strip()}"
 """
 
-    def launch(self) -> Optional[subprocess.Popen]:
+    def launch(self, capture_output: bool = True) -> Optional[subprocess.Popen]:
         path = Path(f"{self.cfg.job_name}.jobset.yaml")
         path.write_text(self.manifest())
         if self.cfg.dry_run:
             return None
         return subprocess.Popen(["kubectl", "apply", "-f", str(path)],
-                                stdout=subprocess.PIPE,
-                                stderr=subprocess.STDOUT, text=True)
+                                stdout=self._pipe(capture_output),
+                                stderr=subprocess.STDOUT if capture_output else None,
+                                text=True)
 
     def describe(self) -> str:
         return f"kubectl apply -f {self.cfg.job_name}.jobset.yaml"
@@ -234,7 +248,7 @@ class ProcessOrchestrator:
         self.process: Optional[subprocess.Popen] = None
 
     def start(self, stream_output: bool = True) -> int:
-        self.process = self.launcher.launch()
+        self.process = self.launcher.launch(capture_output=stream_output)
         if self.process is None:     # dry run
             return 0
         if stream_output and self.process.stdout is not None:

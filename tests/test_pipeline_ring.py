@@ -1,0 +1,145 @@
+"""Pipeline-parallel and ring-attention equivalence tests (8 fake devices).
+
+These are the SURVEY §7.3 'hard parts' — correctness is established by
+comparing against the plain single-program path on identical data.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llm_training_and_inference_system_tpu.config import (
+    OptimizerConfig, ParallelConfig, get_model_config)
+from distributed_llm_training_and_inference_system_tpu.exec import (
+    TrainState, make_train_step)
+from distributed_llm_training_and_inference_system_tpu.models import init
+from distributed_llm_training_and_inference_system_tpu.parallel import (
+    ShardedTrainer, build_mesh, use_mesh)
+
+
+def _ref_losses(model_cfg, batch, steps=3, lr=1e-2):
+    step_fn, tx, _ = make_train_step(model_cfg, OptimizerConfig(lr=lr))
+    state = TrainState.create(init(model_cfg, jax.random.PRNGKey(0)), tx)
+    out = []
+    jstep = jax.jit(step_fn)
+    for _ in range(steps):
+        state, m = jstep(state, batch)
+        out.append(float(m["loss"]))
+    return out
+
+
+def test_pipeline_matches_single_device(devices8):
+    """pp=4 x dp=2 GPipe schedule must reproduce the unpipelined loss
+    trajectory (same data, same init, same optimizer)."""
+    model_cfg = get_model_config("gpt-test")   # 2 layers
+    par = ParallelConfig(data_parallel=2, pipeline_parallel=4,
+                         num_microbatches=4, micro_batch_size=1,
+                         global_batch_size=8,
+                         activation_checkpoint="none")
+    # need layers % pp == 0 -> use a 4-layer variant
+    import dataclasses
+    model_cfg = dataclasses.replace(model_cfg, num_layers=4)
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 1,
+                                model_cfg.vocab_size)
+    batch = {"tokens": tokens}
+    ref = _ref_losses(model_cfg, batch)
+
+    tr = ShardedTrainer(model_cfg, OptimizerConfig(lr=1e-2), par,
+                        devices=devices8)
+    tr.init_state(seed=0)
+    losses = [float(tr.step(batch)["loss"]) for _ in range(3)]
+    np.testing.assert_allclose(losses, ref, rtol=2e-3, atol=1e-4)
+
+
+def test_pipeline_with_tp(devices8):
+    """pp=2 x tp=2 x dp=2: pipeline composes with tensor parallelism."""
+    import dataclasses
+    model_cfg = dataclasses.replace(get_model_config("gpt-test"), num_layers=4)
+    par = ParallelConfig(data_parallel=2, tensor_parallel=2,
+                         pipeline_parallel=2, num_microbatches=2,
+                         micro_batch_size=2, global_batch_size=8,
+                         activation_checkpoint="selective")
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (8, 32), 1,
+                                model_cfg.vocab_size)
+    batch = {"tokens": tokens}
+    ref = _ref_losses(model_cfg, batch)
+    tr = ShardedTrainer(model_cfg, OptimizerConfig(lr=1e-2), par,
+                        devices=devices8)
+    tr.init_state(seed=0)
+    losses = [float(tr.step(batch)["loss"]) for _ in range(3)]
+    np.testing.assert_allclose(losses, ref, rtol=2e-3, atol=1e-4)
+
+
+def test_ring_attention_matches_reference(devices8):
+    """Ring attention over sp=4 == single-chunk attention on gathered seq."""
+    from distributed_llm_training_and_inference_system_tpu.models.layers import (
+        attention_mask, dot_product_attention)
+    from distributed_llm_training_and_inference_system_tpu.ops.ring_attention import (
+        ring_attention)
+
+    B, S, N, D = 2, 64, 4, 16
+    q = jax.random.normal(jax.random.PRNGKey(0), (B, S, N, D), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(1), (B, S, N, D), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (B, S, N, D), jnp.float32)
+    pos = jnp.arange(S)[None, :].repeat(B, axis=0)
+    segs = jnp.concatenate([jnp.full((B, 40), 1), jnp.full((B, 24), 2)], axis=1)
+
+    ref = dot_product_attention(q, k, v, attention_mask(pos, pos, segs, segs))
+
+    par = ParallelConfig(data_parallel=2, sequence_parallel=4)
+    mesh = build_mesh(par, devices8)
+    with use_mesh(mesh):
+        out = jax.jit(lambda *a: ring_attention(*a, axis_name="sp"))(
+            q, k, v, pos, segs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ring_attention_gradients(devices8):
+    """Backward through the ring (reverse ppermute) matches reference."""
+    from distributed_llm_training_and_inference_system_tpu.models.layers import (
+        attention_mask, dot_product_attention)
+    from distributed_llm_training_and_inference_system_tpu.ops.ring_attention import (
+        ring_attention)
+
+    B, S, N, D = 1, 32, 2, 8
+    q = jax.random.normal(jax.random.PRNGKey(3), (B, S, N, D), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(4), (B, S, N, D), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(5), (B, S, N, D), jnp.float32)
+    pos = jnp.arange(S)[None, :].repeat(B, axis=0)
+
+    def ref_loss(q, k, v):
+        mask = attention_mask(pos, pos)
+        return jnp.sum(dot_product_attention(q, k, v, mask) ** 2)
+
+    par = ParallelConfig(sequence_parallel=8)
+    mesh = build_mesh(par, devices8)
+
+    def ring_loss(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, pos, axis_name="sp") ** 2)
+
+    with use_mesh(mesh):
+        g_ring = jax.jit(jax.grad(ring_loss, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b, n in zip(g_ring, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5, err_msg=n)
+
+
+def test_model_forward_ring_vs_xla(devices8):
+    """Full model with attn_impl='ring' on an sp mesh == xla attention."""
+    from distributed_llm_training_and_inference_system_tpu.models import forward
+    cfg = get_model_config("gpt-test")
+    params = init(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 1,
+                                cfg.vocab_size)
+    ref = forward(params, tokens, cfg, attn_impl="xla")
+    par = ParallelConfig(data_parallel=2, sequence_parallel=4)
+    mesh = build_mesh(par, devices8)
+    with use_mesh(mesh):
+        out = jax.jit(lambda p, t: forward(p, t, cfg, attn_impl="ring"))(
+            params, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=5e-4, atol=5e-4)
