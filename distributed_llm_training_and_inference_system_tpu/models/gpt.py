@@ -167,6 +167,7 @@ def forward(
     norm_impl: str = "xla",          # xla | pallas
     remat: str = "none",             # none | selective | full
     return_aux: bool = False,
+    unembed_positions: Optional[jax.Array] = None,
 ):
     """Compute logits [B, S, V] (fp32).
 
@@ -175,6 +176,11 @@ def forward(
       [B] enable incremental decoding; the updated cache is returned.
     - ``attn_impl='ring'`` runs context-parallel ring attention over the
       'sp' mesh axis (sequence must be sharded on 'sp').
+    - ``unembed_positions`` [B] restricts the LM head to one position per
+      row, returning [B, 1, V] — prefill needs only the last position's
+      logits, and skipping the [S, V] unembed saves HBM and MXU time
+      (the reference recomputes and discards full-vocab logits every step,
+      reference serve/server.py:199-204).
     """
     compute_dtype = jnp.dtype(cfg.dtype)
     B, S = tokens.shape
@@ -222,6 +228,9 @@ def forward(
                                     params["blocks"]), k_cache, v_cache))
         new_cache = new_kvs
 
+    if unembed_positions is not None:
+        x = jnp.take_along_axis(
+            x, unembed_positions[:, None, None].astype(jnp.int32), axis=1)
     out = unembed(params, x, cfg, norm_impl=norm_impl)
     result = [out]
     if kv_cache is not None:

@@ -1,0 +1,77 @@
+"""Paged decode attention over a block-table-indexed KV cache.
+
+The reference's KVCacheManager is dead code — instantiated but never read
+during generation, so every decode step recomputes the full prefix
+(reference serve/server.py:57-87 + :199-204, defect SURVEY §2.4.2). This op
+is the real thing: KV lives in fixed-size pages in HBM, each sequence owns a
+block table of page indices, and decode attends through the table.
+
+Layout (per layer): pages [num_pages, page_size, Nkv, D]. Static shapes
+throughout — the block table has a fixed ``max_pages_per_seq`` width and
+unused entries point at the reserved scratch page 0, so XLA compiles one
+program regardless of how many sequences or tokens are live (SURVEY §7.3.2:
+continuous batching under XLA static shapes).
+
+The gather-based implementation below is the portable baseline; on TPU the
+same layout is consumed by a Pallas kernel that streams pages HBM->VMEM
+without materialising the gathered cache (ops/paged_attention_pallas).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..models.layers import NEG_INF
+
+
+def paged_attention(
+    q: jax.Array,            # [B, Nq, D] — one query token per sequence
+    k_pages: jax.Array,      # [NP, PS, Nkv, D]
+    v_pages: jax.Array,      # [NP, PS, Nkv, D]
+    block_tables: jax.Array, # [B, maxP] int32 physical page ids
+    lengths: jax.Array,      # [B] int32 — tokens already in cache INCLUDING
+                             #   the current one (i.e. attend to [0, lengths))
+) -> jax.Array:
+    """Decode attention: each row attends over its paged KV prefix.
+
+    Returns [B, Nq, D] in q.dtype. GQA via head-group broadcast, softmax in
+    fp32 — numerics match models.layers.dot_product_attention.
+    """
+    B, Nq, D = q.shape
+    NP, PS, Nkv, _ = k_pages.shape
+    maxP = block_tables.shape[1]
+    groups = Nq // Nkv
+
+    # Gather each row's pages: [B, maxP, PS, Nkv, D] -> [B, Lmax, Nkv, D]
+    k = k_pages[block_tables].reshape(B, maxP * PS, Nkv, D)
+    v = v_pages[block_tables].reshape(B, maxP * PS, Nkv, D)
+
+    qg = q.reshape(B, Nkv, groups, D)
+    scores = jnp.einsum("bhgd,bkhd->bhgk", qg, k.astype(q.dtype),
+                        preferred_element_type=jnp.float32)
+    scores = scores / jnp.sqrt(jnp.float32(D))
+
+    kv_pos = jnp.arange(maxP * PS, dtype=jnp.int32)[None, :]        # [1,Lmax]
+    valid = kv_pos < lengths[:, None]                                # [B,Lmax]
+    scores = jnp.where(valid[:, None, None, :], scores, NEG_INF)
+
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhgk,bkhd->bhgd", probs, v,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(B, Nq, D).astype(q.dtype)
+
+
+def write_token_to_pages(
+    pages: jax.Array,        # [NP, PS, Nkv, D]
+    new_kv: jax.Array,       # [B, Nkv, D] — this step's K or V
+    block_tables: jax.Array, # [B, maxP]
+    positions: jax.Array,    # [B] int32 — slot-local position to write
+) -> jax.Array:
+    """Scatter one token per sequence into its page. Rows whose table entry
+    is the scratch page (0) harmlessly overwrite scratch."""
+    logical_page = positions // pages.shape[1]
+    offset = positions % pages.shape[1]
+    phys = jnp.take_along_axis(block_tables, logical_page[:, None],
+                               axis=1)[:, 0]                         # [B]
+    return pages.at[phys, offset].set(new_kv.astype(pages.dtype))

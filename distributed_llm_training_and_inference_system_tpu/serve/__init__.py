@@ -1,0 +1,27 @@
+"""Serving layer: continuous batching, paged KV cache, OpenAI-style API.
+
+Parity surface: reference llmctl/serve/ (server.py) — rebuilt with the
+reference's defects fixed (SURVEY §2.4.1/2) and a TPU-shaped
+prefill/decode split.
+"""
+
+from .engine import InferenceEngine
+from .kv_cache import PagedKVCache
+from .scheduler import (
+    ContinuousBatchingScheduler,
+    Request,
+    RequestState,
+    SamplingParams,
+)
+from .server import InferenceServer, create_inference_server
+
+__all__ = [
+    "ContinuousBatchingScheduler",
+    "InferenceEngine",
+    "InferenceServer",
+    "PagedKVCache",
+    "Request",
+    "RequestState",
+    "SamplingParams",
+    "create_inference_server",
+]

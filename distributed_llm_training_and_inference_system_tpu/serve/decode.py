@@ -1,0 +1,95 @@
+"""Single-token decode forward over the paged KV cache.
+
+Serving on TPU wants prefill and decode as separate compiled programs
+(SURVEY §7.3.2): prefill is a large-matmul batch-1 pass through the standard
+``models.gpt.forward``; decode is this function — one token for EVERY slot
+per call, static shapes, paged attention. Reuses the same param pytree and
+layer building blocks as training, so numerics can never diverge from the
+train-side model (tested in tests/test_serve.py against the dense path).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ..config.schema import ModelConfig
+from ..models.layers import (
+    apply_rope,
+    mlp_block,
+    moe_block,
+    rms_norm,
+    rope_frequencies,
+)
+from ..ops.paged_attention import paged_attention, write_token_to_pages
+
+
+def decode_step_forward(
+    params: Any,
+    tokens: jax.Array,        # [B] int32 — the newest token per slot
+    positions: jax.Array,     # [B] int32 — position of that token
+    k_pages: jax.Array,       # [L, NP, PS, Nkv, D]
+    v_pages: jax.Array,
+    block_tables: jax.Array,  # [B, maxP] int32
+    cfg: ModelConfig,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Returns (logits [B, V] fp32, new k_pages, new v_pages).
+
+    The new token's K/V are written into the pages *inside* this traced
+    function (page arrays should be donated by the jit wrapper so XLA
+    updates them in place in HBM).
+    """
+    compute_dtype = jnp.dtype(cfg.dtype)
+    B = tokens.shape[0]
+    D, Nq, Nkv = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
+
+    x = params["embed"]["embedding"][tokens].astype(compute_dtype)   # [B,H]
+    inv_freq = rope_frequencies(cfg.head_dim, cfg.rope.base,
+                                cfg.rope.scaling, cfg.rope.scaling_factor)
+    lengths = positions + 1      # attend over [0, position] inclusive
+
+    def body(x, layer_and_pages):
+        layer, kp, vp = layer_and_pages
+        h = rms_norm(x, layer["attn_norm"]["scale"], cfg.norm_eps)
+        q = (h @ layer["q"]["kernel"]).reshape(B, Nq, D)
+        k = (h @ layer["k"]["kernel"]).reshape(B, Nkv, D)
+        v = (h @ layer["v"]["kernel"]).reshape(B, Nkv, D)
+        if cfg.attention_bias:
+            q = q + layer["q"]["bias"].reshape(Nq, D)
+            k = k + layer["k"]["bias"].reshape(Nkv, D)
+            v = v + layer["v"]["bias"].reshape(Nkv, D)
+        # rope for a single token: positions [B] -> [B,1] sequence of len 1
+        q = apply_rope(q[:, None], positions[:, None], inv_freq)[:, 0]
+        k = apply_rope(k[:, None], positions[:, None], inv_freq)[:, 0]
+
+        kp = write_token_to_pages(kp, k, block_tables, positions)
+        vp = write_token_to_pages(vp, v, block_tables, positions)
+        attn = paged_attention(q, kp, vp, block_tables, lengths)
+        x = x + (attn.reshape(B, Nq * D) @ layer["o"]["kernel"]).astype(x.dtype)
+
+        h = rms_norm(x, layer["mlp_norm"]["scale"], cfg.norm_eps)
+        if cfg.is_moe:
+            ffn, _ = moe_block(h[:, None], layer["moe"], cfg)
+            ffn = ffn[:, 0]
+        else:
+            ffn = mlp_block(h[:, None], layer["mlp"], cfg)[:, 0]
+        return x + ffn.astype(x.dtype), (kp, vp)
+
+    cast = functools.partial(jax.tree_util.tree_map,
+                             lambda p: p.astype(compute_dtype))
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (cast(params["blocks"]), k_pages, v_pages))
+
+    x = rms_norm(x, params["final_norm"]["scale"].astype(x.dtype), cfg.norm_eps)
+    if cfg.tie_word_embeddings:
+        logits = jnp.einsum("bh,vh->bv", x,
+                            params["embed"]["embedding"].astype(x.dtype),
+                            preferred_element_type=jnp.float32)
+    else:
+        logits = jnp.einsum("bh,hv->bv", x,
+                            params["lm_head"]["kernel"].astype(x.dtype),
+                            preferred_element_type=jnp.float32)
+    return logits.astype(jnp.float32), new_k, new_v

@@ -1,0 +1,109 @@
+"""Paged KV cache: device-resident pages + host-side page allocator.
+
+Replaces the reference's LRU-dict KVCacheManager that generation never reads
+(reference serve/server.py:57-87, defect SURVEY §2.4.2). Design is
+vLLM-style paging mapped onto XLA's static-shape world:
+
+- All layers' pages live in two arrays [L, num_pages, page_size, Nkv, D] in
+  HBM (one allocation, no fragmentation).
+- Page 0 is reserved scratch: every unused block-table entry points at it,
+  so the jitted decode step can run over ALL slots every step — inactive
+  slots write into scratch and read garbage that their length mask hides.
+- Allocation/free is host-side (cheap integer bookkeeping between device
+  steps); the device only ever sees the dense block_tables array.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config.schema import ModelConfig
+
+
+class PagedKVCache:
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        num_slots: int,
+        max_seq_len: int,
+        page_size: int = 16,
+        num_pages: int = 0,
+        hbm_budget_gb: float = 4.0,
+        dtype=jnp.bfloat16,
+    ):
+        self.cfg = cfg
+        self.num_slots = num_slots
+        self.max_seq_len = max_seq_len
+        self.page_size = page_size
+        self.max_pages_per_slot = math.ceil(max_seq_len / page_size)
+        if num_pages <= 0:
+            bytes_per_page = (2 * cfg.num_layers * page_size
+                              * cfg.num_kv_heads * cfg.head_dim
+                              * jnp.dtype(dtype).itemsize)
+            num_pages = max(int(hbm_budget_gb * 1e9 // bytes_per_page), 2)
+        # never more than every slot fully resident (+1 scratch)
+        num_pages = min(num_pages, num_slots * self.max_pages_per_slot + 1)
+        self.num_pages = num_pages
+        self.dtype = dtype
+
+        shape = (cfg.num_layers, num_pages, page_size, cfg.num_kv_heads,
+                 cfg.head_dim)
+        self.k_pages = jnp.zeros(shape, dtype)
+        self.v_pages = jnp.zeros(shape, dtype)
+
+        # host-side state; page 0 is scratch and never allocated
+        self._free: list[int] = list(range(1, num_pages))
+        self._owned: dict[int, list[int]] = {}            # slot -> pages
+        self.block_tables = np.zeros((num_slots, self.max_pages_per_slot),
+                                     np.int32)
+
+    # -- accounting ----------------------------------------------------------
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def pages_needed(self, num_tokens: int) -> int:
+        return math.ceil(max(num_tokens, 1) / self.page_size)
+
+    def can_allocate(self, num_tokens: int) -> bool:
+        return self.pages_needed(num_tokens) <= self.free_pages
+
+    def can_ever_allocate(self, num_tokens: int) -> bool:
+        """Whether an EMPTY cache could hold this many tokens (page 0 is
+        reserved scratch)."""
+        return self.pages_needed(num_tokens) <= self.num_pages - 1
+
+    def hbm_bytes(self) -> int:
+        return 2 * int(np.prod(self.k_pages.shape)) * jnp.dtype(self.dtype).itemsize
+
+    # -- alloc / grow / free -------------------------------------------------
+
+    def allocate(self, slot: int, num_tokens: int) -> None:
+        """Give ``slot`` enough pages for ``num_tokens`` tokens."""
+        need = self.pages_needed(num_tokens)
+        if need > self.free_pages:
+            raise RuntimeError(
+                f"KV cache OOM: need {need} pages, {self.free_pages} free")
+        pages = [self._free.pop() for _ in range(need)]
+        self._owned[slot] = pages
+        self.block_tables[slot, :] = 0
+        self.block_tables[slot, :need] = pages
+
+    def release(self, slot: int) -> None:
+        for page in self._owned.pop(slot, []):
+            self._free.append(page)
+        self.block_tables[slot, :] = 0
+
+    def stats(self) -> dict:
+        return {
+            "num_pages": self.num_pages,
+            "free_pages": self.free_pages,
+            "page_size": self.page_size,
+            "hbm_bytes": self.hbm_bytes(),
+            "slots_resident": len(self._owned),
+        }

@@ -1,0 +1,58 @@
+"""Batched, jit-compatible token sampling.
+
+Parity: the reference samples per request in a Python loop on the host —
+temperature, top-k, top-p, multinomial (reference serve/server.py:209-235).
+Here the whole batch is sampled in one traced function on device: every
+request carries its own (temperature, top_k, top_p, key) and the math is
+vectorised — no data-dependent Python control flow (XLA requirement).
+
+temperature == 0 means greedy (argmax), selected via jnp.where, not cond.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..models.layers import NEG_INF
+
+
+def _apply_top_k(logits: jax.Array, top_k: jax.Array) -> jax.Array:
+    """Mask logits outside each row's top-k. top_k==0 disables. [B,V]."""
+    V = logits.shape[-1]
+    sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]                  # [B,V]
+    k = jnp.clip(top_k, 1, V)
+    kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=1)  # [B,1]
+    keep = (logits >= kth) | (top_k[:, None] == 0)
+    return jnp.where(keep, logits, NEG_INF)
+
+
+def _apply_top_p(logits: jax.Array, top_p: jax.Array) -> jax.Array:
+    """Nucleus filtering per row; top_p>=1 disables. [B,V]."""
+    sort_idx = jnp.argsort(logits, axis=-1)[:, ::-1]
+    sorted_logits = jnp.take_along_axis(logits, sort_idx, axis=-1)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep tokens until cumulative prob exceeds p; the top token is always
+    # kept so top_p=0 degrades to greedy instead of masking everything
+    keep_sorted = ((cum - probs) < top_p[:, None]).at[:, 0].set(True)
+    keep = jnp.zeros_like(keep_sorted).at[
+        jnp.arange(logits.shape[0])[:, None], sort_idx].set(keep_sorted)
+    keep = keep | (top_p[:, None] >= 1.0)
+    return jnp.where(keep, logits, NEG_INF)
+
+
+def sample_tokens(
+    logits: jax.Array,       # [B, V] fp32
+    keys: jax.Array,         # [B] PRNG keys (uint32[2] each)
+    temperature: jax.Array,  # [B] fp32; 0 = greedy
+    top_k: jax.Array,        # [B] int32; 0 = disabled
+    top_p: jax.Array,        # [B] fp32; 1.0 = disabled
+) -> jax.Array:
+    """Return sampled token ids [B] int32."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    filtered = _apply_top_p(_apply_top_k(logits / temp, top_k), top_p)
+    sampled = jax.vmap(
+        lambda key, row: jax.random.categorical(key, row))(keys, filtered)
+    return jnp.where(temperature <= 0.0, greedy, sampled.astype(jnp.int32))

@@ -100,6 +100,7 @@ class ContinuousBatchingScheduler:
         max_seq_len: int = 2048,
         can_allocate: Optional[Callable[[Request], bool]] = None,
         on_release: Optional[Callable[[Request], None]] = None,
+        can_ever_allocate: Optional[Callable[[Request], bool]] = None,
     ):
         self.max_batch_size = max_batch_size
         self.max_queue = max_queue
@@ -108,6 +109,10 @@ class ContinuousBatchingScheduler:
         self.slots: list[Optional[Request]] = [None] * max_batch_size
         self._can_allocate = can_allocate or (lambda r: True)
         self._on_release = on_release or (lambda r: None)
+        # capacity check at ADMISSION TIME vs EVER: a request whose KV
+        # footprint exceeds the whole cache would head-of-line-block admit()
+        # forever, so it must be rejected up front
+        self._can_ever_allocate = can_ever_allocate or (lambda r: True)
         self.completed: deque[Request] = deque(maxlen=1024)
         # counters for metrics
         self.total_admitted = 0
@@ -130,6 +135,15 @@ class ContinuousBatchingScheduler:
             self.completed.append(request)
             self.total_rejected += 1
             return False
+        if not self._can_ever_allocate(request):
+            request.state = RequestState.FAILED
+            request.error = (
+                f"request KV footprint ({request.num_prompt_tokens}+"
+                f"{request.sampling.max_tokens} tokens) exceeds total cache "
+                "capacity")
+            self.completed.append(request)
+            self.total_rejected += 1
+            return False
         request.state = RequestState.QUEUED
         self.waiting.append(request)
         return True
@@ -143,6 +157,12 @@ class ContinuousBatchingScheduler:
                 return True
         for i, r in enumerate(self.slots):
             if r is not None and r.request_id == request_id:
+                if r.state == RequestState.PREFILLING:
+                    # prefill is in flight on the engine thread; releasing
+                    # the slot's KV pages under it would corrupt the cache.
+                    # The request becomes RUNNING within one engine step and
+                    # can be cancelled then.
+                    return False
                 self._release_slot(i, "cancelled")
                 return True
         return False

@@ -1,0 +1,248 @@
+"""OpenAI-compatible inference server on aiohttp.
+
+API parity with the reference (reference serve/server.py:286-311):
+``POST /v1/completions``, ``GET /v1/models``, ``GET /health`` — plus
+``GET /metrics`` (Prometheus text) and ``GET /v1/stats``, closing the
+reference's unwired-observability gap (SURVEY §5.5).
+
+Concurrency model: the reference runs generation inside the asyncio event
+loop, blocking every HTTP request during each forward pass
+(reference server.py:372-386). Here the engine runs in a dedicated thread;
+device compute never holds the shared lock (engine.step acquires it only
+around scheduler/page bookkeeping), so handlers stay responsive during
+forward passes. Completion is signalled per request via an asyncio.Event
+set with call_soon_threadsafe from the engine thread — no polling.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import threading
+import time
+import uuid
+from typing import Optional
+
+from aiohttp import web
+
+from ..config.schema import ModelConfig, ServeConfig
+from .engine import InferenceEngine
+from .scheduler import Request, RequestState, SamplingParams
+from .tokenizer import load_tokenizer
+
+logger = logging.getLogger("llmctl.serve.server")
+
+
+class InferenceServer:
+    def __init__(self, model_cfg: ModelConfig, serve_cfg: ServeConfig,
+                 params=None, observer=None):
+        self.model_cfg = model_cfg
+        self.serve_cfg = serve_cfg
+        self.tokenizer = load_tokenizer(serve_cfg.artifact or None,
+                                        model_cfg.vocab_size)
+        self.engine = InferenceEngine(
+            model_cfg, serve_cfg, params=params,
+            eos_token_id=getattr(self.tokenizer, "eos_token_id", None))
+        self.observer = observer or (lambda event, payload: None)
+        self._lock = self.engine.lock
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._recent_latencies: list[float] = []
+        self._waiters: dict[str, tuple[asyncio.AbstractEventLoop, asyncio.Event]] = {}
+        self.engine.on_finish = self._notify_finished
+        self.app = self._build_app()
+
+    def _notify_finished(self, req) -> None:
+        """Engine-thread callback: wake the handler awaiting this request."""
+        waiter = self._waiters.pop(req.request_id, None)
+        if waiter is not None:
+            loop, event = waiter
+            loop.call_soon_threadsafe(event.set)
+
+    # -- engine thread -------------------------------------------------------
+
+    def _engine_loop(self) -> None:
+        logger.info("engine thread started")
+        while not self._stop.is_set():
+            with self._lock:
+                busy = (self.engine.scheduler.queue_depth > 0
+                        or self.engine.scheduler.active_count > 0)
+            if not busy:
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+                continue
+            # step() does its own fine-grained locking; compute runs unlocked
+            self.engine.step()
+        logger.info("engine thread stopped")
+
+    def start_engine(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._engine_loop,
+                                            daemon=True, name="llmctl-engine")
+            self._thread.start()
+
+    def stop_engine(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    # -- request handling ----------------------------------------------------
+
+    async def _await_request(self, req: Request, event: asyncio.Event,
+                             timeout: float = 600.0) -> None:
+        try:
+            await asyncio.wait_for(event.wait(), timeout=timeout)
+        except asyncio.TimeoutError:
+            raise asyncio.TimeoutError(
+                f"request {req.request_id} timed out") from None
+
+    async def handle_completions(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid JSON"}, status=400)
+
+        prompt = body.get("prompt", "")
+        if isinstance(prompt, list):           # OpenAI also accepts token ids
+            prompt_tokens = [int(t) for t in prompt]
+        else:
+            prompt_tokens = self.tokenizer.encode(str(prompt))
+        if not prompt_tokens:
+            return web.json_response({"error": "empty prompt"}, status=400)
+
+        try:
+            sampling = SamplingParams(
+                temperature=float(body.get("temperature", 1.0)),
+                top_k=int(body.get("top_k", 0)),
+                top_p=float(body.get("top_p", 1.0)),
+                max_tokens=int(body.get("max_tokens", 64)),
+                seed=body.get("seed"),
+            )
+        except (TypeError, ValueError) as e:
+            return web.json_response(
+                {"error": f"invalid sampling parameter: {e}"}, status=400)
+        req = Request(request_id=f"cmpl-{uuid.uuid4().hex[:24]}",
+                      prompt_tokens=prompt_tokens, sampling=sampling)
+        event = asyncio.Event()
+        self._waiters[req.request_id] = (asyncio.get_running_loop(), event)
+        with self._lock:
+            accepted = self.engine.scheduler.add_request(req)
+        if not accepted:
+            self._waiters.pop(req.request_id, None)
+            if req.error:
+                return web.json_response({"error": req.error}, status=400)
+            return web.json_response(
+                {"error": "server overloaded"}, status=503)
+        self._wake.set()
+
+        try:
+            await self._await_request(req, event)
+        except asyncio.TimeoutError:
+            self._waiters.pop(req.request_id, None)
+            with self._lock:
+                self.engine.scheduler.cancel(req.request_id)
+            return web.json_response({"error": "timeout"}, status=504)
+
+        if req.state is RequestState.FAILED:
+            return web.json_response({"error": req.error or "failed"},
+                                     status=500)
+
+        latency_ms = (req.finish_time - req.arrival_time) * 1000.0
+        self._recent_latencies = (self._recent_latencies + [latency_ms])[-1000:]
+        n_gen = len(req.generated_tokens)
+        self.observer("inference_request", {
+            "latency_ms": latency_ms, "ttft_ms": req.ttft_ms,
+            "prompt_tokens": req.num_prompt_tokens, "tokens": n_gen,
+        })
+        return web.json_response({
+            "id": req.request_id,
+            "object": "text_completion",
+            "created": int(time.time()),
+            "model": self.model_cfg.name,
+            "choices": [{
+                "index": 0,
+                "text": self.tokenizer.decode(req.generated_tokens),
+                "token_ids": req.generated_tokens,
+                "finish_reason": req.finish_reason,
+            }],
+            "usage": {
+                "prompt_tokens": req.num_prompt_tokens,
+                "completion_tokens": n_gen,
+                "total_tokens": req.num_prompt_tokens + n_gen,
+            },
+            "metrics": {"ttft_ms": req.ttft_ms, "latency_ms": latency_ms},
+        })
+
+    async def handle_models(self, request: web.Request) -> web.Response:
+        return web.json_response({
+            "object": "list",
+            "data": [{"id": self.model_cfg.name, "object": "model",
+                      "owned_by": "llmctl",
+                      "max_model_len": self.serve_cfg.max_seq_len}],
+        })
+
+    async def handle_health(self, request: web.Request) -> web.Response:
+        with self._lock:
+            stats = self.engine.stats()
+        lats = sorted(self._recent_latencies)
+        p50 = lats[len(lats) // 2] if lats else None
+        return web.json_response({
+            "status": "healthy",
+            "model": self.model_cfg.name,
+            "engine": stats,
+            "p50_latency_ms": p50,
+        })
+
+    async def handle_stats(self, request: web.Request) -> web.Response:
+        with self._lock:
+            return web.json_response(self.engine.stats())
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        try:
+            from prometheus_client import generate_latest
+            payload = generate_latest()
+        except Exception:
+            payload = b""
+        return web.Response(body=payload, content_type="text/plain")
+
+    def _build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_post("/v1/completions", self.handle_completions)
+        app.router.add_get("/v1/models", self.handle_models)
+        app.router.add_get("/v1/stats", self.handle_stats)
+        app.router.add_get("/health", self.handle_health)
+        app.router.add_get("/metrics", self.handle_metrics)
+        return app
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def start_async(self) -> web.AppRunner:
+        self.start_engine()
+        runner = web.AppRunner(self.app)
+        await runner.setup()
+        site = web.TCPSite(runner, self.serve_cfg.host, self.serve_cfg.port)
+        await site.start()
+        logger.info("serving %s on %s:%d", self.model_cfg.name,
+                    self.serve_cfg.host, self.serve_cfg.port)
+        return runner
+
+    def run_forever(self) -> None:
+        async def _main():
+            runner = await self.start_async()
+            try:
+                while True:
+                    await asyncio.sleep(3600)
+            finally:
+                await runner.cleanup()
+                self.stop_engine()
+        asyncio.run(_main())
+
+
+def create_inference_server(model_cfg: ModelConfig, serve_cfg: ServeConfig,
+                            params=None, observer=None) -> InferenceServer:
+    return InferenceServer(model_cfg, serve_cfg, params=params,
+                           observer=observer)

@@ -1,0 +1,65 @@
+"""Tokenizer abstraction for serving and eval.
+
+The reference always loads a HuggingFace tokenizer from the hub
+(reference serve/server.py:151-160, engine.py:125-134) — which requires
+network access. Here:
+
+- If the artifact directory contains HF tokenizer files, use them
+  (transformers is in the environment; loading from a local dir is offline).
+- Otherwise fall back to a self-contained byte-level tokenizer: ids are raw
+  UTF-8 bytes, with EOS/BOS above 255 when the model vocab has room. This
+  keeps `llmctl serve` and `llmctl eval` fully functional with zero egress.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional, Sequence
+
+
+class ByteTokenizer:
+    """UTF-8 byte tokenizer: token id == byte value; specials above 255."""
+
+    def __init__(self, vocab_size: int = 512):
+        self.vocab_size = vocab_size
+        self.bos_token_id: Optional[int] = 256 if vocab_size > 257 else None
+        self.eos_token_id: Optional[int] = 257 if vocab_size > 257 else None
+
+    def encode(self, text: str) -> list[int]:
+        ids = list(text.encode("utf-8"))
+        if self.vocab_size < 256:  # tiny test vocabs: clamp into range
+            ids = [i % self.vocab_size for i in ids]
+        return ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        data = bytes(i for i in ids if 0 <= i < 256)
+        return data.decode("utf-8", errors="replace")
+
+
+class HFTokenizerAdapter:
+    """Wraps a locally-stored HuggingFace tokenizer (no hub access)."""
+
+    def __init__(self, path: str | Path):
+        from transformers import AutoTokenizer  # local dir load, offline
+        self._tok = AutoTokenizer.from_pretrained(str(path))
+        self.vocab_size = len(self._tok)
+        self.eos_token_id = self._tok.eos_token_id
+        self.bos_token_id = self._tok.bos_token_id
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok.encode(text, add_special_tokens=False)
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=True)
+
+
+def load_tokenizer(artifact_dir: Optional[str | Path], vocab_size: int):
+    """HF tokenizer from the artifact dir when present, else byte-level."""
+    if artifact_dir:
+        p = Path(artifact_dir)
+        if (p / "tokenizer.json").exists() or (p / "tokenizer_config.json").exists():
+            try:
+                return HFTokenizerAdapter(p)
+            except Exception:   # corrupt/partial tokenizer dir: fall through
+                pass
+    return ByteTokenizer(vocab_size)
