@@ -1,0 +1,113 @@
+"""`llmctl admin` — checkpoint GC, tensor inspection, dataset indexing.
+
+Un-stubs the reference's admin command (reference cli/commands/admin.py:9-29,
+SURVEY §2 row 22).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import click
+
+
+@click.group(name="admin", invoke_without_command=True)
+@click.pass_context
+def app(ctx):
+    """Maintenance utilities."""
+    if ctx.invoked_subcommand is None:
+        click.echo(ctx.get_help())
+
+
+@app.command()
+@click.option("--ckpt", "ckpt_dir", required=True,
+              type=click.Path(exists=True, file_okay=False))
+@click.option("--keep-latest", default=5, show_default=True)
+@click.option("--dry-run", is_flag=True)
+def gc(ckpt_dir, keep_latest, dry_run):
+    """Garbage-collect old checkpoints, keeping the newest N
+    (the reference's save_total_limit is never enforced, SURVEY §5.4)."""
+    from ...io.checkpoint import CheckpointManager
+
+    ckpt = CheckpointManager(ckpt_dir, keep_latest=keep_latest)
+    steps = ckpt.all_steps()
+    doomed = steps[:-keep_latest] if len(steps) > keep_latest else []
+    if not doomed:
+        click.echo(f"nothing to collect ({len(steps)} checkpoints <= "
+                   f"keep_latest {keep_latest})")
+        return
+    if dry_run:
+        click.echo(f"would remove steps: {doomed}")
+        return
+    ckpt._gc()
+    click.echo(f"removed steps: {doomed}; kept {ckpt.all_steps()}")
+
+
+@app.command()
+@click.option("--ckpt", "ckpt_dir", required=True,
+              type=click.Path(exists=True, file_okay=False))
+@click.option("--step", default=None, type=int)
+@click.option("--limit", default=40, show_default=True,
+              help="Max tensors to list.")
+def inspect(ckpt_dir, step, limit):
+    """List tensors in a checkpoint: path, shape, dtype, bytes."""
+    import numpy as np
+
+    from ...io.checkpoint import CheckpointManager
+    from ...utils.tree import flatten_with_paths
+
+    ckpt = CheckpointManager(ckpt_dir)
+    if ckpt.latest_step() is None:
+        raise click.ClickException(f"no checkpoints under {ckpt_dir}")
+    state, extra = ckpt.restore(step=step)
+    flat = flatten_with_paths(state)
+    total_bytes = 0
+    total_params = 0
+    for i, (path, arr) in enumerate(flat):
+        a = np.asarray(arr)
+        total_bytes += a.nbytes
+        total_params += a.size
+        if i < limit:
+            click.echo(f"  {path}  {a.shape}  {a.dtype}  {a.nbytes / 1e6:.2f} MB")
+    if len(flat) > limit:
+        click.echo(f"  ... {len(flat) - limit} more tensors")
+    click.echo(f"step {step or ckpt.latest_step()}: {len(flat)} tensors, "
+               f"{total_params / 1e6:.1f}M values, {total_bytes / 1e9:.2f} GB")
+    if extra:
+        click.echo(f"extra keys: {sorted(extra)}")
+
+
+@app.command()
+@click.option("--data", "data_dir", required=True,
+              type=click.Path(exists=True, file_okay=False))
+@click.option("--out", "out_path", default=None,
+              type=click.Path(dir_okay=False))
+def index(data_dir, out_path):
+    """Index tokenized dataset shards: docs, tokens, bytes per shard."""
+    from ...io.data import _discover_shards
+
+    shards = _discover_shards(data_dir)
+    if not shards:
+        raise click.ClickException(f"no token shards under {data_dir}")
+    rows = []
+    for s in shards:
+        rows.append({
+            "path": str(s.path),
+            "num_documents": int(len(s.doc_bounds) - 1),
+            "num_tokens": int(s.num_tokens),
+            "dtype": str(s.dtype),
+            "bytes": Path(s.path).stat().st_size,
+        })
+        click.echo(f"  {Path(s.path).name}: {rows[-1]['num_documents']} docs, "
+                   f"{rows[-1]['num_tokens']} tokens")
+    summary = {
+        "shards": rows,
+        "total_documents": sum(r["num_documents"] for r in rows),
+        "total_tokens": sum(r["num_tokens"] for r in rows),
+    }
+    click.echo(f"total: {summary['total_documents']} docs, "
+               f"{summary['total_tokens']} tokens in {len(rows)} shards")
+    if out_path:
+        Path(out_path).write_text(json.dumps(summary, indent=2))
+        click.echo(f"index written to {out_path}")

@@ -1,0 +1,224 @@
+"""`llmctl bench` — real benchmarks.
+
+Un-stubs the entirely-"coming soon" reference bench command
+(reference cli/commands/bench.py:13-75, SURVEY §2 row 19): kernels, e2e
+train/serve, collectives, dataloader — every number measured on the live
+backend.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import click
+
+
+from ...utils.timing import time_fn as _timed
+
+
+@click.group(name="bench", invoke_without_command=True)
+@click.pass_context
+def app(ctx):
+    """Benchmarks (kernels, end-to-end, comms, dataloader)."""
+    if ctx.invoked_subcommand is None:
+        click.echo(ctx.get_help())
+
+
+@app.command()
+@click.option("--op", default="all", show_default=True,
+              type=click.Choice(["attention", "flash", "matmul", "rmsnorm",
+                                 "rope", "all"]))
+@click.option("--seq-len", default=1024, show_default=True)
+@click.option("--hidden", default=1024, show_default=True)
+@click.option("--heads", default=8, show_default=True)
+@click.option("--batch", default=4, show_default=True)
+def kernels(op, seq_len, hidden, heads, batch):
+    """Micro-benchmark core ops (parity: reference bench.py:13-33 flags)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...models import layers
+
+    D = hidden // heads
+    key = jax.random.PRNGKey(0)
+    results = {}
+
+    if op in ("matmul", "all"):
+        a = jax.random.normal(key, (seq_len * batch, hidden), jnp.bfloat16)
+        w = jax.random.normal(key, (hidden, hidden), jnp.bfloat16)
+        sec = _timed(jax.jit(lambda x, y: x @ y), a, w)
+        results["matmul"] = {
+            "time_ms": sec * 1e3,
+            "tflops": 2 * a.shape[0] * hidden * hidden / sec / 1e12}
+
+    if op in ("attention", "flash", "all"):
+        shape = (batch, seq_len, heads, D)
+        q, k, v = (jax.random.normal(jax.random.PRNGKey(i), shape,
+                                     jnp.bfloat16) for i in range(3))
+        pos = jnp.arange(seq_len, dtype=jnp.int32)[None].repeat(batch, 0)
+        mask = layers.attention_mask(pos, pos)
+        sec = _timed(jax.jit(
+            lambda q, k, v: layers.dot_product_attention(q, k, v, mask)),
+            q, k, v)
+        results["attention_xla"] = {"time_ms": sec * 1e3}
+        if jax.default_backend() == "tpu" and op in ("flash", "all"):
+            from ...ops.attention import flash_attention
+            sec_f = _timed(jax.jit(
+                lambda q, k, v: flash_attention(q, k, v, causal=True)),
+                q, k, v)
+            results["attention_flash"] = {
+                "time_ms": sec_f * 1e3,
+                "speedup_vs_xla": sec / sec_f}
+
+    if op in ("rmsnorm", "all"):
+        x = jax.random.normal(key, (batch, seq_len, hidden), jnp.bfloat16)
+        s = jnp.zeros((hidden,), jnp.bfloat16)
+        sec = _timed(jax.jit(lambda x, s: layers.rms_norm(x, s)), x, s)
+        results["rmsnorm"] = {"time_ms": sec * 1e3}
+
+    if op in ("rope", "all"):
+        x = jax.random.normal(key, (batch, seq_len, heads, D), jnp.bfloat16)
+        pos = jnp.arange(seq_len, dtype=jnp.int32)[None].repeat(batch, 0)
+        freqs = layers.rope_frequencies(D)
+        sec = _timed(jax.jit(
+            lambda x, p: layers.apply_rope(x, p, freqs)), x, pos)
+        results["rope"] = {"time_ms": sec * 1e3}
+
+    click.echo(json.dumps(results, indent=2))
+
+
+@app.command()
+@click.option("--model", "model_name", default="gpt-test", show_default=True)
+@click.option("--mode", default="train", show_default=True,
+              type=click.Choice(["train", "serve", "both"]))
+@click.option("--steps", default=10, show_default=True)
+@click.option("--batch", default=4, show_default=True)
+@click.option("--seq-len", default=None, type=int)
+@click.option("--prompt-len", default=128, show_default=True)
+@click.option("--gen-len", default=64, show_default=True)
+@click.option("--requests", default=8, show_default=True)
+def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
+        requests):
+    """End-to-end train step throughput / serve TTFT+throughput
+    (parity: reference bench.py:35-49)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ...config.presets import get_model_config
+    from ...config.schema import OptimizerConfig, ParallelConfig, ServeConfig
+
+    cfg = get_model_config(model_name)
+    on_tpu = jax.default_backend() == "tpu"
+    seq_len = seq_len or min(1024 if on_tpu else 128,
+                             cfg.max_position_embeddings)
+    results = {}
+
+    if mode in ("train", "both"):
+        from ...exec.train_step import TrainState, make_train_step
+        from ...models import init
+        from ...models.gpt import flops_per_token
+
+        par = ParallelConfig(micro_batch_size=batch, global_batch_size=batch,
+                             activation_checkpoint="selective")
+        step_fn, tx, _ = make_train_step(
+            cfg, OptimizerConfig(lr=1e-4), par,
+            attn_impl="flash" if on_tpu else "xla")
+        state = TrainState.create(init(cfg, jax.random.PRNGKey(0)), tx)
+        tokens = jnp.ones((batch, seq_len), jnp.int32)
+        batch_d = {"tokens": tokens}
+        state, _ = jax.block_until_ready(step_fn(state, batch_d))  # compile
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step_fn(state, batch_d)
+        jax.block_until_ready(metrics["loss"])
+        dt = time.perf_counter() - t0
+        tok_s = steps * batch * seq_len / dt
+        results["train"] = {
+            "tokens_per_sec": tok_s,
+            "step_ms": dt / steps * 1e3,
+            "model_tflops_per_sec": tok_s * flops_per_token(cfg, seq_len) / 1e12,
+        }
+
+    if mode in ("serve", "both"):
+        from ...serve import InferenceEngine, SamplingParams
+
+        eng = InferenceEngine(cfg, ServeConfig(
+            model=model_name, max_batch_size=min(requests, 8),
+            max_seq_len=min(prompt_len + gen_len + 16,
+                            cfg.max_position_embeddings),
+            kv_block_size=16, dtype="bfloat16" if on_tpu else "float32"))
+        rng = np.random.default_rng(0)
+        prompts = [[int(t) for t in rng.integers(1, cfg.vocab_size,
+                                                 size=prompt_len)]
+                   for _ in range(requests)]
+        # warmup compile with one request
+        eng.generate([prompts[0]], SamplingParams(temperature=0.0,
+                                                  max_tokens=2))
+        t0 = time.perf_counter()
+        reqs = eng.generate(prompts, SamplingParams(temperature=0.0,
+                                                    max_tokens=gen_len))
+        dt = time.perf_counter() - t0
+        ttfts = sorted(r.ttft_ms for r in reqs)
+        total_tokens = sum(len(r.generated_tokens) for r in reqs)
+        results["serve"] = {
+            "p50_ttft_ms": ttfts[len(ttfts) // 2],
+            "p99_ttft_ms": ttfts[-1],
+            "tokens_per_sec": total_tokens / dt,
+            "requests": requests,
+        }
+
+    click.echo(json.dumps(results, indent=2))
+
+
+@app.command()
+@click.option("--pattern", default="all", show_default=True,
+              type=click.Choice(["allreduce", "all_gather", "reduce_scatter",
+                                 "ppermute", "all_to_all", "all"]))
+@click.option("--size-mb", default=16.0, show_default=True, type=float)
+@click.option("--devices", "n_devices", default=None, type=int,
+              help="Mesh size (default: all available).")
+def comms(pattern, size_mb, n_devices):
+    """Measure real collectives over the live mesh
+    (parity: reference bench.py:51-64, which was a stub; the reference's
+    comm 'tuner' was simulated, autotuning.py:222-245)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from ...comms.bench import bench_all, bench_collective
+
+    devs = jax.devices()[:n_devices] if n_devices else jax.devices()
+    if len(devs) < 2:
+        raise click.ClickException(
+            "need >=2 devices for collectives; run under "
+            "JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8")
+    mesh = Mesh(devs, ("x",))
+    if pattern == "all":
+        rows = bench_all(mesh, "x", size_mb)
+    else:
+        rows = [bench_collective(mesh, "x", pattern, size_mb)]
+    click.echo(json.dumps(rows, indent=2))
+
+
+@app.command()
+@click.option("--path", default="synthetic", show_default=True)
+@click.option("--batch", default=8, show_default=True)
+@click.option("--seq-len", default=1024, show_default=True)
+@click.option("--batches", default=50, show_default=True)
+def dataloader(path, batch, seq_len, batches):
+    """Dataset streaming throughput (parity: reference bench.py:66-75)."""
+    from ...io.data import make_dataset
+
+    ds = make_dataset(path, batch, seq_len, vocab_size=50304, seed=0)
+    next(ds)  # warm
+    t0 = time.perf_counter()
+    for _ in range(batches):
+        next(ds)
+    dt = time.perf_counter() - t0
+    toks = batches * batch * seq_len
+    click.echo(json.dumps({
+        "tokens_per_sec": toks / dt,
+        "batches_per_sec": batches / dt,
+        "MB_per_sec": toks * 4 / dt / 1e6,
+    }, indent=2))

@@ -1,0 +1,108 @@
+"""`llmctl eval` — model evaluation (perplexity + simple tasks).
+
+Un-stubs the reference's `eval run` "coming soon"
+(reference cli/commands/eval.py:30, SURVEY §2 row 17): loads a checkpoint,
+streams an eval dataset, and reports loss/perplexity; ``--suite tasks`` adds
+greedy-completion accuracy probes.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import click
+
+
+@click.group(name="eval", invoke_without_command=True)
+@click.pass_context
+def app(ctx):
+    """Evaluation suites."""
+    if ctx.invoked_subcommand is None:
+        click.echo(ctx.get_help())
+
+
+@app.command()
+@click.option("--ckpt", "ckpt_dir", default=None,
+              type=click.Path(file_okay=False),
+              help="Checkpoint directory (omit for random init smoke eval).")
+@click.option("--model", "model_name", default="gpt-test", show_default=True)
+@click.option("--data", "data_path", default="synthetic", show_default=True,
+              help="Eval dataset path (token shards) or 'synthetic'.")
+@click.option("--suite", default="perplexity", show_default=True,
+              type=click.Choice(["perplexity", "tasks", "all"]))
+@click.option("--batches", default=16, show_default=True)
+@click.option("--batch-size", default=8, show_default=True)
+@click.option("--seq-len", default=512, show_default=True)
+@click.option("--out", "out_path", default=None,
+              type=click.Path(dir_okay=False), help="Write results JSON.")
+def run(ckpt_dir, model_name, data_path, suite, batches, batch_size, seq_len,
+        out_path):
+    """Evaluate a checkpoint: perplexity over a dataset, optional tasks."""
+    import json
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ...config.presets import get_model_config
+    from ...exec.train_step import make_eval_step
+    from ...io.data import make_dataset
+    from ...models import gpt
+
+    cfg = get_model_config(model_name)
+    seq_len = min(seq_len, cfg.max_position_embeddings)
+
+    if ckpt_dir and Path(ckpt_dir).exists():
+        from ...io.checkpoint import CheckpointManager
+        ckpt = CheckpointManager(ckpt_dir)
+        if ckpt.latest_step() is None:
+            raise click.ClickException(f"no checkpoints under {ckpt_dir}")
+        from ...io.checkpoint import params_from_flat
+        state, _ = ckpt.restore()
+        params = params_from_flat(state)
+        params = jax.tree_util.tree_map(jnp.asarray, params)
+        click.echo(f"loaded checkpoint step {ckpt.latest_step()}")
+    else:
+        params = gpt.init(cfg, jax.random.PRNGKey(0))
+        click.echo("no checkpoint given: evaluating random init (smoke mode)")
+
+    results: dict = {"model": model_name, "suite": suite}
+
+    if suite in ("perplexity", "all"):
+        data = make_dataset(data_path, batch_size, seq_len, cfg.vocab_size,
+                            seed=0)
+        eval_step = make_eval_step(cfg)
+        losses, counts = [], []
+        for _ in range(batches):
+            out = eval_step(params, next(data))
+            losses.append(float(out["loss"]))
+            counts.append(float(out["tokens"]))
+        total = float(np.sum(counts))
+        loss = float(np.sum([l * c for l, c in zip(losses, counts)])) / max(total, 1)
+        ppl = float(np.exp(min(loss, 30.0)))
+        results["perplexity"] = {"loss": loss, "perplexity": ppl,
+                                 "tokens": total}
+        click.echo(f"perplexity: loss={loss:.4f} ppl={ppl:.2f} "
+                   f"({total:.0f} tokens)")
+
+    if suite in ("tasks", "all"):
+        # greedy next-token recall on repeated patterns: a model-free probe
+        # that any trained LM should beat chance on
+        rng = np.random.default_rng(0)
+        correct = total_probes = 0
+        for _ in range(min(batches, 8)):
+            pattern = rng.integers(1, cfg.vocab_size,
+                                   size=4).astype(np.int32)
+            prompt = np.tile(pattern, 8)[:-1]
+            logits = gpt.forward(params, jnp.asarray(prompt[None]), cfg)
+            pred = int(jnp.argmax(logits[0, -1]))
+            correct += int(pred == int(pattern[-1]))
+            total_probes += 1
+        results["tasks"] = {"pattern_recall_acc": correct / total_probes,
+                            "probes": total_probes}
+        click.echo(f"pattern-recall accuracy: {correct}/{total_probes}")
+
+    if out_path:
+        Path(out_path).parent.mkdir(parents=True, exist_ok=True)
+        Path(out_path).write_text(json.dumps(results, indent=2))
+        click.echo(f"results written to {out_path}")

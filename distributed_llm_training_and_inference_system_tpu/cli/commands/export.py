@@ -1,0 +1,54 @@
+"""`llmctl export` — checkpoint conversion.
+
+Un-stubs the reference's `export convert` "coming soon"
+(reference cli/commands/export.py:29, SURVEY §2 row 18): safetensors/npz
+export with optional int8 quantization (ops/quantization.py), from a
+checkpoint dir or fresh init.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import click
+
+
+@click.group(name="export", invoke_without_command=True)
+@click.pass_context
+def app(ctx):
+    """Model export and conversion."""
+    if ctx.invoked_subcommand is None:
+        click.echo(ctx.get_help())
+
+
+@app.command()
+@click.option("--ckpt", "ckpt_dir", required=True,
+              type=click.Path(exists=True, file_okay=False),
+              help="Checkpoint directory (CheckpointManager layout).")
+@click.option("--format", "fmt", default="safetensors", show_default=True,
+              type=click.Choice(["safetensors", "npz"]))
+@click.option("--quant", default=None, type=click.Choice(["int8"]),
+              help="Quantize weights before export.")
+@click.option("--out", "out_path", required=True,
+              type=click.Path(dir_okay=False))
+@click.option("--step", default=None, type=int,
+              help="Checkpoint step (default: latest).")
+def convert(ckpt_dir, fmt, quant, out_path, step):
+    """Convert a training checkpoint into a deployment artifact."""
+    from ...io.checkpoint import CheckpointManager
+    from ...io.export import export_params
+
+    ckpt = CheckpointManager(ckpt_dir)
+    if ckpt.latest_step() is None:
+        raise click.ClickException(f"no checkpoints under {ckpt_dir}")
+    from ...io.checkpoint import params_from_flat
+    state, extra = ckpt.restore(step=step)
+    params = params_from_flat(state)
+    meta = {"source_step": str(step or ckpt.latest_step())}
+    if isinstance(extra, dict) and "config" in extra:
+        meta["model"] = str(extra["config"].get("model", ""))
+    path = export_params(params, out_path, fmt=fmt, quant=quant,
+                         metadata=meta)
+    size_mb = Path(path).stat().st_size / 1e6
+    click.echo(f"exported {fmt}{'+' + quant if quant else ''} artifact: "
+               f"{path} ({size_mb:.1f} MB)")

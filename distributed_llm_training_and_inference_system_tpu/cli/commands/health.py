@@ -1,0 +1,103 @@
+"""`llmctl health` — health checks and drift detection.
+
+Parity: reference cli/commands/health.py (check :15-50, drift :114-186),
+driven by metrics/health.py monitors.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+import click
+
+
+def _display(report):
+    from rich.console import Console
+    from rich.table import Table
+
+    console = Console()
+    table = Table(title=f"Health: {report.status.value.upper()}")
+    table.add_column("Check")
+    table.add_column("Status")
+    table.add_column("Value", justify="right")
+    table.add_column("Message")
+    for c in report.checks:
+        color = {"healthy": "green", "warning": "yellow",
+                 "critical": "red"}.get(c.status.value, "white")
+        table.add_row(c.name, f"[{color}]{c.status.value}[/{color}]",
+                      f"{c.value:.1f}" if c.value is not None else "-",
+                      c.message)
+    console.print(table)
+
+
+@click.group(name="health", invoke_without_command=True)
+@click.pass_context
+def app(ctx):
+    """System health."""
+    if ctx.invoked_subcommand is None:
+        ctx.invoke(check)
+
+
+@app.command()
+@click.option("--monitor-duration", default=0.0, show_default=True,
+              help="Seconds to keep monitoring (0 = one-shot).")
+@click.option("--interval", default=5.0, show_default=True)
+@click.option("--json", "as_json", is_flag=True)
+def check(monitor_duration, interval, as_json):
+    """Run health checks once or continuously."""
+    from ...metrics.health import HealthManager
+
+    mgr = HealthManager(interval=interval)
+    deadline = time.monotonic() + monitor_duration
+    while True:
+        report = mgr.run_checks()
+        if as_json:
+            click.echo(json.dumps(report.to_dict()))
+        else:
+            _display(report)
+        if time.monotonic() >= deadline:
+            break
+        time.sleep(interval)
+    if report.status.value == "critical":
+        raise SystemExit(1)
+
+
+@app.command()
+@click.option("--baseline", "baseline_path", required=True,
+              type=click.Path(exists=True, dir_okay=False),
+              help="Baseline metrics JSON ({metric: value}).")
+@click.option("--current", "current_path", default=None,
+              type=click.Path(exists=True, dir_okay=False),
+              help="Current metrics JSON (default: re-measure system).")
+@click.option("--tolerance", default=10.0, show_default=True,
+              help="Allowed drift percent.")
+def drift(baseline_path, current_path, tolerance):
+    """Compare current metrics to a baseline; exit 1 on drift
+    (parity: reference health.py:114-186)."""
+    baseline = json.loads(Path(baseline_path).read_text())
+    if current_path:
+        current = json.loads(Path(current_path).read_text())
+    else:
+        from ...metrics.observability import MetricsCollector
+        s = MetricsCollector().sample_once()
+        current = {"cpu_percent": s.cpu_percent,
+                   "memory_percent": s.memory_percent}
+
+    drifted = []
+    for metric, base_val in baseline.items():
+        if metric not in current or not isinstance(base_val, (int, float)):
+            continue
+        cur = current[metric]
+        pct = (abs(cur - base_val) / abs(base_val) * 100.0
+               if base_val else (100.0 if cur else 0.0))
+        status = "DRIFT" if pct > tolerance else "ok"
+        click.echo(f"{metric}: baseline={base_val:.3f} current={cur:.3f} "
+                   f"({pct:+.1f}%) {status}")
+        if pct > tolerance:
+            drifted.append(metric)
+    if drifted:
+        click.echo(f"drift detected in: {', '.join(drifted)}")
+        raise SystemExit(1)
+    click.echo("no drift beyond tolerance")

@@ -1,0 +1,167 @@
+"""`llmctl hw` — hardware probe and microbenchmark.
+
+Parity: reference cli/commands/hw.py (probe :133-282, benchmark :284-345) —
+reshaped for TPU: the probe reads `jax.devices()` / chip topology / HBM
+instead of nvidia-smi, and the benchmark measures real matmul FLOPs and
+memory bandwidth on the active backend (the reference hardcodes A100 limits,
+hw.py:179-184).
+"""
+
+from __future__ import annotations
+
+import platform as _platform
+from pathlib import Path
+
+import click
+
+from ...utils.tomlio import dump_toml
+
+
+def _cpu_info() -> dict:
+    import psutil
+    freq = psutil.cpu_freq()
+    return {
+        "model": _platform.processor() or _platform.machine(),
+        "cores_physical": psutil.cpu_count(logical=False) or 0,
+        "cores_logical": psutil.cpu_count(logical=True) or 0,
+        "freq_mhz": freq.current if freq else 0.0,
+    }
+
+
+def _memory_info() -> dict:
+    import psutil
+    vm = psutil.virtual_memory()
+    return {"total_gb": vm.total / 1e9, "available_gb": vm.available / 1e9}
+
+
+def _chip_info() -> dict:
+    """TPU probe: devices, topology coords, memory stats where exposed."""
+    import jax
+    devices = jax.devices()
+    d0 = devices[0]
+    info = {
+        "platform": d0.platform,
+        "num_chips": len(devices),
+        "num_hosts": jax.process_count(),
+        "device_kind": d0.device_kind,
+        "devices": [
+            {"id": d.id, "process": d.process_index,
+             "coords": list(getattr(d, "coords", []) or []),
+             "core_on_chip": getattr(d, "core_on_chip", 0)}
+            for d in devices
+        ],
+    }
+    try:
+        stats = d0.memory_stats()
+        if stats:
+            info["hbm_gb_per_chip"] = stats.get("bytes_limit", 0) / 1e9
+    except Exception:
+        pass
+    return info
+
+
+# public datasheet peaks per chip kind (bf16 TFLOPs, HBM GB/s)
+_KNOWN_CHIPS = {
+    "v4": (275.0, 1228.0), "v5e": (197.0, 819.0), "v5p": (459.0, 2765.0),
+    "v6e": (918.0, 1640.0),
+}
+
+
+def _limits(chips: dict) -> dict:
+    kind = chips.get("device_kind", "").lower()
+    for name, (tflops, bw) in _KNOWN_CHIPS.items():
+        if name in kind:
+            return {"peak_bf16_tflops": tflops, "hbm_bw_gbps": bw,
+                    "source": "datasheet"}
+    return {"peak_bf16_tflops": 0.2, "hbm_bw_gbps": 50.0,
+            "source": "cpu-fallback"}
+
+
+@click.group(name="hw", invoke_without_command=True)
+@click.pass_context
+def app(ctx):
+    """Hardware probing and benchmarking."""
+    if ctx.invoked_subcommand is None:
+        ctx.invoke(probe)
+
+
+@app.command()
+@click.option("--emit", "emit_path", default=None,
+              type=click.Path(dir_okay=False),
+              help="Write the profile to a TOML/JSON file.")
+def probe(emit_path):
+    """Probe CPU, memory, and accelerator chips; optionally emit a profile."""
+    from rich.console import Console
+    from rich.table import Table
+
+    cpu, mem, chips = _cpu_info(), _memory_info(), _chip_info()
+    limits = _limits(chips)
+    profile = {
+        "system": {"os": _platform.system(), "python": _platform.python_version()},
+        "cpu": cpu, "memory": mem, "chips": chips, "limits": limits,
+        "hardware": {
+            "platform": chips["platform"],
+            "chip_type": chips["device_kind"],
+            "num_chips": chips["num_chips"],
+            "num_hosts": chips["num_hosts"],
+            "hbm_gb_per_chip": chips.get("hbm_gb_per_chip", 0.0),
+            "peak_bf16_tflops": limits["peak_bf16_tflops"],
+            "hbm_bw_gbps": limits["hbm_bw_gbps"],
+        },
+    }
+
+    console = Console()
+    table = Table(title="Hardware Profile")
+    table.add_column("Component")
+    table.add_column("Details")
+    table.add_row("Platform", f"{chips['platform']} ({chips['device_kind']})")
+    table.add_row("Chips", f"{chips['num_chips']} on {chips['num_hosts']} host(s)")
+    table.add_row("CPU", f"{cpu['model']} ({cpu['cores_logical']} threads)")
+    table.add_row("Host memory", f"{mem['total_gb']:.1f} GB")
+    if "hbm_gb_per_chip" in chips:
+        table.add_row("HBM / chip", f"{chips['hbm_gb_per_chip']:.1f} GB")
+    table.add_row("Peak bf16", f"{limits['peak_bf16_tflops']:.1f} TFLOPs/chip "
+                               f"({limits['source']})")
+    console.print(table)
+
+    if emit_path:
+        p = Path(emit_path)
+        if p.suffix == ".json":
+            import json
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_text(json.dumps(profile, indent=2))
+        else:
+            dump_toml(profile, p)
+        click.echo(f"Profile written to {p}")
+
+
+@app.command()
+@click.option("--matmul-size", default=2048, show_default=True)
+@click.option("--mem-size-mb", default=256, show_default=True)
+def benchmark(matmul_size: int, mem_size_mb: int):
+    """Measure achieved matmul TFLOPs and HBM bandwidth (real, not assumed).
+
+    Parity: reference hw.py:284-345 (numpy memory + torch matmul) — but on
+    the JAX backend so the numbers are the chips', not the host's.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ...utils.timing import time_fn
+
+    n = matmul_size
+    a = jax.random.normal(jax.random.PRNGKey(0), (n, n), jnp.bfloat16)
+    b = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.bfloat16)
+    sec = time_fn(jax.jit(lambda x, y: x @ y), a, b, warmup=1, iters=10)
+    tflops = 2 * n**3 / sec / 1e12
+
+    elems = mem_size_mb * 1024 * 1024 // 4
+    x = jnp.ones((elems,), jnp.float32)
+    sec = time_fn(jax.jit(lambda v: v * 2.0 + 1.0), x, warmup=1, iters=10)
+    # read + write per element
+    bw = 2 * elems * 4 / sec / 1e9
+
+    backend = jax.default_backend()
+    click.echo(f"backend={backend}")
+    click.echo(f"matmul {n}x{n}x{n} bf16: {tflops:.2f} TFLOPs")
+    click.echo(f"memory bandwidth ({mem_size_mb} MB stream): {bw:.1f} GB/s")

@@ -1,0 +1,156 @@
+"""`llmctl plan` — parallelism planning.
+
+Parity: reference cli/commands/plan.py:204-377 (auto search, manual mode,
+rich tables, plan TOML artifact, remediation hints) — driven by the
+TPU cost model in parallel/planner.py, whose plans the executor actually
+runs (the reference's planner output is never consumed by training,
+SURVEY §2.2).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import click
+
+from ...config.presets import HARDWARE_PRESETS, get_hardware_preset, get_model_config
+from ...config.schema import HardwareConfig, ModelConfig, ParallelConfig
+from ...utils.tomlio import dump_toml, load_config_file
+
+
+def _load_model(spec: str) -> ModelConfig:
+    if Path(spec).exists():
+        return ModelConfig.from_dict(load_config_file(spec))
+    return get_model_config(spec)
+
+
+def _load_hw(spec: str) -> HardwareConfig:
+    if spec in HARDWARE_PRESETS:
+        return get_hardware_preset(spec)
+    raw = load_config_file(spec)
+    return HardwareConfig.from_dict(raw.get("hardware", raw))
+
+
+@click.group(name="plan", invoke_without_command=True)
+@click.pass_context
+def app(ctx):
+    """Parallelism planning."""
+    if ctx.invoked_subcommand is None and not ctx.args:
+        click.echo(ctx.get_help())
+
+
+@app.command()
+@click.option("--model", required=True,
+              help="Model template name or config file (JSON/TOML).")
+@click.option("--hardware", required=True,
+              help="Hardware preset name (e.g. v5e-8) or profile file.")
+@click.option("--seq-len", default=2048, show_default=True)
+@click.option("--global-batch", default=32, show_default=True)
+@click.option("--long-context", is_flag=True,
+              help="Search sequence-parallel (ring attention) axes too.")
+@click.option("--tensor-parallel", "-tp", default=None, type=int,
+              help="Manual mode: fix TP degree.")
+@click.option("--pipeline-parallel", "-pp", default=None, type=int)
+@click.option("--sequence-parallel", "-sp", default=None, type=int)
+@click.option("--expert-parallel", "-ep", default=None, type=int)
+@click.option("--fsdp", default=None, type=int)
+@click.option("--zero-stage", default=None, type=int)
+@click.option("--micro-batch", default=None, type=int)
+@click.option("--candidates", default=3, show_default=True,
+              help="How many top plans to display.")
+@click.option("--out", "out_path", default=None,
+              type=click.Path(dir_okay=False), help="Save plan TOML.")
+def compute(model, hardware, seq_len, global_batch, long_context,
+            tensor_parallel, pipeline_parallel, sequence_parallel,
+            expert_parallel, fsdp, zero_stage, micro_batch, candidates,
+            out_path):
+    """Search (or evaluate) a parallelism plan for MODEL on HARDWARE."""
+    from rich.console import Console
+    from rich.table import Table
+
+    from ...parallel.planner import MeshPlanner, manual_plan
+
+    model_cfg = _load_model(model)
+    hw = _load_hw(hardware)
+    console = Console()
+
+    manual = any(v is not None for v in (
+        tensor_parallel, pipeline_parallel, sequence_parallel,
+        expert_parallel, fsdp, zero_stage, micro_batch))
+    if manual:
+        tp = tensor_parallel or 1
+        pp = pipeline_parallel or 1
+        sp = sequence_parallel or 1
+        ep = expert_parallel or 1
+        fs = fsdp or 1
+        dp = max(hw.num_chips // (tp * pp * sp * ep * fs), 1)
+        mb = micro_batch or 1
+        shards = dp * fs
+        par = ParallelConfig(
+            strategy="manual", data_parallel=dp, fsdp=fs,
+            tensor_parallel=tp, pipeline_parallel=pp, sequence_parallel=sp,
+            expert_parallel=ep, zero_stage=zero_stage or 0,
+            micro_batch_size=mb, global_batch_size=global_batch,
+            gradient_accumulation_steps=max(
+                global_batch // max(shards * mb, 1), 1))
+        plans = [manual_plan(model_cfg, hw, par, seq_len, global_batch)]
+    else:
+        planner = MeshPlanner(model_cfg, hw)
+        plans = planner.search(hw.num_chips, seq_len, global_batch,
+                               max_candidates=candidates,
+                               long_context=long_context)
+    if not plans:
+        raise click.ClickException(
+            "no feasible plan found — reduce model/batch or add chips")
+
+    table = Table(title=f"Parallelism plans: {model_cfg.name} on "
+                        f"{hw.chip_type}x{hw.num_chips} "
+                        f"(seq {seq_len}, batch {global_batch})")
+    for col in ("dp", "fsdp", "tp", "pp", "sp", "ep", "zero", "mb",
+                "mem GB/chip", "step ms", "tok/s/chip", "MFU", "fits"):
+        table.add_column(col, justify="right")
+    for p in plans:
+        e, c = p.estimate, p.parallel
+        table.add_row(
+            str(c.data_parallel), str(c.fsdp), str(c.tensor_parallel),
+            str(c.pipeline_parallel), str(c.sequence_parallel),
+            str(c.expert_parallel), str(c.zero_stage),
+            str(c.micro_batch_size), f"{e.total_gb:.1f}",
+            f"{e.step_time_s * 1e3:.0f}", f"{e.tokens_per_sec_per_chip:.0f}",
+            f"{e.mfu * 100:.0f}%", "Y" if e.fits else "N")
+    console.print(table)
+
+    best = plans[0]
+    e = best.estimate
+    breakdown = Table(title="Best plan: per-chip memory & time breakdown")
+    breakdown.add_column("Resource")
+    breakdown.add_column("Value", justify="right")
+    breakdown.add_column("Limit", justify="right")
+    breakdown.add_row("params", f"{e.params_gb:.2f} GB", "")
+    breakdown.add_row("grads", f"{e.grads_gb:.2f} GB", "")
+    breakdown.add_row("optimizer", f"{e.optimizer_gb:.2f} GB", "")
+    breakdown.add_row("activations", f"{e.activations_gb:.2f} GB", "")
+    breakdown.add_row("total", f"{e.total_gb:.2f} GB",
+                      f"{hw.hbm_gb_per_chip:.0f} GB "
+                      + ("OK" if e.fits else "EXCEEDED"))
+    breakdown.add_row("compute", f"{e.compute_time_s * 1e3:.1f} ms", "")
+    breakdown.add_row("dp comm", f"{e.dp_comm_time_s * 1e3:.1f} ms", "")
+    breakdown.add_row("tp comm", f"{e.tp_comm_time_s * 1e3:.1f} ms", "")
+    breakdown.add_row("pp bubble", f"{e.pp_bubble_frac * 100:.0f}%", "")
+    console.print(breakdown)
+
+    if not e.fits:
+        # remediation hints (parity: reference plan.py:366-377)
+        console.print("[yellow]Plan exceeds limits. Consider:[/yellow]")
+        for hint in (
+                "raise --tensor-parallel or --fsdp to shard more",
+                "set --zero-stage 1 (sharded optimizer state)",
+                "use activation_checkpoint=full",
+                "reduce --global-batch or --seq-len"):
+            console.print(f"  - {hint}")
+        if e.reject_reason:
+            console.print(f"  reason: {e.reject_reason}")
+
+    if out_path:
+        dump_toml(best.to_dict(), out_path)
+        click.echo(f"Plan saved to {out_path}")

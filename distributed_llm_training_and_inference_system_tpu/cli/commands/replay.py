@@ -1,0 +1,71 @@
+"""`llmctl replay` — deterministic re-execution of a recorded run.
+
+Un-stubs the reference's replay (reference cli/commands/replay.py:9-12).
+JAX's explicit-PRNG purity makes this structural (SURVEY §5.2): the run
+manifest (written by TrainingEngine at the end of every run) pins config +
+seeds; replay re-executes from scratch and verifies the final loss matches.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import tempfile
+from pathlib import Path
+
+import click
+
+
+@click.group(name="replay", invoke_without_command=True)
+@click.pass_context
+def app(ctx):
+    """Deterministic replay."""
+    if ctx.invoked_subcommand is None:
+        click.echo(ctx.get_help())
+
+
+@app.command()
+@click.argument("manifest", type=click.Path(exists=True))
+@click.option("--tolerance", default=1e-4, show_default=True,
+              help="Allowed relative loss deviation (bitwise runs give 0).")
+@click.option("--steps", default=None, type=int,
+              help="Replay only the first N steps (faster spot check; "
+              "skips the final-loss comparison).")
+def run(manifest, tolerance, steps):
+    """Re-run the training recorded in MANIFEST (a run_manifest.json or the
+    checkpoint dir containing one) and verify the loss trajectory."""
+    from ...config.schema import RunConfig
+    from ...runtime.engine import TrainingEngine
+
+    mpath = Path(manifest)
+    if mpath.is_dir():
+        mpath = mpath / "run_manifest.json"
+    if not mpath.exists():
+        raise click.ClickException(f"no run manifest at {mpath}")
+    m = json.loads(mpath.read_text())
+
+    cfg = RunConfig.from_dict(m["config"])
+    max_steps = steps if steps is not None else m["end_step"]
+    partial = steps is not None and steps < m["end_step"]
+    with tempfile.TemporaryDirectory(prefix="llmctl-replay-") as tmp:
+        cfg.checkpoint.path = tmp      # never clobber the original run
+        cfg.training.max_steps = max_steps
+        click.echo(f"replaying run {m['run_id']}: {max_steps} steps, "
+                   f"seed {m['seed']}")
+        engine = TrainingEngine(cfg)
+        final = engine.train(resume=False)
+
+    if partial:
+        click.echo(f"partial replay done: loss {final['loss']:.6f} at step "
+                   f"{max_steps} (no recorded value to compare)")
+        return
+    recorded = m["final_metrics"].get("loss")
+    if recorded is None:
+        raise click.ClickException("manifest has no recorded final loss")
+    got = final["loss"]
+    rel = (abs(got - recorded) / abs(recorded)) if recorded else abs(got)
+    ok = math.isfinite(got) and rel <= tolerance
+    click.echo(f"recorded loss {recorded:.6f} | replayed {got:.6f} | "
+               f"rel diff {rel:.2e} -> {'MATCH' if ok else 'MISMATCH'}")
+    if not ok:
+        raise SystemExit(1)

@@ -1,0 +1,68 @@
+"""`llmctl serve` — start the inference server.
+
+Parity: reference cli/commands/serve.py:16-61, with the --scheduler/--device
+options actually forwarded (the reference accepts and drops them, defect
+SURVEY §2.4.8).
+"""
+
+from __future__ import annotations
+
+import click
+
+
+@click.group(name="serve", invoke_without_command=True)
+@click.pass_context
+def app(ctx):
+    """Inference serving."""
+    if ctx.invoked_subcommand is None:
+        click.echo(ctx.get_help())
+
+
+@app.command()
+@click.option("--model", "model_name", default="gpt-125m", show_default=True,
+              help="Model template name.")
+@click.option("--artifact", default="", help="Checkpoint dir to load.")
+@click.option("--host", default="0.0.0.0", show_default=True)
+@click.option("--port", default=8080, show_default=True, type=int)
+@click.option("--max-batch-size", default=8, show_default=True, type=int)
+@click.option("--max-seq-len", default=2048, show_default=True, type=int)
+@click.option("--kv-block-size", default=16, show_default=True, type=int)
+@click.option("--kv-hbm-gb", default=4.0, show_default=True, type=float,
+              help="HBM budget for the paged KV cache.")
+@click.option("--scheduler", default="continuous", show_default=True,
+              type=click.Choice(["continuous", "static"]))
+@click.option("--dtype", default=None,
+              type=click.Choice(["bfloat16", "float32"]),
+              help="Serving dtype (default bf16 on TPU, fp32 on CPU).")
+@click.option("--prometheus-port", default=None, type=int,
+              help="Also start a Prometheus scrape endpoint.")
+def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
+          kv_block_size, kv_hbm_gb, scheduler, dtype, prometheus_port):
+    """Start the OpenAI-compatible inference server."""
+    import jax
+
+    from ...config.presets import get_model_config
+    from ...config.schema import ServeConfig
+    from ...metrics.observability import setup_observability
+    from ...serve.server import create_inference_server
+
+    if dtype is None:
+        dtype = "bfloat16" if jax.default_backend() == "tpu" else "float32"
+    model_cfg = get_model_config(model_name)
+    serve_cfg = ServeConfig(
+        model=model_name, artifact=artifact, host=host, port=port,
+        max_batch_size=max_batch_size,
+        max_seq_len=min(max_seq_len, model_cfg.max_position_embeddings),
+        kv_block_size=kv_block_size, kv_hbm_budget_gb=kv_hbm_gb,
+        scheduler=scheduler, dtype=dtype)
+
+    observer = None
+    if prometheus_port:
+        obs = setup_observability(prometheus_port=prometheus_port)
+        observer = lambda event, payload: obs.record_inference(payload)
+
+    server = create_inference_server(model_cfg, serve_cfg, observer=observer)
+    click.echo(f"serving {model_name} on {host}:{port} "
+               f"(backend={jax.default_backend()}, dtype={dtype}, "
+               f"scheduler={scheduler})")
+    server.run_forever()

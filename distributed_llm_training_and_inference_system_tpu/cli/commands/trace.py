@@ -1,0 +1,72 @@
+"""`llmctl trace` — profiler trace capture & inspection.
+
+Un-stubs the reference's trace command (reference cli/commands/trace.py:9-19,
+SURVEY §5.1): capture = run real train steps under ``jax.profiler.trace``
+(TensorBoard/Perfetto format); summarize = inventory the capture.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import click
+
+
+@click.group(name="trace", invoke_without_command=True)
+@click.pass_context
+def app(ctx):
+    """Profiler traces."""
+    if ctx.invoked_subcommand is None:
+        click.echo(ctx.get_help())
+
+
+@app.command()
+@click.option("--config", "config_file", default=None,
+              type=click.Path(exists=True, dir_okay=False))
+@click.option("--model", "model_name", default=None,
+              help="Model template (when no --config).")
+@click.option("--steps", default=5, show_default=True)
+@click.option("--out", "out_dir", default="traces", show_default=True)
+def capture(config_file, model_name, steps, out_dir):
+    """Capture a profiler trace of real training steps."""
+    from ...config.loader import load_run_config
+    from ...config.presets import get_model_config
+    from ...metrics.observability import engine_observer
+    from ...runtime.engine import TrainingEngine
+
+    overrides = {"training": {"max_steps": steps, "profile": True,
+                              "profile_dir": out_dir,
+                              "log_interval": max(steps // 2, 1)},
+                 "checkpoint": {"interval_steps": 10_000_000}}
+    cfg = load_run_config(config_file, cli_overrides=overrides)
+    if model_name:
+        cfg.model = get_model_config(model_name)
+    engine = TrainingEngine(cfg, observer=engine_observer())
+    final = engine.train(resume=False)
+    click.echo(f"captured {steps} steps (final loss "
+               f"{final.get('loss', float('nan')):.4f}) into {out_dir}")
+    click.echo(f"open with: tensorboard --logdir {out_dir}  "
+               "(or load the .trace.json.gz in Perfetto)")
+
+
+@app.command()
+@click.argument("trace_dir", type=click.Path(exists=True, file_okay=False))
+def summarize(trace_dir):
+    """Inventory a captured trace directory."""
+    root = Path(trace_dir)
+    files = sorted(root.rglob("*"), key=lambda p: str(p))
+    n_files = 0
+    total = 0
+    for f in files:
+        if f.is_file():
+            n_files += 1
+            size = f.stat().st_size
+            total += size
+            click.echo(f"  {f.relative_to(root)}  ({size / 1e3:.1f} kB)")
+    if n_files == 0:
+        raise click.ClickException(f"no trace files under {trace_dir}")
+    click.echo(f"{n_files} files, {total / 1e6:.2f} MB total")
+    xplanes = [f for f in files if f.suffix == ".pb" or ".xplane" in f.name]
+    if xplanes:
+        click.echo("xplane captures present: load in TensorBoard's profiler "
+                   "plugin for op-level timing")

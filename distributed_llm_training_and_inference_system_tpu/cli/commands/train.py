@@ -1,0 +1,105 @@
+"""`llmctl train` — launch training.
+
+Parity: reference cli/commands/train.py:15-106 (LaunchConfig assembly,
+dry-run, orchestrator start) — plus the k8s/gke launchers the reference
+advertises but never implemented (defect SURVEY §2.4.5) and an in-process
+`--local` fast path (single-controller JAX needs no torchrun-style
+per-device spawn).
+"""
+
+from __future__ import annotations
+
+import click
+
+from ...runtime.launcher import LaunchConfig, ProcessOrchestrator
+
+
+@click.group(name="train", invoke_without_command=True)
+@click.pass_context
+def app(ctx):
+    """Training workflows."""
+    if ctx.invoked_subcommand is None:
+        click.echo(ctx.get_help())
+
+
+@app.command()
+@click.option("--config", "config_file", default=None,
+              type=click.Path(exists=True, dir_okay=False),
+              help="Run config TOML/JSON (from `llmctl init scaffold`).")
+@click.option("--model", default=None,
+              help="Model template name (overrides config).")
+@click.option("--max-steps", default=None, type=int)
+@click.option("--launcher", default=None,
+              type=click.Choice(["local", "slurm", "mpi", "k8s", "gke"]),
+              help="Multi-host launcher (default: from global -—launcher).")
+@click.option("--nodes", default=None, type=int, help="Number of hosts.")
+@click.option("--in-process", is_flag=True,
+              help="Run the engine in THIS process (no subprocess spawn).")
+@click.option("--no-resume", is_flag=True, help="Ignore existing checkpoints.")
+@click.option("--dry-run", is_flag=True,
+              help="Print the launch plan without starting.")
+@click.option("--set", "overrides", multiple=True, metavar="SEC.KEY=V",
+              help="Config override, repeatable.")
+@click.pass_context
+def launch(ctx, config_file, model, max_steps, launcher, nodes, in_process,
+           no_resume, dry_run, overrides):
+    """Launch a training run (local process, SLURM, MPI, k8s, or GKE)."""
+    root = ctx.obj or {}
+    launcher = launcher or root.get("launcher", "local")
+    nodes = nodes or root.get("nodes", 1)
+
+    if in_process or (launcher == "local" and nodes == 1 and not dry_run):
+        # single-controller JAX: one process drives every local chip — no
+        # reason to pay a subprocess hop (reference spawns torchrun even for
+        # one GPU, launcher.py:97-105)
+        from ...runtime.train_entry import main as train_main
+        args = []
+        if config_file:
+            args += ["--config", config_file]
+        if model:
+            args += ["--model", model]
+        if max_steps is not None:
+            args += ["--max-steps", str(max_steps)]
+        if no_resume:
+            args += ["--no-resume"]
+        for ov in overrides:
+            args += ["--set", ov]
+        raise SystemExit(train_main(args))
+
+    cfg = LaunchConfig(
+        num_hosts=nodes, launcher=launcher, config_file=config_file,
+        deterministic=root.get("deterministic", False),
+        mixed_precision=root.get("mixed_precision", "bf16"),
+        seed=root.get("seed", 42), dry_run=dry_run,
+        extra_args=([a for ov in overrides for a in ("--set", ov)]
+                    + (["--model", model] if model else [])
+                    + (["--max-steps", str(max_steps)]
+                       if max_steps is not None else [])
+                    + (["--no-resume"] if no_resume else [])),
+    )
+    orch = ProcessOrchestrator(cfg)
+    if dry_run:
+        click.echo(orch.launcher.describe())
+        click.echo("dry-run: nothing launched")
+        return
+    rc = orch.start(stream_output=True)
+    raise SystemExit(rc)
+
+
+@app.command()
+@click.option("--config", "config_file", required=True,
+              type=click.Path(exists=True, dir_okay=False))
+def status(config_file):
+    """Show checkpoint/run status for a training config."""
+    from ...config.loader import load_run_config
+    from ...io.checkpoint import CheckpointManager
+
+    cfg = load_run_config(config_file)
+    ckpt = CheckpointManager(cfg.checkpoint.path,
+                             keep_latest=cfg.checkpoint.keep_latest)
+    steps = ckpt.all_steps()
+    if not steps:
+        click.echo("no checkpoints yet")
+        return
+    click.echo(f"checkpoints at steps: {steps} (latest {steps[-1]} of "
+               f"max {cfg.training.max_steps})")

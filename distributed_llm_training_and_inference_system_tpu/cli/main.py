@@ -73,11 +73,28 @@ class _LazyGroup(click.Group):
               help="Bit-deterministic mode (fixed PRNG keys + deterministic XLA ops).")
 @click.option("--log-level", default="INFO", show_default=True)
 @click.option("--otlp-endpoint", default=None, help="OTLP collector endpoint.")
+@click.option("--platform", default=None, type=click.Choice(["tpu", "cpu"]),
+              help="Force the JAX platform (cpu = host simulation).")
+@click.option("--fake-devices", default=None, type=int,
+              help="With --platform cpu: simulate N devices "
+                   "(XLA host-platform device count).")
 @click.pass_context
 def main(ctx, **global_opts):
     """llmctl — TPU-native distributed LLM training and inference control."""
     ctx.ensure_object(dict)
     ctx.obj.update(global_opts)
+    if global_opts.get("fake_devices"):
+        import os
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count="
+                f"{global_opts['fake_devices']}").strip()
+    if global_opts.get("platform"):
+        # works even though the environment's sitecustomize already imported
+        # jax: backends are created lazily, so the live config still wins
+        import jax
+        jax.config.update("jax_platforms", global_opts["platform"])
 
 
 if __name__ == "__main__":

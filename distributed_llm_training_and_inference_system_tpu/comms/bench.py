@@ -22,16 +22,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from . import collectives as cc
 
 
-def _time_fn(fn: Callable, *args, warmup: int = 2, iters: int = 10) -> float:
-    """Median wall-clock seconds per call (device-synchronised)."""
-    for _ in range(warmup):
-        jax.block_until_ready(fn(*args))
-    times = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        jax.block_until_ready(fn(*args))
-        times.append(time.perf_counter() - t0)
-    return float(np.median(times))
+from ..utils.timing import time_fn as _time_fn
 
 
 def _payload(mesh: Mesh, axis: str, size_mb: float, dtype=jnp.float32):

@@ -249,3 +249,24 @@ class CheckpointManager:
             if shardings is not None:
                 state = jax.device_put(state, shardings)
         return state, index.get("extra", {})
+
+
+def params_from_flat(state: Any) -> Any:
+    """Rebuild the nested ``params`` subtree from a target-less ``restore()``
+    result (a flat dict keyed by dotted path). Accepts already-nested trees
+    unchanged — callers that only need model weights (export, eval, serve)
+    use this instead of carrying the optimizer state along."""
+    if not isinstance(state, dict):
+        return state
+    if "params" in state:
+        return state["params"]
+    nested: dict = {}
+    for key, leaf in state.items():
+        if not key.startswith("params."):
+            continue
+        parts = key.split(".")[1:]
+        d = nested
+        for p in parts[:-1]:
+            d = d.setdefault(p, {})
+        d[parts[-1]] = leaf
+    return nested if nested else state

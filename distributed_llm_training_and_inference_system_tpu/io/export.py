@@ -100,6 +100,10 @@ def export_params(params: Any, out_path: str | Path, fmt: str = "safetensors",
             raise ValueError(f"unsupported quant {quant!r} (int8 only for now)")
         params = quantize_tree_int8(params)
     flat = dict(flatten_with_paths(params))
+    # quantized leaves carry a "__quant__": "int8" string marker; markers are
+    # metadata, not tensors (the ".values"/".scale" suffix pair identifies
+    # quantized weights on load)
+    flat = {k: v for k, v in flat.items() if not k.endswith("__quant__")}
     if fmt == "safetensors":
         save_safetensors(flat, out_path, metadata=meta)
     elif fmt == "npz":

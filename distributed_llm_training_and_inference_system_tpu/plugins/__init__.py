@@ -1,0 +1,27 @@
+"""Plugin layer: autotuning (parity: reference llmctl/plugins/).
+
+The pyproject `llmctl.plugins` entry-point group targets modules that exist
+(unlike the reference's dangling entry points, defect SURVEY §2.4.6).
+"""
+
+from .autotuning import (
+    AttentionTuner,
+    AutoTuner,
+    CollectiveTuner,
+    MatMulTuner,
+    Tunable,
+    TuningConfig,
+    TuningResult,
+    create_auto_tuner,
+)
+
+__all__ = [
+    "AttentionTuner",
+    "AutoTuner",
+    "CollectiveTuner",
+    "MatMulTuner",
+    "Tunable",
+    "TuningConfig",
+    "TuningResult",
+    "create_auto_tuner",
+]

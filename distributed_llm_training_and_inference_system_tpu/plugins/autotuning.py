@@ -1,0 +1,350 @@
+"""Kernel & collective autotuning with REAL measurements.
+
+Parity surface: reference plugins/autotuning.py (TuningConfig :21-29,
+TuningResult :31-39, Tunable ABC :41-62, MatMulTuner :64-126,
+AttentionTuner :128-201, CommunicationTuner :203-257, AutoTuner.grid_search
+:259-368, save/load :416-454) — with two deliberate departures:
+
+1. **Everything is measured.** The reference's CommunicationTuner fabricates
+   timings (base_time x backend-factor + gaussian noise,
+   reference autotuning.py:222-245); here collectives are dispatched through
+   shard_map on a live mesh (comms/bench.py) and timed for real.
+2. **The knobs are TPU knobs.** Instead of CUDA block sizes / TF32 flags,
+   the spaces are what actually moves the needle under XLA: matmul
+   precision & accumulation dtype (MXU passes), Pallas grid block sizes for
+   flash attention, collective payload chunking.
+
+Results cache + JSON persistence keep parity with the reference's
+tuning_results/ artifacts (SURVEY §6).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import time
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+logger = logging.getLogger("llmctl.autotuning")
+
+
+# ---------------------------------------------------------------------------
+# Config / result containers (parity: reference autotuning.py:21-39)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class TuningConfig:
+    max_iterations: int = 64
+    timeout_seconds: float = 120.0
+    num_warmup: int = 2
+    num_trials: int = 5
+    convergence_patience: int = 16   # stop after N configs with no gain
+
+
+@dataclass
+class TuningResult:
+    best_params: dict[str, Any]
+    best_latency_ms: float
+    improvement_pct: float           # vs the first valid config measured
+    num_evaluated: int
+    all_results: list[dict] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "best_params": self.best_params,
+            "best_latency_ms": self.best_latency_ms,
+            "improvement_pct": self.improvement_pct,
+            "num_evaluated": self.num_evaluated,
+            "all_results": self.all_results,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Tunables
+# ---------------------------------------------------------------------------
+
+class Tunable(ABC):
+    """A benchmarkable operation with a discrete parameter space."""
+
+    name: str = "tunable"
+
+    @abstractmethod
+    def parameter_space(self) -> dict[str, list]:
+        ...
+
+    def validate(self, params: dict[str, Any]) -> bool:
+        return True
+
+    @abstractmethod
+    def build(self, params: dict[str, Any]):
+        """Return (fn, args): a jitted callable and its inputs."""
+        ...
+
+    def benchmark(self, params: dict[str, Any], warmup: int, trials: int) -> float:
+        """Median latency in ms (device-synchronised)."""
+        from ..utils.timing import time_fn
+        fn, args = self.build(params)
+        return time_fn(fn, *args, warmup=warmup, iters=trials) * 1e3
+
+
+class MatMulTuner(Tunable):
+    """Tune an (M,K)x(K,N) matmul: dtype, MXU precision, accumulation type.
+
+    Replaces the reference MatMulTuner's CUDA-centric space
+    (block_size/num_threads/tensor_cores, reference autotuning.py:71-78)
+    with the knobs XLA actually exposes on TPU.
+    """
+
+    name = "matmul"
+
+    def __init__(self, m: int, k: int, n: int, seed: int = 0):
+        self.m, self.k, self.n = m, k, n
+        key = jax.random.PRNGKey(seed)
+        ka, kb = jax.random.split(key)
+        self._a32 = jax.random.normal(ka, (m, k), jnp.float32)
+        self._b32 = jax.random.normal(kb, (k, n), jnp.float32)
+
+    def parameter_space(self) -> dict[str, list]:
+        return {
+            "dtype": ["bfloat16", "float32"],
+            "precision": ["default", "high", "highest"],
+            "accum_dtype": ["float32", "bfloat16"],
+        }
+
+    def validate(self, params: dict[str, Any]) -> bool:
+        # fp32 inputs with bf16 accumulation is a pointless downcast
+        return not (params["dtype"] == "float32"
+                    and params["accum_dtype"] == "bfloat16")
+
+    def build(self, params: dict[str, Any]):
+        dt = jnp.dtype(params["dtype"])
+        prec = {"default": jax.lax.Precision.DEFAULT,
+                "high": jax.lax.Precision.HIGH,
+                "highest": jax.lax.Precision.HIGHEST}[params["precision"]]
+        accum = jnp.dtype(params["accum_dtype"])
+        a, b = self._a32.astype(dt), self._b32.astype(dt)
+        fn = jax.jit(lambda x, y: jax.lax.dot(
+            x, y, precision=prec, preferred_element_type=accum))
+        return fn, (a, b)
+
+    def flops(self) -> float:
+        return 2.0 * self.m * self.k * self.n
+
+
+class AttentionTuner(Tunable):
+    """Tune causal self-attention: implementation + Pallas grid blocks.
+
+    The reference benchmarks ONLY naive QK^T-softmax-V regardless of its
+    use_flash_attention flag (reference autotuning.py:149-193); here 'flash'
+    actually runs the Pallas kernel (ops/attention.py) and block_q/block_k
+    select its grid.
+    """
+
+    name = "attention"
+
+    def __init__(self, seq_len: int, head_dim: int, num_heads: int,
+                 batch_size: int, seed: int = 0):
+        self.seq_len, self.head_dim = seq_len, head_dim
+        self.num_heads, self.batch_size = num_heads, batch_size
+        key = jax.random.PRNGKey(seed)
+        kq, kk, kv = jax.random.split(key, 3)
+        shape = (batch_size, seq_len, num_heads, head_dim)
+        self._q = jax.random.normal(kq, shape, jnp.float32)
+        self._k = jax.random.normal(kk, shape, jnp.float32)
+        self._v = jax.random.normal(kv, shape, jnp.float32)
+
+    def parameter_space(self) -> dict[str, list]:
+        return {
+            "impl": ["xla", "flash"],
+            "block_q": [128, 256, 512],
+            "block_k": [128, 256, 512],
+            "dtype": ["bfloat16", "float32"],
+        }
+
+    def validate(self, params: dict[str, Any]) -> bool:
+        if params["impl"] == "xla":
+            # block sizes are meaningless for the XLA path: pin to one combo
+            # so the grid isn't redundantly re-measured
+            return params["block_q"] == 128 and params["block_k"] == 128
+        if params["block_q"] > self.seq_len or params["block_k"] > self.seq_len:
+            return False
+        # Pallas flash path runs in slow interpret mode off-TPU: skip it
+        # there (the reference "tunes" flash on CPU by not running it at all)
+        return jax.default_backend() == "tpu"
+
+    def build(self, params: dict[str, Any]):
+        dt = jnp.dtype(params["dtype"])
+        q, k, v = (x.astype(dt) for x in (self._q, self._k, self._v))
+        if params["impl"] == "flash":
+            from ..ops.attention import flash_attention
+            fn = jax.jit(lambda q, k, v: flash_attention(
+                q, k, v, causal=True,
+                block_q=params["block_q"], block_k=params["block_k"]))
+        else:
+            from ..models.layers import attention_mask, dot_product_attention
+            S = self.seq_len
+            pos = jnp.arange(S, dtype=jnp.int32)[None, :].repeat(
+                self.batch_size, axis=0)
+            mask = attention_mask(pos, pos)
+            fn = jax.jit(lambda q, k, v: dot_product_attention(q, k, v, mask))
+        return fn, (q, k, v)
+
+    def flops(self) -> float:
+        # 2 matmuls of [S,D]x[D,S] and [S,S]x[S,D] per head, causal ~ /2
+        return (2.0 * 2 * self.batch_size * self.num_heads
+                * self.seq_len * self.seq_len * self.head_dim / 2)
+
+
+class CollectiveTuner(Tunable):
+    """Tune collective dispatch over a live mesh axis — REAL timings.
+
+    Space: pattern x payload chunking x dtype. Chunking (splitting one big
+    collective into n_chunks sequential ones) is the TPU analog of the
+    reference's bucket_size_mb knob (reference autotuning.py:209-216), and
+    actually matters for comm/compute overlap.
+    """
+
+    name = "collective"
+
+    def __init__(self, mesh, axis: str, size_mb: float = 8.0):
+        self.mesh, self.axis, self.size_mb = mesh, axis, size_mb
+
+    def parameter_space(self) -> dict[str, list]:
+        return {
+            "pattern": ["allreduce", "all_gather", "reduce_scatter",
+                        "ppermute", "all_to_all"],
+            "n_chunks": [1, 2, 4],
+            "dtype": ["float32", "bfloat16"],
+        }
+
+    def build(self, params: dict[str, Any]):
+        from ..comms.bench import bench_collective
+        # bench_collective handles its own timing; wrap it to fit the
+        # benchmark() contract by returning a closure that runs one call
+        raise NotImplementedError   # benchmark() is overridden instead
+
+    def benchmark(self, params: dict[str, Any], warmup: int, trials: int) -> float:
+        from ..comms.bench import bench_collective
+        chunk_mb = self.size_mb / params["n_chunks"]
+        total = 0.0
+        for _ in range(params["n_chunks"]):
+            r = bench_collective(self.mesh, self.axis, params["pattern"],
+                                 size_mb=chunk_mb,
+                                 dtype=jnp.dtype(params["dtype"]),
+                                 iters=trials)
+            total += r["time_ms"]
+        return total
+
+
+# ---------------------------------------------------------------------------
+# Grid-search driver (parity: reference autotuning.py:259-368)
+# ---------------------------------------------------------------------------
+
+class AutoTuner:
+    def __init__(self, config: Optional[TuningConfig] = None):
+        self.config = config or TuningConfig()
+        self.cache: dict[str, dict] = {}
+
+    def grid_search(self, tunable: Tunable,
+                    cache_key: Optional[str] = None) -> TuningResult:
+        cfg = self.config
+        if cache_key and cache_key in self.cache:
+            cached = self.cache[cache_key]
+            logger.info("cache hit for %s", cache_key)
+            return TuningResult(**cached)
+
+        space = tunable.parameter_space()
+        names = list(space)
+        combos = list(itertools.product(*(space[n] for n in names)))
+
+        t_start = time.perf_counter()
+        best: Optional[dict] = None
+        best_ms = float("inf")
+        first_ms: Optional[float] = None
+        since_improvement = 0
+        all_results: list[dict] = []
+
+        for combo in combos[:cfg.max_iterations]:
+            params = dict(zip(names, combo))
+            if not tunable.validate(params):
+                continue
+            if time.perf_counter() - t_start > cfg.timeout_seconds:
+                logger.warning("%s tuning timed out after %d configs",
+                               tunable.name, len(all_results))
+                break
+            if since_improvement >= cfg.convergence_patience:
+                logger.info("%s tuning converged after %d configs",
+                            tunable.name, len(all_results))
+                break
+            try:
+                ms = tunable.benchmark(params, cfg.num_warmup, cfg.num_trials)
+            except Exception as e:   # invalid shape/dtype combo at runtime
+                logger.debug("config %s failed: %s", params, e)
+                continue
+            all_results.append({"params": params, "latency_ms": ms})
+            if first_ms is None:
+                first_ms = ms
+            if ms < best_ms:
+                best, best_ms = params, ms
+                since_improvement = 0
+            else:
+                since_improvement += 1
+
+        if best is None:
+            raise RuntimeError(
+                f"no valid configuration for {tunable.name} "
+                f"(space={len(combos)} combos)")
+        improvement = (100.0 * (first_ms - best_ms) / first_ms
+                       if first_ms else 0.0)
+        result = TuningResult(
+            best_params=best, best_latency_ms=best_ms,
+            improvement_pct=improvement, num_evaluated=len(all_results),
+            all_results=all_results)
+        if cache_key:
+            self.cache[cache_key] = result.to_dict()
+        return result
+
+    # -- convenience wrappers (parity: reference autotuning.py:370-414) ------
+
+    def tune_matmul(self, m: int, k: int, n: int) -> TuningResult:
+        backend = jax.default_backend()
+        return self.grid_search(MatMulTuner(m, k, n),
+                                cache_key=f"matmul_{m}x{k}x{n}_{backend}")
+
+    def tune_attention(self, seq_len: int, head_dim: int, num_heads: int,
+                       batch_size: int) -> TuningResult:
+        backend = jax.default_backend()
+        return self.grid_search(
+            AttentionTuner(seq_len, head_dim, num_heads, batch_size),
+            cache_key=f"attention_{seq_len}_{head_dim}_{num_heads}"
+                      f"_{batch_size}_{backend}")
+
+    def tune_collective(self, mesh, axis: str,
+                        size_mb: float = 8.0) -> TuningResult:
+        return self.grid_search(
+            CollectiveTuner(mesh, axis, size_mb),
+            cache_key=f"collective_{axis}{mesh.shape[axis]}_{size_mb}mb")
+
+    # -- persistence (parity: reference autotuning.py:416-454) ---------------
+
+    def save_results(self, path: str | Path) -> None:
+        p = Path(path)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(json.dumps(self.cache, indent=2, sort_keys=True))
+
+    def load_results(self, path: str | Path) -> None:
+        p = Path(path)
+        if p.exists():
+            self.cache.update(json.loads(p.read_text()))
+
+
+def create_auto_tuner(config: Optional[TuningConfig] = None) -> AutoTuner:
+    return AutoTuner(config)

@@ -158,7 +158,32 @@ class TrainingEngine:
             jax.profiler.stop_trace()
         self.save(max_steps)
         self.ckpt.wait()
+        self._write_manifest(start, max_steps, last_metrics)
         return last_metrics
+
+    def _write_manifest(self, start_step: int, end_step: int,
+                        final_metrics: dict) -> None:
+        """Record everything needed to re-run this training deterministically
+        — the basis of `llmctl replay` (the reference's replay is a stub and
+        its seed is plumbed but never applied, SURVEY §5.2)."""
+        import json
+        manifest = {
+            "run_id": f"{self.cfg.model.name}-s{self.cfg.training.seed}"
+                      f"-{start_step}to{end_step}",
+            "config": self.cfg.to_dict(),
+            "seed": self.cfg.training.seed,
+            "data_seed": self.cfg.data.seed,
+            "start_step": start_step,
+            "end_step": end_step,
+            "num_hosts": jax.process_count(),
+            "num_devices": self.trainer.mesh.size,
+            "final_metrics": {k: v for k, v in final_metrics.items()
+                              if isinstance(v, (int, float))},
+        }
+        if jax.process_index() == 0:
+            path = Path(self.cfg.checkpoint.path) / "run_manifest.json"
+            path.parent.mkdir(parents=True, exist_ok=True)
+            path.write_text(json.dumps(manifest, indent=2))
 
     def evaluate(self, num_batches: Optional[int] = None) -> dict:
         num_batches = num_batches or self.cfg.training.eval_steps

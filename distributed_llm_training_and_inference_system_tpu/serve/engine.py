@@ -72,11 +72,14 @@ class InferenceEngine:
             hbm_budget_gb=serve_cfg.kv_hbm_budget_gb, dtype=dtype)
 
         self._req_slot: dict[str, int] = {}
+        # pages promised to admitted-but-not-yet-prefilled requests; without
+        # this, one admit() round can over-commit: each request individually
+        # passes a free-page check but their SUM exceeds what's free
+        self._reserved_pages = 0
         self.scheduler = ContinuousBatchingScheduler(
             max_batch_size=S, max_queue=serve_cfg.max_queue,
             max_seq_len=serve_cfg.max_seq_len,
-            can_allocate=lambda r: self.kv.can_allocate(
-                r.num_prompt_tokens + r.sampling.max_tokens),
+            can_allocate=self._try_reserve,
             on_release=self._on_release,
             can_ever_allocate=lambda r: self.kv.can_ever_allocate(
                 r.num_prompt_tokens + r.sampling.max_tokens))
@@ -111,11 +114,11 @@ class InferenceEngine:
         paths self-contained)."""
         art = serve_cfg.artifact
         if art and Path(art).exists():
-            from ..io.checkpoint import CheckpointManager
+            from ..io.checkpoint import CheckpointManager, params_from_flat
             ckpt = CheckpointManager(art)
             if ckpt.latest_step() is not None:
                 state, _ = ckpt.restore()
-                params = state["params"] if isinstance(state, dict) and "params" in state else state
+                params = params_from_flat(state)
                 logger.info("loaded params from %s step %s", art,
                             ckpt.latest_step())
                 return jax.tree_util.tree_map(
@@ -125,6 +128,17 @@ class InferenceEngine:
         return gpt.init(model_cfg, jax.random.PRNGKey(seed), dtype=dtype)
 
     # -- prefill -------------------------------------------------------------
+
+    def _try_reserve(self, req: Request) -> bool:
+        """Admission hook (runs under self.lock inside admit()): reserve the
+        request's full KV footprint so concurrent admissions can't
+        collectively over-commit the page pool."""
+        need = self.kv.pages_needed(
+            req.num_prompt_tokens + req.sampling.max_tokens)
+        if need > self.kv.free_pages - self._reserved_pages:
+            return False
+        self._reserved_pages += need
+        return True
 
     def _bucket(self, n: int) -> int:
         chunk = max(self.serve_cfg.prefill_chunk, self.kv.page_size)
@@ -164,6 +178,8 @@ class InferenceEngine:
         slot, n = req.slot, req.num_prompt_tokens
         with self.lock:   # page bookkeeping is shared with cancel/release
             self.kv.allocate(slot, n + req.sampling.max_tokens)
+            self._reserved_pages -= self.kv.pages_needed(
+                n + req.sampling.max_tokens)
             self._req_slot[req.request_id] = slot
             # table entries for the bucket: beyond-length pages -> scratch 0
             bucket = self._bucket(n)

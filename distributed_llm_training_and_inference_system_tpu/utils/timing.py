@@ -1,0 +1,26 @@
+"""Shared device-synchronised timing for every benchmark path.
+
+One methodology (warmup, block_until_ready, median) used by comms/bench,
+cli bench, hw benchmark, and the autotuner — so a change to how we measure
+is a change everywhere.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+
+def time_fn(fn: Callable, *args, warmup: int = 2, iters: int = 10) -> float:
+    """Median wall-clock seconds per call, device-synchronised."""
+    import jax
+    import numpy as np
+
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    times = []
+    for _ in range(max(iters, 1)):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
