@@ -74,8 +74,12 @@ class InferenceEngine:
         self._req_slot: dict[str, int] = {}
         # pages promised to admitted-but-not-yet-prefilled requests; without
         # this, one admit() round can over-commit: each request individually
-        # passes a free-page check but their SUM exceeds what's free
+        # passes a free-page check but their SUM exceeds what's free.
+        # Tracked per request id so a request released BEFORE its prefill
+        # (cancel / engine failure) returns its reservation instead of
+        # leaking it.
         self._reserved_pages = 0
+        self._reserved_by: dict[str, int] = {}
         self.scheduler = ContinuousBatchingScheduler(
             max_batch_size=S, max_queue=serve_cfg.max_queue,
             max_seq_len=serve_cfg.max_seq_len,
@@ -138,6 +142,7 @@ class InferenceEngine:
         if need > self.kv.free_pages - self._reserved_pages:
             return False
         self._reserved_pages += need
+        self._reserved_by[req.request_id] = need
         return True
 
     def _bucket(self, n: int) -> int:
@@ -178,8 +183,7 @@ class InferenceEngine:
         slot, n = req.slot, req.num_prompt_tokens
         with self.lock:   # page bookkeeping is shared with cancel/release
             self.kv.allocate(slot, n + req.sampling.max_tokens)
-            self._reserved_pages -= self.kv.pages_needed(
-                n + req.sampling.max_tokens)
+            self._reserved_pages -= self._reserved_by.pop(req.request_id, 0)
             self._req_slot[req.request_id] = slot
             # table entries for the bucket: beyond-length pages -> scratch 0
             bucket = self._bucket(n)
@@ -249,6 +253,9 @@ class InferenceEngine:
     # -- lifecycle -----------------------------------------------------------
 
     def _on_release(self, req: Request) -> None:
+        # admitted-but-never-prefilled (cancel/failure before _prefill):
+        # return the admission reservation so capacity can't leak
+        self._reserved_pages -= self._reserved_by.pop(req.request_id, 0)
         slot = self._req_slot.pop(req.request_id, None)
         if slot is not None:
             self.kv.release(slot)
@@ -282,6 +289,17 @@ class InferenceEngine:
                 self.scheduler.step_finished(self.eos_token_id)
         with self.lock:
             return self.scheduler.active_count
+
+    def fail_all(self, error: str) -> None:
+        """Fail every queued and resident request (engine-thread crash path);
+        waiters fire via on_finish instead of hanging to the HTTP timeout."""
+        with self.lock:
+            failed = self.scheduler.fail_all(error)
+        if self.on_finish is not None:
+            for r in failed:
+                # slot holders were already notified via _on_release; the
+                # waiter registry pop is idempotent so double-notify is safe
+                self.on_finish(r)
 
     def run_until_idle(self, max_steps: int = 100_000) -> None:
         for _ in range(max_steps):

@@ -18,12 +18,16 @@ from ..models.layers import NEG_INF
 
 
 def _apply_top_k(logits: jax.Array, top_k: jax.Array) -> jax.Array:
-    """Mask logits outside each row's top-k. top_k==0 disables. [B,V]."""
+    """Mask logits outside each row's top-k. top_k<=0 disables. [B,V].
+
+    top_k <= 0 disabled matches the reference/ecosystem convention
+    (reference serve/server.py defaults top_k=-1 and checks top_k>0).
+    """
     V = logits.shape[-1]
     sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]                  # [B,V]
     k = jnp.clip(top_k, 1, V)
     kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=1)  # [B,1]
-    keep = (logits >= kth) | (top_k[:, None] == 0)
+    keep = (logits >= kth) | (top_k[:, None] <= 0)
     return jnp.where(keep, logits, NEG_INF)
 
 

@@ -33,7 +33,7 @@ class RequestState(str, Enum):
 class SamplingParams:
     """Per-request sampling knobs (parity: reference server.py:209-235)."""
     temperature: float = 1.0
-    top_k: int = 0               # 0 = disabled
+    top_k: int = 0               # <= 0 = disabled (reference convention: -1)
     top_p: float = 1.0
     max_tokens: int = 64
     stop_token_ids: tuple[int, ...] = ()
@@ -48,6 +48,9 @@ class Request:
     state: RequestState = RequestState.QUEUED
     generated_tokens: list[int] = field(default_factory=list)
     slot: Optional[int] = None
+    # set while PREFILLING (when the slot can't be torn down mid-flight);
+    # the engine releases the slot at the next step boundary
+    cancel_requested: bool = False
     arrival_time: float = field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None   # for TTFT
     finish_time: Optional[float] = None
@@ -160,12 +163,33 @@ class ContinuousBatchingScheduler:
                 if r.state == RequestState.PREFILLING:
                     # prefill is in flight on the engine thread; releasing
                     # the slot's KV pages under it would corrupt the cache.
-                    # The request becomes RUNNING within one engine step and
-                    # can be cancelled then.
-                    return False
+                    # Mark cancel-pending: the engine frees the slot (and
+                    # its pages) at the next step boundary, so a client
+                    # timeout can't leak capacity.
+                    r.cancel_requested = True
+                    return True
                 self._release_slot(i, "cancelled")
                 return True
         return False
+
+    def fail_all(self, error: str) -> list[Request]:
+        """Engine-failure path: fail every queued and resident request so
+        their waiters fire instead of hanging until the HTTP timeout."""
+        failed = []
+        while self.waiting:
+            r = self.waiting.popleft()
+            r.state = RequestState.FAILED
+            r.error = error
+            r.finish_time = time.monotonic()
+            r.finish_reason = "error"
+            self.completed.append(r)
+            failed.append(r)
+        for i, r in enumerate(self.slots):
+            if r is not None:
+                r.error = error
+                self._release_slot(i, "error")
+                failed.append(r)
+        return failed
 
     # -- scheduling ---------------------------------------------------------
 
@@ -202,7 +226,8 @@ class ContinuousBatchingScheduler:
         for i, r in enumerate(self.slots):
             if r is None or r.state != RequestState.RUNNING:
                 continue
-            reason = r.should_stop(eos_token_id)
+            reason = ("cancelled" if r.cancel_requested
+                      else r.should_stop(eos_token_id))
             if reason is not None:
                 done.append(r)
                 self._release_slot(i, reason)
@@ -216,11 +241,12 @@ class ContinuousBatchingScheduler:
         r.slot = None
         r.finish_time = time.monotonic()
         r.finish_reason = reason
-        r.state = (RequestState.CANCELLED if reason == "cancelled"
-                   else RequestState.FINISHED)
+        r.state = {"cancelled": RequestState.CANCELLED,
+                   "error": RequestState.FAILED}.get(
+                       reason, RequestState.FINISHED)
         self._on_release(r)
         self.completed.append(r)
-        if reason != "cancelled":
+        if reason not in ("cancelled", "error"):
             self.total_finished += 1
 
     # -- introspection ------------------------------------------------------

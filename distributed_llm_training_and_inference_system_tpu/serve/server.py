@@ -50,6 +50,8 @@ class InferenceServer:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._recent_latencies: list[float] = []
+        self._engine_error: Optional[str] = None
+        self._engine_error_count = 0
         self._waiters: dict[str, tuple[asyncio.AbstractEventLoop, asyncio.Event]] = {}
         self.engine.on_finish = self._notify_finished
         self.app = self._build_app()
@@ -74,7 +76,22 @@ class InferenceServer:
                 self._wake.clear()
                 continue
             # step() does its own fine-grained locking; compute runs unlocked
-            self.engine.step()
+            try:
+                self.engine.step()
+                # a successful step clears the degraded flag so a transient
+                # error doesn't leave /health at 503 forever (the cumulative
+                # count stays visible for operators)
+                self._engine_error = None
+            except Exception as e:  # device/runtime error: fail loudly, not
+                # silently — in-flight requests get FAILED (waiters fire),
+                # /health reports the outage, and the loop keeps serving.
+                logger.exception("engine step failed")
+                self._engine_error = f"{type(e).__name__}: {e}"
+                self._engine_error_count += 1
+                try:
+                    self.engine.fail_all(self._engine_error)
+                except Exception:
+                    logger.exception("fail_all after engine error failed")
         logger.info("engine thread stopped")
 
     def start_engine(self) -> None:
@@ -108,23 +125,46 @@ class InferenceServer:
 
         prompt = body.get("prompt", "")
         if isinstance(prompt, list):           # OpenAI also accepts token ids
-            prompt_tokens = [int(t) for t in prompt]
+            try:
+                prompt_tokens = [int(t) for t in prompt]
+            except (TypeError, ValueError):
+                return web.json_response(
+                    {"error": "prompt token ids must be integers"}, status=400)
+            bad = [t for t in prompt_tokens
+                   if not 0 <= t < self.model_cfg.vocab_size]
+            if bad:
+                # OOB ids would clamp silently in the embedding gather and
+                # produce wrong completions — reject instead
+                return web.json_response(
+                    {"error": f"prompt token id {bad[0]} outside "
+                              f"[0, {self.model_cfg.vocab_size})"}, status=400)
         else:
             prompt_tokens = self.tokenizer.encode(str(prompt))
         if not prompt_tokens:
             return web.json_response({"error": "empty prompt"}, status=400)
 
+        seed = body.get("seed")
+        if seed is not None and (isinstance(seed, bool)
+                                 or not isinstance(seed, int)):
+            # an unvalidated seed would raise inside the engine thread
+            return web.json_response(
+                {"error": f"seed must be an integer, got {seed!r}"},
+                status=400)
         try:
             sampling = SamplingParams(
                 temperature=float(body.get("temperature", 1.0)),
                 top_k=int(body.get("top_k", 0)),
                 top_p=float(body.get("top_p", 1.0)),
                 max_tokens=int(body.get("max_tokens", 64)),
-                seed=body.get("seed"),
+                seed=seed,
             )
         except (TypeError, ValueError) as e:
             return web.json_response(
                 {"error": f"invalid sampling parameter: {e}"}, status=400)
+        if sampling.max_tokens < 1:
+            return web.json_response(
+                {"error": f"max_tokens must be >= 1, got "
+                          f"{sampling.max_tokens}"}, status=400)
         req = Request(request_id=f"cmpl-{uuid.uuid4().hex[:24]}",
                       prompt_tokens=prompt_tokens, sampling=sampling)
         event = asyncio.Event()
@@ -190,12 +230,15 @@ class InferenceServer:
             stats = self.engine.stats()
         lats = sorted(self._recent_latencies)
         p50 = lats[len(lats) // 2] if lats else None
+        healthy = self._engine_error is None
         return web.json_response({
-            "status": "healthy",
+            "status": "healthy" if healthy else "degraded",
             "model": self.model_cfg.name,
             "engine": stats,
             "p50_latency_ms": p50,
-        })
+            "last_engine_error": self._engine_error,
+            "engine_error_count": self._engine_error_count,
+        }, status=200 if healthy else 503)
 
     async def handle_stats(self, request: web.Request) -> web.Response:
         with self._lock:

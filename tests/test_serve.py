@@ -202,9 +202,43 @@ class TestHTTPServer:
     def test_bad_request(self, server):
         import requests as rq
         srv, port = server
-        r = rq.post(f"http://127.0.0.1:{port}/v1/completions",
+        base = f"http://127.0.0.1:{port}"
+        r = rq.post(f"{base}/v1/completions",
                     json={"prompt": "", "max_tokens": 3}, timeout=10)
         assert r.status_code == 400
+        # max_tokens < 1 is invalid, not "generate one token anyway"
+        r = rq.post(f"{base}/v1/completions",
+                    json={"prompt": [1, 2], "max_tokens": 0}, timeout=10)
+        assert r.status_code == 400
+        # out-of-vocab token ids must 400, not clamp silently
+        r = rq.post(f"{base}/v1/completions",
+                    json={"prompt": [1, 10**9], "max_tokens": 3}, timeout=10)
+        assert r.status_code == 400
+        assert "token id" in r.json()["error"]
+        # non-integer seed would raise inside the engine thread
+        r = rq.post(f"{base}/v1/completions",
+                    json={"prompt": [1, 2], "max_tokens": 3, "seed": "x"},
+                    timeout=10)
+        assert r.status_code == 400
+        assert "seed" in r.json()["error"]
+
+    def test_engine_crash_returns_500_and_degrades_health(self, server):
+        import requests as rq
+        srv, port = server
+        base = f"http://127.0.0.1:{port}"
+
+        def boom():
+            raise RuntimeError("device exploded")
+        srv.engine.step = boom
+
+        r = rq.post(f"{base}/v1/completions", json={
+            "prompt": [1, 2, 3], "max_tokens": 5}, timeout=30)
+        assert r.status_code == 500
+        assert "device exploded" in r.json()["error"]
+        h = rq.get(f"{base}/health", timeout=10)
+        assert h.status_code == 503
+        assert h.json()["status"] == "degraded"
+        assert "device exploded" in h.json()["last_engine_error"]
 
 
 class TestReviewRegressions:
@@ -226,6 +260,73 @@ class TestReviewRegressions:
         assert big.state is RequestState.FAILED
         assert "capacity" in big.error
         # a small request behind it still runs fine
+        [ok] = eng.generate([[1, 2, 3]], SamplingParams(temperature=0.0,
+                                                        max_tokens=2))
+        assert ok.state is RequestState.FINISHED
+
+    def test_negative_top_k_means_disabled_not_greedy(self, model_cfg):
+        """top_k=-1 is the reference's 'disabled' convention; clipping it to
+        1 silently turned sampling into argmax (ADVICE r1)."""
+        eng = make_engine(model_cfg)
+        s = dict(temperature=0.9, top_p=1.0, max_tokens=8, seed=123)
+        [neg] = eng.generate([[7, 8, 9]], SamplingParams(top_k=-1, **s))
+        [zero] = eng.generate([[7, 8, 9]], SamplingParams(top_k=0, **s))
+        [one] = eng.generate([[7, 8, 9]], SamplingParams(top_k=1, **s))
+        assert neg.generated_tokens == zero.generated_tokens
+        greedy = greedy_reference(eng.params, model_cfg, [7, 8, 9], 8)
+        assert one.generated_tokens == greedy  # top_k=1 IS greedy
+        assert neg.generated_tokens != greedy  # -1 must not be
+
+    def test_cancel_during_prefill_releases_slot(self, model_cfg):
+        """Cancel of a PREFILLING request is deferred to the next step
+        boundary instead of leaking the slot + KV pages (ADVICE r1)."""
+        eng = make_engine(model_cfg)
+        free0 = eng.kv.free_pages
+        r = Request("c1", [1, 2, 3], SamplingParams(temperature=0.0,
+                                                    max_tokens=5))
+        assert eng.scheduler.add_request(r)
+        [admitted] = eng.scheduler.admit()
+        assert admitted.state is RequestState.PREFILLING
+        assert eng.scheduler.cancel("c1")       # cancel-pending, not False
+        assert r.cancel_requested
+        eng._prefill(r)
+        eng.scheduler.step_finished(eng.eos_token_id)
+        assert r.state is RequestState.CANCELLED
+        assert eng.scheduler.active_count == 0
+        assert eng.kv.free_pages == free0       # pages reclaimed
+
+    def test_engine_failure_fails_requests_not_hangs(self, model_cfg):
+        """A crashed engine step must FAIL in-flight requests (waiters fire)
+        rather than leaving them hanging (ADVICE r1)."""
+        eng = make_engine(model_cfg)
+        r1 = Request("f1", [1, 2], SamplingParams(max_tokens=4))
+        r2 = Request("f2", [3, 4], SamplingParams(max_tokens=4))
+        assert eng.scheduler.add_request(r1)
+        eng.scheduler.admit()
+        eng._prefill(r1)                        # r1 resident
+        assert eng.scheduler.add_request(r2)    # r2 queued
+        notified = []
+        eng.on_finish = lambda req: notified.append(req.request_id)
+        eng.fail_all("RuntimeError: boom")
+        assert r1.state is RequestState.FAILED
+        assert r2.state is RequestState.FAILED
+        assert "boom" in r1.error and "boom" in r2.error
+        assert set(notified) >= {"f1", "f2"}
+        assert eng.scheduler.active_count == 0 and eng.scheduler.queue_depth == 0
+
+    def test_fail_before_prefill_returns_reservation(self, model_cfg):
+        """A request admitted (pages reserved) but failed before its prefill
+        must return its reservation — otherwise every crash permanently
+        shrinks admissible KV capacity (code-review r2)."""
+        eng = make_engine(model_cfg)
+        r = Request("rsv", [1, 2, 3], SamplingParams(max_tokens=5))
+        assert eng.scheduler.add_request(r)
+        eng.scheduler.admit()                  # reserves pages, no prefill yet
+        assert eng._reserved_pages > 0
+        eng.fail_all("RuntimeError: boom")
+        assert eng._reserved_pages == 0
+        assert not eng._reserved_by
+        # capacity is intact: a fresh request still runs
         [ok] = eng.generate([[1, 2, 3]], SamplingParams(temperature=0.0,
                                                         max_tokens=2))
         assert ok.state is RequestState.FINISHED
