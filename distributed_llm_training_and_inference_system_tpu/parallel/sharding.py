@@ -6,7 +6,7 @@ plan.py:73-125). Megatron-style tensor parallelism as data layout:
 
 - column-parallel kernels (q/k/v, mlp gate/up, lm_head): output dim on tp
 - row-parallel kernels (o, mlp down): input dim on tp
-- embedding: vocab dim on tp (logits psum'd by XLA), hidden on fsdp
+- embedding: vocab on fsdp, hidden on tp (see PARAM_RULES comment)
 - every 2D kernel additionally shards its other dim on fsdp (ZeRO-3-style)
 - MoE expert kernels put their leading E axis on ep
 - stacked-layer leading axis goes on pp (when pipeline_parallel > 1 the
@@ -27,11 +27,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 # (path regex, spec WITHOUT the stacked-layer axis). First match wins.
 # Paths are dotted: e.g. "blocks.q.kernel", "embed.embedding".
 PARAM_RULES: list[tuple[str, P]] = [
-    # Embedding shards HIDDEN, not vocab: a vocab-sharded table turns the
-    # token gather into an involuntary full rematerialization under GSPMD
-    # (observed on the 8-device mesh); hidden-sharded partitions the gather
-    # trivially, and tied logits become a psum over the contracted dim.
-    (r"embed\.embedding$",        P(None, ("fsdp", "tp"))),
+    # Embedding: vocab on fsdp, hidden on tp. The hidden dim must NOT carry
+    # fsdp: activations shard batch on fsdp, so a hidden-fsdp gather output
+    # forces GSPMD into "Involuntary full rematerialization" when resharding
+    # to the activation spec (observed round 1 on the fsdp x sp x ep mesh).
+    # Vocab-on-fsdp partitions the gather as mask+psum and the tied-logits
+    # einsum as a plain contraction — verified warning-free on both dryrun
+    # regimes (tests/test_parallel.py::test_no_involuntary_remat).
+    (r"embed\.embedding$",        P("fsdp", "tp")),
     (r"lm_head\.kernel$",         P("fsdp", "tp")),
     (r"final_norm\.scale$",       P(None)),
     (r"blocks\.(q|k|v)\.kernel$", P("fsdp", "tp")),

@@ -301,6 +301,26 @@ class InferenceEngine:
                 # waiter registry pop is idempotent so double-notify is safe
                 self.on_finish(r)
 
+    def recover(self) -> bool:
+        """Restore engine invariants after a failed step and probe the device.
+
+        The jitted prefill/decode programs donate the KV page buffers; an
+        exception after dispatch leaves ``self.kv.k_pages/v_pages`` pointing
+        at deleted arrays, so every later step would raise "Array has been
+        deleted" forever. Reallocate them (all requests were already failed
+        by fail_all, so no live KV is lost) and run a tiny device op to
+        check the backend is usable again. Returns True when healthy."""
+        try:
+            for name in ("k_pages", "v_pages"):
+                buf = getattr(self.kv, name)
+                if buf.is_deleted():
+                    setattr(self.kv, name, jnp.zeros(buf.shape, buf.dtype))
+            probe = jnp.zeros((8,), jnp.float32) + 1.0
+            return bool(np.asarray(probe).sum() == 8.0)
+        except Exception:
+            logger.exception("engine recovery probe failed")
+            return False
+
     def run_until_idle(self, max_steps: int = 100_000) -> None:
         for _ in range(max_steps):
             if self.step() == 0 and self.scheduler.queue_depth == 0:

@@ -92,6 +92,16 @@ class InferenceServer:
                     self.engine.fail_all(self._engine_error)
                 except Exception:
                     logger.exception("fail_all after engine error failed")
+                # Reallocate donated-then-deleted KV buffers and probe the
+                # device. On success, clear the degraded flag here — fail_all
+                # drained every request, so an idle server would otherwise
+                # hold /health at 503 until external traffic arrived despite
+                # the 503 (load balancers gating on /health would never send
+                # the request that clears it).
+                if self.engine.recover():
+                    self._engine_error = None
+                else:
+                    logger.error("engine recovery failed; /health degraded")
         logger.info("engine thread stopped")
 
     def start_engine(self) -> None:
@@ -125,11 +135,13 @@ class InferenceServer:
 
         prompt = body.get("prompt", "")
         if isinstance(prompt, list):           # OpenAI also accepts token ids
-            try:
-                prompt_tokens = [int(t) for t in prompt]
-            except (TypeError, ValueError):
+            # strict: int(t) would silently truncate floats / coerce bools,
+            # generating from a different prompt than the client sent
+            if any(isinstance(t, bool) or not isinstance(t, int)
+                   for t in prompt):
                 return web.json_response(
                     {"error": "prompt token ids must be integers"}, status=400)
+            prompt_tokens = list(prompt)
             bad = [t for t in prompt_tokens
                    if not 0 <= t < self.model_cfg.vocab_size]
             if bad:

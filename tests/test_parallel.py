@@ -120,6 +120,40 @@ def test_moe_ep_sharding(devices8):
     assert np.isfinite(float(m["loss"]))
 
 
+def test_no_involuntary_remat(devices8):
+    """The fsdp x sp x ep regime must compile without GSPMD's "Involuntary
+    full rematerialization" warning on the token-embedding gather (round-1
+    verdict: a hidden-fsdp-sharded table replicated a multi-GB table per
+    step at 7b scale). The warning is emitted by the C++ partitioner on
+    fd 2, so capture the raw fd around compilation."""
+    import os
+    import tempfile
+
+    model_cfg = get_model_config("gpt-test-moe")
+    par = ParallelConfig(fsdp=2, sequence_parallel=2, expert_parallel=2,
+                         micro_batch_size=1, global_batch_size=8,
+                         zero_stage=1)
+    trainer = ShardedTrainer(model_cfg, OptimizerConfig(lr=1e-3), par,
+                             devices=devices8, attn_impl="ring")
+    trainer.init_state(seed=0)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 1,
+                                model_cfg.vocab_size)
+
+    saved = os.dup(2)
+    with tempfile.TemporaryFile(mode="w+b") as tf:
+        os.dup2(tf.fileno(), 2)
+        try:
+            m = trainer.step({"tokens": tokens})
+        finally:
+            os.dup2(saved, 2)
+            os.close(saved)
+        tf.seek(0)
+        stderr_text = tf.read().decode(errors="replace")
+    assert "Involuntary full rematerialization" not in stderr_text, (
+        stderr_text[-2000:])
+    assert np.isfinite(float(m["loss"]))
+
+
 # -- planner ------------------------------------------------------------------
 
 def test_planner_7b_v5e256():
