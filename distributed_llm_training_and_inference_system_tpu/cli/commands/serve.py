@@ -26,7 +26,8 @@ def app(ctx):
 @click.option("--port", default=8080, show_default=True, type=int)
 @click.option("--max-batch-size", default=8, show_default=True, type=int)
 @click.option("--max-seq-len", default=2048, show_default=True, type=int)
-@click.option("--kv-block-size", default=16, show_default=True, type=int)
+@click.option("--kv-block-size", default=64, show_default=True, type=int,
+              help="Tokens per KV page (64 = one Pallas DMA tile).")
 @click.option("--kv-hbm-gb", default=4.0, show_default=True, type=float,
               help="HBM budget for the paged KV cache.")
 @click.option("--scheduler", default="continuous", show_default=True,

@@ -29,6 +29,18 @@ MODEL_TEMPLATES: dict[str, ModelConfig] = {
         max_position_embeddings=2048, activation="silu",
         tie_word_embeddings=True,
     ),
+    # gpt-750m: the single-chip benchmark flagship — the largest model whose
+    # fp32-AdamW train state + grads (~11.5 GB) fits one 16 GB v5e chip with
+    # batch headroom. H=2048/D=128 shapes sustain ~2.3x the matmul
+    # efficiency of gpt-350m's H=1024 on the v5e MXU (measured: H=1024
+    # matmuls cap at 17-30% of peak — round 1 benched gpt-350m and its
+    # 0.34 MFU was the SHAPE ceiling, not a kernel deficit).
+    "gpt-750m": ModelConfig(
+        name="gpt-750m", num_layers=12, hidden_size=2048, ffn_size=5632,
+        num_heads=16, num_kv_heads=16, head_dim=128, vocab_size=50304,
+        max_position_embeddings=4096, activation="silu",
+        tie_word_embeddings=True,
+    ),
     "gpt-1b": ModelConfig(
         name="gpt-1b", num_layers=24, hidden_size=2048, ffn_size=5632,
         num_heads=16, num_kv_heads=16, head_dim=128, vocab_size=50304,

@@ -21,6 +21,7 @@ import optax
 
 from ..config.schema import ModelConfig, OptimizerConfig, ParallelConfig
 from ..models import forward, next_token_loss
+from ..models.loss import chunked_next_token_loss
 from ..utils.tree import global_norm
 from .optimizer import make_optimizer
 
@@ -39,20 +40,34 @@ class TrainState:
                    opt_state=tx.init(params))
 
 
-def _loss_fn(params, batch, model_cfg: ModelConfig, attn_impl: str, remat: str):
+def _loss_fn(params, batch, model_cfg: ModelConfig, attn_impl: str, remat: str,
+             loss_chunk: int = 512):
+    """Training loss. With ``loss_chunk > 0`` the LM head + cross-entropy run
+    chunked over the sequence (models.loss.chunked_next_token_loss): the
+    [B, S, V] fp32 logits pair is never resident — it was the round-1
+    single-chip HBM ceiling (~3.3 GB at B=4, S=2048, V=50k)."""
     out = forward(
         params, batch["tokens"], model_cfg,
         positions=batch.get("positions"),
         segment_ids=batch.get("segment_ids"),
         attn_impl=attn_impl, remat=remat,
         return_aux=model_cfg.is_moe,
+        return_hidden=loss_chunk > 0,
     )
     if model_cfg.is_moe:
-        logits, aux = out
+        head_in, aux = out
     else:
-        logits, aux = out, 0.0
-    loss, count = next_token_loss(logits, batch["tokens"],
-                                  batch.get("segment_ids"))
+        head_in, aux = out, 0.0
+    if loss_chunk > 0:
+        tied = model_cfg.tie_word_embeddings
+        w = (params["embed"]["embedding"] if tied
+             else params["lm_head"]["kernel"])
+        loss, count = chunked_next_token_loss(
+            head_in, w, batch["tokens"], batch.get("segment_ids"),
+            chunk=loss_chunk, tied=tied)
+    else:
+        loss, count = next_token_loss(head_in, batch["tokens"],
+                                      batch.get("segment_ids"))
     return loss + aux, (loss, count)
 
 
@@ -62,6 +77,7 @@ def make_train_step(
     par_cfg: Optional[ParallelConfig] = None,
     attn_impl: str = "xla",
     loss_fn: Optional[Callable] = None,
+    loss_chunk: int = 512,
 ) -> tuple[Callable, optax.GradientTransformation, Callable]:
     """Build (train_step, tx, schedule).
 
@@ -80,7 +96,8 @@ def make_train_step(
     remat = par_cfg.activation_checkpoint
     if loss_fn is None:
         loss_fn = functools.partial(_loss_fn, model_cfg=model_cfg,
-                                    attn_impl=attn_impl, remat=remat)
+                                    attn_impl=attn_impl, remat=remat,
+                                    loss_chunk=loss_chunk)
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
     def train_step(state: TrainState, batch: dict[str, jax.Array]):

@@ -168,8 +168,11 @@ def forward(
     remat: str = "none",             # none | selective | full
     return_aux: bool = False,
     unembed_positions: Optional[jax.Array] = None,
+    return_hidden: bool = False,
 ):
-    """Compute logits [B, S, V] (fp32).
+    """Compute logits [B, S, V] (fp32) — or, with ``return_hidden=True``,
+    the final-normed hidden states [B, S, H] in the compute dtype (consumed
+    by models.loss.chunked_next_token_loss so [B,S,V] never materialises).
 
     - ``segment_ids`` [B,S] enables packed sequences (0 = pad).
     - ``kv_cache`` ([L,B,Smax,Nkv,D], [L,B,Smax,Nkv,D]) + ``cache_offset``
@@ -231,7 +234,13 @@ def forward(
     if unembed_positions is not None:
         x = jnp.take_along_axis(
             x, unembed_positions[:, None, None].astype(jnp.int32), axis=1)
-    out = unembed(params, x, cfg, norm_impl=norm_impl)
+    if return_hidden:
+        # final-normed hidden [B,S,H] for chunked-loss consumers
+        # (models.loss.chunked_next_token_loss) — skips the [S,V] unembed
+        out = rms_norm(x, params["final_norm"]["scale"].astype(x.dtype),
+                       cfg.norm_eps, impl=norm_impl)
+    else:
+        out = unembed(params, x, cfg, norm_impl=norm_impl)
     result = [out]
     if kv_cache is not None:
         result.append(new_cache)

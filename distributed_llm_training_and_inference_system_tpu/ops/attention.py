@@ -51,7 +51,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref,
                 o_ref, lse_ref,
                 acc_scratch, m_scratch, l_scratch,
                 *, causal: bool, block_q: int, block_k: int,
-                seq_len: int, scale: float):
+                seq_len: int, scale: float, q_mod: int = 0):
     qi = pl.program_id(1)   # q block index
     ki = pl.program_id(2)   # kv block index
 
@@ -61,7 +61,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref,
         m_scratch[:] = jnp.full_like(m_scratch, NEG_INF)
         l_scratch[:] = jnp.zeros_like(l_scratch)
 
-    q_start = qi * block_q
+    # GQA folding: q rows of all head-groups are stacked along the q axis
+    # (row r of group g is sequence position r % q_mod), so each KV block is
+    # loaded once per KV head instead of once per Q head
+    q_start = (qi * block_q) % q_mod if q_mod else qi * block_q
     k_start = ki * block_k
 
     run = True
@@ -71,9 +74,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref,
 
     @pl.when(run)
     def _body():
-        q = q_ref[...].astype(jnp.float32)           # [bq, d]
-        k = k_ref[...].astype(jnp.float32)           # [bk, d]
-        v = v_ref[...].astype(jnp.float32)           # [bk, d]
+        # dots stay in the input dtype (bf16 on TPU -> full MXU rate; fp32
+        # operands would run at a fraction of peak) with fp32 ACCUMULATION
+        # via preferred_element_type; softmax math is fp32 throughout
+        q = q_ref[...]                               # [bq, d]
+        k = k_ref[...]                               # [bk, d]
+        v = v_ref[...]                               # [bk, d]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # [bq, bk]
@@ -98,7 +104,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref,
         alpha = jnp.exp(jnp.where(m_new > NEG_INF / 2, m_prev - m_new, 0.0))
         l_new = alpha * l_scratch[...] + jnp.sum(p, axis=1, keepdims=True)
         acc_scratch[...] = acc_scratch[...] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         m_scratch[...] = m_new
         l_scratch[...] = l_new
 
@@ -111,17 +118,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref,
         lse_ref[...] = jnp.where(l > 0, lse, NEG_INF).astype(jnp.float32)
 
 
-def _fwd(q, k, v, q_segments, kv_segments, causal, block_q, block_k, scale):
+def _fwd(q, k, v, q_segments, kv_segments, causal, block_q, block_k, scale,
+         q_mod=0):
     """q: [BH, S, D] (heads folded into batch), segments: [BH, S]."""
     BH, S, D = q.shape
     Skv = k.shape[1]
-    bq = min(block_q, S)
+    # with GQA folding, a q block must never span two head groups
+    bq = min(block_q, q_mod) if q_mod else min(block_q, S)
     bk = min(block_k, Skv)
     grid = (BH, pl.cdiv(S, bq), pl.cdiv(Skv, bk))
 
     kernel = functools.partial(
         _fwd_kernel, causal=causal, block_q=bq, block_k=bk,
-        seq_len=Skv, scale=scale)
+        seq_len=Skv, scale=scale, q_mod=q_mod)
 
     out, lse = pl.pallas_call(
         kernel,
@@ -157,7 +166,7 @@ def _fwd(q, k, v, q_segments, kv_segments, causal, block_q, block_k, scale):
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, do_ref, lse_ref,
                    delta_ref, dq_ref, dq_scratch,
-                   *, causal, block_q, block_k, seq_len, scale):
+                   *, causal, block_q, block_k, seq_len, scale, q_mod=0):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -165,17 +174,19 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, do_ref, lse_ref,
     def _init():
         dq_scratch[...] = jnp.zeros_like(dq_scratch)
 
-    q_start, k_start = qi * block_q, ki * block_k
+    q_start = (qi * block_q) % q_mod if q_mod else qi * block_q
+    k_start = ki * block_k
     run = True
     if causal:
         run = k_start <= q_start + block_q - 1
 
     @pl.when(run)
     def _body():
-        q = q_ref[...].astype(jnp.float32)
-        k = k_ref[...].astype(jnp.float32)
-        v = v_ref[...].astype(jnp.float32)
-        do = do_ref[...].astype(jnp.float32)
+        # bf16 dot operands / fp32 accumulation, as in the forward kernel
+        q = q_ref[...]
+        k = k_ref[...]
+        v = v_ref[...]
+        do = do_ref[...]
         lse = lse_ref[...]                            # [bq, 1]
         delta = delta_ref[...]                        # [bq, 1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -190,7 +201,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, do_ref, lse_ref,
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)    # [bq, bk]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
+        ds = (p * (dp - delta) * scale).astype(k.dtype)
         dq_scratch[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
@@ -201,7 +212,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, do_ref, lse_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, do_ref, lse_ref,
                     delta_ref, dk_ref, dv_ref, dk_scratch, dv_scratch,
-                    *, causal, block_q, block_k, seq_len, scale):
+                    *, causal, block_q, block_k, seq_len, scale, q_mod=0):
     ki = pl.program_id(1)   # kv block (outer)
     qi = pl.program_id(2)   # q block (inner loop dim)
 
@@ -210,17 +221,19 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, do_ref, lse_ref,
         dk_scratch[...] = jnp.zeros_like(dk_scratch)
         dv_scratch[...] = jnp.zeros_like(dv_scratch)
 
-    q_start, k_start = qi * block_q, ki * block_k
+    q_start = (qi * block_q) % q_mod if q_mod else qi * block_q
+    k_start = ki * block_k
     run = True
     if causal:
         run = q_start + block_q - 1 >= k_start
 
     @pl.when(run)
     def _body():
-        q = q_ref[...].astype(jnp.float32)
-        k = k_ref[...].astype(jnp.float32)
-        v = v_ref[...].astype(jnp.float32)
-        do = do_ref[...].astype(jnp.float32)
+        # bf16 dot operands / fp32 accumulation, as in the forward kernel
+        q = q_ref[...]
+        k = k_ref[...]
+        v = v_ref[...]
+        do = do_ref[...]
         lse = lse_ref[...]
         delta = delta_ref[...]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -234,10 +247,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, do_ref, lse_ref,
         mask = mask & (qseg[:, None] == kseg[None, :]) & (kseg[None, :] != 0)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dv_scratch[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
         dk_scratch[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
@@ -247,18 +261,21 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, do_ref, lse_ref,
         dv_ref[...] = dv_scratch[...].astype(dv_ref.dtype)
 
 
-def _bwd(causal, block_q, block_k, scale, residuals, dout):
+def _bwd(causal, block_q, block_k, scale, q_mod, residuals, dout):
     q, k, v, q_segments, kv_segments, out, lse = residuals
     BH, S, D = q.shape
     Skv = k.shape[1]
-    bq = min(block_q, S)
+    bq = min(block_q, q_mod) if q_mod else min(block_q, S)
     bk = min(block_k, Skv)
-    do = dout.astype(jnp.float32)
-    delta = jnp.sum(do * out.astype(jnp.float32), axis=-1, keepdims=True)
+    # delta in fp32; dO itself stays in the compute dtype so kernel dots
+    # keep bf16 operands on TPU
+    delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    do = dout.astype(q.dtype)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal, block_q=bq,
-                          block_k=bk, seq_len=Skv, scale=scale),
+                          block_k=bk, seq_len=Skv, scale=scale, q_mod=q_mod),
         grid=(BH, pl.cdiv(S, bq), pl.cdiv(Skv, bk)),
         in_specs=[
             pl.BlockSpec((None, bq, D), lambda b, i, j: (b, i, 0)),
@@ -278,7 +295,7 @@ def _bwd(causal, block_q, block_k, scale, residuals, dout):
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, causal=causal, block_q=bq,
-                          block_k=bk, seq_len=Skv, scale=scale),
+                          block_k=bk, seq_len=Skv, scale=scale, q_mod=q_mod),
         grid=(BH, pl.cdiv(Skv, bk), pl.cdiv(S, bq)),
         in_specs=[
             pl.BlockSpec((None, bq, D), lambda b, j, i: (b, i, 0)),
@@ -310,23 +327,24 @@ def _bwd(causal, block_q, block_k, scale, residuals, dout):
 # Public API
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
-def _flash(q, k, v, q_segments, kv_segments, causal, block_q, block_k, scale):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash(q, k, v, q_segments, kv_segments, causal, block_q, block_k, scale,
+           q_mod=0):
     out, _ = _fwd(q, k, v, q_segments, kv_segments, causal, block_q,
-                  block_k, scale)
+                  block_k, scale, q_mod)
     return out
 
 
 def _flash_fwd(q, k, v, q_segments, kv_segments, causal, block_q, block_k,
-               scale):
+               scale, q_mod=0):
     out, lse = _fwd(q, k, v, q_segments, kv_segments, causal, block_q,
-                    block_k, scale)
+                    block_k, scale, q_mod)
     return out, (q, k, v, q_segments, kv_segments, out, lse)
 
 
 _flash.defvjp(_flash_fwd,
-              lambda causal, bq, bk, scale, res, g:
-              _bwd(causal, bq, bk, scale, res, g))
+              lambda causal, bq, bk, scale, q_mod, res, g:
+              _bwd(causal, bq, bk, scale, q_mod, res, g))
 
 
 def flash_attention(
@@ -341,11 +359,39 @@ def flash_attention(
     """Flash attention with GQA and packed-segment support.
 
     Matches models.layers.dot_product_attention numerics (fp32 softmax).
+
+    GQA runs KV-deduplicated: the G query heads sharing a KV head are
+    STACKED along the kernel's q-row axis (row r of group g = sequence
+    position r % S), so each KV block streams into VMEM once per KV head
+    instead of once per query head — KV HBM traffic and VMEM drop by Gx
+    versus the repeat-based fallback (round-1 verdict item 6).
     """
     B, S, Nq, D = q.shape
     Skv, Nkv = k.shape[1], k.shape[2]
     groups = Nq // Nkv
-    if groups > 1:   # GQA: repeat kv heads (kernel-side dedup is a TODO)
+    if segment_ids is None:
+        segs = jnp.ones((B, S), jnp.int32)
+    else:
+        segs = segment_ids.astype(jnp.int32)
+    scale = 1.0 / float(D) ** 0.5
+    bq = min(block_q, S)
+
+    if groups > 1 and Skv == S and S % bq == 0:
+        # fold query-head groups into q rows: [B,S,Nkv,G,D] ->
+        # [B*Nkv, G*S, D] (q head n = h*G + g, the repeat convention)
+        qf = q.reshape(B, S, Nkv, groups, D).transpose(0, 2, 3, 1, 4)
+        qf = qf.reshape(B * Nkv, groups * S, D)
+        kf = k.transpose(0, 2, 1, 3).reshape(B * Nkv, Skv, D)
+        vf = v.transpose(0, 2, 1, 3).reshape(B * Nkv, Skv, D)
+        segs_q = jnp.repeat(jnp.tile(segs, (1, groups)), Nkv,
+                            axis=0)[:, None, :]          # [B*Nkv, 1, G*S]
+        segs_kv = jnp.repeat(segs, Nkv, axis=0)[:, None, :]
+        out = _flash(qf, kf, vf, segs_q, segs_kv, causal,
+                     block_q, block_k, scale, S)
+        out = out.reshape(B, Nkv, groups, S, D).transpose(0, 3, 1, 2, 4)
+        return out.reshape(B, S, Nq, D).astype(q.dtype)
+
+    if groups > 1:   # irregular shapes: repeat-KV fallback
         k = jnp.repeat(k, groups, axis=2)
         v = jnp.repeat(v, groups, axis=2)
 
@@ -353,15 +399,10 @@ def flash_attention(
     def fold(x):
         return x.transpose(0, 2, 1, 3).reshape(B * Nq, x.shape[1], D)
 
-    if segment_ids is None:
-        segs = jnp.ones((B, S), jnp.int32)
-    else:
-        segs = segment_ids.astype(jnp.int32)
     segs_q = jnp.repeat(segs, Nq, axis=0)[:, None, :]   # [B*N, 1, S]
     segs_kv = segs_q if Skv == S else jnp.repeat(
         jnp.ones((B, Skv), jnp.int32), Nq, axis=0)[:, None, :]
 
-    scale = 1.0 / float(D) ** 0.5
     out = _flash(fold(q), fold(k), fold(v), segs_q, segs_kv, causal,
-                 block_q, block_k, scale)
+                 block_q, block_k, scale, 0)
     return out.reshape(B, Nq, S, D).transpose(0, 2, 1, 3).astype(q.dtype)

@@ -6,15 +6,16 @@ during generation, so every decode step recomputes the full prefix
 is the real thing: KV lives in fixed-size pages in HBM, each sequence owns a
 block table of page indices, and decode attends through the table.
 
-Layout (per layer): pages [num_pages, page_size, Nkv, D]. Static shapes
+Layout (per layer): pages [num_pages, Nkv, page_size, D]. Static shapes
 throughout — the block table has a fixed ``max_pages_per_seq`` width and
 unused entries point at the reserved scratch page 0, so XLA compiles one
 program regardless of how many sequences or tokens are live (SURVEY §7.3.2:
 continuous batching under XLA static shapes).
 
 The gather-based implementation below is the portable baseline; on TPU the
-same layout is consumed by a Pallas kernel that streams pages HBM->VMEM
-without materialising the gathered cache (ops/paged_attention_pallas).
+same layout is consumed by the Pallas kernel in ops/paged_attention_pallas
+that streams pages HBM->VMEM without materialising the gathered cache.
+``paged_attention(impl="auto")`` dispatches between them.
 """
 
 from __future__ import annotations
@@ -27,28 +28,42 @@ from ..models.layers import NEG_INF
 
 def paged_attention(
     q: jax.Array,            # [B, Nq, D] — one query token per sequence
-    k_pages: jax.Array,      # [NP, PS, Nkv, D]
-    v_pages: jax.Array,      # [NP, PS, Nkv, D]
+    k_pages: jax.Array,      # [NP, Nkv, PS, D]
+    v_pages: jax.Array,      # [NP, Nkv, PS, D]
     block_tables: jax.Array, # [B, maxP] int32 physical page ids
     lengths: jax.Array,      # [B] int32 — tokens already in cache INCLUDING
                              #   the current one (i.e. attend to [0, lengths))
+    impl: str = "auto",      # auto | pallas | gather
 ) -> jax.Array:
     """Decode attention: each row attends over its paged KV prefix.
 
     Returns [B, Nq, D] in q.dtype. GQA via head-group broadcast, softmax in
     fp32 — numerics match models.layers.dot_product_attention.
+
+    ``impl="auto"`` uses the page-streaming Pallas kernel on TPU (HBM
+    traffic proportional to live length) and this gather baseline
+    elsewhere.
     """
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "gather"
+    if impl == "pallas":
+        from .paged_attention_pallas import paged_attention_pallas
+        return paged_attention_pallas(
+            q, k_pages, v_pages, block_tables, lengths,
+            interpret=jax.default_backend() != "tpu")
     B, Nq, D = q.shape
-    NP, PS, Nkv, _ = k_pages.shape
+    NP, Nkv, PS, _ = k_pages.shape
     maxP = block_tables.shape[1]
     groups = Nq // Nkv
 
-    # Gather each row's pages: [B, maxP, PS, Nkv, D] -> [B, Lmax, Nkv, D]
-    k = k_pages[block_tables].reshape(B, maxP * PS, Nkv, D)
-    v = v_pages[block_tables].reshape(B, maxP * PS, Nkv, D)
+    # Gather each row's pages: [B, maxP, Nkv, PS, D] -> [B, Nkv, Lmax, D]
+    k = k_pages[block_tables].transpose(0, 2, 1, 3, 4).reshape(
+        B, Nkv, maxP * PS, D)
+    v = v_pages[block_tables].transpose(0, 2, 1, 3, 4).reshape(
+        B, Nkv, maxP * PS, D)
 
     qg = q.reshape(B, Nkv, groups, D)
-    scores = jnp.einsum("bhgd,bkhd->bhgk", qg, k.astype(q.dtype),
+    scores = jnp.einsum("bhgd,bhkd->bhgk", qg, k.astype(q.dtype),
                         preferred_element_type=jnp.float32)
     scores = scores / jnp.sqrt(jnp.float32(D))
 
@@ -57,21 +72,22 @@ def paged_attention(
     scores = jnp.where(valid[:, None, None, :], scores, NEG_INF)
 
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
-    out = jnp.einsum("bhgk,bkhd->bhgd", probs, v,
+    out = jnp.einsum("bhgk,bhkd->bhgd", probs, v,
                      preferred_element_type=jnp.float32)
     return out.reshape(B, Nq, D).astype(q.dtype)
 
 
 def write_token_to_pages(
-    pages: jax.Array,        # [NP, PS, Nkv, D]
+    pages: jax.Array,        # [NP, Nkv, PS, D]
     new_kv: jax.Array,       # [B, Nkv, D] — this step's K or V
     block_tables: jax.Array, # [B, maxP]
     positions: jax.Array,    # [B] int32 — slot-local position to write
 ) -> jax.Array:
     """Scatter one token per sequence into its page. Rows whose table entry
     is the scratch page (0) harmlessly overwrite scratch."""
-    logical_page = positions // pages.shape[1]
-    offset = positions % pages.shape[1]
+    page_size = pages.shape[2]
+    logical_page = positions // page_size
+    offset = positions % page_size
     phys = jnp.take_along_axis(block_tables, logical_page[:, None],
                                axis=1)[:, 0]                         # [B]
-    return pages.at[phys, offset].set(new_kv.astype(pages.dtype))
+    return pages.at[phys, :, offset].set(new_kv.astype(pages.dtype))

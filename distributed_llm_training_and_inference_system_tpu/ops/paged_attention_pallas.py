@@ -1,0 +1,144 @@
+"""Pallas TPU kernel for paged decode attention: stream pages HBM->VMEM.
+
+The gather baseline (ops/paged_attention.py) materialises every slot's full
+[Nkv, maxP*PS, D] KV prefix in HBM each decode step — O(max_seq) traffic per
+token regardless of the sequence's actual length. This kernel reads only the
+pages a sequence owns:
+
+- Grid (B, Nkv, maxP), page index innermost. The page arrays stay in HBM;
+  each grid step's BlockSpec uses the scalar-prefetched block table to DMA
+  exactly one physical page [PS, D] into VMEM (``PrefetchScalarGridSpec``
+  — the pallas_guide.md pattern for data-dependent addressing). Pallas
+  double-buffers the copies, overlapping page DMA with compute.
+- Pages past a sequence's live length are CLAMPED to its last used page in
+  the index map. Consecutive identical block indices elide the re-fetch
+  entirely (the pipeline emitter skips the DMA), so per-token HBM traffic is
+  proportional to the sequence's true length — the whole point of paging.
+- Online softmax in fp32 VMEM scratch across pages (same recurrence as the
+  training-side flash kernel); GQA folds the q-head group into the tile so
+  each KV page is loaded ONCE per kv head, not once per q head.
+
+Numerics match ops.paged_attention.paged_attention (the gather baseline) —
+asserted in tests/test_serve.py. The baseline remains the CPU/interpret
+fallback.
+
+Reference defect this replaces: the dead KVCacheManager + full-prefix
+recompute at reference serve/server.py:57-87,199-204.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..models.layers import NEG_INF
+
+
+def _decode_kernel(tables_ref, used_ref,          # scalar prefetch
+                   q_ref,                          # [G, D] VMEM
+                   k_ref, v_ref,                   # [PS, D] VMEM (one page)
+                   o_ref,                          # [G, D] VMEM out
+                   acc_ref, m_ref, l_ref,          # VMEM scratch
+                   *, page_size: int, scale: float):
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+
+    @pl.when(p == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    length = used_ref[b]                 # tokens live in this row's cache
+
+    @pl.when(p * page_size < length)
+    def _body():
+        q = q_ref[...].astype(jnp.float32)            # [G, D]
+        k = k_ref[...].astype(jnp.float32)            # [PS, D]
+        v = v_ref[...].astype(jnp.float32)            # [PS, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale        # [G, PS]
+        pos = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(pos < length, s, NEG_INF)
+
+        m_prev = m_ref[...]                            # [G, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        # fully-masked guard: exp(NEG_INF - NEG_INF) would be 1
+        p_ = jnp.exp(jnp.where(m_new > NEG_INF / 2, s - m_new, NEG_INF))
+        alpha = jnp.exp(jnp.where(m_new > NEG_INF / 2, m_prev - m_new, 0.0))
+        l_ref[...] = alpha * l_ref[...] + jnp.sum(p_, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p_, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = m_new
+
+    @pl.when(p == pl.num_programs(2) - 1)
+    def _finalize():
+        l = l_ref[...]
+        o_ref[...] = (acc_ref[...] / jnp.where(l > 0, l, 1.0)).astype(
+            o_ref.dtype)
+
+
+def paged_attention_pallas(
+    q: jax.Array,            # [B, Nq, D] — one query token per sequence
+    k_pages: jax.Array,      # [NP, Nkv, PS, D]
+    v_pages: jax.Array,
+    block_tables: jax.Array, # [B, maxP] int32 physical page ids
+    lengths: jax.Array,      # [B] int32 — attend over [0, lengths)
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Returns [B, Nq, D] in q.dtype; same contract as the gather baseline."""
+    B, Nq, D = q.shape
+    NP, Nkv, PS, _ = k_pages.shape
+    maxP = block_tables.shape[1]
+    groups = Nq // Nkv
+    scale = 1.0 / float(D) ** 0.5
+
+    qg = q.reshape(B, Nkv, groups, D)
+    lengths = lengths.astype(jnp.int32)
+    # pages_used - 1 per row, for the tail clamp (lengths >= 1 in decode:
+    # the current token is always live)
+    last_used = jnp.maximum((lengths + PS - 1) // PS - 1, 0)   # [B]
+
+    # Pre-clamp the table outside the kernel (cheap vector op) so the index
+    # map stays a pure lookup: past-the-end pages repeat the row's last live
+    # page, and consecutive identical block indices elide the DMA.
+    clamped_p = jnp.minimum(
+        jnp.arange(maxP, dtype=jnp.int32)[None, :], last_used[:, None])
+    tables_clamped = jnp.take_along_axis(
+        block_tables.astype(jnp.int32), clamped_p, axis=1)      # [B, maxP]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,       # tables_clamped, lengths
+        grid=(B, Nkv, maxP),
+        in_specs=[
+            pl.BlockSpec((None, None, groups, D),
+                         lambda b, h, p, t, u: (b, h, 0, 0)),   # q
+            pl.BlockSpec((None, None, PS, D),
+                         lambda b, h, p, t, u: (t[b, p], h, 0, 0)),  # k page
+            pl.BlockSpec((None, None, PS, D),
+                         lambda b, h, p, t, u: (t[b, p], h, 0, 0)),  # v page
+        ],
+        out_specs=pl.BlockSpec((None, None, groups, D),
+                               lambda b, h, p, t, u: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((groups, D), jnp.float32),
+            pltpu.VMEM((groups, 1), jnp.float32),
+            pltpu.VMEM((groups, 1), jnp.float32),
+        ],
+    )
+
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, page_size=PS, scale=scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Nkv, groups, D), q.dtype),
+        interpret=interpret,
+    )(tables_clamped, lengths, qg, k_pages, v_pages)
+    return out.reshape(B, Nq, D)

@@ -31,7 +31,7 @@ def decode_step_forward(
     params: Any,
     tokens: jax.Array,        # [B] int32 — the newest token per slot
     positions: jax.Array,     # [B] int32 — position of that token
-    k_pages: jax.Array,       # [L, NP, PS, Nkv, D]
+    k_pages: jax.Array,       # [L, NP, Nkv, PS, D]
     v_pages: jax.Array,
     block_tables: jax.Array,  # [B, maxP] int32
     cfg: ModelConfig,

@@ -108,6 +108,9 @@ class InferenceEngine:
         self._decode_jit = jax.jit(self._decode_impl, donate_argnums=(1, 2))
         self.total_decode_steps = 0
         self.total_prefill_tokens = 0
+        # decode always runs over all slots (one compiled program); padded
+        # slots are wasted work — tracked so batch-size tuning isn't blind
+        self.total_padded_slot_steps = 0
 
     # -- setup ---------------------------------------------------------------
 
@@ -165,10 +168,13 @@ class InferenceEngine:
                     params, tokens, cfg, kv_cache=zeros,
                     cache_offset=jnp.zeros((1,), jnp.int32),
                     unembed_positions=length - 1)
-                kd = kd[:, 0].reshape(cfg.num_layers, n_pages,
-                                      self.kv.page_size, cfg.num_kv_heads,
-                                      cfg.head_dim)
-                vd = vd[:, 0].reshape(kd.shape)
+                # dense [L, bucket, Nkv, D] -> paged [L, n_pages, Nkv, PS, D]
+                kd = kd[:, 0].reshape(
+                    cfg.num_layers, n_pages, self.kv.page_size,
+                    cfg.num_kv_heads, cfg.head_dim).transpose(0, 1, 3, 2, 4)
+                vd = vd[:, 0].reshape(
+                    cfg.num_layers, n_pages, self.kv.page_size,
+                    cfg.num_kv_heads, cfg.head_dim).transpose(0, 1, 3, 2, 4)
                 k_pages = k_pages.at[:, entries].set(kd)
                 v_pages = v_pages.at[:, entries].set(vd)
                 token = sample_tokens(logits[:, 0], key[None], temp[None],
@@ -238,6 +244,8 @@ class InferenceEngine:
             jnp.asarray(self._slot_keys), jnp.asarray(self.temperature),
             jnp.asarray(self.top_k), jnp.asarray(self.top_p))
         self.total_decode_steps += 1
+        self.total_padded_slot_steps += int(
+            self.serve_cfg.max_batch_size - self.active.sum())
         return np.asarray(sampled)
 
     def _apply_decode(self, sampled: np.ndarray) -> None:
@@ -274,8 +282,14 @@ class InferenceEngine:
         """
         static = self.serve_cfg.scheduler == "static"
         with self.lock:
-            admitted = ([] if static and self.scheduler.active_count > 0
-                        else self.scheduler.admit())
+            if static:
+                # static batches form only when fully drained — there are no
+                # resident streams to protect, so no prefill budget applies
+                admitted = ([] if self.scheduler.active_count > 0
+                            else self.scheduler.admit())
+            else:
+                admitted = self.scheduler.admit(
+                    self.serve_cfg.prefill_budget_tokens)
         for req in admitted:
             self._prefill(req)
         if admitted:
@@ -344,9 +358,14 @@ class InferenceEngine:
         return reqs
 
     def stats(self) -> dict:
+        steps = max(self.total_decode_steps, 1)
         return {
             **self.scheduler.stats(),
             "kv": self.kv.stats(),
             "decode_steps": self.total_decode_steps,
             "prefill_tokens": self.total_prefill_tokens,
+            "padded_slot_steps": self.total_padded_slot_steps,
+            "decode_slot_utilization": round(
+                1.0 - self.total_padded_slot_steps
+                / (steps * self.serve_cfg.max_batch_size), 4),
         }

@@ -4,7 +4,7 @@ Replaces the reference's LRU-dict KVCacheManager that generation never reads
 (reference serve/server.py:57-87, defect SURVEY §2.4.2). Design is
 vLLM-style paging mapped onto XLA's static-shape world:
 
-- All layers' pages live in two arrays [L, num_pages, page_size, Nkv, D] in
+- All layers' pages live in two arrays [L, num_pages, Nkv, page_size, D] in
   HBM (one allocation, no fragmentation).
 - Page 0 is reserved scratch: every unused block-table entry points at it,
   so the jitted decode step can run over ALL slots every step — inactive
@@ -50,7 +50,10 @@ class PagedKVCache:
         self.num_pages = num_pages
         self.dtype = dtype
 
-        shape = (cfg.num_layers, num_pages, page_size, cfg.num_kv_heads,
+        # [L, NP, Nkv, PS, D] — (PS, D) minor-most so the Pallas decode
+        # kernel can DMA one [PS, D] page tile per (kv-head, page) grid step
+        # (TPU block shapes must end in the tiled dims)
+        shape = (cfg.num_layers, num_pages, cfg.num_kv_heads, page_size,
                  cfg.head_dim)
         self.k_pages = jnp.zeros(shape, dtype)
         self.v_pages = jnp.zeros(shape, dtype)

@@ -50,6 +50,7 @@ class InferenceServer:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._recent_latencies: list[float] = []
+        self._recent_ttfts: list[float] = []
         self._engine_error: Optional[str] = None
         self._engine_error_count = 0
         self._waiters: dict[str, tuple[asyncio.AbstractEventLoop, asyncio.Event]] = {}
@@ -205,6 +206,8 @@ class InferenceServer:
 
         latency_ms = (req.finish_time - req.arrival_time) * 1000.0
         self._recent_latencies = (self._recent_latencies + [latency_ms])[-1000:]
+        if req.ttft_ms is not None:
+            self._recent_ttfts = (self._recent_ttfts + [req.ttft_ms])[-1000:]
         n_gen = len(req.generated_tokens)
         self.observer("inference_request", {
             "latency_ms": latency_ms, "ttft_ms": req.ttft_ms,
@@ -240,14 +243,20 @@ class InferenceServer:
     async def handle_health(self, request: web.Request) -> web.Response:
         with self._lock:
             stats = self.engine.stats()
-        lats = sorted(self._recent_latencies)
-        p50 = lats[len(lats) // 2] if lats else None
+        def pct(xs, q):
+            if not xs:
+                return None
+            s = sorted(xs)
+            return s[min(int(q * len(s)), len(s) - 1)]
+
         healthy = self._engine_error is None
         return web.json_response({
             "status": "healthy" if healthy else "degraded",
             "model": self.model_cfg.name,
             "engine": stats,
-            "p50_latency_ms": p50,
+            "p50_latency_ms": pct(self._recent_latencies, 0.50),
+            "ttft_ms": {"p50": pct(self._recent_ttfts, 0.50),
+                        "p99": pct(self._recent_ttfts, 0.99)},
             "last_engine_error": self._engine_error,
             "engine_error_count": self._engine_error_count,
         }, status=200 if healthy else 503)

@@ -143,3 +143,37 @@ def test_flops_per_token_sane():
     f = flops_per_token(cfg7, 2048)
     # ~6 * 7e9 ≈ 4.2e10 dense + attention term
     assert 3e10 < f < 9e10
+
+
+def test_chunked_loss_matches_dense(cfg, params):
+    """chunked_next_token_loss (scan + per-chunk remat, no [B,S,V] resident)
+    must match the dense next_token_loss in value AND gradient, including
+    packed-segment masking."""
+    from distributed_llm_training_and_inference_system_tpu.exec.train_step import (
+        _loss_fn)
+
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (2, 64), 1,
+                                cfg.vocab_size)
+    segs = jnp.concatenate([jnp.ones((2, 40), jnp.int32),
+                            2 * jnp.ones((2, 20), jnp.int32),
+                            jnp.zeros((2, 4), jnp.int32)], axis=1)
+    batch = {"tokens": tokens, "segment_ids": segs}
+
+    def dense(p):
+        total, (loss, count) = _loss_fn(p, batch, cfg, "xla", "none",
+                                        loss_chunk=0)
+        return total
+
+    def chunked(p):
+        total, (loss, count) = _loss_fn(p, batch, cfg, "xla", "none",
+                                        loss_chunk=24)   # non-divisor: pads
+        return total
+
+    l_ref, g_ref = jax.value_and_grad(dense)(params)
+    l_chk, g_chk = jax.value_and_grad(chunked)(params)
+    np.testing.assert_allclose(float(l_chk), float(l_ref), rtol=1e-5)
+    flat_r = jax.tree_util.tree_leaves(g_ref)
+    flat_c = jax.tree_util.tree_leaves(g_chk)
+    for r, c in zip(flat_r, flat_c):
+        np.testing.assert_allclose(np.asarray(c), np.asarray(r),
+                                   rtol=2e-4, atol=1e-5)

@@ -330,3 +330,49 @@ class TestReviewRegressions:
         [ok] = eng.generate([[1, 2, 3]], SamplingParams(temperature=0.0,
                                                         max_tokens=2))
         assert ok.state is RequestState.FINISHED
+
+
+class TestPrefillDecodeInterleaving:
+    def test_long_prompt_burst_does_not_stall_resident_stream(self, model_cfg):
+        """With a prefill token budget per step, a burst of long prompts is
+        admitted across MULTIPLE engine steps, and the resident stream
+        gains one token per step throughout (round-1 verdict weak #4 /
+        next-round #9)."""
+        eng = make_engine(model_cfg, max_batch_size=8,
+                          prefill_budget_tokens=40)
+        # resident stream first
+        resident = Request(request_id="res", prompt_tokens=[5, 17, 99],
+                           sampling=SamplingParams(temperature=0.0,
+                                                   max_tokens=100))
+        assert eng.scheduler.add_request(resident)
+        eng.step()
+        assert resident.state is RequestState.RUNNING
+
+        # burst of 5 long prompts (40 tokens each; budget admits ~1/step)
+        burst = [Request(request_id=f"b{i}",
+                         prompt_tokens=list(range(1, 41)),
+                         sampling=SamplingParams(temperature=0.0,
+                                                 max_tokens=4))
+                 for i in range(5)]
+        for r in burst:
+            assert eng.scheduler.add_request(r)
+
+        admits_per_step = []
+        for _ in range(6):
+            before = eng.scheduler.total_admitted
+            tokens_before = len(resident.generated_tokens)
+            eng.step()
+            admits_per_step.append(eng.scheduler.total_admitted - before)
+            # the resident stream advanced THIS step — no multi-prefill stall
+            assert len(resident.generated_tokens) == tokens_before + 1
+        # the burst was spread over multiple steps, not swallowed in one
+        assert max(admits_per_step) <= 2
+        assert sum(admits_per_step) >= 4
+
+    def test_padded_slot_accounting(self, model_cfg):
+        eng = make_engine(model_cfg, max_batch_size=4)
+        [req] = eng.generate([[5, 17, 99]],
+                             SamplingParams(temperature=0.0, max_tokens=5))
+        stats = eng.stats()
+        assert stats["padded_slot_steps"] > 0          # 3 idle slots/step
+        assert 0.0 < stats["decode_slot_utilization"] < 1.0
