@@ -1,0 +1,52 @@
+import time, jax, jax.numpy as jnp, numpy as np
+from distributed_llm_training_and_inference_system_tpu.config import get_model_config
+from distributed_llm_training_and_inference_system_tpu.config.schema import ServeConfig
+from distributed_llm_training_and_inference_system_tpu.models import gpt
+from distributed_llm_training_and_inference_system_tpu.serve.decode import decode_multi_step
+import distributed_llm_training_and_inference_system_tpu.ops.paged_attention as PA
+
+cfg = get_model_config("gpt-1b")
+B, PS, max_seq = 8, 64, 1024
+maxP = max_seq // PS
+NP = 1 + B * maxP
+params = gpt.init(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+L, Nkv, D = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim
+kp = jnp.zeros((L, NP, Nkv, PS, D), jnp.bfloat16)
+vp = jnp.zeros((L, NP, Nkv, PS, D), jnp.bfloat16)
+bt = np.zeros((B, maxP), np.int32)
+n = 0
+for b in range(B):
+    bt[b, :8] = np.arange(1 + n, 9 + n); n += 8   # 512 tokens resident
+bt = jnp.asarray(bt)
+toks = jnp.ones((B,), jnp.int32)
+pos = jnp.full((B,), 512, jnp.int32)
+stops = jnp.full((B,), 1000, jnp.int32)
+keys = jnp.zeros((B, 2), jnp.uint32)
+temp = jnp.zeros((B,), jnp.float32)
+tk = jnp.zeros((B,), jnp.int32)
+tp = jnp.ones((B,), jnp.float32)
+
+import sys
+impl = sys.argv[1] if len(sys.argv) > 1 else "auto"
+if impl != "auto":
+    orig = PA.paged_attention
+    def forced(*a, **kw):
+        kw["impl"] = impl
+        return orig(*a, **kw)
+    PA.paged_attention = forced
+    import distributed_llm_training_and_inference_system_tpu.serve.decode as dec
+    dec.paged_attention = forced
+
+for K in (1, 8, 32):
+    f = jax.jit(lambda t, p, kp, vp: decode_multi_step(
+        params, t, p, kp, vp, bt, stops, keys, temp, tk, tp, cfg, num_steps=K),
+        donate_argnums=(2, 3))
+    out, kp, vp = f(toks, pos, kp, vp)
+    int(out[0, 0])
+    t0 = time.perf_counter()
+    reps = 3
+    for _ in range(reps):
+        out, kp, vp = f(toks, pos, kp, vp)
+    int(out[0, 0])
+    dt = (time.perf_counter() - t0) / reps
+    print(f"impl={impl} K={K}: {dt*1e3:8.1f} ms/dispatch = {dt/K*1e3:6.1f} ms/token-step")

@@ -1,0 +1,32 @@
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS","") + " --xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import dataclasses
+from distributed_llm_training_and_inference_system_tpu.config import (
+    OptimizerConfig, ParallelConfig, get_model_config)
+from distributed_llm_training_and_inference_system_tpu.parallel import ShardedTrainer
+
+cfg = dataclasses.replace(get_model_config("gpt-test"), num_layers=4)
+
+def temp_bytes(schedule, M):
+    par = ParallelConfig(pipeline_parallel=4, data_parallel=2,
+                         num_microbatches=M, micro_batch_size=1,
+                         global_batch_size=2 * M,
+                         pipeline_schedule=schedule,
+                         activation_checkpoint="none")
+    tr = ShardedTrainer(cfg, OptimizerConfig(), par, devices=jax.devices()[:8])
+    tr.init_state(seed=0)
+    batch = {"tokens": jnp.ones((2 * M, 32), jnp.int32)}
+    from distributed_llm_training_and_inference_system_tpu.parallel.api import use_mesh
+    with use_mesh(tr.mesh):
+        lowered = tr.train_step.lower(tr.state, tr.shard_batch(batch))
+        c = lowered.compile()
+        ma = c.memory_analysis()
+        return ma.temp_size_in_bytes if ma else None
+
+for sched in ("gpipe", "1f1b"):
+    for M in (4, 16):
+        print(sched, M, temp_bytes(sched, M))

@@ -148,7 +148,8 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
             model=model_name, max_batch_size=min(requests, 8),
             max_seq_len=min(prompt_len + gen_len + 16,
                             cfg.max_position_embeddings),
-            kv_block_size=16, dtype="bfloat16" if on_tpu else "float32"))
+            kv_block_size=64 if on_tpu else 16,
+            dtype="bfloat16" if on_tpu else "float32"))
         rng = np.random.default_rng(0)
         prompts = [[int(t) for t in rng.integers(1, cfg.vocab_size,
                                                  size=prompt_len)]

@@ -256,6 +256,11 @@ class ParallelConfig:
     global_batch_size: int = 8
     gradient_accumulation_steps: int = 1
     num_microbatches: int = 1       # pipeline microbatches per step
+    # gpipe: autodiff-through-scan (activation memory grows with
+    # num_microbatches); 1f1b: interleaved fwd/bwd schedule with a
+    # constant-size stage-input ring (memory independent of M) — the
+    # BASELINE config-3 schedule
+    pipeline_schedule: str = "1f1b"
 
     def validate(self) -> None:
         for f_ in ("data_parallel", "fsdp", "tensor_parallel", "pipeline_parallel",
@@ -269,6 +274,8 @@ class ParallelConfig:
         if self.pipeline_parallel > 1 and self.num_microbatches < self.pipeline_parallel:
             raise ConfigError(
                 "num_microbatches must be >= pipeline_parallel for a full pipeline")
+        if self.pipeline_schedule not in ("gpipe", "1f1b"):
+            raise ConfigError("pipeline_schedule must be gpipe|1f1b")
 
     @property
     def total_devices(self) -> int:
@@ -450,6 +457,11 @@ class ServeConfig:
     # max prompt tokens prefetched between two decode steps; bounds the
     # inter-token stall resident streams see during a long-prompt burst
     prefill_budget_tokens: int = 2048
+    # decode iterations fused into one device dispatch (lax.scan): each
+    # dispatch pays one host round trip for K tokens. Finished requests
+    # waste at most K-1 trailing iterations; admission happens between
+    # dispatches, so K also bounds admission latency in decode steps.
+    decode_steps_per_dispatch: int = 8
     # tokens per KV-cache page: 64 makes each page a [64, D] DMA tile for
     # the Pallas decode kernel (16-token pages measured 2.4x slower — DMA
     # too small); internal fragmentation is at most page_size-1 tokens/seq

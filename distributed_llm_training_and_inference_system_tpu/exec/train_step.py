@@ -78,6 +78,7 @@ def make_train_step(
     attn_impl: str = "xla",
     loss_fn: Optional[Callable] = None,
     loss_chunk: int = 512,
+    grad_fn: Optional[Callable] = None,
 ) -> tuple[Callable, optax.GradientTransformation, Callable]:
     """Build (train_step, tx, schedule).
 
@@ -87,18 +88,23 @@ def make_train_step(
     accumulation boundary (engine.py:294-305) in one compiled program.
 
     A custom ``loss_fn(params, batch) -> (total, (loss, count))`` overrides
-    the default forward (used by the pipeline-parallel runner, which packs
-    its own microbatching — accumulation is then forced to 1).
+    the default forward (used by the GPipe pipeline runner, which packs its
+    own microbatching — accumulation is then forced to 1). A custom
+    ``grad_fn(params, batch) -> ((total, (loss, count)), grads)`` bypasses
+    autodiff entirely (the 1F1B pipeline schedule computes its backward
+    inside its own schedule scan).
     """
     par_cfg = par_cfg or ParallelConfig()
     tx, schedule = make_optimizer(opt_cfg)
-    accum = max(par_cfg.gradient_accumulation_steps, 1) if loss_fn is None else 1
+    custom = loss_fn is not None or grad_fn is not None
+    accum = 1 if custom else max(par_cfg.gradient_accumulation_steps, 1)
     remat = par_cfg.activation_checkpoint
-    if loss_fn is None:
-        loss_fn = functools.partial(_loss_fn, model_cfg=model_cfg,
-                                    attn_impl=attn_impl, remat=remat,
-                                    loss_chunk=loss_chunk)
-    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    if grad_fn is None:
+        if loss_fn is None:
+            loss_fn = functools.partial(_loss_fn, model_cfg=model_cfg,
+                                        attn_impl=attn_impl, remat=remat,
+                                        loss_chunk=loss_chunk)
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
     def train_step(state: TrainState, batch: dict[str, jax.Array]):
         if accum == 1:

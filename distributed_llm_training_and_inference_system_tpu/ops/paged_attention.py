@@ -82,12 +82,18 @@ def write_token_to_pages(
     new_kv: jax.Array,       # [B, Nkv, D] — this step's K or V
     block_tables: jax.Array, # [B, maxP]
     positions: jax.Array,    # [B] int32 — slot-local position to write
+    active: jax.Array = None,  # [B] bool — rows past their stop write scratch
 ) -> jax.Array:
     """Scatter one token per sequence into its page. Rows whose table entry
-    is the scratch page (0) harmlessly overwrite scratch."""
+    is the scratch page (0) — or whose ``active`` mask is False (multi-step
+    decode continuing past a row's token budget) — harmlessly overwrite
+    scratch page 0 instead of corrupting pages beyond the block table."""
     page_size = pages.shape[2]
-    logical_page = positions // page_size
+    maxP = block_tables.shape[1]
+    logical_page = jnp.clip(positions // page_size, 0, maxP - 1)
     offset = positions % page_size
     phys = jnp.take_along_axis(block_tables, logical_page[:, None],
                                axis=1)[:, 0]                         # [B]
+    if active is not None:
+        phys = jnp.where(active, phys, 0)
     return pages.at[phys, :, offset].set(new_kv.astype(pages.dtype))

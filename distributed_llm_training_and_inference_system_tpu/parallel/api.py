@@ -60,13 +60,21 @@ class ShardedTrainer:
         self.par_cfg = par_cfg
         self.mesh = build_mesh(par_cfg, devices)
         self.pipelined = par_cfg.pipeline_parallel > 1
-        custom_loss = None
+        custom_loss = custom_grad = None
         if self.pipelined:
-            from .pipeline import make_pipeline_loss_fn
-            custom_loss = make_pipeline_loss_fn(model_cfg, par_cfg, attn_impl)
+            if par_cfg.pipeline_schedule == "1f1b" and not model_cfg.is_moe:
+                from .pipeline import make_pipeline_grad_fn
+                custom_grad = make_pipeline_grad_fn(model_cfg, par_cfg,
+                                                    attn_impl)
+            else:
+                # MoE needs the autodiff (GPipe) schedule for its aux-loss
+                # gradient path
+                from .pipeline import make_pipeline_loss_fn
+                custom_loss = make_pipeline_loss_fn(model_cfg, par_cfg,
+                                                    attn_impl)
         step_fn, tx, schedule = make_train_step(
             model_cfg, opt_cfg, par_cfg, attn_impl=attn_impl,
-            loss_fn=custom_loss)
+            loss_fn=custom_loss, grad_fn=custom_grad)
         self.tx, self.schedule = tx, schedule
         self._specs, self._abstract = state_specs(
             model_cfg, tx, self.mesh, par_cfg.zero_stage)

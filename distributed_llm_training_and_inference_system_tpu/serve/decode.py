@@ -35,6 +35,7 @@ def decode_step_forward(
     v_pages: jax.Array,
     block_tables: jax.Array,  # [B, maxP] int32
     cfg: ModelConfig,
+    active: Any = None,       # [B] bool — inactive rows write scratch page
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Returns (logits [B, V] fp32, new k_pages, new v_pages).
 
@@ -65,8 +66,8 @@ def decode_step_forward(
         q = apply_rope(q[:, None], positions[:, None], inv_freq)[:, 0]
         k = apply_rope(k[:, None], positions[:, None], inv_freq)[:, 0]
 
-        kp = write_token_to_pages(kp, k, block_tables, positions)
-        vp = write_token_to_pages(vp, v, block_tables, positions)
+        kp = write_token_to_pages(kp, k, block_tables, positions, active)
+        vp = write_token_to_pages(vp, v, block_tables, positions, active)
         attn = paged_attention(q, kp, vp, block_tables, lengths)
         x = x + (attn.reshape(B, Nq * D) @ layer["o"]["kernel"]).astype(x.dtype)
 
@@ -93,3 +94,55 @@ def decode_step_forward(
                             params["lm_head"]["kernel"].astype(x.dtype),
                             preferred_element_type=jnp.float32)
     return logits.astype(jnp.float32), new_k, new_v
+
+
+def decode_multi_step(
+    params: Any,
+    tokens: jax.Array,          # [B] int32 — newest token per slot
+    positions: jax.Array,       # [B] int32 — its position
+    k_pages: jax.Array,         # [L, NP, Nkv, PS, D]
+    v_pages: jax.Array,
+    block_tables: jax.Array,    # [B, maxP]
+    stop_positions: jax.Array,  # [B] — first position a slot must NOT write
+    slot_keys: jax.Array,       # [B, 2] uint32 PRNG key data
+    temperature: jax.Array,     # [B]
+    top_k: jax.Array,           # [B]
+    top_p: jax.Array,           # [B]
+    cfg: ModelConfig,
+    num_steps: int,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Run ``num_steps`` decode+sample iterations in ONE compiled program.
+
+    The host-driven single-step loop costs one host<->device round trip per
+    generated token; on a remote/tunneled device that RTT (~100 ms measured
+    here) dwarfs the ~3 ms decode compute, and even co-located hosts pay
+    dispatch + sync per token. Scanning K steps on device amortises that Kx
+    (vLLM-style multi-step scheduling, TPU-shaped: the scan is one XLA
+    program, sampling included).
+
+    Per-slot stop handling: rows at/past ``stop_positions`` redirect KV
+    writes to scratch page 0 and re-emit their previous token. Slots that
+    hit EOS mid-scan keep decoding into their (reserved) pages; the host
+    trims trailing tokens — at most ``num_steps - 1`` wasted iterations per
+    finished request. Sampling folds the per-slot key by position exactly
+    like the single-step path, so generations are bit-identical to
+    ``num_steps=1``.
+
+    Returns ([K, B] sampled tokens, new k_pages, new v_pages).
+    """
+    from .sampling import sample_tokens
+
+    def one(carry, _):
+        toks, pos, kp, vp = carry
+        act = pos < stop_positions
+        logits, kp, vp = decode_step_forward(
+            params, toks, pos, kp, vp, block_tables, cfg, active=act)
+        keys = jax.vmap(jax.random.fold_in)(
+            jax.vmap(jax.random.wrap_key_data)(slot_keys), pos + 1)
+        nxt = sample_tokens(logits, keys, temperature, top_k, top_p)
+        nxt = jnp.where(act, nxt, toks)
+        return (nxt, pos + 1, kp, vp), nxt
+
+    (_, _, k_pages, v_pages), toks_seq = jax.lax.scan(
+        one, (tokens, positions, k_pages, v_pages), None, length=num_steps)
+    return toks_seq, k_pages, v_pages

@@ -38,7 +38,7 @@ import numpy as np
 
 from ..config.schema import ModelConfig, ServeConfig
 from ..models import gpt
-from .decode import decode_step_forward
+from .decode import decode_multi_step
 from .kv_cache import PagedKVCache
 from .sampling import sample_tokens
 from .scheduler import ContinuousBatchingScheduler, Request, SamplingParams
@@ -96,6 +96,7 @@ class InferenceEngine:
         # per-slot host state
         self.last_tokens = np.zeros(S, np.int32)
         self.positions = np.zeros(S, np.int32)    # cached length per slot
+        self.stop_positions = np.zeros(S, np.int32)  # first un-writable pos
         self.active = np.zeros(S, bool)
         self.temperature = np.full(S, 1.0, np.float32)
         self.top_k = np.zeros(S, np.int32)
@@ -218,6 +219,10 @@ class InferenceEngine:
         req.state = RequestState.RUNNING
         self.last_tokens[slot] = int(token)
         self.positions[slot] = n
+        # first position this slot may NOT write: its page reservation
+        # covers prompt + max_tokens, and multi-step decode masks writes
+        # at/past this bound to scratch page 0
+        self.stop_positions[slot] = n + req.sampling.max_tokens
         self.active[slot] = True
         self.temperature[slot] = s.temperature
         self.top_k[slot] = s.top_k
@@ -227,36 +232,49 @@ class InferenceEngine:
     # -- decode --------------------------------------------------------------
 
     def _decode_impl(self, params, k_pages, v_pages, tokens, positions,
-                     tables, slot_keys, temp, top_k, top_p):
-        logits, k_pages, v_pages = decode_step_forward(
-            params, tokens, positions, k_pages, v_pages, tables, self.cfg)
-        keys = jax.vmap(jax.random.fold_in)(
-            jax.vmap(jax.random.wrap_key_data)(slot_keys), positions + 1)
-        sampled = sample_tokens(logits, keys, temp, top_k, top_p)
-        return sampled, k_pages, v_pages
+                     tables, stops, slot_keys, temp, top_k, top_p):
+        return decode_multi_step(
+            params, tokens, positions, k_pages, v_pages, tables, stops,
+            slot_keys, temp, top_k, top_p, self.cfg,
+            num_steps=max(self.serve_cfg.decode_steps_per_dispatch, 1))
 
     def _decode_device(self) -> np.ndarray:
-        """Dispatch one decode step for every slot; lock-free device work."""
-        sampled, self.kv.k_pages, self.kv.v_pages = self._decode_jit(
+        """Dispatch K decode steps for every slot; lock-free device work.
+
+        One dispatch + one device->host fetch per K tokens: the
+        host-round-trip cost (the decode bottleneck on remote devices) is
+        amortised K-fold (see decode.decode_multi_step)."""
+        sampled_seq, self.kv.k_pages, self.kv.v_pages = self._decode_jit(
             self.params, self.kv.k_pages, self.kv.v_pages,
             jnp.asarray(self.last_tokens), jnp.asarray(self.positions),
             jnp.asarray(self.kv.block_tables),
+            jnp.asarray(self.stop_positions),
             jnp.asarray(self._slot_keys), jnp.asarray(self.temperature),
             jnp.asarray(self.top_k), jnp.asarray(self.top_p))
-        self.total_decode_steps += 1
-        self.total_padded_slot_steps += int(
+        out = np.asarray(sampled_seq)              # [K, B]
+        self.total_decode_steps += out.shape[0]
+        self.total_padded_slot_steps += out.shape[0] * int(
             self.serve_cfg.max_batch_size - self.active.sum())
-        return np.asarray(sampled)
+        return out
 
-    def _apply_decode(self, sampled: np.ndarray) -> None:
-        """Host bookkeeping for a decode step (called under self.lock)."""
+    def _apply_decode(self, sampled_seq: np.ndarray) -> None:
+        """Host bookkeeping for K decode steps (called under self.lock).
+
+        Continuing slots accept all K tokens (positions advance in lockstep
+        with the device scan carry); slots that hit a stop condition
+        mid-scan stop accepting — their trailing device iterations wrote
+        reserved pages that are released with the slot."""
         for slot, req in enumerate(self.scheduler.slots):
             if req is None or not self.active[slot]:
                 continue
-            self.positions[slot] += 1
-            tok = int(sampled[slot])
-            req.record_token(tok)
-            self.last_tokens[slot] = tok
+            for k in range(sampled_seq.shape[0]):
+                self.positions[slot] += 1
+                tok = int(sampled_seq[k, slot])
+                req.record_token(tok)
+                self.last_tokens[slot] = tok
+                if (req.cancel_requested
+                        or req.should_stop(self.eos_token_id) is not None):
+                    break
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -269,6 +287,7 @@ class InferenceEngine:
             self.kv.release(slot)
             self.active[slot] = False
             self.positions[slot] = 0
+            self.stop_positions[slot] = 0
         if self.on_finish is not None:
             self.on_finish(req)
 

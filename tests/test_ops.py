@@ -179,3 +179,30 @@ def test_flash_gqa_folded_matches_xla():
     for a, b in zip(g_out, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=5e-4, atol=5e-4)
+
+
+def test_flash_packed_restarting_positions():
+    """Packed batches restart positions at document boundaries (io/data.py),
+    so positions are NOT monotonic within a kernel block. The causal
+    block-prune bound must use true block min/max — a first/last-element
+    bound silently skipped live blocks (round-2 review regression)."""
+    from distributed_llm_training_and_inference_system_tpu.ops.attention import (
+        flash_attention)
+    from distributed_llm_training_and_inference_system_tpu.models.layers import (
+        attention_mask, dot_product_attention)
+
+    B, S, N, D = 1, 256, 2, 64
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(ks[0], (B, S, N, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, S, N, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, S, N, D), jnp.float32)
+    # doc1 rows 0..199 (pos 0..199), doc2 rows 200..255 (pos 0..55):
+    # the boundary falls inside a 64-row block
+    segs = jnp.asarray([[1] * 200 + [2] * 56], jnp.int32)
+    pos = jnp.asarray([list(range(200)) + list(range(56))], jnp.int32)
+    mask = attention_mask(pos, pos, segs, segs, causal=True)
+    ref = dot_product_attention(q, k, v, mask=mask)
+    out = flash_attention(q, k, v, segment_ids=segs, positions=pos,
+                          causal=True, block_q=64, block_k=64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=5e-4, atol=5e-4)

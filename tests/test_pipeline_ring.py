@@ -143,3 +143,100 @@ def test_model_forward_ring_vs_xla(devices8):
             params, tokens)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=5e-4, atol=5e-4)
+
+
+def test_1f1b_matches_gpipe_trajectory(devices8):
+    """The 1F1B manual-backward schedule must reproduce the GPipe (autodiff)
+    loss trajectory exactly — same grads, same optimizer updates."""
+    import dataclasses
+    model_cfg = dataclasses.replace(get_model_config("gpt-test"),
+                                    num_layers=4)
+    tokens = jax.random.randint(jax.random.PRNGKey(5), (8, 32), 1,
+                                model_cfg.vocab_size)
+    losses = {}
+    for sched in ("gpipe", "1f1b"):
+        par = ParallelConfig(data_parallel=2, pipeline_parallel=4,
+                             num_microbatches=4, micro_batch_size=1,
+                             global_batch_size=8,
+                             pipeline_schedule=sched,
+                             activation_checkpoint="none")
+        tr = ShardedTrainer(model_cfg, OptimizerConfig(lr=1e-2), par,
+                            devices=devices8)
+        tr.init_state(seed=0)
+        losses[sched] = [float(tr.step({"tokens": tokens})["loss"])
+                         for _ in range(3)]
+    np.testing.assert_allclose(losses["1f1b"], losses["gpipe"],
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_1f1b_memory_constant_in_microbatches(devices8):
+    """THE property 1F1B exists for (BASELINE config 3, round-1 verdict #4):
+    compiled temp memory must be ~constant as the microbatch count grows,
+    while GPipe's (autodiff through the schedule scan) grows with M."""
+    import dataclasses
+    model_cfg = dataclasses.replace(get_model_config("gpt-test"),
+                                    num_layers=4)
+
+    def temp_bytes(schedule, M):
+        par = ParallelConfig(pipeline_parallel=4, data_parallel=2,
+                             num_microbatches=M, micro_batch_size=1,
+                             global_batch_size=2 * M,
+                             pipeline_schedule=schedule,
+                             activation_checkpoint="none")
+        tr = ShardedTrainer(model_cfg, OptimizerConfig(), par,
+                            devices=devices8)
+        tr.init_state(seed=0)
+        batch = {"tokens": jnp.ones((2 * M, 32), jnp.int32)}
+        with use_mesh(tr.mesh):
+            ma = tr.train_step.lower(
+                tr.state, tr.shard_batch(batch)).compile().memory_analysis()
+        assert ma is not None
+        return ma.temp_size_in_bytes
+
+    grow_1f1b = temp_bytes("1f1b", 16) / temp_bytes("1f1b", 4)
+    grow_gpipe = temp_bytes("gpipe", 16) / temp_bytes("gpipe", 4)
+    assert grow_1f1b < 1.3, f"1f1b temp memory grew {grow_1f1b:.2f}x in M"
+    assert grow_gpipe > 1.5, (
+        f"gpipe baseline sanity: expected M-linear growth, got {grow_gpipe:.2f}x")
+
+
+def test_long_context_32k_memory_scales_linearly(devices8):
+    """BASELINE config 4 / SURVEY §5.7: ring attention + remat must make
+    activation memory S-LINEAR, so 32k context compiles and fits. Compiles
+    the full train step (fwd+bwd+opt) at S = 8k/16k/32k on an sp=8 mesh
+    with a tiny model and asserts per-device temp memory grows ~linearly
+    (naive attention materialising [S,S] would grow ~4x per doubling), then
+    EXECUTES one real 16k-token step to prove the compile isn't vacuous."""
+    import dataclasses
+    model_cfg = dataclasses.replace(
+        get_model_config("gpt-test"), num_layers=1, hidden_size=16,
+        ffn_size=32, num_heads=1, num_kv_heads=1, head_dim=16,
+        max_position_embeddings=32768)
+
+    def build(S):
+        par = ParallelConfig(sequence_parallel=8, micro_batch_size=1,
+                             global_batch_size=1,
+                             activation_checkpoint="selective")
+        tr = ShardedTrainer(model_cfg, OptimizerConfig(lr=1e-3), par,
+                            devices=devices8, attn_impl="ring")
+        tr.init_state(seed=0)
+        batch = {"tokens": jnp.ones((1, S), jnp.int32)}
+        return tr, batch
+
+    temps = {}
+    for S in (8192, 16384, 32768):
+        tr, batch = build(S)
+        with use_mesh(tr.mesh):
+            ma = tr.train_step.lower(
+                tr.state, tr.shard_batch(batch)).compile().memory_analysis()
+        assert ma is not None
+        temps[S] = ma.temp_size_in_bytes
+    g1 = temps[16384] / temps[8192]
+    g2 = temps[32768] / temps[16384]
+    assert g1 < 2.7 and g2 < 2.7, f"superlinear activation memory: {temps}"
+
+    # one real 32k-token-context step (16k run keeps CPU time sane? no:
+    # execute at 16384 — still a genuinely long context on 8 fake devices)
+    tr, batch = build(16384)
+    m = tr.step(batch)
+    assert np.isfinite(float(m["loss"]))

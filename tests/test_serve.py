@@ -339,7 +339,8 @@ class TestPrefillDecodeInterleaving:
         gains one token per step throughout (round-1 verdict weak #4 /
         next-round #9)."""
         eng = make_engine(model_cfg, max_batch_size=8,
-                          prefill_budget_tokens=40)
+                          prefill_budget_tokens=40,
+                          decode_steps_per_dispatch=1)
         # resident stream first
         resident = Request(request_id="res", prompt_tokens=[5, 17, 99],
                            sampling=SamplingParams(temperature=0.0,
@@ -376,3 +377,31 @@ class TestPrefillDecodeInterleaving:
         stats = eng.stats()
         assert stats["padded_slot_steps"] > 0          # 3 idle slots/step
         assert 0.0 < stats["decode_slot_utilization"] < 1.0
+
+
+class TestMultiStepDecode:
+    def test_multi_step_matches_single_step(self, model_cfg):
+        """K decode iterations fused into one dispatch must generate exactly
+        the same tokens as the host-driven single-step loop — greedy AND
+        sampled (the per-position key folding is identical)."""
+        prompts = [[5, 17, 99, 3], [42, 7], [23, 1, 2, 3, 4, 5]]
+        for sampling in (SamplingParams(temperature=0.0, max_tokens=11),
+                         SamplingParams(temperature=0.9, top_k=40,
+                                        max_tokens=11, seed=7)):
+            eng1 = make_engine(model_cfg, decode_steps_per_dispatch=1)
+            engK = make_engine(model_cfg, decode_steps_per_dispatch=4)
+            out1 = [r.generated_tokens for r in eng1.generate(prompts, sampling)]
+            outK = [r.generated_tokens for r in engK.generate(prompts, sampling)]
+            assert out1 == outK
+
+    def test_multi_step_respects_max_tokens_and_pages(self, model_cfg):
+        """max_tokens not divisible by K: the request stops at exactly
+        max_tokens and its pages are all reclaimed (overshoot iterations
+        wrote only scratch/reserved pages)."""
+        eng = make_engine(model_cfg, decode_steps_per_dispatch=8)
+        free0 = eng.kv.free_pages
+        [req] = eng.generate([[5, 17, 99]],
+                             SamplingParams(temperature=0.0, max_tokens=5))
+        assert len(req.generated_tokens) == 5
+        assert req.finish_reason == "length"
+        assert eng.kv.free_pages == free0
