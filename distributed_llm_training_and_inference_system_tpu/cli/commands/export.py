@@ -27,13 +27,20 @@ def app(ctx):
               help="Checkpoint directory (CheckpointManager layout).")
 @click.option("--format", "fmt", default="safetensors", show_default=True,
               type=click.Choice(["safetensors", "npz"]))
-@click.option("--quant", default=None, type=click.Choice(["int8"]),
-              help="Quantize weights before export.")
+@click.option("--quant", default=None,
+              type=click.Choice(["int8", "int8-awq"]),
+              help="Quantize weights before export (int8-awq = activation-"
+                   "aware channel scaling from a calibration pass).")
+@click.option("--model", "model_name", default=None,
+              help="Model template (required for int8-awq calibration; "
+                   "defaults to the checkpoint's recorded model).")
+@click.option("--calib-seq", default=512, show_default=True,
+              help="Calibration tokens for int8-awq.")
 @click.option("--out", "out_path", required=True,
               type=click.Path(dir_okay=False))
 @click.option("--step", default=None, type=int,
               help="Checkpoint step (default: latest).")
-def convert(ckpt_dir, fmt, quant, out_path, step):
+def convert(ckpt_dir, fmt, quant, model_name, calib_seq, out_path, step):
     """Convert a training checkpoint into a deployment artifact."""
     from ...io.checkpoint import CheckpointManager
     from ...io.export import export_params
@@ -47,8 +54,22 @@ def convert(ckpt_dir, fmt, quant, out_path, step):
     meta = {"source_step": str(step or ckpt.latest_step())}
     if isinstance(extra, dict) and "config" in extra:
         meta["model"] = str(extra["config"].get("model", ""))
+    model_cfg = calib = None
+    if quant == "int8-awq":
+        import jax
+        import jax.numpy as jnp
+
+        from ...config.presets import get_model_config
+        name = model_name or meta.get("model") or ""
+        if not name:
+            raise click.ClickException(
+                "--quant int8-awq needs --model for calibration")
+        model_cfg = get_model_config(name)
+        calib = jax.random.randint(
+            jax.random.PRNGKey(0), (1, calib_seq), 1, model_cfg.vocab_size)
     path = export_params(params, out_path, fmt=fmt, quant=quant,
-                         metadata=meta)
+                         metadata=meta, model_cfg=model_cfg,
+                         calib_tokens=calib)
     size_mb = Path(path).stat().st_size / 1e6
     click.echo(f"exported {fmt}{'+' + quant if quant else ''} artifact: "
                f"{path} ({size_mb:.1f} MB)")

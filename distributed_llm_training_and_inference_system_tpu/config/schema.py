@@ -276,6 +276,15 @@ class ParallelConfig:
                 "num_microbatches must be >= pipeline_parallel for a full pipeline")
         if self.pipeline_schedule not in ("gpipe", "1f1b"):
             raise ConfigError("pipeline_schedule must be gpipe|1f1b")
+        if self.zero_stage == 3 and self.fsdp <= 1:
+            # stage-3 (fully-sharded params) IS the fsdp mesh axis here; a
+            # bare zero_stage=3 would silently behave as stage 1
+            raise ConfigError(
+                "zero_stage=3 means fully-sharded parameters, which this "
+                "framework expresses as the fsdp mesh axis: set fsdp>1 "
+                "(optimizer-state sharding alone is zero_stage=1; gradient "
+                "reduce-scatter (stage 2) is inserted by XLA from the "
+                "stage-1 shardings)")
 
     @property
     def total_devices(self) -> int:

@@ -8,8 +8,10 @@ real data:
 - **Token shard format**: ``<name>.bin`` files of little-endian uint16/
   uint32 token ids with a sidecar ``<name>.idx.json`` recording dtype and
   document boundaries. Shards are memory-mapped; the hot path (sequence
-  packing) is handled by the C++ packer in native/dataloader.cpp when built,
-  with a numpy fallback.
+  packing) runs in the C++ packer (../native/dataloader.cpp via io/native.py,
+  compiled lazily with g++) with this module's numpy implementation as the
+  semantically-identical fallback (equivalence asserted in tests/test_io.py;
+  set LLMCTL_NO_NATIVE=1 to force the fallback).
 - **Sequence packing**: documents are packed back-to-back into fixed
   [B, S] batches with segment_ids (1-based per document, 0 = pad) and
   per-document restarting positions — the input contract of
@@ -169,6 +171,14 @@ class MemmapDataset(DatasetIterator):
         self._cursor = 0          # index into this host's permuted doc list
         self._carry: Optional[np.ndarray] = None   # partial doc continuation
         self._perm = self._make_perm()
+        self._native = None
+        try:
+            from .native import NativePacker
+            self._native = NativePacker(
+                self.shards, np.asarray(docs, np.int64), pack,
+                drop_tail_docs)
+        except (RuntimeError, OSError, ValueError):
+            pass   # numpy fallback (LLMCTL_NO_NATIVE, no toolchain, ...)
 
     @property
     def num_documents(self) -> int:
@@ -193,6 +203,18 @@ class MemmapDataset(DatasetIterator):
 
     def __next__(self) -> dict[str, np.ndarray]:
         B, S = self.batch_size, self.seq_len
+        if self._native is not None:
+            def next_perm(increments):
+                self._epoch += 1
+                self._cursor = 0
+                self._perm = self._make_perm()
+                return self._perm
+
+            self._native.carry = self._carry
+            batch, self._cursor, _ = self._native.pack_batch(
+                self._perm, self._cursor, B, S, next_perm)
+            self._carry = self._native.carry
+            return batch
         tokens = np.zeros((B, S), np.int32)
         segs = np.zeros((B, S), np.int32)
         pos = np.zeros((B, S), np.int32)

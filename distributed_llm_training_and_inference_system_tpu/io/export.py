@@ -87,18 +87,30 @@ def load_safetensors(path: str | Path) -> tuple[dict[str, np.ndarray], dict[str,
 
 
 def export_params(params: Any, out_path: str | Path, fmt: str = "safetensors",
-                  quant: str | None = None, metadata: dict | None = None) -> Path:
-    """Export a param pytree. fmt: safetensors | npz. quant: None | int8."""
+                  quant: str | None = None, metadata: dict | None = None,
+                  model_cfg=None, calib_tokens=None) -> Path:
+    """Export a param pytree. fmt: safetensors | npz.
+    quant: None | int8 | int8-awq (activation-aware; needs model_cfg +
+    calib_tokens for the calibration forward pass)."""
     from ..utils.tree import flatten_with_paths
     out_path = Path(out_path)
     meta = dict(metadata or {})
     meta["format"] = fmt
     if quant:
-        from ..ops.quantization import quantize_tree_int8
         meta["quant"] = quant
-        if quant != "int8":
-            raise ValueError(f"unsupported quant {quant!r} (int8 only for now)")
-        params = quantize_tree_int8(params)
+        if quant == "int8":
+            from ..ops.quantization import quantize_tree_int8
+            params = quantize_tree_int8(params)
+        elif quant == "int8-awq":
+            if model_cfg is None or calib_tokens is None:
+                raise ValueError(
+                    "int8-awq needs model_cfg and calib_tokens for the "
+                    "activation-aware calibration pass")
+            from ..ops.quantization import quantize_tree_int8_awq
+            params = quantize_tree_int8_awq(params, model_cfg, calib_tokens)
+        else:
+            raise ValueError(
+                f"unsupported quant {quant!r} (int8 | int8-awq)")
     flat = dict(flatten_with_paths(params))
     # quantized leaves carry a "__quant__": "int8" string marker; markers are
     # metadata, not tensors (the ".values"/".scale" suffix pair identifies

@@ -1,0 +1,165 @@
+"""ctypes bridge to the C++ dataloader packer (native/dataloader.cpp).
+
+No pybind11 in this environment (see repo build notes), so the boundary is
+a C ABI loaded via ctypes. The shared library is compiled lazily with g++
+on first use and cached next to the source; set ``LLMCTL_NO_NATIVE=1`` to
+force the pure-numpy fallback (io/data.py), e.g. on hosts without a
+toolchain. Build failures degrade silently to the fallback — the native
+path is a performance feature, never a correctness dependency.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger("llmctl.io.native")
+
+_SRC = Path(__file__).parent.parent / "native" / "dataloader.cpp"
+_LIB = _SRC.parent / "libllmctl_dataloader.so"
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+class PackState(ctypes.Structure):
+    _fields_ = [("row", ctypes.c_int64), ("fill", ctypes.c_int64),
+                ("seg", ctypes.c_int32), ("cursor", ctypes.c_int64)]
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", str(_SRC), "-o", str(_LIB)],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError, OSError) as e:
+        logger.warning("native dataloader build failed (%s); using numpy "
+                       "fallback", e)
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded packer library, building it on first call; None if
+    unavailable (numpy fallback applies)."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("LLMCTL_NO_NATIVE"):
+        return None
+    if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
+        if not _build():
+            return None
+    try:
+        lib = ctypes.CDLL(str(_LIB))
+        fn = lib.llmctl_pack_continue
+        fn.restype = ctypes.c_int64
+        fn.argtypes = [
+            ctypes.POINTER(ctypes.c_uint64),   # shard_ptrs
+            ctypes.POINTER(ctypes.c_int32),    # shard_itemsize
+            ctypes.POINTER(ctypes.c_int64),    # doc_table
+            ctypes.POINTER(ctypes.c_int64),    # order
+            ctypes.c_int64,                    # order_len
+            ctypes.POINTER(ctypes.c_int32),    # tokens
+            ctypes.POINTER(ctypes.c_int32),    # segs
+            ctypes.POINTER(ctypes.c_int32),    # pos
+            ctypes.c_int64, ctypes.c_int64,    # B, S
+            ctypes.c_int32, ctypes.c_int32,    # pack, drop_tail
+            ctypes.POINTER(ctypes.c_int32),    # carry
+            ctypes.c_int64,                    # carry_cap
+            ctypes.POINTER(ctypes.c_int64),    # carry_len
+            ctypes.POINTER(PackState),         # state
+        ]
+        _lib = lib
+    except OSError as e:
+        logger.warning("native dataloader load failed (%s); using numpy "
+                       "fallback", e)
+    return _lib
+
+
+def _i32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def _i64p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+class NativePacker:
+    """Stateful wrapper owning the C-side buffers for one MemmapDataset."""
+
+    def __init__(self, shards, doc_table: np.ndarray, pack: bool,
+                 drop_tail: bool):
+        # checked per-construction (get_lib caches the loaded library, so
+        # its env check wouldn't see a later LLMCTL_NO_NATIVE)
+        if os.environ.get("LLMCTL_NO_NATIVE"):
+            raise RuntimeError("native packer disabled (LLMCTL_NO_NATIVE)")
+        self.lib = get_lib()
+        if self.lib is None:
+            raise RuntimeError("native packer unavailable")
+        self._maps = [sh.tokens() for sh in shards]   # hold memmaps alive
+        self._ptrs = np.asarray(
+            [m.ctypes.data for m in self._maps], np.uint64)
+        self._itemsize = np.asarray([m.dtype.itemsize for m in self._maps],
+                                    np.int32)
+        self.doc_table = np.ascontiguousarray(doc_table, np.int64)
+        lens = self.doc_table[:, 2] - self.doc_table[:, 1]
+        self._carry = np.zeros(max(int(lens.max()), 1), np.int32)
+        self._carry_len = ctypes.c_int64(0)
+        self.pack = pack
+        self.drop_tail = drop_tail
+
+    @property
+    def carry(self) -> Optional[np.ndarray]:
+        n = self._carry_len.value
+        return None if n == 0 else self._carry[:n].copy()
+
+    @carry.setter
+    def carry(self, value: Optional[np.ndarray]) -> None:
+        if value is None:
+            self._carry_len.value = 0
+        else:
+            v = np.asarray(value, np.int32)
+            self._carry[:len(v)] = v
+            self._carry_len.value = len(v)
+
+    def pack_batch(self, order: np.ndarray, cursor: int, B: int, S: int,
+                   next_perm) -> tuple[dict, int, int]:
+        """Pack one [B, S] batch starting at ``cursor`` into ``order``.
+
+        ``next_perm(epoch_increments) -> new order`` is called when the
+        order is exhausted mid-batch (the Python-side seeded re-permute).
+        Returns (batch dict, cursor, epochs_advanced).
+        """
+        tokens = np.zeros((B, S), np.int32)
+        segs = np.zeros((B, S), np.int32)
+        pos = np.zeros((B, S), np.int32)
+        st = PackState(0, 0, 1, int(cursor))
+        order = np.ascontiguousarray(order, np.int64)
+        epochs = 0
+        while True:
+            rc = self.lib.llmctl_pack_continue(
+                self._ptrs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                self._itemsize.ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_int32)),
+                _i64p(self.doc_table), _i64p(order), len(order),
+                _i32p(tokens), _i32p(segs), _i32p(pos),
+                B, S, int(self.pack), int(self.drop_tail),
+                _i32p(self._carry), len(self._carry),
+                ctypes.byref(self._carry_len), ctypes.byref(st))
+            if rc == 0:
+                break
+            if rc == 1:
+                epochs += 1
+                order = np.ascontiguousarray(next_perm(epochs), np.int64)
+                st.cursor = 0
+                continue
+            raise RuntimeError(f"native packer error {rc}")
+        return ({"tokens": tokens, "segment_ids": segs, "positions": pos},
+                int(st.cursor), epochs)

@@ -82,10 +82,14 @@ def quantize_tree_int8(params: Any, min_size: int = 4096) -> Any:
 
 def dequantize_tree(params: Any, dtype=jnp.bfloat16) -> Any:
     def is_qleaf(x):
-        return isinstance(x, dict) and x.get("__quant__") == "int8"
+        return isinstance(x, dict) and str(
+            x.get("__quant__", "")).startswith("int8")
 
     def dq(x):
         if is_qleaf(x):
+            if x["__quant__"] == "int8-awq":
+                return dequantize_int8_awq(x["values"], x["scale"],
+                                           x["chan"], dtype)
             return dequantize_int8(x["values"], x["scale"], dtype)
         return x
     return jax.tree_util.tree_map(dq, params, is_leaf=is_qleaf)
@@ -99,3 +103,108 @@ def quantization_error(x: np.ndarray, block: int | None = None) -> float:
     num = float(jnp.linalg.norm((back - xj.astype(jnp.float32))))
     den = float(jnp.linalg.norm(xj.astype(jnp.float32))) + 1e-12
     return num / den
+
+
+def activation_channel_scales(
+    params: Any, model_cfg, calib_tokens: jax.Array,
+) -> dict[str, jax.Array]:
+    """Per-input-channel activation RMS for the projection kernels, from one
+    calibration forward pass — the "activation-aware" statistic AWQ scales
+    by (channels carrying large activations keep more precision). Params use
+    the stacked-layer layout (kernels [L, in, out]), so this returns
+    {stacked param path: [L, in_features] fp32} for the q/k/v and mlp
+    gate/up/down kernels (o and MoE expert kernels keep plain absmax: o's
+    input never leaves attention_block, and experts are token-routed).
+    """
+    from ..models.layers import (
+        _activate, attention_block, rms_norm, rope_frequencies)
+
+    compute_dtype = jnp.dtype(model_cfg.dtype)
+    x = params["embed"]["embedding"][calib_tokens].astype(compute_dtype)
+    inv_freq = rope_frequencies(model_cfg.head_dim, model_cfg.rope.base,
+                                model_cfg.rope.scaling,
+                                model_cfg.rope.scaling_factor)
+    B, S = calib_tokens.shape
+    positions = jnp.arange(S, dtype=jnp.int32)[None, :].repeat(B, axis=0)
+    scales: dict[str, jax.Array] = {}
+
+    def rms_over_channels(h):
+        return jnp.sqrt(jnp.mean(
+            h.astype(jnp.float32) ** 2,
+            axis=tuple(range(h.ndim - 1)))) + 1e-6
+
+    per_layer: dict[str, list[jax.Array]] = {}
+
+    def record(key, h):
+        per_layer.setdefault(key, []).append(rms_over_channels(h))
+
+    for i in range(model_cfg.num_layers):
+        layer = jax.tree_util.tree_map(
+            lambda p: p[i].astype(compute_dtype), params["blocks"])
+        h_attn = rms_norm(x, layer["attn_norm"]["scale"], model_cfg.norm_eps)
+        for name in ("q", "k", "v"):
+            record(f"blocks.{name}.kernel", h_attn)
+        attn_out, _ = attention_block(h_attn, layer, model_cfg, positions,
+                                      None, inv_freq)
+        x = x + attn_out
+        h_mlp = rms_norm(x, layer["mlp_norm"]["scale"], model_cfg.norm_eps)
+        if not model_cfg.is_moe:
+            for name in ("gate", "up"):
+                record(f"blocks.mlp.{name}.kernel", h_mlp)
+            a = _activate(h_mlp @ layer["mlp"]["gate"]["kernel"],
+                          model_cfg.activation)
+            a = a * (h_mlp @ layer["mlp"]["up"]["kernel"])
+            record("blocks.mlp.down.kernel", a)
+            x = x + (a @ layer["mlp"]["down"]["kernel"]).astype(x.dtype)
+        else:
+            from ..models.layers import moe_block
+            ffn, _ = moe_block(h_mlp, layer["moe"], model_cfg)
+            x = x + ffn.astype(x.dtype)
+    return {k: jnp.stack(v) for k, v in per_layer.items()}   # [L, in]
+
+
+def quantize_int8_awq(
+    w: jax.Array,            # [..., in, out] kernel(s)
+    act_scale: jax.Array,    # [..., in] per-input-channel activation RMS
+    alpha: float = 0.5,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Activation-aware int8: scale salient input channels UP before absmax
+    quantization (AWQ's s = act^alpha, normalised), so channels that carry
+    large activations keep more mantissa; the inverse scale folds into
+    dequant. Returns (q int8, scales fp32 per-out-channel, chan fp32
+    [..., in]). W ≈ (q * scales) / chan[..., None]."""
+    s = act_scale.astype(jnp.float32) ** alpha
+    s = s / jnp.exp(jnp.mean(jnp.log(s), axis=-1, keepdims=True))  # geomean=1
+    w_scaled = w.astype(jnp.float32) * s[..., :, None]
+    q, scales = quantize_int8(w_scaled, axis=-2)   # per-out-channel absmax
+    return q, scales, s
+
+
+def dequantize_int8_awq(q: jax.Array, scales: jax.Array, chan: jax.Array,
+                        dtype=jnp.bfloat16) -> jax.Array:
+    """Inverse of quantize_int8_awq."""
+    return ((q.astype(jnp.float32) * scales)
+            / chan[..., :, None]).astype(dtype)
+
+
+def quantize_tree_int8_awq(params: Any, model_cfg, calib_tokens: jax.Array,
+                           alpha: float = 0.5, min_size: int = 4096) -> Any:
+    """AWQ-style activation-aware int8 over a param pytree.
+
+    Kernels with a calibrated activation statistic get channel-scaled
+    quantization (quantize_int8_awq); everything else falls back to plain
+    absmax. Reference parity: the `int8-awq` flag of the reference's
+    stubbed `export convert` (reference cli/commands/export.py:29)."""
+    act = activation_channel_scales(params, model_cfg, calib_tokens)
+
+    def q(path_entries, x):
+        path = ".".join(str(getattr(k, "key", k)) for k in path_entries)
+        if (path in act and hasattr(x, "dtype")
+                and jnp.issubdtype(x.dtype, jnp.floating)
+                and x.size >= min_size and x.ndim == 3):   # [L, in, out]
+            qv, scales, chan = quantize_int8_awq(x, act[path], alpha=alpha)
+            return {"__quant__": "int8-awq", "values": qv,
+                    "scale": scales, "chan": chan}
+        return quantize_tree_int8(x, min_size=min_size)
+
+    return jax.tree_util.tree_map_with_path(q, params)

@@ -186,7 +186,12 @@ class InferenceEngine:
                 prefill, donate_argnums=(3, 4))
         return self._prefill_cache[bucket]
 
-    def _prefill(self, req: Request) -> None:
+    def _prefill(self, req: Request):
+        """Dispatch one prompt's prefill; returns (req, device token).
+
+        The first-token fetch is DEFERRED (_finish_prefill) so a burst of
+        admitted prompts pays one host round trip total, not one per
+        prompt — dispatches pipeline on-device."""
         slot, n = req.slot, req.num_prompt_tokens
         with self.lock:   # page bookkeeping is shared with cancel/release
             self.kv.allocate(slot, n + req.sampling.max_tokens)
@@ -213,7 +218,14 @@ class InferenceEngine:
             self.kv.k_pages, self.kv.v_pages, jnp.asarray(entries),
             first_key, jnp.float32(s.temperature),
             jnp.int32(s.top_k), jnp.float32(s.top_p))
+        self.total_prefill_tokens += n
+        return req, token
 
+    def _finish_prefill(self, req: Request, token) -> None:
+        """Resolve a dispatched prefill: fetch its first token and make the
+        slot live for decode."""
+        slot, n = req.slot, req.num_prompt_tokens
+        s = req.sampling
         req.record_token(int(token))
         from .scheduler import RequestState
         req.state = RequestState.RUNNING
@@ -222,12 +234,11 @@ class InferenceEngine:
         # first position this slot may NOT write: its page reservation
         # covers prompt + max_tokens, and multi-step decode masks writes
         # at/past this bound to scratch page 0
-        self.stop_positions[slot] = n + req.sampling.max_tokens
+        self.stop_positions[slot] = n + s.max_tokens
         self.active[slot] = True
         self.temperature[slot] = s.temperature
         self.top_k[slot] = s.top_k
         self.top_p[slot] = s.top_p
-        self.total_prefill_tokens += n
 
     # -- decode --------------------------------------------------------------
 
@@ -309,8 +320,9 @@ class InferenceEngine:
             else:
                 admitted = self.scheduler.admit(
                     self.serve_cfg.prefill_budget_tokens)
-        for req in admitted:
-            self._prefill(req)
+        pending = [self._prefill(req) for req in admitted]
+        for req, token in pending:
+            self._finish_prefill(req, token)
         if admitted:
             with self.lock:
                 # prompt-is-whole-request edge: finished on the first token

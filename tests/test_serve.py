@@ -289,7 +289,7 @@ class TestReviewRegressions:
         assert admitted.state is RequestState.PREFILLING
         assert eng.scheduler.cancel("c1")       # cancel-pending, not False
         assert r.cancel_requested
-        eng._prefill(r)
+        eng._finish_prefill(*eng._prefill(r))
         eng.scheduler.step_finished(eng.eos_token_id)
         assert r.state is RequestState.CANCELLED
         assert eng.scheduler.active_count == 0
