@@ -57,9 +57,11 @@ def run(ckpt_dir, model_name, data_path, suite, batches, batch_size, seq_len,
         ckpt = CheckpointManager(ckpt_dir)
         if ckpt.latest_step() is None:
             raise click.ClickException(f"no checkpoints under {ckpt_dir}")
-        from ...io.checkpoint import params_from_flat
-        state, _ = ckpt.restore()
+        from ...io.checkpoint import (apply_ckpt_model_overrides,
+                                      params_from_flat)
+        state, extra = ckpt.restore()
         params = params_from_flat(state)
+        cfg = apply_ckpt_model_overrides(cfg, extra)
         params = jax.tree_util.tree_map(jnp.asarray, params)
         click.echo(f"loaded checkpoint step {ckpt.latest_step()}")
     else:

@@ -73,3 +73,31 @@ def convert(ckpt_dir, fmt, quant, model_name, calib_seq, out_path, step):
     size_mb = Path(path).stat().st_size / 1e6
     click.echo(f"exported {fmt}{'+' + quant if quant else ''} artifact: "
                f"{path} ({size_mb:.1f} MB)")
+
+
+@app.command(name="import-hf")
+@click.option("--src", required=True,
+              type=click.Path(exists=True),
+              help="HF safetensors file or directory (llama-style names).")
+@click.option("--model", "model_name", required=True,
+              help="Model template matching the checkpoint's architecture "
+                   "(e.g. llama-7b, llama-8b-gqa).")
+@click.option("--out", "out_dir", required=True,
+              type=click.Path(file_okay=False))
+def import_hf(src, model_name, out_dir):
+    """Import a local HuggingFace llama-format checkpoint.
+
+    Writes a committed framework checkpoint consumable by train --resume,
+    eval, export, and serve --artifact — the switching path for users of
+    the reference's AutoModelForCausalLM loading (reference
+    engine.py:119-140)."""
+    from ...config.presets import get_model_config
+    from ...io.hf_import import import_hf_checkpoint
+
+    cfg = get_model_config(model_name)
+    path, eff = import_hf_checkpoint(src, cfg, out_dir)
+    tie_note = ("" if eff.tie_word_embeddings == cfg.tie_word_embeddings
+                else f" (tie_word_embeddings inferred as "
+                     f"{eff.tie_word_embeddings} from the checkpoint)")
+    click.echo(f"imported HF checkpoint -> {path} (step 0, model "
+               f"{eff.name}){tie_note}")

@@ -36,19 +36,33 @@ def app(ctx):
 @click.option("--in-process", is_flag=True,
               help="Run the engine in THIS process (no subprocess spawn).")
 @click.option("--no-resume", is_flag=True, help="Ignore existing checkpoints.")
+@click.option("--restart-on-failure", default=0, show_default=True, type=int,
+              help="Supervise the job and relaunch up to N times on "
+                   "non-zero exit; each restart resumes from the latest "
+                   "committed checkpoint (preemption recovery).")
 @click.option("--dry-run", is_flag=True,
               help="Print the launch plan without starting.")
 @click.option("--set", "overrides", multiple=True, metavar="SEC.KEY=V",
               help="Config override, repeatable.")
 @click.pass_context
 def launch(ctx, config_file, model, max_steps, launcher, nodes, in_process,
-           no_resume, dry_run, overrides):
+           no_resume, restart_on_failure, dry_run, overrides):
     """Launch a training run (local process, SLURM, MPI, k8s, or GKE)."""
     root = ctx.obj or {}
     launcher = launcher or root.get("launcher", "local")
     nodes = nodes or root.get("nodes", 1)
 
-    if in_process or (launcher == "local" and nodes == 1 and not dry_run):
+    if restart_on_failure and in_process:
+        raise click.ClickException(
+            "--restart-on-failure needs the subprocess launcher "
+            "(drop --in-process)")
+    if restart_on_failure and no_resume:
+        raise click.ClickException(
+            "--restart-on-failure recovers by RESUMING from the latest "
+            "checkpoint; combining it with --no-resume would retrain from "
+            "step 0 on every restart")
+    if (in_process or (launcher == "local" and nodes == 1 and not dry_run
+                       and not restart_on_failure)):
         # single-controller JAX: one process drives every local chip — no
         # reason to pay a subprocess hop (reference spawns torchrun even for
         # one GPU, launcher.py:97-105)
@@ -82,7 +96,10 @@ def launch(ctx, config_file, model, max_steps, launcher, nodes, in_process,
         click.echo(orch.launcher.describe())
         click.echo("dry-run: nothing launched")
         return
-    rc = orch.start(stream_output=True)
+    if restart_on_failure:
+        rc = orch.run_with_restarts(max_restarts=restart_on_failure)
+    else:
+        rc = orch.start(stream_output=True)
     raise SystemExit(rc)
 
 

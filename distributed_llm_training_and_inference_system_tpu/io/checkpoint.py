@@ -347,3 +347,17 @@ def params_from_flat(state: Any) -> Any:
             d = d.setdefault(p, {})
         d[parts[-1]] = leaf
     return nested if nested else state
+
+
+def apply_ckpt_model_overrides(cfg, extra: dict):
+    """Align a model config with architecture facts recorded in a
+    checkpoint's extra metadata (currently tie_word_embeddings, stamped by
+    the HF importer — a tied checkpoint has no lm_head and would KeyError
+    under an untied template)."""
+    import dataclasses
+
+    rec = (extra or {}).get("config", {})
+    tied = rec.get("tie_word_embeddings")
+    if tied is not None and tied != cfg.tie_word_embeddings:
+        cfg = dataclasses.replace(cfg, tie_word_embeddings=bool(tied))
+    return cfg

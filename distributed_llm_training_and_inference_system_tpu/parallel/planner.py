@@ -96,9 +96,10 @@ def load_calibration(path: str | None = None) -> dict | None:
 
 def save_calibration(data: dict, path: str | None = None) -> str:
     import json
+    import os
     from pathlib import Path
 
-    p = Path(path or CALIBRATION_FILE)
+    p = Path(path or os.environ.get("LLMCTL_CALIBRATION", CALIBRATION_FILE))
     p.parent.mkdir(parents=True, exist_ok=True)
     p.write_text(json.dumps(data, indent=2))
     return str(p)

@@ -256,6 +256,30 @@ class ProcessOrchestrator:
                 print(line, end="")
         return self.process.wait()
 
+    def run_with_restarts(self, max_restarts: int = 0,
+                          backoff_seconds: float = 5.0,
+                          stream_output: bool = True) -> int:
+        """Supervise the job, restarting on failure up to ``max_restarts``
+        times — checkpoint-restore-based recovery, the TPU answer to
+        preemption (SURVEY §5.3: the reference has detection but no
+        recovery path). Each restart relaunches the SAME command; the
+        training entrypoint resumes params+optimizer+data cursor from the
+        latest committed checkpoint, so a killed pod job continues instead
+        of starting over. Exit code 0, SIGINT, or restart exhaustion ends
+        supervision."""
+        attempt = 0
+        while True:
+            rc = self.start(stream_output=stream_output)
+            if rc == 0:
+                return 0
+            if rc == -signal.SIGINT or attempt >= max_restarts:
+                return rc
+            attempt += 1
+            print(f"[orchestrator] job exited rc={rc}; restart "
+                  f"{attempt}/{max_restarts} in {backoff_seconds:.0f}s "
+                  "(resume from latest checkpoint)")
+            time.sleep(backoff_seconds)
+
     def stop(self, grace_seconds: float = 5.0) -> None:
         if self.process is None or self.process.poll() is not None:
             return

@@ -55,13 +55,17 @@ class InferenceEngine:
         seed: int = 0,
         eos_token_id: Optional[int] = None,
     ):
-        self.cfg = model_cfg
         self.serve_cfg = serve_cfg
         self.eos_token_id = eos_token_id
         dtype = jnp.dtype(serve_cfg.dtype)
 
         if params is None:
-            params = self._load_params(model_cfg, serve_cfg, seed, dtype)
+            # the artifact may override architecture facts (e.g. an
+            # HF-imported tied-embedding checkpoint under an untied
+            # template) — the effective config comes back with the params
+            params, model_cfg = self._load_params(model_cfg, serve_cfg,
+                                                  seed, dtype)
+        self.cfg = model_cfg
         self.params = params
 
         S = serve_cfg.max_batch_size
@@ -122,18 +126,22 @@ class InferenceEngine:
         paths self-contained)."""
         art = serve_cfg.artifact
         if art and Path(art).exists():
-            from ..io.checkpoint import CheckpointManager, params_from_flat
+            from ..io.checkpoint import (CheckpointManager,
+                                         apply_ckpt_model_overrides,
+                                         params_from_flat)
             ckpt = CheckpointManager(art)
             if ckpt.latest_step() is not None:
-                state, _ = ckpt.restore()
+                state, extra = ckpt.restore()
                 params = params_from_flat(state)
+                model_cfg = apply_ckpt_model_overrides(model_cfg, extra)
                 logger.info("loaded params from %s step %s", art,
                             ckpt.latest_step())
                 return jax.tree_util.tree_map(
-                    lambda a: jnp.asarray(a, dtype), params)
+                    lambda a: jnp.asarray(a, dtype), params), model_cfg
         logger.warning("no artifact checkpoint found (%r): using random init",
                        art)
-        return gpt.init(model_cfg, jax.random.PRNGKey(seed), dtype=dtype)
+        return gpt.init(model_cfg, jax.random.PRNGKey(seed),
+                        dtype=dtype), model_cfg
 
     # -- prefill -------------------------------------------------------------
 

@@ -75,3 +75,36 @@ def test_orchestrator_status(tmp_path, monkeypatch):
     orch = ProcessOrchestrator(LaunchConfig(launcher="local", dry_run=True))
     assert orch.status() == {"state": "not_started"}
     assert orch.start() == 0
+
+
+def test_orchestrator_restart_on_failure(tmp_path, capsys):
+    """run_with_restarts relaunches a failed job (checkpoint-restore
+    recovery, SURVEY §5.3 — the reference detects failures but has no
+    recovery path). A job that crashes twice then succeeds must end with
+    rc=0 after 2 restarts; restart exhaustion must surface the failure."""
+    import subprocess
+    import sys
+
+    from distributed_llm_training_and_inference_system_tpu.runtime.launcher import (
+        LaunchConfig, ProcessOrchestrator)
+
+    marker = tmp_path / "attempts"
+    script = tmp_path / "flaky.py"
+    script.write_text(
+        "import pathlib, sys\n"
+        f"p = pathlib.Path({str(marker)!r})\n"
+        "n = int(p.read_text()) if p.exists() else 0\n"
+        "p.write_text(str(n + 1))\n"
+        "sys.exit(0 if n >= 2 else 1)\n")
+
+    orch = ProcessOrchestrator(LaunchConfig(launcher="local", dry_run=False))
+    orch.launcher.launch = lambda capture_output=True: subprocess.Popen(
+        [sys.executable, str(script)], stdout=subprocess.PIPE, text=True)
+
+    rc = orch.run_with_restarts(max_restarts=5, backoff_seconds=0.01)
+    assert rc == 0
+    assert marker.read_text() == "3"      # 2 failures + 1 success
+
+    marker.unlink()
+    rc = orch.run_with_restarts(max_restarts=1, backoff_seconds=0.01)
+    assert rc != 0                         # exhausted before success
