@@ -64,7 +64,8 @@ def convert(ckpt_dir, fmt, quant, model_name, calib_seq, out_path, step):
         if not name:
             raise click.ClickException(
                 "--quant int8-awq needs --model for calibration")
-        model_cfg = get_model_config(name)
+        from ...io.checkpoint import apply_ckpt_model_overrides
+        model_cfg = apply_ckpt_model_overrides(get_model_config(name), extra)
         calib = jax.random.randint(
             jax.random.PRNGKey(0), (1, calib_seq), 1, model_cfg.vocab_size)
     path = export_params(params, out_path, fmt=fmt, quant=quant,

@@ -56,6 +56,11 @@ def launch(ctx, config_file, model, max_steps, launcher, nodes, in_process,
         raise click.ClickException(
             "--restart-on-failure needs the subprocess launcher "
             "(drop --in-process)")
+    if restart_on_failure and launcher != "local":
+        raise click.ClickException(
+            "--restart-on-failure supervises a LOCAL job process; "
+            f"launcher {launcher!r} only submits (sbatch/kubectl exit "
+            "immediately) — use the scheduler's own requeue/backoff there")
     if restart_on_failure and no_resume:
         raise click.ClickException(
             "--restart-on-failure recovers by RESUMING from the latest "

@@ -163,7 +163,7 @@ def forward(
     segment_ids: Optional[jax.Array] = None,
     kv_cache: Optional[tuple[jax.Array, jax.Array]] = None,
     cache_offset: Optional[jax.Array] = None,
-    attn_impl: str = "xla",          # xla | flash | ring
+    attn_impl: str = "xla",          # xla | flash | ring | ulysses
     norm_impl: str = "xla",          # xla | pallas
     remat: str = "none",             # none | selective | full
     return_aux: bool = False,

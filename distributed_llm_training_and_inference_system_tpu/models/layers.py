@@ -170,6 +170,10 @@ def attention_block(
         from ..ops.ring_attention import ring_attention
         out = ring_attention(q, k, v, positions=positions,
                              segment_ids=segment_ids, axis_name="sp")
+    elif attn_impl == "ulysses":
+        from ..ops.ulysses import ulysses_attention
+        out = ulysses_attention(q, k, v, positions=positions,
+                                segment_ids=segment_ids, axis_name="sp")
     else:
         mask = attention_mask(positions, positions, segment_ids, segment_ids)
         out = dot_product_attention(q, k, v, mask)

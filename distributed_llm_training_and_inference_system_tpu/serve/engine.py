@@ -96,6 +96,10 @@ class InferenceEngine:
         self.lock = threading.Lock()
         # fired (from the engine thread) whenever a request leaves its slot
         self.on_finish: Optional[Callable[[Request], None]] = None
+        # fired (engine thread) with each batch of newly accepted tokens for
+        # a request — the streaming hook (multi-step decode delivers up to
+        # K per call)
+        self.on_token: Optional[Callable[[Request, list], None]] = None
 
         # per-slot host state
         self.last_tokens = np.zeros(S, np.int32)
@@ -235,6 +239,8 @@ class InferenceEngine:
         slot, n = req.slot, req.num_prompt_tokens
         s = req.sampling
         req.record_token(int(token))
+        if self.on_token is not None:
+            self.on_token(req, [int(token)])
         from .scheduler import RequestState
         req.state = RequestState.RUNNING
         self.last_tokens[slot] = int(token)
@@ -286,14 +292,18 @@ class InferenceEngine:
         for slot, req in enumerate(self.scheduler.slots):
             if req is None or not self.active[slot]:
                 continue
+            accepted = []
             for k in range(sampled_seq.shape[0]):
                 self.positions[slot] += 1
                 tok = int(sampled_seq[k, slot])
                 req.record_token(tok)
+                accepted.append(tok)
                 self.last_tokens[slot] = tok
                 if (req.cancel_requested
                         or req.should_stop(self.eos_token_id) is not None):
                     break
+            if accepted and self.on_token is not None:
+                self.on_token(req, accepted)
 
     # -- lifecycle -----------------------------------------------------------
 

@@ -54,7 +54,11 @@ class InferenceServer:
         self._engine_error: Optional[str] = None
         self._engine_error_count = 0
         self._waiters: dict[str, tuple[asyncio.AbstractEventLoop, asyncio.Event]] = {}
+        # streaming requests: request_id -> (loop, asyncio.Queue of token
+        # batches; None = finished)
+        self._streams: dict[str, tuple] = {}
         self.engine.on_finish = self._notify_finished
+        self.engine.on_token = self._notify_tokens
         self.app = self._build_app()
 
     def _notify_finished(self, req) -> None:
@@ -63,6 +67,18 @@ class InferenceServer:
         if waiter is not None:
             loop, event = waiter
             loop.call_soon_threadsafe(event.set)
+        stream = self._streams.pop(req.request_id, None)
+        if stream is not None:
+            loop, q = stream
+            loop.call_soon_threadsafe(q.put_nowait, None)   # end-of-stream
+
+    def _notify_tokens(self, req, tokens: list) -> None:
+        """Engine-thread callback: push a freshly decoded token batch to the
+        request's SSE stream (multi-step decode delivers up to K at once)."""
+        stream = self._streams.get(req.request_id)
+        if stream is not None:
+            loop, q = stream
+            loop.call_soon_threadsafe(q.put_nowait, list(tokens))
 
     # -- engine thread -------------------------------------------------------
 
@@ -178,19 +194,29 @@ class InferenceServer:
             return web.json_response(
                 {"error": f"max_tokens must be >= 1, got "
                           f"{sampling.max_tokens}"}, status=400)
+        stream = bool(body.get("stream", False))
         req = Request(request_id=f"cmpl-{uuid.uuid4().hex[:24]}",
                       prompt_tokens=prompt_tokens, sampling=sampling)
+        loop = asyncio.get_running_loop()
         event = asyncio.Event()
-        self._waiters[req.request_id] = (asyncio.get_running_loop(), event)
+        self._waiters[req.request_id] = (loop, event)
+        token_q: Optional[asyncio.Queue] = None
+        if stream:
+            token_q = asyncio.Queue()
+            self._streams[req.request_id] = (loop, token_q)
         with self._lock:
             accepted = self.engine.scheduler.add_request(req)
         if not accepted:
             self._waiters.pop(req.request_id, None)
+            self._streams.pop(req.request_id, None)
             if req.error:
                 return web.json_response({"error": req.error}, status=400)
             return web.json_response(
                 {"error": "server overloaded"}, status=503)
         self._wake.set()
+
+        if stream:
+            return await self._stream_response(request, req, token_q)
 
         try:
             await self._await_request(req, event)
@@ -205,14 +231,8 @@ class InferenceServer:
                                      status=500)
 
         latency_ms = (req.finish_time - req.arrival_time) * 1000.0
-        self._recent_latencies = (self._recent_latencies + [latency_ms])[-1000:]
-        if req.ttft_ms is not None:
-            self._recent_ttfts = (self._recent_ttfts + [req.ttft_ms])[-1000:]
         n_gen = len(req.generated_tokens)
-        self.observer("inference_request", {
-            "latency_ms": latency_ms, "ttft_ms": req.ttft_ms,
-            "prompt_tokens": req.num_prompt_tokens, "tokens": n_gen,
-        })
+        self._record_request_metrics(req)
         return web.json_response({
             "id": req.request_id,
             "object": "text_completion",
@@ -230,6 +250,70 @@ class InferenceServer:
                 "total_tokens": req.num_prompt_tokens + n_gen,
             },
             "metrics": {"ttft_ms": req.ttft_ms, "latency_ms": latency_ms},
+        })
+
+    async def _stream_response(self, http_req: web.Request, req: Request,
+                               token_q: asyncio.Queue) -> web.StreamResponse:
+        """Server-sent events (OpenAI `stream: true` wire format): one
+        `data: {...}` chunk per decoded token batch, `data: [DONE]` at the
+        end. Multi-step decode delivers tokens in bursts of up to K."""
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+        })
+        await resp.prepare(http_req)
+
+        def chunk(text, finish_reason=None):
+            return ("data: " + json.dumps({
+                "id": req.request_id, "object": "text_completion",
+                "model": self.model_cfg.name,
+                "choices": [{"index": 0, "text": text,
+                             "finish_reason": finish_reason}],
+            }) + "\n\n").encode()
+
+        try:
+            while True:
+                try:
+                    batch = await asyncio.wait_for(token_q.get(),
+                                                   timeout=600.0)
+                except asyncio.TimeoutError:
+                    # engine stalled: free the slot + KV pages like the
+                    # non-streaming timeout path does
+                    with self._lock:
+                        self.engine.scheduler.cancel(req.request_id)
+                    break
+                if batch is None:               # request left its slot
+                    break
+                await resp.write(chunk(self.tokenizer.decode(batch)))
+            final = chunk("", req.finish_reason or "error")
+            await resp.write(final)
+            await resp.write(b"data: [DONE]\n\n")
+        except (ConnectionResetError, asyncio.CancelledError):
+            # client went away: free the slot + pages
+            with self._lock:
+                self.engine.scheduler.cancel(req.request_id)
+            raise
+        finally:
+            self._streams.pop(req.request_id, None)
+            self._waiters.pop(req.request_id, None)
+        self._record_request_metrics(req)
+        await resp.write_eof()
+        return resp
+
+    def _record_request_metrics(self, req: Request) -> None:
+        """Shared /health percentile + observer accounting for finished
+        requests (streaming and blocking paths must not drift)."""
+        if req.finish_time is None:
+            return
+        latency_ms = (req.finish_time - req.arrival_time) * 1000.0
+        self._recent_latencies = (
+            self._recent_latencies + [latency_ms])[-1000:]
+        if req.ttft_ms is not None:
+            self._recent_ttfts = (self._recent_ttfts + [req.ttft_ms])[-1000:]
+        self.observer("inference_request", {
+            "latency_ms": latency_ms, "ttft_ms": req.ttft_ms,
+            "prompt_tokens": req.num_prompt_tokens,
+            "tokens": len(req.generated_tokens),
         })
 
     async def handle_models(self, request: web.Request) -> web.Response:

@@ -240,3 +240,25 @@ def test_long_context_32k_memory_scales_linearly(devices8):
     tr, batch = build(16384)
     m = tr.step(batch)
     assert np.isfinite(float(m["loss"]))
+
+
+def test_ulysses_matches_ring_and_dense(devices8):
+    """Ulysses (all-to-all head scatter) must produce the same losses as
+    ring attention and the unsharded step on the sp mesh — the second
+    context-parallel scheme SURVEY §5.7 names (the reference has neither)."""
+    model_cfg = get_model_config("gpt-test")   # 4 q heads, 2 kv heads
+    tokens = jax.random.randint(jax.random.PRNGKey(9), (4, 64), 1,
+                                model_cfg.vocab_size)
+    batch = {"tokens": tokens}
+    ref = _ref_losses(model_cfg, batch, steps=2, lr=1e-2)
+
+    losses = {}
+    for impl in ("ring", "ulysses"):
+        par = ParallelConfig(data_parallel=4, sequence_parallel=2,
+                             micro_batch_size=1, global_batch_size=4)
+        tr = ShardedTrainer(model_cfg, OptimizerConfig(lr=1e-2), par,
+                            devices=devices8, attn_impl=impl)
+        tr.init_state(seed=0)
+        losses[impl] = [float(tr.step(batch)["loss"]) for _ in range(2)]
+    np.testing.assert_allclose(losses["ring"], ref, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(losses["ulysses"], ref, rtol=2e-4, atol=2e-5)

@@ -199,6 +199,43 @@ class TestHTTPServer:
         assert r.status_code == 200
         assert isinstance(r.json()["choices"][0]["text"], str)
 
+    def test_streaming_completions(self, server):
+        """`stream: true` emits OpenAI-style SSE chunks ending in [DONE];
+        concatenated chunk texts equal the non-streaming completion (greedy
+        is deterministic), and the final chunk carries finish_reason."""
+        import json as _json
+
+        import requests as rq
+        srv, port = server
+        base = f"http://127.0.0.1:{port}"
+        ref = rq.post(f"{base}/v1/completions", json={
+            "prompt": [5, 17, 99], "max_tokens": 6, "temperature": 0.0,
+        }, timeout=60).json()
+
+        r = rq.post(f"{base}/v1/completions", json={
+            "prompt": [5, 17, 99], "max_tokens": 6, "temperature": 0.0,
+            "stream": True,
+        }, stream=True, timeout=60)
+        assert r.status_code == 200
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        texts, finish = [], None
+        saw_done = False
+        for line in r.iter_lines():
+            if not line:
+                continue
+            payload = line.decode().removeprefix("data: ")
+            if payload == "[DONE]":
+                saw_done = True
+                break
+            obj = _json.loads(payload)
+            choice = obj["choices"][0]
+            texts.append(choice["text"])
+            if choice["finish_reason"]:
+                finish = choice["finish_reason"]
+        assert saw_done
+        assert finish == "length"
+        assert "".join(texts) == ref["choices"][0]["text"]
+
     def test_bad_request(self, server):
         import requests as rq
         srv, port = server
