@@ -67,6 +67,9 @@ def main() -> None:
     state, m = jstep(state, b)
     float(m["loss"])
 
+    # fixed across rounds: min-of-4-windows is the statistic BENCH_r* rows
+    # are compared with; changing the window count would change the
+    # sample-minimum's bias and break round-over-round comparability
     n_windows, per_window = (4, 5) if on_tpu else (2, 2)
     windows = []
     final_loss = 0.0
