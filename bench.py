@@ -8,14 +8,16 @@ The reference publishes no measured numbers (BASELINE.md: bench is
 base is the BASELINE.json north-star target: >=50% MFU for training.
 ``vs_baseline`` = measured_MFU / 0.50 — 1.0 means the target is met.
 
-Model: gpt-750m (H=2048/D=128) — the largest template whose fp32-AdamW
-state + grads fits one 16 GB v5e chip. Round 1 benched gpt-350m, but its
-H=1024 matmul shapes cap at 17-30% of the v5e MXU peak in isolation
-(measured via _-probe sweeps, BASELINE.md round-2 notes), so its 0.34 MFU
-was a model-shape ceiling, not a framework one. bf16 compute, flash
-attention Pallas kernel, selective remat, chunked cross-entropy (the
-[B,S,V] fp32 logits pair is never materialised) — the same code path
-`llmctl train` uses. Runs anywhere jax runs; on CPU it reports CPU numbers.
+Model: gpt-750m (H=2048/D=128) — the largest template whose AdamW state +
+grads fits one 16 GB v5e chip. Round 1 benched gpt-350m, but its H=1024
+matmul shapes cap at 17-30% of the v5e MXU peak in isolation (measured via
+matmul-probe sweeps, BASELINE.md round-2 notes), so its 0.34 MFU was a
+model-shape ceiling, not a framework one. bf16 compute, flash attention
+Pallas kernel, selective remat, chunked cross-entropy (the [B,S,V] fp32
+logits pair is never materialised), bf16 Adam first moment
+(OptimizerConfig.moment_dtype — measured +0.035 MFU at this scale, the
+freed HBM improves XLA scheduling) — the same code path `llmctl train`
+uses. Runs anywhere jax runs; on CPU it reports CPU numbers.
 
 Timing: pipelined windows of 5 steps, each fenced by a scalar fetch (on the
 tunneled backend block_until_ready can return early — the only trustworthy
@@ -53,8 +55,8 @@ def main() -> None:
     par = ParallelConfig(activation_checkpoint="selective",
                          micro_batch_size=batch, global_batch_size=batch)
     step_fn, tx, _ = make_train_step(
-        cfg, OptimizerConfig(lr=1e-4), par,
-        attn_impl="flash" if on_tpu else "xla")
+        cfg, OptimizerConfig(lr=1e-4, moment_dtype="bfloat16"), par,
+        attn_impl="flash" if on_tpu else "xla", loss_chunk=1024)
     params = init(cfg, jax.random.PRNGKey(0))
     state = TrainState.create(params, tx)
     jstep = jax.jit(step_fn, donate_argnums=(0,))

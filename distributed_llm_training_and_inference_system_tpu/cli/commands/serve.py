@@ -37,8 +37,21 @@ def app(ctx):
               help="Serving dtype (default bf16 on TPU, fp32 on CPU).")
 @click.option("--prometheus-port", default=None, type=int,
               help="Also start a Prometheus scrape endpoint.")
+@click.option("--speculative", default="off", show_default=True,
+              type=click.Choice(["off", "ngram"]),
+              help="Speculative decoding (ngram = host prompt-lookup "
+                   "drafts, device verification; greedy output unchanged).")
+@click.option("--spec-tokens", default=8, show_default=True, type=int,
+              help="Speculative verify window (drafts per dispatch + 1).")
+@click.option("--prefix-cache/--no-prefix-cache", default=True,
+              show_default=True,
+              help="Share full prompt-prefix KV pages between requests.")
+@click.option("--tensor-parallel", default=1, show_default=True, type=int,
+              help="Shard the model over this many local devices "
+                   "(Megatron TP; needs num_kv_heads % tp == 0).")
 def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
-          kv_block_size, kv_hbm_gb, scheduler, dtype, prometheus_port):
+          kv_block_size, kv_hbm_gb, scheduler, dtype, prometheus_port,
+          speculative, spec_tokens, prefix_cache, tensor_parallel):
     """Start the OpenAI-compatible inference server."""
     import jax
 
@@ -55,7 +68,10 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
         max_batch_size=max_batch_size,
         max_seq_len=min(max_seq_len, model_cfg.max_position_embeddings),
         kv_block_size=kv_block_size, kv_hbm_budget_gb=kv_hbm_gb,
-        scheduler=scheduler, dtype=dtype)
+        scheduler=scheduler, dtype=dtype, speculative=speculative,
+        speculative_tokens=spec_tokens, prefix_caching=prefix_cache,
+        tensor_parallel=tensor_parallel)
+    serve_cfg.validate()
 
     observer = None
     if prometheus_port:

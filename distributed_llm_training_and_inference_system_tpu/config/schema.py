@@ -207,6 +207,12 @@ class OptimizerConfig:
     eps: float = 1e-8
     weight_decay: float = 0.1
     grad_clip: float = 1.0
+    # dtype of Adam's first moment (mu). bfloat16 halves that buffer
+    # (~1.5 GB freed at gpt-750m) — mu is a smoothed gradient, bf16's ~3
+    # decimal digits suffice; the variance (nu) stays fp32 (rsqrt is
+    # precision-sensitive). Measured +0.035 MFU at gpt-750m b4 (BASELINE.md
+    # round-2 sweep; batch 6 still OOMs by ~632 MB even with bf16 mu).
+    moment_dtype: str = "float32"
     scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
 
     def validate(self) -> None:
@@ -214,6 +220,8 @@ class OptimizerConfig:
             raise ConfigError(f"unknown optimizer {self.type!r}")
         if not (0 < self.lr < 1):
             raise ConfigError(f"suspicious learning rate {self.lr}")
+        if self.moment_dtype not in ("float32", "bfloat16"):
+            raise ConfigError("moment_dtype must be float32|bfloat16")
 
     @classmethod
     def from_dict(cls, d: dict[str, Any] | None) -> "OptimizerConfig":
@@ -227,6 +235,7 @@ class OptimizerConfig:
             eps=float(_take(d, "eps", default=1e-8)),
             weight_decay=float(_take(d, "weight_decay", default=0.1)),
             grad_clip=float(_take(d, "grad_clip", "gradient_clipping", default=1.0)),
+            moment_dtype=str(_take(d, "moment_dtype", default="float32")),
             scheduler=SchedulerConfig.from_dict(d.get("scheduler")),
         )
         cfg.validate()
@@ -269,8 +278,10 @@ class ParallelConfig:
                 raise ConfigError(f"{f_} must be >= 1")
         if self.zero_stage not in (0, 1, 2, 3):
             raise ConfigError("zero_stage must be 0..3")
-        if self.activation_checkpoint not in ("none", "selective", "full"):
-            raise ConfigError("activation_checkpoint must be none|selective|full")
+        if self.activation_checkpoint not in ("none", "selective",
+                                              "selective_attn", "full"):
+            raise ConfigError(
+                "activation_checkpoint must be none|selective|selective_attn|full")
         if self.pipeline_parallel > 1 and self.num_microbatches < self.pipeline_parallel:
             raise ConfigError(
                 "num_microbatches must be >= pipeline_parallel for a full pipeline")
@@ -481,6 +492,43 @@ class ServeConfig:
     dtype: str = "bfloat16"
     scheduler: str = "continuous"   # continuous | static
     temperature: float = 1.0
+    # speculative decoding: "off" | "ngram" (host prompt-lookup drafts,
+    # device verification — serve/speculative.py). Greedy requests accept
+    # up to speculative_tokens-1 drafts + 1 bonus token per dispatch; the
+    # acceptance rule is draft == argmax, so output is bit-identical to
+    # plain greedy decode regardless of draft quality.
+    speculative: str = "off"
+    speculative_tokens: int = 8     # verify window T (drafts = T-1)
+    speculative_ngram: int = 3      # longest n-gram tried by the proposer
+    # adaptive kill switch: after 64 dispatches, if the measured draft
+    # acceptance is below this, the engine falls back to plain multi-step
+    # decode for the rest of its life (the verify window costs ~9
+    # decode-steps, BASELINE.md round 2 — low acceptance means the spec
+    # path is a pure loss)
+    speculative_min_acceptance: float = 0.05
+    # automatic prefix caching: full prompt pages are content-hashed and
+    # shared read-only between requests (refcounted, LRU-evicted when the
+    # allocator runs dry). A hit skips that prefix's prefill compute —
+    # shared-system-prompt workloads see near-zero marginal TTFT.
+    prefix_caching: bool = True
+    # Megatron-style tensor-parallel serving over a tp mesh axis: params
+    # shard per parallel.sharding.PARAM_RULES, KV pages shard over the
+    # kv-head axis, GSPMD inserts the per-layer collectives. Requires
+    # num_kv_heads % tensor_parallel == 0 and that many local devices.
+    tensor_parallel: int = 1
+
+    def validate(self) -> None:
+        if self.tensor_parallel < 1:
+            raise ConfigError("tensor_parallel must be >= 1")
+        # the engine checks `speculative == "ngram"`, so a config-file typo
+        # ("n-gram", "medusa") would otherwise silently disable speculation
+        if self.speculative not in ("off", "ngram"):
+            raise ConfigError(
+                f"speculative must be off|ngram, got {self.speculative!r}")
+        if self.speculative != "off" and self.speculative_tokens < 2:
+            raise ConfigError("speculative_tokens must be >= 2")
+        if self.scheduler not in ("continuous", "static"):
+            raise ConfigError("scheduler must be continuous|static")
 
     @classmethod
     def from_dict(cls, d: dict[str, Any] | None) -> "ServeConfig":
@@ -490,7 +538,9 @@ class ServeConfig:
         for f_ in dataclasses.fields(cls):
             if f_.name in d:
                 kw[f_.name] = type(f_.default)(d[f_.name]) if f_.default is not None else d[f_.name]
-        return cls(**kw)
+        cfg = cls(**kw)
+        cfg.validate()
+        return cfg
 
 
 @dataclass

@@ -39,7 +39,10 @@ def make_optimizer(cfg: OptimizerConfig) -> tuple[optax.GradientTransformation,
     if cfg.type in ("adamw", "adam"):
         wd = cfg.weight_decay if cfg.type == "adamw" else 0.0
         tx = optax.chain(
-            optax.scale_by_adam(b1=cfg.betas[0], b2=cfg.betas[1], eps=cfg.eps),
+            # mu_dtype=bfloat16 halves the first-moment buffer; nu stays
+            # fp32 (rsqrt precision) — see OptimizerConfig.moment_dtype
+            optax.scale_by_adam(b1=cfg.betas[0], b2=cfg.betas[1], eps=cfg.eps,
+                                mu_dtype=jnp.dtype(cfg.moment_dtype)),
             optax.add_decayed_weights(wd, mask=_decay_mask) if wd else optax.identity(),
             optax.scale_by_learning_rate(schedule),
         )

@@ -178,6 +178,10 @@ class MeshPlanner:
         per_layer = {
             "none": 14 * b * s_local * h + 2 * b * s_local * m.ffn_size,
             "selective": 6 * b * s_local * h,
+            # selective + the named flash-attention output pinned resident
+            # (models/gpt.py _remat_wrap): one extra [b, s, Nq*D] per layer
+            "selective_attn": 6 * b * s_local * h
+            + b * s_local * m.num_heads * m.head_dim,
             "full": 2 * b * s_local * h,
         }[par.activation_checkpoint]
         per_layer /= par.tensor_parallel

@@ -36,47 +36,95 @@ def decode_step_forward(
     block_tables: jax.Array,  # [B, maxP] int32
     cfg: ModelConfig,
     active: Any = None,       # [B] bool — inactive rows write scratch page
+    attn_impl: str = "auto",
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Returns (logits [B, V] fp32, new k_pages, new v_pages).
 
-    The new token's K/V are written into the pages *inside* this traced
-    function (page arrays should be donated by the jit wrapper so XLA
-    updates them in place in HBM).
+    The T=1 case of ``extend_step_forward`` (one layer-body implementation
+    for both, so the paths can never diverge numerically). The new token's
+    K/V are written into the pages *inside* the traced function (page
+    arrays should be donated by the jit wrapper so XLA updates them in
+    place in HBM).
+    """
+    write_ok = None if active is None else active[:, None]
+    logits, new_k, new_v = extend_step_forward(
+        params, tokens[:, None], positions, k_pages, v_pages, block_tables,
+        cfg, write_ok=write_ok, attn_impl=attn_impl)
+    return logits[:, 0], new_k, new_v
+
+
+def extend_step_forward(
+    params: Any,
+    tokens: jax.Array,        # [B, T] int32 — T new tokens per slot
+    start_positions: jax.Array,  # [B] int32 — position of tokens[:, 0]
+    k_pages: jax.Array,       # [L, NP, Nkv, PS, D]
+    v_pages: jax.Array,
+    block_tables: jax.Array,  # [B, maxP] int32
+    cfg: ModelConfig,
+    write_ok: Any = None,     # [B, T] bool — False rows write scratch page 0
+    attn_impl: str = "auto",  # forwarded to ops.paged_attention; the
+                              # tensor-parallel engine forces "gather" (the
+                              # Pallas kernel is opaque to GSPMD and would
+                              # be replicated, gathering all pages per chip)
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Paged forward over T tokens per slot: the multi-token sibling of
+    ``decode_step_forward``. Returns (logits [B, T, V] fp32, k_pages, v_pages).
+
+    Token j sits at position ``start_positions + j`` and attends causally
+    over the paged prefix *including* earlier tokens of this same call: all
+    T tokens' K/V are scattered into the pages first, then attention runs
+    with per-query length ``start + j + 1``. This one primitive powers both
+    speculative-decode verification (serve/speculative.py: score K draft
+    tokens in one weight-streaming pass — decode is HBM-bound on weights,
+    so T<=8 tokens cost nearly the same as 1) and cached-prefix suffix
+    prefill (only the un-cached tail of a prompt is computed).
+
+    The multi-query paged attention reuses the single-token kernel by
+    flattening [B, T] -> rows: row (b, j) carries length start_b + j + 1
+    with slot b's block table. Prefix pages are streamed once per query row
+    — redundant T-fold, acceptable for small T (drafts, suffix chunks).
     """
     compute_dtype = jnp.dtype(cfg.dtype)
-    B = tokens.shape[0]
+    B, T = tokens.shape
     D, Nq, Nkv = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
 
-    x = params["embed"]["embedding"][tokens].astype(compute_dtype)   # [B,H]
+    positions = start_positions[:, None] + jnp.arange(T, dtype=jnp.int32)
+    flat_pos = positions.reshape(B * T)
+    flat_tables = jnp.repeat(block_tables, T, axis=0)        # [B*T, maxP]
+    flat_ok = None if write_ok is None else write_ok.reshape(B * T)
+    lengths = flat_pos + 1
+
+    x = params["embed"]["embedding"][tokens].astype(compute_dtype)  # [B,T,H]
     inv_freq = rope_frequencies(cfg.head_dim, cfg.rope.base,
                                 cfg.rope.scaling, cfg.rope.scaling_factor)
-    lengths = positions + 1      # attend over [0, position] inclusive
 
     def body(x, layer_and_pages):
         layer, kp, vp = layer_and_pages
         h = rms_norm(x, layer["attn_norm"]["scale"], cfg.norm_eps)
-        q = (h @ layer["q"]["kernel"]).reshape(B, Nq, D)
-        k = (h @ layer["k"]["kernel"]).reshape(B, Nkv, D)
-        v = (h @ layer["v"]["kernel"]).reshape(B, Nkv, D)
+        q = (h @ layer["q"]["kernel"]).reshape(B, T, Nq, D)
+        k = (h @ layer["k"]["kernel"]).reshape(B, T, Nkv, D)
+        v = (h @ layer["v"]["kernel"]).reshape(B, T, Nkv, D)
         if cfg.attention_bias:
             q = q + layer["q"]["bias"].reshape(Nq, D)
             k = k + layer["k"]["bias"].reshape(Nkv, D)
             v = v + layer["v"]["bias"].reshape(Nkv, D)
-        # rope for a single token: positions [B] -> [B,1] sequence of len 1
-        q = apply_rope(q[:, None], positions[:, None], inv_freq)[:, 0]
-        k = apply_rope(k[:, None], positions[:, None], inv_freq)[:, 0]
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
 
-        kp = write_token_to_pages(kp, k, block_tables, positions, active)
-        vp = write_token_to_pages(vp, v, block_tables, positions, active)
-        attn = paged_attention(q, kp, vp, block_tables, lengths)
-        x = x + (attn.reshape(B, Nq * D) @ layer["o"]["kernel"]).astype(x.dtype)
+        kp = write_token_to_pages(kp, k.reshape(B * T, Nkv, D), flat_tables,
+                                  flat_pos, flat_ok)
+        vp = write_token_to_pages(vp, v.reshape(B * T, Nkv, D), flat_tables,
+                                  flat_pos, flat_ok)
+        attn = paged_attention(q.reshape(B * T, Nq, D), kp, vp, flat_tables,
+                               lengths, impl=attn_impl)
+        attn = attn.reshape(B, T, Nq * D)
+        x = x + (attn @ layer["o"]["kernel"]).astype(x.dtype)
 
         h = rms_norm(x, layer["mlp_norm"]["scale"], cfg.norm_eps)
         if cfg.is_moe:
-            ffn, _ = moe_block(h[:, None], layer["moe"], cfg)
-            ffn = ffn[:, 0]
+            ffn, _ = moe_block(h, layer["moe"], cfg)
         else:
-            ffn = mlp_block(h[:, None], layer["mlp"], cfg)[:, 0]
+            ffn = mlp_block(h, layer["mlp"], cfg)
         return x + ffn.astype(x.dtype), (kp, vp)
 
     cast = functools.partial(jax.tree_util.tree_map,
@@ -86,11 +134,11 @@ def decode_step_forward(
 
     x = rms_norm(x, params["final_norm"]["scale"].astype(x.dtype), cfg.norm_eps)
     if cfg.tie_word_embeddings:
-        logits = jnp.einsum("bh,vh->bv", x,
+        logits = jnp.einsum("bth,vh->btv", x,
                             params["embed"]["embedding"].astype(x.dtype),
                             preferred_element_type=jnp.float32)
     else:
-        logits = jnp.einsum("bh,hv->bv", x,
+        logits = jnp.einsum("bth,hv->btv", x,
                             params["lm_head"]["kernel"].astype(x.dtype),
                             preferred_element_type=jnp.float32)
     return logits.astype(jnp.float32), new_k, new_v
@@ -110,6 +158,7 @@ def decode_multi_step(
     top_p: jax.Array,           # [B]
     cfg: ModelConfig,
     num_steps: int,
+    attn_impl: str = "auto",
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Run ``num_steps`` decode+sample iterations in ONE compiled program.
 
@@ -130,19 +179,32 @@ def decode_multi_step(
 
     Returns ([K, B] sampled tokens, new k_pages, new v_pages).
     """
+    (_, _, k_pages, v_pages), toks_seq = decode_scan(
+        params, tokens, positions, k_pages, v_pages, block_tables,
+        stop_positions, slot_keys, temperature, top_k, top_p, cfg,
+        num_steps, attn_impl)
+    return toks_seq, k_pages, v_pages
+
+
+def decode_scan(params, tokens, positions, k_pages, v_pages, block_tables,
+                stop_positions, slot_keys, temperature, top_k, top_p,
+                cfg: ModelConfig, num_steps: int, attn_impl: str = "auto"):
+    """The decode+sample scan shared by ``decode_multi_step`` and the fused
+    speculative dispatch (speculative.verify_and_decode). Returns
+    ((tokens, positions, k_pages, v_pages), toks_seq [K, B])."""
     from .sampling import sample_tokens
 
     def one(carry, _):
         toks, pos, kp, vp = carry
         act = pos < stop_positions
         logits, kp, vp = decode_step_forward(
-            params, toks, pos, kp, vp, block_tables, cfg, active=act)
+            params, toks, pos, kp, vp, block_tables, cfg, active=act,
+            attn_impl=attn_impl)
         keys = jax.vmap(jax.random.fold_in)(
             jax.vmap(jax.random.wrap_key_data)(slot_keys), pos + 1)
         nxt = sample_tokens(logits, keys, temperature, top_k, top_p)
         nxt = jnp.where(act, nxt, toks)
         return (nxt, pos + 1, kp, vp), nxt
 
-    (_, _, k_pages, v_pages), toks_seq = jax.lax.scan(
-        one, (tokens, positions, k_pages, v_pages), None, length=num_steps)
-    return toks_seq, k_pages, v_pages
+    return jax.lax.scan(one, (tokens, positions, k_pages, v_pages), None,
+                        length=num_steps)

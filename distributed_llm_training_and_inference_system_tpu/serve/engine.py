@@ -38,7 +38,7 @@ import numpy as np
 
 from ..config.schema import ModelConfig, ServeConfig
 from ..models import gpt
-from .decode import decode_multi_step
+from .decode import decode_multi_step, extend_step_forward
 from .kv_cache import PagedKVCache
 from .sampling import sample_tokens
 from .scheduler import ContinuousBatchingScheduler, Request, SamplingParams
@@ -66,6 +66,34 @@ class InferenceEngine:
             params, model_cfg = self._load_params(model_cfg, serve_cfg,
                                                   seed, dtype)
         self.cfg = model_cfg
+
+        # tensor-parallel serving: one tp-axis mesh; params shard per
+        # PARAM_RULES (column/row-parallel kernels), pages per kv head.
+        # GSPMD inserts the per-layer collectives — the serve-side
+        # equivalent of the training ShardedTrainer. Attention runs the
+        # gather impl under tp: the Pallas kernel is a custom call GSPMD
+        # can't partition (it would replicate every page to every chip).
+        tp = serve_cfg.tensor_parallel
+        self.mesh = None
+        self._attn_impl = "auto"
+        page_sharding = None
+        if tp > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..config.schema import ParallelConfig
+            from ..parallel.mesh import build_mesh
+            from ..parallel.sharding import shard_params
+            if model_cfg.num_kv_heads % tp or model_cfg.num_heads % tp:
+                raise ValueError(
+                    f"tensor_parallel={tp} must divide num_heads="
+                    f"{model_cfg.num_heads} and num_kv_heads="
+                    f"{model_cfg.num_kv_heads}")
+            self.mesh = build_mesh(ParallelConfig(tensor_parallel=tp),
+                                   jax.devices()[:tp])
+            params = shard_params(params, self.mesh)
+            page_sharding = NamedSharding(
+                self.mesh, P(None, None, "tp", None, None))
+            self._attn_impl = "gather"
         self.params = params
 
         S = serve_cfg.max_batch_size
@@ -73,7 +101,8 @@ class InferenceEngine:
             model_cfg, num_slots=S, max_seq_len=serve_cfg.max_seq_len,
             page_size=serve_cfg.kv_block_size,
             num_pages=serve_cfg.kv_num_blocks,
-            hbm_budget_gb=serve_cfg.kv_hbm_budget_gb, dtype=dtype)
+            hbm_budget_gb=serve_cfg.kv_hbm_budget_gb, dtype=dtype,
+            page_sharding=page_sharding)
 
         self._req_slot: dict[str, int] = {}
         # pages promised to admitted-but-not-yet-prefilled requests; without
@@ -84,6 +113,9 @@ class InferenceEngine:
         # leaking it.
         self._reserved_pages = 0
         self._reserved_by: dict[str, int] = {}
+        # prefix-cache pins per request: pages pinned at admission (so LRU
+        # eviction can't drop them before prefill), unpinned on release
+        self._prefix_pins: dict[str, list[int]] = {}
         self.scheduler = ContinuousBatchingScheduler(
             max_batch_size=S, max_queue=serve_cfg.max_queue,
             max_seq_len=serve_cfg.max_seq_len,
@@ -112,14 +144,27 @@ class InferenceEngine:
         self._slot_keys = np.zeros((S, 2), np.uint32)
         self._base_seed = seed
         self._admitted_counter = 0
+        # per-slot incremental context (prompt + accepted tokens) for the
+        # speculative draft proposer — rebuilding prompt+generated lists
+        # per dispatch is O(context) host work in the latency-critical loop
+        self._ctx = np.zeros((S, serve_cfg.max_seq_len), np.int32)
+        self._ctx_len = np.zeros(S, np.int64)
 
         self._prefill_cache: dict[int, callable] = {}
         self._decode_jit = jax.jit(self._decode_impl, donate_argnums=(1, 2))
+        self._spec_jit = (jax.jit(self._spec_impl, donate_argnums=(1, 2))
+                          if serve_cfg.speculative == "ngram" else None)
         self.total_decode_steps = 0
-        self.total_prefill_tokens = 0
+        self.total_prefill_tokens = 0      # tokens actually computed
+        self.total_prefix_cached_tokens = 0  # prompt tokens skipped via cache
         # decode always runs over all slots (one compiled program); padded
         # slots are wasted work — tracked so batch-size tuning isn't blind
         self.total_padded_slot_steps = 0
+        # speculative-decode accounting (acceptance rate drives the
+        # use-it-or-not decision per deployment)
+        self.total_spec_dispatches = 0
+        self.total_spec_drafts = 0
+        self.total_spec_accepted = 0
 
     # -- setup ---------------------------------------------------------------
 
@@ -152,11 +197,46 @@ class InferenceEngine:
     def _try_reserve(self, req: Request) -> bool:
         """Admission hook (runs under self.lock inside admit()): reserve the
         request's full KV footprint so concurrent admissions can't
-        collectively over-commit the page pool."""
-        need = self.kv.pages_needed(
-            req.num_prompt_tokens + req.sampling.max_tokens)
+        collectively over-commit the page pool. With prefix caching, cached
+        prompt pages are pinned here (they stop being evictable) and only
+        the remainder is reserved."""
+        n = req.num_prompt_tokens
+        pins: list[int] = []
+        usable = 0
+        if self.serve_cfg.prefix_caching:
+            if req.prefix_hashes is None:      # once per request, not per retry
+                from .kv_cache import prefix_page_hashes
+                req.prefix_hashes = prefix_page_hashes(
+                    req.prompt_tokens, self.kv.page_size)
+            # keep >=1 suffix token: the last prompt token must be
+            # re-processed to produce the first sampled token's logits
+            usable = min(len(req.prefix_hashes),
+                         max((n - 1) // self.kv.page_size, 0))
+            pins = self.kv.lookup_prefix(req.prefix_hashes[:usable])
+            # a hit is only worth taking when the un-cached tail is small:
+            # the suffix path (extend_step_forward) re-streams the whole
+            # prefix once PER SUFFIX TOKEN, so a 1-page hit on a long
+            # prompt would cost more than a cold dense prefill
+            computed = n - len(pins) * self.kv.page_size
+            if pins and computed > max(len(pins) * self.kv.page_size,
+                                       self.serve_cfg.prefill_chunk):
+                pins = []
+        # pin BEFORE the capacity check: pinned pages leave the evictable
+        # pool, so free_pages below no longer counts them — otherwise a
+        # pool full of ref==0 cached prefixes admits requests whose fresh
+        # allocation later OOMs in _prefill (over-commit)
+        if pins:
+            self.kv.pin_pages(pins)
+        need = self.kv.pages_needed(n + req.sampling.max_tokens) - len(pins)
         if need > self.kv.free_pages - self._reserved_pages:
+            if pins:
+                self.kv.unpin_pages(pins)
             return False
+        if pins:
+            self._prefix_pins[req.request_id] = pins
+        # hit-rate stats once per successful admission (not per retry)
+        self.kv.prefix_queries += usable
+        self.kv.prefix_hits += len(pins)
         self._reserved_pages += need
         self._reserved_by[req.request_id] = need
         return True
@@ -167,6 +247,15 @@ class InferenceEngine:
         return min(int(math.ceil(max(n, 1) / chunk)) * chunk,
                    int(math.ceil(self.serve_cfg.max_seq_len
                                  / self.kv.page_size)) * self.kv.page_size)
+
+    def _suffix_bucket(self, m: int) -> int:
+        """Bucket for the un-cached prompt tail: page-granular, power-of-two
+        page counts (bounded program count). Bucketing the tail by
+        prefill_chunk like the dense path would pad a 64-token suffix to
+        512 query rows — measured 5x slower than a cold dense prefill."""
+        pages = max(math.ceil(m / self.kv.page_size), 1)
+        pages = 1 << (pages - 1).bit_length()
+        return min(pages * self.kv.page_size, self._bucket(m))
 
     def _prefill_fn(self, bucket: int):
         if bucket not in self._prefill_cache:
@@ -198,6 +287,32 @@ class InferenceEngine:
                 prefill, donate_argnums=(3, 4))
         return self._prefill_cache[bucket]
 
+    def _extend_prefill_fn(self, bucket: int):
+        """Suffix prefill over a cached paged prefix: only the un-cached
+        tail of the prompt is computed (decode.extend_step_forward), writing
+        straight through the slot's block table. One program per suffix
+        bucket, same bucketing as the dense path."""
+        key_ = ("extend", bucket)
+        if key_ not in self._prefill_cache:
+            cfg = self.cfg
+
+            def extend_prefill(params, tokens, start, m, k_pages, v_pages,
+                               table, key, temp, top_k, top_p):
+                write_ok = (jnp.arange(bucket, dtype=jnp.int32)[None]
+                            < m[:, None])
+                logits, k_pages, v_pages = extend_step_forward(
+                    params, tokens, start, k_pages, v_pages, table, cfg,
+                    write_ok=write_ok, attn_impl=self._attn_impl)
+                last = jnp.take_along_axis(
+                    logits, (m - 1)[:, None, None], axis=1)[:, 0]   # [1, V]
+                token = sample_tokens(last, key[None], temp[None],
+                                      top_k[None], top_p[None])[0]
+                return token, k_pages, v_pages
+
+            self._prefill_cache[key_] = jax.jit(
+                extend_prefill, donate_argnums=(4, 5))
+        return self._prefill_cache[key_]
+
     def _prefill(self, req: Request):
         """Dispatch one prompt's prefill; returns (req, device token).
 
@@ -205,17 +320,22 @@ class InferenceEngine:
         admitted prompts pays one host round trip total, not one per
         prompt — dispatches pipeline on-device."""
         slot, n = req.slot, req.num_prompt_tokens
+        rid = req.request_id
+        PS = self.kv.page_size
         with self.lock:   # page bookkeeping is shared with cancel/release
-            self.kv.allocate(slot, n + req.sampling.max_tokens)
-            self._reserved_pages -= self._reserved_by.pop(req.request_id, 0)
-            self._req_slot[req.request_id] = slot
-            # table entries for the bucket: beyond-length pages -> scratch 0
-            bucket = self._bucket(n)
-            entries = np.zeros(bucket // self.kv.page_size, np.int32)
-            used = self.kv.pages_needed(n)
-            entries[:used] = self.kv.block_tables[slot, :used]
-        tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, :n] = req.prompt_tokens
+            pins = self._prefix_pins.get(rid, [])
+            self.kv.allocate(slot, n + req.sampling.max_tokens,
+                             prefix_pages=pins)
+            self._reserved_pages -= self._reserved_by.pop(rid, 0)
+            self._req_slot[rid] = slot
+            cached = len(pins) * PS       # prompt tokens served from cache
+            if cached == 0:
+                # table entries for the bucket: beyond-length -> scratch 0
+                bucket = self._bucket(n)
+                entries = np.zeros(bucket // PS, np.int32)
+                used = self.kv.pages_needed(n)
+                entries[:used] = self.kv.block_tables[slot, :used]
+            table_row = self.kv.block_tables[slot].copy()
 
         s = req.sampling
         seed = s.seed if s.seed is not None else (
@@ -225,12 +345,40 @@ class InferenceEngine:
         self._slot_keys[slot] = np.asarray(jax.random.key_data(slot_key))
         first_key = jax.random.fold_in(slot_key, n)
 
-        token, self.kv.k_pages, self.kv.v_pages = self._prefill_fn(bucket)(
-            self.params, jnp.asarray(tokens), jnp.asarray([n], jnp.int32),
-            self.kv.k_pages, self.kv.v_pages, jnp.asarray(entries),
-            first_key, jnp.float32(s.temperature),
-            jnp.int32(s.top_k), jnp.float32(s.top_p))
-        self.total_prefill_tokens += n
+        if cached == 0:
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, :n] = req.prompt_tokens
+            token, self.kv.k_pages, self.kv.v_pages = self._prefill_fn(bucket)(
+                self.params, jnp.asarray(tokens), jnp.asarray([n], jnp.int32),
+                self.kv.k_pages, self.kv.v_pages, jnp.asarray(entries),
+                first_key, jnp.float32(s.temperature),
+                jnp.int32(s.top_k), jnp.float32(s.top_p))
+            computed = n
+        else:
+            computed = n - cached
+            bucket = self._suffix_bucket(computed)
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, :computed] = req.prompt_tokens[cached:]
+            token, self.kv.k_pages, self.kv.v_pages = \
+                self._extend_prefill_fn(bucket)(
+                    self.params, jnp.asarray(tokens),
+                    jnp.asarray([cached], jnp.int32),
+                    jnp.asarray([computed], jnp.int32),
+                    self.kv.k_pages, self.kv.v_pages,
+                    jnp.asarray(table_row[None]), first_key,
+                    jnp.float32(s.temperature), jnp.int32(s.top_k),
+                    jnp.float32(s.top_p))
+            self.total_prefix_cached_tokens += cached
+
+        # publish this prompt's freshly-written full pages for future hits
+        if self.serve_cfg.prefix_caching and req.prefix_hashes:
+            with self.lock:
+                table = self.kv.block_tables[slot]
+                self.kv.register_pages(
+                    [(req.prefix_hashes[i], int(table[i]))
+                     for i in range(len(pins), n // PS)])
+
+        self.total_prefill_tokens += computed
         return req, token
 
     def _finish_prefill(self, req: Request, token) -> None:
@@ -244,6 +392,9 @@ class InferenceEngine:
         from .scheduler import RequestState
         req.state = RequestState.RUNNING
         self.last_tokens[slot] = int(token)
+        self._ctx[slot, :n] = req.prompt_tokens
+        self._ctx[slot, n] = int(token)
+        self._ctx_len[slot] = n + 1
         self.positions[slot] = n
         # first position this slot may NOT write: its page reservation
         # covers prompt + max_tokens, and multi-step decode masks writes
@@ -261,7 +412,8 @@ class InferenceEngine:
         return decode_multi_step(
             params, tokens, positions, k_pages, v_pages, tables, stops,
             slot_keys, temp, top_k, top_p, self.cfg,
-            num_steps=max(self.serve_cfg.decode_steps_per_dispatch, 1))
+            num_steps=max(self.serve_cfg.decode_steps_per_dispatch, 1),
+            attn_impl=self._attn_impl)
 
     def _decode_device(self) -> np.ndarray:
         """Dispatch K decode steps for every slot; lock-free device work.
@@ -281,6 +433,104 @@ class InferenceEngine:
         self.total_padded_slot_steps += out.shape[0] * int(
             self.serve_cfg.max_batch_size - self.active.sum())
         return out
+
+    # -- speculative decode --------------------------------------------------
+
+    def _spec_impl(self, params, k_pages, v_pages, tokens, positions,
+                   tables, stops, slot_keys, temp, top_k, top_p):
+        from .speculative import verify_and_decode
+        # verify (1 forward over the window) + K-1 plain decode steps: the
+        # same forward-pass count as multi-step decode, yielding n_accepted
+        # extra tokens. NOT free in practice: the verify window measures
+        # ~9 decode-steps of extra cost (BASELINE.md round 2), so low
+        # acceptance is a net loss — the adaptive check in step() falls
+        # back to plain decode when acceptance stays under
+        # speculative_min_acceptance.
+        return verify_and_decode(
+            params, tokens, positions, k_pages, v_pages, tables, stops,
+            slot_keys, temp, top_k, top_p, self.cfg,
+            num_decode_steps=max(
+                self.serve_cfg.decode_steps_per_dispatch - 1, 0),
+            attn_impl=self._attn_impl)
+
+    def _spec_device(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """One fused speculative dispatch: propose drafts on host (prompt-
+        lookup over each slot's prompt+generated context), then verify +
+        K-1 decode steps on device. Returns (emitted [B, T], n_emit [B],
+        decode_seq [K-1, B])."""
+        T = max(self.serve_cfg.speculative_tokens, 2)
+        B = self.serve_cfg.max_batch_size
+        tokens = np.zeros((B, T), np.int32)
+        tokens[:, 0] = self.last_tokens
+        # draftless rows repeat the last token — acceptance is self-
+        # verifying (draft == argmax), so a lucky repeat is correct greedy
+        # output, not an error
+        tokens[:, 1:] = self.last_tokens[:, None]
+        from .speculative import propose_ngram_draft
+        n_drafted = 0
+        for slot, req in enumerate(self.scheduler.slots):
+            if req is None or not self.active[slot] \
+                    or self.temperature[slot] > 0:
+                continue
+            # every greedy row verifies T-1 drafts (ngram or the repeat
+            # fallback) — counting only ngram rows would let fallback
+            # acceptances push spec_acceptance above 1.0
+            n_drafted += T - 1
+            # bounded lookback keeps proposal O(window), not O(context)
+            ctx = self._ctx[slot, max(self._ctx_len[slot] - 1024, 0):
+                            self._ctx_len[slot]]
+            draft = propose_ngram_draft(
+                ctx, T - 1, self.serve_cfg.speculative_ngram)
+            if draft is not None:
+                tokens[slot, 1:] = draft
+        emitted, n_emit, decode_seq, self.kv.k_pages, self.kv.v_pages = \
+            self._spec_jit(
+                self.params, self.kv.k_pages, self.kv.v_pages,
+                jnp.asarray(tokens), jnp.asarray(self.positions),
+                jnp.asarray(self.kv.block_tables),
+                jnp.asarray(self.stop_positions),
+                jnp.asarray(self._slot_keys), jnp.asarray(self.temperature),
+                jnp.asarray(self.top_k), jnp.asarray(self.top_p))
+        emitted, n_emit = np.asarray(emitted), np.asarray(n_emit)
+        decode_seq = np.asarray(decode_seq)
+        self.total_spec_dispatches += 1
+        self.total_spec_drafts += n_drafted
+        self.total_decode_steps += 1 + decode_seq.shape[0]
+        self.total_padded_slot_steps += (1 + decode_seq.shape[0]) * int(
+            B - self.active.sum())
+        return emitted, n_emit, decode_seq
+
+    def _apply_speculative(self, emitted: np.ndarray, n_emit: np.ndarray,
+                           decode_seq: np.ndarray) -> None:
+        """Host bookkeeping for one fused dispatch (under self.lock):
+        n_emit verified tokens, then the trailing decode-scan rows.
+        Positions advance in lockstep with what is recorded so slot length
+        always matches the KV state."""
+        for slot, req in enumerate(self.scheduler.slots):
+            if req is None or not self.active[slot]:
+                continue
+            stream = [int(emitted[slot, k])
+                      for k in range(int(n_emit[slot]))]
+            stream += [int(t) for t in decode_seq[:, slot]]
+            accepted = []
+            for tok in stream:
+                self.positions[slot] += 1
+                req.record_token(tok)
+                accepted.append(tok)
+                self.last_tokens[slot] = tok
+                if (req.cancel_requested
+                        or req.should_stop(self.eos_token_id) is not None):
+                    break
+            end = self._ctx_len[slot] + len(accepted)
+            self._ctx[slot, self._ctx_len[slot]:end] = accepted
+            self._ctx_len[slot] = end
+            if self.temperature[slot] <= 0:
+                # device-side acceptance (n_emit - 1 drafts verified), not
+                # recorded count: a stop condition can truncate recording
+                # after the device already verified the draft
+                self.total_spec_accepted += max(int(n_emit[slot]) - 1, 0)
+            if accepted and self.on_token is not None:
+                self.on_token(req, accepted)
 
     def _apply_decode(self, sampled_seq: np.ndarray) -> None:
         """Host bookkeeping for K decode steps (called under self.lock).
@@ -302,6 +552,9 @@ class InferenceEngine:
                 if (req.cancel_requested
                         or req.should_stop(self.eos_token_id) is not None):
                     break
+            end = self._ctx_len[slot] + len(accepted)
+            self._ctx[slot, self._ctx_len[slot]:end] = accepted
+            self._ctx_len[slot] = end
             if accepted and self.on_token is not None:
                 self.on_token(req, accepted)
 
@@ -311,6 +564,9 @@ class InferenceEngine:
         # admitted-but-never-prefilled (cancel/failure before _prefill):
         # return the admission reservation so capacity can't leak
         self._reserved_pages -= self._reserved_by.pop(req.request_id, 0)
+        pins = self._prefix_pins.pop(req.request_id, None)
+        if pins:
+            self.kv.unpin_pages(pins)
         slot = self._req_slot.pop(req.request_id, None)
         if slot is not None:
             self.kv.release(slot)
@@ -346,10 +602,35 @@ class InferenceEngine:
                 # prompt-is-whole-request edge: finished on the first token
                 self.scheduler.step_finished(self.eos_token_id)
         if any(self.active):
-            sampled = self._decode_device()
-            with self.lock:
-                self._apply_decode(sampled)
-                self.scheduler.step_finished(self.eos_token_id)
+            # speculative path only when a greedy stream is resident: for
+            # sampled rows a verify dispatch yields 1 token vs K from
+            # multi-step decode, so an all-sampled batch stays on decode.
+            # Adaptive kill switch: once 64 dispatches have measured a
+            # draft-acceptance rate under the configured floor, speculation
+            # is a pure loss (the verify window isn't free) — fall back to
+            # plain multi-step decode permanently.
+            if (self._spec_jit is not None and self.total_spec_dispatches >= 64
+                    and self.total_spec_accepted
+                    < self.serve_cfg.speculative_min_acceptance
+                    * self.total_spec_drafts):
+                logger.warning(
+                    "speculative decode disabled: acceptance %.3f < %.3f "
+                    "after %d dispatches",
+                    self.total_spec_accepted / max(self.total_spec_drafts, 1),
+                    self.serve_cfg.speculative_min_acceptance,
+                    self.total_spec_dispatches)
+                self._spec_jit = None
+            if (self._spec_jit is not None
+                    and bool((self.temperature[self.active] <= 0).any())):
+                emitted, n_emit, decode_seq = self._spec_device()
+                with self.lock:
+                    self._apply_speculative(emitted, n_emit, decode_seq)
+                    self.scheduler.step_finished(self.eos_token_id)
+            else:
+                sampled = self._decode_device()
+                with self.lock:
+                    self._apply_decode(sampled)
+                    self.scheduler.step_finished(self.eos_token_id)
         with self.lock:
             return self.scheduler.active_count
 
@@ -374,10 +655,17 @@ class InferenceEngine:
         by fail_all, so no live KV is lost) and run a tiny device op to
         check the backend is usable again. Returns True when healthy."""
         try:
+            reallocated = False
             for name in ("k_pages", "v_pages"):
                 buf = getattr(self.kv, name)
                 if buf.is_deleted():
-                    setattr(self.kv, name, jnp.zeros(buf.shape, buf.dtype))
+                    setattr(self.kv, name,
+                            self.kv._new_pages(buf.shape, buf.dtype))
+                    reallocated = True
+            if reallocated:
+                # zeroed buffers invalidate every cached prefix page — a
+                # future hash hit would attend over all-zero K/V
+                self.kv.flush_prefix_cache()
             probe = jnp.zeros((8,), jnp.float32) + 1.0
             return bool(np.asarray(probe).sum() == 8.0)
         except Exception:
@@ -413,8 +701,14 @@ class InferenceEngine:
             "kv": self.kv.stats(),
             "decode_steps": self.total_decode_steps,
             "prefill_tokens": self.total_prefill_tokens,
+            "prefix_cached_tokens": self.total_prefix_cached_tokens,
             "padded_slot_steps": self.total_padded_slot_steps,
             "decode_slot_utilization": round(
                 1.0 - self.total_padded_slot_steps
                 / (steps * self.serve_cfg.max_batch_size), 4),
+            "spec_dispatches": self.total_spec_dispatches,
+            "spec_drafts": self.total_spec_drafts,
+            "spec_accepted": self.total_spec_accepted,
+            "spec_acceptance": round(
+                self.total_spec_accepted / max(self.total_spec_drafts, 1), 4),
         }

@@ -15,13 +15,34 @@ vLLM-style paging mapped onto XLA's static-shape world:
 
 from __future__ import annotations
 
+import hashlib
 import math
+from collections import OrderedDict
 from typing import Optional
 
 import jax.numpy as jnp
 import numpy as np
 
 from ..config.schema import ModelConfig
+
+
+def prefix_page_hashes(tokens, page_size: int) -> list[bytes]:
+    """Chain hashes for every FULL page of a token prefix.
+
+    ``h_i`` digests tokens[0 : (i+1)*page_size] (via the chain), because a
+    page's K/V content depends on the *entire* prefix through attention —
+    two prompts may share page i only if they agree on every token through
+    its end. Only full pages are shareable: a partially-filled page keeps
+    receiving decode writes and stays private to its slot.
+    """
+    arr = np.asarray(tokens, np.int32)
+    out, h = [], b""
+    for i in range(len(arr) // page_size):
+        h = hashlib.blake2b(
+            h + arr[i * page_size:(i + 1) * page_size].tobytes(),
+            digest_size=16).digest()
+        out.append(h)
+    return out
 
 
 class PagedKVCache:
@@ -34,6 +55,8 @@ class PagedKVCache:
         num_pages: int = 0,
         hbm_budget_gb: float = 4.0,
         dtype=jnp.bfloat16,
+        page_sharding=None,     # NamedSharding over the kv-head axis for
+                                # tensor-parallel serving (None = one device)
     ):
         self.cfg = cfg
         self.num_slots = num_slots
@@ -55,8 +78,9 @@ class PagedKVCache:
         # (TPU block shapes must end in the tiled dims)
         shape = (cfg.num_layers, num_pages, cfg.num_kv_heads, page_size,
                  cfg.head_dim)
-        self.k_pages = jnp.zeros(shape, dtype)
-        self.v_pages = jnp.zeros(shape, dtype)
+        self.page_sharding = page_sharding
+        self.k_pages = self._new_pages(shape, dtype)
+        self.v_pages = self._new_pages(shape, dtype)
 
         # host-side state; page 0 is scratch and never allocated
         self._free: list[int] = list(range(1, num_pages))
@@ -64,11 +88,28 @@ class PagedKVCache:
         self.block_tables = np.zeros((num_slots, self.max_pages_per_slot),
                                      np.int32)
 
+        # prefix cache: refcounted shared pages + LRU of evictable ones.
+        # A page is in exactly one of: _free, referenced (_ref > 0), or
+        # _evictable (ref == 0 but content cached for future hits).
+        self._ref = np.zeros(num_pages, np.int32)
+        self._hash_to_page: dict[bytes, int] = {}
+        self._page_to_hash: dict[int, bytes] = {}
+        self._evictable: OrderedDict[int, None] = OrderedDict()
+        self.prefix_hits = 0          # pages served from cache
+        self.prefix_queries = 0       # full pages looked up
+
+    def _new_pages(self, shape, dtype):
+        """Allocate a (possibly tensor-parallel-sharded) page buffer."""
+        import jax
+        if self.page_sharding is not None:
+            return jax.device_put(jnp.zeros(shape, dtype), self.page_sharding)
+        return jnp.zeros(shape, dtype)
+
     # -- accounting ----------------------------------------------------------
 
     @property
     def free_pages(self) -> int:
-        return len(self._free)
+        return len(self._free) + len(self._evictable)
 
     def pages_needed(self, num_tokens: int) -> int:
         return math.ceil(max(num_tokens, 1) / self.page_size)
@@ -86,21 +127,101 @@ class PagedKVCache:
 
     # -- alloc / grow / free -------------------------------------------------
 
-    def allocate(self, slot: int, num_tokens: int) -> None:
-        """Give ``slot`` enough pages for ``num_tokens`` tokens."""
+    def _take_free_page(self) -> int:
+        """Pop a free page, evicting the LRU cached page if needed."""
+        if self._free:
+            return self._free.pop()
+        if self._evictable:
+            page, _ = self._evictable.popitem(last=False)   # oldest first
+            h = self._page_to_hash.pop(page, None)
+            if h is not None:
+                self._hash_to_page.pop(h, None)
+            return page
+        raise RuntimeError("KV cache OOM: no free or evictable pages")
+
+    def _drop_ref(self, page: int) -> None:
+        self._ref[page] -= 1
+        if self._ref[page] <= 0:
+            self._ref[page] = 0
+            if page in self._page_to_hash:
+                self._evictable[page] = None    # keep content, reclaimable
+            else:
+                self._free.append(page)
+
+    def allocate(self, slot: int, num_tokens: int,
+                 prefix_pages: Optional[list[int]] = None) -> None:
+        """Give ``slot`` enough pages for ``num_tokens`` tokens.
+
+        ``prefix_pages`` (already pinned via ``pin_pages``) become the head
+        of the slot's block table; only the remainder is freshly allocated.
+        """
+        prefix_pages = prefix_pages or []
         need = self.pages_needed(num_tokens)
-        if need > self.free_pages:
+        fresh = need - len(prefix_pages)
+        if fresh > self.free_pages:
             raise RuntimeError(
-                f"KV cache OOM: need {need} pages, {self.free_pages} free")
-        pages = [self._free.pop() for _ in range(need)]
+                f"KV cache OOM: need {fresh} pages, {self.free_pages} free")
+        pages = [self._take_free_page() for _ in range(fresh)]
+        for p in pages:
+            self._ref[p] = 1
+        # slot owns refs on fresh pages only; prefix pins are tracked by
+        # the engine per request and dropped via unpin_pages on release
         self._owned[slot] = pages
+        table = list(prefix_pages) + pages
         self.block_tables[slot, :] = 0
-        self.block_tables[slot, :need] = pages
+        self.block_tables[slot, :len(table)] = table
 
     def release(self, slot: int) -> None:
         for page in self._owned.pop(slot, []):
-            self._free.append(page)
+            self._drop_ref(page)
         self.block_tables[slot, :] = 0
+
+    # -- prefix cache --------------------------------------------------------
+
+    def lookup_prefix(self, hashes: list[bytes]) -> list[int]:
+        """Longest cached page chain for these full-page hashes (NOT pinned;
+        call ``pin_pages`` under the same lock before releasing it). Pure
+        lookup — hit/query stats are counted by the caller once per
+        admission, so a head-of-line request retried every step doesn't
+        skew the rate."""
+        pages = []
+        for h in hashes:
+            page = self._hash_to_page.get(h)
+            if page is None:
+                break
+            pages.append(page)
+        return pages
+
+    def pin_pages(self, pages: list[int]) -> None:
+        for p in pages:
+            if self._ref[p] == 0:
+                self._evictable.pop(p, None)
+            self._ref[p] += 1
+
+    def unpin_pages(self, pages: list[int]) -> None:
+        for p in pages:
+            self._drop_ref(p)
+
+    def flush_prefix_cache(self) -> None:
+        """Drop every hash->page mapping and free the evictable pages.
+
+        Required whenever the page BUFFERS are reallocated (engine
+        recovery): the mappings would otherwise serve zeroed K/V to future
+        prefix hits — silently wrong output, no error."""
+        self._hash_to_page.clear()
+        self._page_to_hash.clear()
+        while self._evictable:
+            page, _ = self._evictable.popitem(last=False)
+            self._free.append(page)
+
+    def register_pages(self, pairs: list[tuple[bytes, int]]) -> None:
+        """Publish (hash, page) pairs into the prefix cache. First writer
+        wins: a hash that is already mapped keeps its existing page (the
+        new page stays private to its slot)."""
+        for h, page in pairs:
+            if h not in self._hash_to_page and page not in self._page_to_hash:
+                self._hash_to_page[h] = page
+                self._page_to_hash[page] = h
 
     def stats(self) -> dict:
         return {
@@ -109,4 +230,9 @@ class PagedKVCache:
             "page_size": self.page_size,
             "hbm_bytes": self.hbm_bytes(),
             "slots_resident": len(self._owned),
+            "prefix_cached_pages": len(self._hash_to_page),
+            "prefix_hits": self.prefix_hits,
+            "prefix_queries": self.prefix_queries,
+            "prefix_hit_rate": round(
+                self.prefix_hits / max(self.prefix_queries, 1), 4),
         }

@@ -51,6 +51,10 @@ class Request:
     # set while PREFILLING (when the slot can't be torn down mid-flight);
     # the engine releases the slot at the next step boundary
     cancel_requested: bool = False
+    # full-page chain hashes of the prompt, computed once at first admission
+    # attempt (engine._try_reserve) — lives on the request so a queued
+    # request retried every step doesn't rehash its prompt under the lock
+    prefix_hashes: Optional[list] = field(default=None, repr=False)
     arrival_time: float = field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None   # for TTFT
     finish_time: Optional[float] = None

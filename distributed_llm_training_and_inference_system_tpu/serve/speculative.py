@@ -1,0 +1,168 @@
+"""Speculative decoding: host n-gram drafts, device verification.
+
+The reference generates strictly one token per forward pass per request
+(reference serve/server.py:199-249). Decode on TPU is HBM-bandwidth-bound on
+*weights* — streaming the params through the MXU for 1 token costs nearly
+the same as for 8 — so scoring a window of draft tokens in one pass makes
+accepted tokens almost free (vLLM/Medusa-style speculation, TPU-shaped:
+static window T, no dynamic shapes).
+
+Draft source is **prompt-lookup (n-gram)**: the most recent earlier
+occurrence of the context's trailing n-gram proposes the following tokens.
+No draft model, no extra weights; it shines on grounded/extractive
+workloads (summarisation, code edit, RAG) where the output re-uses prompt
+spans.
+
+Correctness does not depend on draft quality: a draft token j is accepted
+iff it equals the argmax of the verified logits at its position, so for
+greedy requests the emitted stream is bit-identical to plain greedy decode
+(tested in tests/test_speculative.py). Sampled (temperature > 0) requests
+in the same batch fall back to one verified token per dispatch — the
+engine only routes to the speculative path when a greedy request is
+resident. Rejected drafts leave stale KV beyond the accepted position;
+that is invisible (reads are length-masked) and overwritten as the slot
+advances.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config.schema import ModelConfig
+from .decode import extend_step_forward
+from .sampling import sample_tokens
+
+
+def propose_ngram_draft(
+    context: np.ndarray,     # 1-D int array: prompt + generated so far
+    num_draft: int,
+    max_ngram: int = 3,
+) -> Optional[np.ndarray]:
+    """Prompt-lookup proposal: find the most recent *earlier* occurrence of
+    the context's trailing n-gram (longest n first) and return the
+    ``num_draft`` tokens that followed it. None when nothing matches."""
+    L = len(context)
+    if L < 2 or num_draft < 1:
+        return None
+    for n in range(min(max_ngram, L - 1), 0, -1):
+        tail = context[L - n:]
+        # windows[i] == context[i : i+n]; search the latest i < L - n
+        windows = np.lib.stride_tricks.sliding_window_view(context, n)
+        hits = np.flatnonzero((windows[: L - n] == tail).all(axis=1))
+        if hits.size == 0:
+            continue
+        start = int(hits[-1]) + n          # first token after the match
+        draft = context[start:start + num_draft]
+        if draft.size == 0:
+            continue
+        if draft.size < num_draft:         # pad by repeating the last token
+            draft = np.concatenate(
+                [draft, np.full(num_draft - draft.size, draft[-1],
+                                draft.dtype)])
+        return draft.astype(np.int32)
+    return None
+
+
+def speculative_verify(
+    params: Any,
+    tokens: jax.Array,          # [B, T]: [last_token, draft_1..draft_{T-1}]
+    positions: jax.Array,       # [B] position of tokens[:, 0]
+    k_pages: jax.Array,         # [L, NP, Nkv, PS, D] (donated)
+    v_pages: jax.Array,
+    block_tables: jax.Array,    # [B, maxP]
+    stop_positions: jax.Array,  # [B] first un-writable position
+    slot_keys: jax.Array,       # [B, 2] uint32 key data
+    temperature: jax.Array,     # [B]; <= 0 marks the greedy (verifiable) rows
+    top_k: jax.Array,
+    top_p: jax.Array,
+    cfg: ModelConfig,
+    attn_impl: str = "auto",
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """One verification pass. Returns (emitted [B, T], n_emit [B], kp, vp).
+
+    Row semantics:
+    - greedy row: emitted[:n_emit] = argmax chain; n_emit = accepted + 1
+      (the bonus token from the first unverified position).
+    - sampled row: emitted[0] is sampled from the logits of tokens[:, 0]
+      exactly like one plain decode step (same key fold); n_emit = 1.
+
+    The host must advance positions by the number of tokens it actually
+    records so the slot's length matches the KV the device wrote.
+    """
+    B, T = tokens.shape
+    offs = jnp.arange(T, dtype=jnp.int32)
+    write_ok = (positions[:, None] + offs) < stop_positions[:, None]
+    logits, k_pages, v_pages = extend_step_forward(
+        params, tokens, positions, k_pages, v_pages, block_tables, cfg,
+        write_ok=write_ok, attn_impl=attn_impl)
+
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)     # [B, T]
+    is_greedy = temperature <= 0.0
+    match = (tokens[:, 1:] == greedy[:, :-1]) & is_greedy[:, None]
+    accepted = jnp.cumprod(match.astype(jnp.int32), axis=1)    # [B, T-1]
+    n_acc = accepted.sum(axis=1)                               # [B]
+
+    keys = jax.vmap(jax.random.fold_in)(
+        jax.vmap(jax.random.wrap_key_data)(slot_keys), positions + 1)
+    sampled0 = sample_tokens(logits[:, 0], keys, temperature, top_k, top_p)
+
+    emitted = jnp.where(is_greedy[:, None], greedy,
+                        jnp.broadcast_to(sampled0[:, None], (B, T)))
+    n_emit = jnp.where(is_greedy, n_acc + 1, 1).astype(jnp.int32)
+    return emitted, n_emit, k_pages, v_pages
+
+
+def verify_and_decode(
+    params: Any,
+    tokens: jax.Array,          # [B, T] verify window (last token + drafts)
+    positions: jax.Array,       # [B]
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    block_tables: jax.Array,
+    stop_positions: jax.Array,
+    slot_keys: jax.Array,
+    temperature: jax.Array,
+    top_k: jax.Array,
+    top_p: jax.Array,
+    cfg: ModelConfig,
+    num_decode_steps: int,
+    attn_impl: str = "auto",
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Fused dispatch: one verification window + ``num_decode_steps`` plain
+    decode iterations, all on device.
+
+    Why fused: a verify-only dispatch yields avg ``acceptance*(T-1) + 1``
+    tokens per host round trip — on an RTT-bound link that LOSES to
+    multi-step decode's guaranteed K (measured 21 vs 94 tok/s at 8%
+    acceptance, BASELINE.md). Chaining R decode steps after the verify
+    makes every dispatch yield ``n_acc + 1 + R`` tokens for ``1 + R``
+    forward passes. The verify forward is NOT free, though: measured ~9
+    decode-steps of cost at gpt-1b (extend-path page scatter + per-query
+    prefix streaming, BASELINE.md round 2), so below roughly 50%
+    acceptance this still trails plain multi-step decode — the engine's
+    adaptive check (speculative_min_acceptance) exists for exactly that.
+
+    Returns (emitted [B, T], n_emit [B], decode_seq [R, B], k_pages,
+    v_pages). Host applies emitted[:n_emit] then decode_seq rows.
+    """
+    emitted, n_emit, k_pages, v_pages = speculative_verify(
+        params, tokens, positions, k_pages, v_pages, block_tables,
+        stop_positions, slot_keys, temperature, top_k, top_p, cfg,
+        attn_impl=attn_impl)
+    if num_decode_steps < 1:
+        B = tokens.shape[0]
+        return (emitted, n_emit,
+                jnp.zeros((0, B), jnp.int32), k_pages, v_pages)
+    # device-side carry past the verified window: per-row dynamic position
+    last = jnp.take_along_axis(emitted, (n_emit - 1)[:, None],
+                               axis=1)[:, 0]
+    from .decode import decode_scan
+    (_, _, k_pages, v_pages), decode_seq = decode_scan(
+        params, last, positions + n_emit, k_pages, v_pages, block_tables,
+        stop_positions, slot_keys, temperature, top_k, top_p, cfg,
+        num_decode_steps, attn_impl)
+    return emitted, n_emit, decode_seq, k_pages, v_pages

@@ -1,0 +1,95 @@
+"""Where does the gpt-750m b4 step go? fwd / fwd+bwd / +opt / flash blocks.
+
+Usage: python experiments/ablate_step.py [block_q block_k]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def timeit(fn, *args, n=6):
+    out = fn(*args)
+    import jax
+    jax.tree_util.tree_map(
+        lambda x: x.block_until_ready() if hasattr(x, "block_until_ready")
+        else x, out)
+    # fence via scalar fetch of one leaf
+    leaves = [x for x in jax.tree_util.tree_leaves(out) if hasattr(x, "sum")]
+    float(leaves[0].sum()) if leaves else None
+    best = 1e9
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fn(*args)
+        leaves = [x for x in jax.tree_util.tree_leaves(out)
+                  if hasattr(x, "sum")]
+        float(leaves[0].sum()) if leaves else None
+        best = min(best, (time.perf_counter() - t0) / n)
+    return best * 1e3
+
+
+def main() -> None:
+    mode = sys.argv[1] if len(sys.argv) > 1 else "step"
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_llm_training_and_inference_system_tpu.config import (
+        OptimizerConfig, ParallelConfig, get_model_config)
+    from distributed_llm_training_and_inference_system_tpu.exec import (
+        TrainState, make_train_step)
+    from distributed_llm_training_and_inference_system_tpu.exec.train_step import (
+        _loss_fn)
+    from distributed_llm_training_and_inference_system_tpu.models import init
+
+    cfg = get_model_config("gpt-750m")
+    batch, seq = 4, 2048
+    params = init(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 1,
+                                cfg.vocab_size)
+    b = {"tokens": tokens}
+    loss = functools.partial(_loss_fn, model_cfg=cfg, attn_impl="flash",
+                             remat="selective_attn", loss_chunk=512)
+
+    if mode == "fwd":
+        fwd = jax.jit(lambda p, bb: loss(p, bb)[0])
+        print(json.dumps({"mode": mode, "ms": round(timeit(fwd, params, b), 1)}))
+    elif mode == "grad":
+        # return a scalar so the grad pytree dies inside the program —
+        # holding two grad pytrees across timing calls OOMs the chip
+        def gradnorm(p, bb):
+            g = jax.value_and_grad(lambda q: loss(q, bb)[0])(p)[1]
+            return sum(jnp.vdot(x, x) for x in jax.tree_util.tree_leaves(g))
+        grad = jax.jit(gradnorm)
+        print(json.dumps({"mode": mode, "ms": round(timeit(grad, params, b), 1)}))
+    else:
+        step_fn, tx, _ = make_train_step(
+            cfg, OptimizerConfig(lr=1e-4),
+            ParallelConfig(activation_checkpoint="selective_attn",
+                           micro_batch_size=batch, global_batch_size=batch),
+            attn_impl="flash")
+        state = TrainState.create(params, tx)
+        jstep = jax.jit(step_fn, donate_argnums=(0,))
+        out = jstep(state, b)
+        float(out[1]["loss"])
+        best = 1e9
+        for _ in range(3):
+            t0 = time.perf_counter()
+            s = state
+            for _ in range(4):
+                s, m = jstep(s, b)
+            float(m["loss"])
+            best = min(best, (time.perf_counter() - t0) / 4)
+            state = s
+        print(json.dumps({"mode": mode, "ms": round(best * 1e3, 1)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,73 @@
+"""One-config MFU probe for the remat-policy x batch sweep (round 2).
+
+Run as a subprocess per config so an OOM kills only the probe:
+    python experiments/mfu_sweep.py <batch> <remat> [model]
+Prints one JSON line mirroring bench.py's statistic (min of 3 windows x 4
+steps after a compile+fence warmup). Results recorded in BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    batch = int(sys.argv[1])
+    remat = sys.argv[2]
+    model_name = sys.argv[3] if len(sys.argv) > 3 else "gpt-750m"
+    moment_dtype = sys.argv[4] if len(sys.argv) > 4 else "float32"
+    loss_chunk = int(sys.argv[5]) if len(sys.argv) > 5 else 512
+
+    import jax
+
+    from distributed_llm_training_and_inference_system_tpu.config import (
+        OptimizerConfig, ParallelConfig, get_model_config)
+    from distributed_llm_training_and_inference_system_tpu.exec import (
+        TrainState, make_train_step)
+    from distributed_llm_training_and_inference_system_tpu.models import init
+    from distributed_llm_training_and_inference_system_tpu.models.gpt import (
+        flops_per_token)
+
+    seq_len = 2048
+    peak_tflops = 197.0
+    cfg = get_model_config(model_name)
+    par = ParallelConfig(activation_checkpoint=remat,
+                         micro_batch_size=batch, global_batch_size=batch)
+    step_fn, tx, _ = make_train_step(
+        cfg, OptimizerConfig(lr=1e-4, moment_dtype=moment_dtype), par,
+        attn_impl="flash", loss_chunk=loss_chunk)
+    params = init(cfg, jax.random.PRNGKey(0))
+    state = TrainState.create(params, tx)
+    jstep = jax.jit(step_fn, donate_argnums=(0,))
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq_len), 1,
+                                cfg.vocab_size)
+    b = {"tokens": tokens}
+    state, m = jstep(state, b)
+    float(m["loss"])
+
+    windows = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(4):
+            state, m = jstep(state, b)
+        float(m["loss"])
+        windows.append((time.perf_counter() - t0) / 4)
+
+    dt = min(windows)
+    tokens_per_sec = batch * seq_len / dt
+    mfu = tokens_per_sec * flops_per_token(cfg, seq_len) / (peak_tflops * 1e12)
+    print(json.dumps({"model": model_name, "batch": batch, "remat": remat,
+                      "moment_dtype": moment_dtype, "loss_chunk": loss_chunk,
+                      "step_ms": round(dt * 1e3, 2),
+                      "tok_s": round(tokens_per_sec, 1),
+                      "mfu": round(mfu, 4)}))
+
+
+if __name__ == "__main__":
+    main()
