@@ -77,6 +77,41 @@ def paged_attention(
     return out.reshape(B, Nq, D).astype(q.dtype)
 
 
+def paged_attention_multi(
+    q: jax.Array,              # [B, T, Nq, D] — T consecutive tokens/slot
+    k_pages: jax.Array,        # [NP, Nkv, PS, D]
+    v_pages: jax.Array,
+    block_tables: jax.Array,   # [B, maxP]
+    start_positions: jax.Array,  # [B] int32 — position of q[:, 0]
+    impl: str = "auto",
+) -> jax.Array:
+    """Multi-query paged attention: query j of slot b attends causally over
+    [0, start_b + j] through the pages (the window's own K/V must already
+    be written). Returns [B, T, Nq, D].
+
+    On TPU this runs the dedicated Pallas kernel (each page DMA'd once per
+    slot/kv-head for ALL T queries); the fallback flattens to [B*T] rows of
+    the single-token path — correct everywhere, but it re-streams the
+    prefix T times (measured ~9 decode-steps of overhead for a T=8 verify
+    window at gpt-1b, BASELINE.md round 2 — the motivation for the
+    kernel).
+    """
+    B, T, Nq, D = q.shape
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "gather"
+    if impl == "pallas":
+        from .paged_attention_pallas import paged_attention_pallas_multi
+        return paged_attention_pallas_multi(
+            q, k_pages, v_pages, block_tables, start_positions,
+            interpret=jax.default_backend() != "tpu")
+    flat_pos = (start_positions[:, None]
+                + jnp.arange(T, dtype=jnp.int32)).reshape(B * T)
+    out = paged_attention(
+        q.reshape(B * T, Nq, D), k_pages, v_pages,
+        jnp.repeat(block_tables, T, axis=0), flat_pos + 1, impl="gather")
+    return out.reshape(B, T, Nq, D)
+
+
 def write_token_to_pages(
     pages: jax.Array,        # [NP, Nkv, PS, D]
     new_kv: jax.Array,       # [B, Nkv, D] — this step's K or V

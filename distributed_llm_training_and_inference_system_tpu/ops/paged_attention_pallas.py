@@ -38,12 +38,19 @@ from jax.experimental.pallas import tpu as pltpu
 from ..models.layers import NEG_INF
 
 
-def _decode_kernel(tables_ref, used_ref,          # scalar prefetch
-                   q_ref,                          # [G, D] VMEM
+def _extend_kernel(tables_ref, starts_ref,        # scalar prefetch
+                   q_ref,                          # [T*G, D] VMEM
                    k_ref, v_ref,                   # [PS, D] VMEM (one page)
-                   o_ref,                          # [G, D] VMEM out
+                   o_ref,                          # [T*G, D] VMEM out
                    acc_ref, m_ref, l_ref,          # VMEM scratch
-                   *, page_size: int, scale: float):
+                   *, page_size: int, scale: float, groups: int,
+                   window: int):
+    """Multi-query variant: ``window`` consecutive query tokens per slot
+    (speculative verify / cached-prefix suffix prefill). Each page is
+    DMA'd ONCE per (slot, kv head) and scored against all T queries —
+    the flattened-row fallback re-streams the prefix T times. Query row
+    j (= row // groups) sits at position start + j and attends causally
+    over [0, start + j]."""
     b = pl.program_id(0)
     p = pl.program_id(2)
 
@@ -53,23 +60,24 @@ def _decode_kernel(tables_ref, used_ref,          # scalar prefetch
         m_ref[...] = jnp.full_like(m_ref, NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    length = used_ref[b]                 # tokens live in this row's cache
+    start = starts_ref[b]
+    max_len = start + window             # last window token's length
 
-    @pl.when(p * page_size < length)
+    @pl.when(p * page_size < max_len)
     def _body():
-        q = q_ref[...].astype(jnp.float32)            # [G, D]
+        q = q_ref[...].astype(jnp.float32)            # [T*G, D]
         k = k_ref[...].astype(jnp.float32)            # [PS, D]
         v = v_ref[...].astype(jnp.float32)            # [PS, D]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale        # [G, PS]
+            preferred_element_type=jnp.float32) * scale      # [T*G, PS]
         pos = p * page_size + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1)
-        s = jnp.where(pos < length, s, NEG_INF)
+        row_j = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // groups
+        s = jnp.where(pos <= start + row_j, s, NEG_INF)  # causal per query
 
-        m_prev = m_ref[...]                            # [G, 1]
+        m_prev = m_ref[...]                            # [T*G, 1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        # fully-masked guard: exp(NEG_INF - NEG_INF) would be 1
         p_ = jnp.exp(jnp.where(m_new > NEG_INF / 2, s - m_new, NEG_INF))
         alpha = jnp.exp(jnp.where(m_new > NEG_INF / 2, m_prev - m_new, 0.0))
         l_ref[...] = alpha * l_ref[...] + jnp.sum(p_, axis=1, keepdims=True)
@@ -85,6 +93,65 @@ def _decode_kernel(tables_ref, used_ref,          # scalar prefetch
             o_ref.dtype)
 
 
+def paged_attention_pallas_multi(
+    q: jax.Array,              # [B, T, Nq, D] — T consecutive tokens/slot
+    k_pages: jax.Array,        # [NP, Nkv, PS, D]
+    v_pages: jax.Array,
+    block_tables: jax.Array,   # [B, maxP] int32
+    start_positions: jax.Array,  # [B] int32 — position of q[:, 0]
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Returns [B, T, Nq, D]; query j attends over [0, start+j] via pages
+    (the window's own K/V must already be written to the pages)."""
+    B, T, Nq, D = q.shape
+    NP, Nkv, PS, _ = k_pages.shape
+    maxP = block_tables.shape[1]
+    groups = Nq // Nkv
+    scale = 1.0 / float(D) ** 0.5
+
+    # [B, Nkv, T*G, D]: T outer, groups inner, so row // groups == j
+    qg = q.reshape(B, T, Nkv, groups, D).transpose(0, 2, 1, 3, 4).reshape(
+        B, Nkv, T * groups, D)
+    starts = start_positions.astype(jnp.int32)
+    lengths = starts + T
+    last_used = jnp.maximum((lengths + PS - 1) // PS - 1, 0)
+    clamped_p = jnp.minimum(
+        jnp.arange(maxP, dtype=jnp.int32)[None, :], last_used[:, None])
+    tables_clamped = jnp.take_along_axis(
+        block_tables.astype(jnp.int32), clamped_p, axis=1)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,       # tables_clamped, starts
+        grid=(B, Nkv, maxP),
+        in_specs=[
+            pl.BlockSpec((None, None, T * groups, D),
+                         lambda b, h, p, t, u: (b, h, 0, 0)),   # q
+            pl.BlockSpec((None, None, PS, D),
+                         lambda b, h, p, t, u: (t[b, p], h, 0, 0)),  # k page
+            pl.BlockSpec((None, None, PS, D),
+                         lambda b, h, p, t, u: (t[b, p], h, 0, 0)),  # v page
+        ],
+        out_specs=pl.BlockSpec((None, None, T * groups, D),
+                               lambda b, h, p, t, u: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((T * groups, D), jnp.float32),
+            pltpu.VMEM((T * groups, 1), jnp.float32),
+            pltpu.VMEM((T * groups, 1), jnp.float32),
+        ],
+    )
+
+    out = pl.pallas_call(
+        functools.partial(_extend_kernel, page_size=PS, scale=scale,
+                          groups=groups, window=T),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Nkv, T * groups, D), q.dtype),
+        interpret=interpret,
+    )(tables_clamped, starts, qg, k_pages, v_pages)
+    return out.reshape(B, Nkv, T, groups, D).transpose(0, 2, 1, 3, 4).reshape(
+        B, T, Nq, D)
+
+
 def paged_attention_pallas(
     q: jax.Array,            # [B, Nq, D] — one query token per sequence
     k_pages: jax.Array,      # [NP, Nkv, PS, D]
@@ -94,51 +161,13 @@ def paged_attention_pallas(
     *,
     interpret: bool = False,
 ) -> jax.Array:
-    """Returns [B, Nq, D] in q.dtype; same contract as the gather baseline."""
-    B, Nq, D = q.shape
-    NP, Nkv, PS, _ = k_pages.shape
-    maxP = block_tables.shape[1]
-    groups = Nq // Nkv
-    scale = 1.0 / float(D) ** 0.5
+    """Returns [B, Nq, D] in q.dtype; same contract as the gather baseline.
 
-    qg = q.reshape(B, Nkv, groups, D)
-    lengths = lengths.astype(jnp.int32)
-    # pages_used - 1 per row, for the tail clamp (lengths >= 1 in decode:
-    # the current token is always live)
-    last_used = jnp.maximum((lengths + PS - 1) // PS - 1, 0)   # [B]
-
-    # Pre-clamp the table outside the kernel (cheap vector op) so the index
-    # map stays a pure lookup: past-the-end pages repeat the row's last live
-    # page, and consecutive identical block indices elide the DMA.
-    clamped_p = jnp.minimum(
-        jnp.arange(maxP, dtype=jnp.int32)[None, :], last_used[:, None])
-    tables_clamped = jnp.take_along_axis(
-        block_tables.astype(jnp.int32), clamped_p, axis=1)      # [B, maxP]
-
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,       # tables_clamped, lengths
-        grid=(B, Nkv, maxP),
-        in_specs=[
-            pl.BlockSpec((None, None, groups, D),
-                         lambda b, h, p, t, u: (b, h, 0, 0)),   # q
-            pl.BlockSpec((None, None, PS, D),
-                         lambda b, h, p, t, u: (t[b, p], h, 0, 0)),  # k page
-            pl.BlockSpec((None, None, PS, D),
-                         lambda b, h, p, t, u: (t[b, p], h, 0, 0)),  # v page
-        ],
-        out_specs=pl.BlockSpec((None, None, groups, D),
-                               lambda b, h, p, t, u: (b, h, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((groups, D), jnp.float32),
-            pltpu.VMEM((groups, 1), jnp.float32),
-            pltpu.VMEM((groups, 1), jnp.float32),
-        ],
-    )
-
-    out = pl.pallas_call(
-        functools.partial(_decode_kernel, page_size=PS, scale=scale),
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, Nkv, groups, D), q.dtype),
-        interpret=interpret,
-    )(tables_clamped, lengths, qg, k_pages, v_pages)
-    return out.reshape(B, Nq, D)
+    The T=1 case of ``paged_attention_pallas_multi`` (one kernel body, so
+    the decode and extend paths can never diverge numerically): start
+    position = lengths - 1, window = 1.
+    """
+    out = paged_attention_pallas_multi(
+        q[:, None], k_pages, v_pages, block_tables,
+        lengths.astype(jnp.int32) - 1, interpret=interpret)
+    return out[:, 0]

@@ -24,7 +24,10 @@ from ..models.layers import (
     rms_norm,
     rope_frequencies,
 )
-from ..ops.paged_attention import paged_attention, write_token_to_pages
+from ..ops.paged_attention import (
+    paged_attention_multi,
+    write_token_to_pages,
+)
 
 
 def decode_step_forward(
@@ -79,10 +82,10 @@ def extend_step_forward(
     so T<=8 tokens cost nearly the same as 1) and cached-prefix suffix
     prefill (only the un-cached tail of a prompt is computed).
 
-    The multi-query paged attention reuses the single-token kernel by
-    flattening [B, T] -> rows: row (b, j) carries length start_b + j + 1
-    with slot b's block table. Prefix pages are streamed once per query row
-    — redundant T-fold, acceptable for small T (drafts, suffix chunks).
+    Attention goes through ops.paged_attention_multi: on TPU a dedicated
+    Pallas kernel streams each page once per (slot, kv head) for ALL T
+    queries; elsewhere a flattened [B*T]-row fallback of the single-token
+    path (correct, but re-streams the prefix T-fold).
     """
     compute_dtype = jnp.dtype(cfg.dtype)
     B, T = tokens.shape
@@ -92,7 +95,6 @@ def extend_step_forward(
     flat_pos = positions.reshape(B * T)
     flat_tables = jnp.repeat(block_tables, T, axis=0)        # [B*T, maxP]
     flat_ok = None if write_ok is None else write_ok.reshape(B * T)
-    lengths = flat_pos + 1
 
     x = params["embed"]["embedding"][tokens].astype(compute_dtype)  # [B,T,H]
     inv_freq = rope_frequencies(cfg.head_dim, cfg.rope.base,
@@ -115,8 +117,8 @@ def extend_step_forward(
                                   flat_pos, flat_ok)
         vp = write_token_to_pages(vp, v.reshape(B * T, Nkv, D), flat_tables,
                                   flat_pos, flat_ok)
-        attn = paged_attention(q.reshape(B * T, Nq, D), kp, vp, flat_tables,
-                               lengths, impl=attn_impl)
+        attn = paged_attention_multi(q, kp, vp, block_tables,
+                                     start_positions, impl=attn_impl)
         attn = attn.reshape(B, T, Nq * D)
         x = x + (attn @ layer["o"]["kernel"]).astype(x.dtype)
 

@@ -242,3 +242,55 @@ def test_int8_awq_quantization_roundtrip():
     leaf = q_awq["blocks"]["q"]["kernel"]
     assert leaf["__quant__"] == "int8-awq" and "chan" in leaf
     assert leaf["chan"].shape[0] == cfg.num_layers
+
+
+def test_paged_attention_multi_pallas_matches_gather():
+    """The multi-query extend kernel (speculative verify / suffix prefill)
+    must match the flattened gather baseline: per-query causal masking
+    inside the window, window straddling a page boundary, GQA grouping,
+    and unaligned start positions."""
+    from distributed_llm_training_and_inference_system_tpu.ops.paged_attention import (
+        paged_attention_multi)
+
+    B, T, Nq, Nkv, D, PS, NP, maxP = 3, 5, 8, 4, 64, 16, 12, 4
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(ks[0], (B, T, Nq, D), jnp.float32)
+    k_pages = jax.random.normal(ks[1], (NP, Nkv, PS, D), jnp.float32)
+    v_pages = jax.random.normal(ks[2], (NP, Nkv, PS, D), jnp.float32)
+    bt = np.zeros((B, maxP), np.int32)
+    bt[0, :2] = [3, 7]          # window straddles page 0 -> 1 (start 13)
+    bt[1, :4] = [1, 2, 4, 5]    # deep prefix, unaligned start
+    bt[2, :1] = [9]             # window starts at position 0
+    bt = jnp.asarray(bt)
+    starts = jnp.asarray([13, 37, 0], jnp.int32)
+    ref = paged_attention_multi(q, k_pages, v_pages, bt, starts,
+                                impl="gather")
+    out = paged_attention_multi(q, k_pages, v_pages, bt, starts,
+                                impl="pallas")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_attention_multi_window_is_causal():
+    """Within the window, query j must NOT see tokens j+1..T-1: writing
+    garbage into the positions after query j's own must not change its
+    output."""
+    from distributed_llm_training_and_inference_system_tpu.ops.paged_attention import (
+        paged_attention_multi)
+
+    B, T, Nq, Nkv, D, PS, NP, maxP = 1, 4, 4, 4, 32, 8, 6, 3
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    q = jax.random.normal(ks[0], (B, T, Nq, D), jnp.float32)
+    k_pages = jax.random.normal(ks[1], (NP, Nkv, PS, D), jnp.float32)
+    v_pages = jax.random.normal(ks[2], (NP, Nkv, PS, D), jnp.float32)
+    bt = jnp.asarray([[1, 2, 0]], jnp.int32)
+    start = jnp.asarray([5], jnp.int32)
+    out1 = paged_attention_multi(q, k_pages, v_pages, bt, start,
+                                 impl="pallas")
+    # clobber the last window position (start+T-1 = 8 -> page 2 offset 0)
+    k2 = k_pages.at[2, :, 0, :].set(1e4)
+    v2 = v_pages.at[2, :, 0, :].set(-1e4)
+    out2 = paged_attention_multi(q, k2, v2, bt, start, impl="pallas")
+    np.testing.assert_allclose(np.asarray(out1[:, :3]),
+                               np.asarray(out2[:, :3]), rtol=1e-5, atol=1e-5)
+    assert not np.allclose(np.asarray(out1[:, 3]), np.asarray(out2[:, 3]))
