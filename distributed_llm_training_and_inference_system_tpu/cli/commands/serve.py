@@ -49,9 +49,13 @@ def app(ctx):
 @click.option("--tensor-parallel", default=1, show_default=True, type=int,
               help="Shard the model over this many local devices "
                    "(Megatron TP; needs num_kv_heads % tp == 0).")
+@click.option("--quantization", default="none", show_default=True,
+              type=click.Choice(["none", "int8"]),
+              help="Weight-only int8 (W8A16): ~2x model HBM freed for KV.")
 def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
           kv_block_size, kv_hbm_gb, scheduler, dtype, prometheus_port,
-          speculative, spec_tokens, prefix_cache, tensor_parallel):
+          speculative, spec_tokens, prefix_cache, tensor_parallel,
+          quantization):
     """Start the OpenAI-compatible inference server."""
     import jax
 
@@ -70,7 +74,7 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
         kv_block_size=kv_block_size, kv_hbm_budget_gb=kv_hbm_gb,
         scheduler=scheduler, dtype=dtype, speculative=speculative,
         speculative_tokens=spec_tokens, prefix_caching=prefix_cache,
-        tensor_parallel=tensor_parallel)
+        tensor_parallel=tensor_parallel, quantization=quantization)
     serve_cfg.validate()
 
     observer = None

@@ -516,10 +516,22 @@ class ServeConfig:
     # kv-head axis, GSPMD inserts the per-layer collectives. Requires
     # num_kv_heads % tensor_parallel == 0 and that many local devices.
     tensor_parallel: int = 1
+    # weight-only int8 serving (W8A16): block kernels are stored int8 in
+    # HBM (~2x model memory freed for KV pages / bigger models) and
+    # dequantized one layer at a time inside the forward scan. Embeddings
+    # and lm_head stay bf16 (quantizing the tied unembed costs the most
+    # output quality for the least memory).
+    quantization: str = "none"      # none | int8
 
     def validate(self) -> None:
         if self.tensor_parallel < 1:
             raise ConfigError("tensor_parallel must be >= 1")
+        if self.quantization not in ("none", "int8"):
+            raise ConfigError("quantization must be none|int8")
+        if self.quantization != "none" and self.tensor_parallel > 1:
+            raise ConfigError(
+                "int8 serving + tensor_parallel is not supported yet "
+                "(PARAM_RULES shard plain kernels, not QuantTensor leaves)")
         # the engine checks `speculative == "ngram"`, so a config-file typo
         # ("n-gram", "medusa") would otherwise silently disable speculation
         if self.speculative not in ("off", "ngram"):

@@ -214,17 +214,22 @@ def forward(
     block = functools.partial(_block_fn, cfg, attn_impl, norm_impl)
     block = _remat_wrap(block, remat)
 
+    # the per-layer cast happens INSIDE the scan body (models.gpt._cast):
+    # with int8-quantized serving weights only one layer's bf16
+    # dequantization is ever materialised — the whole-tree int8 storage
+    # saving survives the forward
+    from ..ops.quantization import cast_params as _cast
+
     if kv_cache is None:
         def body(carry, layer):
             x, aux = carry
-            x, _, aux_l = block(x.astype(compute_dtype), layer, positions,
+            x, _, aux_l = block(x.astype(compute_dtype),
+                                _cast(layer, compute_dtype), positions,
                                 segment_ids, inv_freq)
             return (x, aux + aux_l), None
 
         (x, aux_total), _ = jax.lax.scan(
-            body, (x, jnp.float32(0.0)),
-            jax.tree_util.tree_map(lambda p: p.astype(compute_dtype),
-                                   params["blocks"]))
+            body, (x, jnp.float32(0.0)), params["blocks"])
         new_cache = None
     else:
         k_cache, v_cache = kv_cache
@@ -232,15 +237,15 @@ def forward(
         def body(carry, layer_and_cache):
             x, aux = carry
             layer, kc, vc = layer_and_cache
-            x, new_kv, aux_l = block(x.astype(compute_dtype), layer, positions,
+            x, new_kv, aux_l = block(x.astype(compute_dtype),
+                                     _cast(layer, compute_dtype), positions,
                                      segment_ids, inv_freq,
                                      kv_cache=(kc, vc), cache_offset=cache_offset)
             return (x, aux + aux_l), new_kv
 
         (x, aux_total), new_kvs = jax.lax.scan(
             body, (x, jnp.float32(0.0)),
-            (jax.tree_util.tree_map(lambda p: p.astype(compute_dtype),
-                                    params["blocks"]), k_cache, v_cache))
+            (params["blocks"], k_cache, v_cache))
         new_cache = new_kvs
 
     if unembed_positions is not None:

@@ -66,14 +66,83 @@ def dequantize_int4_blockwise(packed: jax.Array, scale: jax.Array,
     return out.reshape(*q.shape[:-1], n).astype(dtype)
 
 
-def quantize_tree_int8(params: Any, min_size: int = 4096) -> Any:
+@jax.tree_util.register_pytree_node_class
+class QuantTensor:
+    """Runtime form of an int8 weight: (values int8, scale fp32), leaves of
+    a registered pytree so it can ride through ``jax.lax.scan`` over the
+    stacked-layer axis (the dict-marked export form carries a string tag,
+    which scan xs cannot). ``W ~= values * scale``."""
+
+    def __init__(self, values, scale):
+        self.values = values
+        self.scale = scale
+
+    @property
+    def shape(self):
+        return self.values.shape
+
+    @property
+    def ndim(self):
+        return self.values.ndim
+
+    def dequant(self, dtype=jnp.bfloat16):
+        return (self.values.astype(jnp.float32) * self.scale).astype(dtype)
+
+    def tree_flatten(self):
+        return (self.values, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def _is_quant_marker(x: Any) -> bool:
+    return isinstance(x, dict) and x.get("__quant__") == "int8"
+
+
+def to_runtime_quant(tree: Any) -> Any:
+    """Convert export-form ``{"__quant__": "int8", values, scale}`` leaves
+    into scan-compatible QuantTensor leaves."""
+    return jax.tree_util.tree_map(
+        lambda x: QuantTensor(x["values"], x["scale"])
+        if _is_quant_marker(x) else x,
+        tree, is_leaf=_is_quant_marker)
+
+
+def cast_params(tree: Any, dtype) -> Any:
+    """Cast a (possibly mixed plain/QuantTensor) param tree for compute:
+    plain leaves are cast; QuantTensor leaves are DEQUANTIZED. Call this
+    per layer inside the scan body so only one layer's bf16 weights are
+    ever materialised (the whole-tree int8 storage saving survives)."""
+    def one(x):
+        if isinstance(x, QuantTensor):
+            return x.dequant(dtype)
+        return x.astype(dtype)
+    return jax.tree_util.tree_map(
+        one, tree, is_leaf=lambda x: isinstance(x, QuantTensor))
+
+
+def tree_weight_bytes(tree: Any) -> int:
+    """HBM bytes of a param tree (QuantTensor counts its int8 + scale)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        total += leaf.size * leaf.dtype.itemsize
+    return int(total)
+
+
+def quantize_tree_int8(params: Any, min_size: int = 4096,
+                       min_ndim: int = 2) -> Any:
     """Quantize every large float leaf of a param pytree to (int8, scale).
 
-    Small leaves (norm scales, biases) stay in their original dtype.
+    Small leaves (norm scales, biases) stay in their original dtype. For
+    STACKED-layer trees (kernels [L, in, out]) pass ``min_ndim=3``: norm
+    scales and attention biases are [L, H]-shaped and big enough to pass
+    the size filter, but quantizing them buys ~0.002% of the memory for a
+    per-layer precision hit on every normalization.
     """
     def q(x):
         if (hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
-                and x.size >= min_size and x.ndim >= 2):
+                and x.size >= min_size and x.ndim >= min_ndim):
             values, scale = quantize_int8(x)
             return {"__quant__": "int8", "values": values, "scale": scale}
         return x

@@ -10,7 +10,6 @@ train-side model (tested in tests/test_serve.py against the dense path).
 
 from __future__ import annotations
 
-import functools
 from typing import Any
 
 import jax
@@ -102,6 +101,10 @@ def extend_step_forward(
 
     def body(x, layer_and_pages):
         layer, kp, vp = layer_and_pages
+        # per-layer cast/dequant: int8-quantized serving weights
+        # materialise one layer of bf16 at a time (ops.quantization)
+        from ..ops.quantization import cast_params
+        layer = cast_params(layer, compute_dtype)
         h = rms_norm(x, layer["attn_norm"]["scale"], cfg.norm_eps)
         q = (h @ layer["q"]["kernel"]).reshape(B, T, Nq, D)
         k = (h @ layer["k"]["kernel"]).reshape(B, T, Nkv, D)
@@ -129,10 +132,8 @@ def extend_step_forward(
             ffn = mlp_block(h, layer["mlp"], cfg)
         return x + ffn.astype(x.dtype), (kp, vp)
 
-    cast = functools.partial(jax.tree_util.tree_map,
-                             lambda p: p.astype(compute_dtype))
     x, (new_k, new_v) = jax.lax.scan(
-        body, x, (cast(params["blocks"]), k_pages, v_pages))
+        body, x, (params["blocks"], k_pages, v_pages))
 
     x = rms_norm(x, params["final_norm"]["scale"].astype(x.dtype), cfg.norm_eps)
     if cfg.tie_word_embeddings:

@@ -55,6 +55,7 @@ class InferenceEngine:
         seed: int = 0,
         eos_token_id: Optional[int] = None,
     ):
+        serve_cfg.validate()    # one source of truth for config rules
         self.serve_cfg = serve_cfg
         self.eos_token_id = eos_token_id
         dtype = jnp.dtype(serve_cfg.dtype)
@@ -66,6 +67,16 @@ class InferenceEngine:
             params, model_cfg = self._load_params(model_cfg, serve_cfg,
                                                   seed, dtype)
         self.cfg = model_cfg
+
+        if serve_cfg.quantization == "int8":
+            from ..ops.quantization import (quantize_tree_int8,
+                                            to_runtime_quant)
+            params = dict(params)
+            # min_ndim=3: only the stacked [L, in, out] kernels — norm
+            # scales / biases are [L, H] and must stay in full precision
+            params["blocks"] = to_runtime_quant(
+                quantize_tree_int8(params["blocks"], min_ndim=3))
+            logger.info("serving with int8 block weights (W8A16)")
 
         # tensor-parallel serving: one tp-axis mesh; params shard per
         # PARAM_RULES (column/row-parallel kernels), pages per kv head.
@@ -213,13 +224,17 @@ class InferenceEngine:
             usable = min(len(req.prefix_hashes),
                          max((n - 1) // self.kv.page_size, 0))
             pins = self.kv.lookup_prefix(req.prefix_hashes[:usable])
-            # a hit is only worth taking when the un-cached tail is small:
-            # the suffix path (extend_step_forward) re-streams the whole
-            # prefix once PER SUFFIX TOKEN, so a 1-page hit on a long
-            # prompt would cost more than a cold dense prefill
+            # On TPU the multi-query Pallas kernel streams each cached page
+            # once for all suffix queries, so ANY hit saves compute. The
+            # gather fallback (CPU / tensor-parallel) re-streams the whole
+            # prefix once PER SUFFIX TOKEN — there a small hit on a long
+            # tail costs more than a cold dense prefill, so it is dropped.
+            pallas_suffix = (self._attn_impl == "auto"
+                             and jax.default_backend() == "tpu")
             computed = n - len(pins) * self.kv.page_size
-            if pins and computed > max(len(pins) * self.kv.page_size,
-                                       self.serve_cfg.prefill_chunk):
+            if pins and not pallas_suffix and computed > max(
+                    len(pins) * self.kv.page_size,
+                    self.serve_cfg.prefill_chunk):
                 pins = []
         # pin BEFORE the capacity check: pinned pages leave the evictable
         # pool, so free_pages below no longer counts them — otherwise a
@@ -695,8 +710,11 @@ class InferenceEngine:
         return reqs
 
     def stats(self) -> dict:
+        from ..ops.quantization import tree_weight_bytes
         steps = max(self.total_decode_steps, 1)
         return {
+            "weight_bytes": tree_weight_bytes(self.params),
+            "quantization": self.serve_cfg.quantization,
             **self.scheduler.stats(),
             "kv": self.kv.stats(),
             "decode_steps": self.total_decode_steps,
