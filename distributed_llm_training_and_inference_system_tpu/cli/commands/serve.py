@@ -52,10 +52,13 @@ def app(ctx):
 @click.option("--quantization", default="none", show_default=True,
               type=click.Choice(["none", "int8"]),
               help="Weight-only int8 (W8A16): ~2x model HBM freed for KV.")
+@click.option("--chunked-prefill", default=0, show_default=True, type=int,
+              help="Prefill prompts longer than this in chunks of this "
+                   "many tokens, interleaved with decode (0 = off).")
 def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
           kv_block_size, kv_hbm_gb, scheduler, dtype, prometheus_port,
           speculative, spec_tokens, prefix_cache, tensor_parallel,
-          quantization):
+          quantization, chunked_prefill):
     """Start the OpenAI-compatible inference server."""
     import jax
 
@@ -74,7 +77,8 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
         kv_block_size=kv_block_size, kv_hbm_budget_gb=kv_hbm_gb,
         scheduler=scheduler, dtype=dtype, speculative=speculative,
         speculative_tokens=spec_tokens, prefix_caching=prefix_cache,
-        tensor_parallel=tensor_parallel, quantization=quantization)
+        tensor_parallel=tensor_parallel, quantization=quantization,
+        chunked_prefill_tokens=chunked_prefill)
     serve_cfg.validate()
 
     observer = None

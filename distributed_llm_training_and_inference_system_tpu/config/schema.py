@@ -477,6 +477,11 @@ class ServeConfig:
     # max prompt tokens prefetched between two decode steps; bounds the
     # inter-token stall resident streams see during a long-prompt burst
     prefill_budget_tokens: int = 2048
+    # chunked prefill: prompts longer than this prefill in chunks of this
+    # many tokens, one chunk per engine step, interleaved with decode — a
+    # single 32k prompt can no longer stall resident streams for its whole
+    # prefill. 0 disables (whole-prompt single-dispatch prefill).
+    chunked_prefill_tokens: int = 0
     # decode iterations fused into one device dispatch (lax.scan): each
     # dispatch pays one host round trip for K tokens. Finished requests
     # waste at most K-1 trailing iterations; admission happens between
@@ -528,6 +533,8 @@ class ServeConfig:
             raise ConfigError("tensor_parallel must be >= 1")
         if self.quantization not in ("none", "int8"):
             raise ConfigError("quantization must be none|int8")
+        if self.chunked_prefill_tokens < 0:
+            raise ConfigError("chunked_prefill_tokens must be >= 0")
         if self.quantization != "none" and self.tensor_parallel > 1:
             raise ConfigError(
                 "int8 serving + tensor_parallel is not supported yet "

@@ -214,11 +214,14 @@ def forward(
     block = functools.partial(_block_fn, cfg, attn_impl, norm_impl)
     block = _remat_wrap(block, remat)
 
-    # the per-layer cast happens INSIDE the scan body (models.gpt._cast):
-    # with int8-quantized serving weights only one layer's bf16
-    # dequantization is ever materialised — the whole-tree int8 storage
-    # saving survives the forward
-    from ..ops.quantization import cast_params as _cast
+    # plain leaves are cast to the compute dtype ONCE before the scan
+    # (casting inside the body would stream fp32 master weights from HBM
+    # every layer — measured -0.05 MFU); int8 QuantTensor leaves ride the
+    # scan quantized and dequantize one layer at a time inside the body,
+    # so the whole-tree int8 storage saving survives the forward
+    from ..ops.quantization import cast_params as _cast, precast_params
+
+    blocks = precast_params(params["blocks"], compute_dtype)
 
     if kv_cache is None:
         def body(carry, layer):
@@ -229,7 +232,7 @@ def forward(
             return (x, aux + aux_l), None
 
         (x, aux_total), _ = jax.lax.scan(
-            body, (x, jnp.float32(0.0)), params["blocks"])
+            body, (x, jnp.float32(0.0)), blocks)
         new_cache = None
     else:
         k_cache, v_cache = kv_cache
@@ -245,7 +248,7 @@ def forward(
 
         (x, aux_total), new_kvs = jax.lax.scan(
             body, (x, jnp.float32(0.0)),
-            (params["blocks"], k_cache, v_cache))
+            (blocks, k_cache, v_cache))
         new_cache = new_kvs
 
     if unembed_positions is not None:

@@ -27,6 +27,7 @@ from ..ops.paged_attention import (
     paged_attention_multi,
     write_token_to_pages,
 )
+from ..ops.quantization import cast_params, precast_params
 
 
 def decode_step_forward(
@@ -103,7 +104,6 @@ def extend_step_forward(
         layer, kp, vp = layer_and_pages
         # per-layer cast/dequant: int8-quantized serving weights
         # materialise one layer of bf16 at a time (ops.quantization)
-        from ..ops.quantization import cast_params
         layer = cast_params(layer, compute_dtype)
         h = rms_norm(x, layer["attn_norm"]["scale"], cfg.norm_eps)
         q = (h @ layer["q"]["kernel"]).reshape(B, T, Nq, D)
@@ -133,7 +133,8 @@ def extend_step_forward(
         return x + ffn.astype(x.dtype), (kp, vp)
 
     x, (new_k, new_v) = jax.lax.scan(
-        body, x, (params["blocks"], k_pages, v_pages))
+        body, x, (precast_params(params["blocks"], compute_dtype),
+                  k_pages, v_pages))
 
     x = rms_norm(x, params["final_norm"]["scale"].astype(x.dtype), cfg.norm_eps)
     if cfg.tie_word_embeddings:

@@ -176,6 +176,18 @@ class ContinuousBatchingScheduler:
                 return True
         return False
 
+    def abort_prefill(self, request_id: str) -> bool:
+        """Release a PREFILLING slot whose request was cancelled between
+        prefill chunks (chunked prefill) — no tokens were produced, so the
+        slot and its pages free immediately instead of after the remaining
+        chunks run."""
+        for i, r in enumerate(self.slots):
+            if (r is not None and r.request_id == request_id
+                    and r.state == RequestState.PREFILLING):
+                self._release_slot(i, "cancelled")
+                return True
+        return False
+
     def fail_all(self, error: str) -> list[Request]:
         """Engine-failure path: fail every queued and resident request so
         their waiters fire instead of hanging until the HTTP timeout."""
