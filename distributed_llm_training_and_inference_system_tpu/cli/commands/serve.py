@@ -55,10 +55,14 @@ def app(ctx):
 @click.option("--chunked-prefill", default=0, show_default=True, type=int,
               help="Prefill prompts longer than this in chunks of this "
                    "many tokens, interleaved with decode (0 = off).")
+@click.option("--kv-quantization", default="none", show_default=True,
+              type=click.Choice(["none", "int8"]),
+              help="int8 KV pages (+per-token scales): 2x KV capacity, "
+                   "half the decode KV streaming.")
 def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
           kv_block_size, kv_hbm_gb, scheduler, dtype, prometheus_port,
           speculative, spec_tokens, prefix_cache, tensor_parallel,
-          quantization, chunked_prefill):
+          quantization, chunked_prefill, kv_quantization):
     """Start the OpenAI-compatible inference server."""
     import jax
 
@@ -78,7 +82,8 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
         scheduler=scheduler, dtype=dtype, speculative=speculative,
         speculative_tokens=spec_tokens, prefix_caching=prefix_cache,
         tensor_parallel=tensor_parallel, quantization=quantization,
-        chunked_prefill_tokens=chunked_prefill)
+        chunked_prefill_tokens=chunked_prefill,
+        kv_quantization=kv_quantization)
     serve_cfg.validate()
 
     observer = None

@@ -26,6 +26,56 @@ import jax.numpy as jnp
 from ..models.layers import NEG_INF
 
 
+@jax.tree_util.register_pytree_node_class
+class QuantPages:
+    """int8 KV pages + per-token absmax scales: values [..., NP, Nkv, PS, D]
+    int8, scale [..., NP, Nkv, PS, 1] fp32 (~3% overhead at D=128, vs 50%
+    saved on the page data — 2x KV capacity per HBM byte and half the
+    decode-attention KV streaming).
+
+    Registered as a pytree so it drops into every k_pages/v_pages slot
+    unchanged: jits, donation, ``lax.scan`` carries/xs (the layer-stacked
+    [L, ...] leading axis slices through both leaves), and device_put
+    sharding all treat it as two arrays. Every read path dequantizes where
+    it already casts to fp32; the write path quantizes per token."""
+
+    def __init__(self, values, scale):
+        self.values = values
+        self.scale = scale
+
+    @property
+    def shape(self):
+        return self.values.shape
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def astype(self, dtype):
+        # appease generic tree-casts (ops never cast pages; keep quantized)
+        return self
+
+    def dequant(self, dtype=jnp.float32):
+        from .quantization import dequantize_int8
+        return dequantize_int8(self.values, self.scale, dtype)
+
+    def tree_flatten(self):
+        return (self.values, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def quantize_kv_token(new_kv: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Per-(row, head) absmax int8 of a token's K or V [..., Nkv, D] ->
+    (int8 values, fp32 scale [..., Nkv]). One implementation of the
+    absmax math lives in ops.quantization; this only drops keepdims."""
+    from .quantization import quantize_int8
+    q, scale = quantize_int8(new_kv, axis=-1)
+    return q, scale[..., 0]
+
+
 def paged_attention(
     q: jax.Array,            # [B, Nq, D] — one query token per sequence
     k_pages: jax.Array,      # [NP, Nkv, PS, D]
@@ -56,11 +106,18 @@ def paged_attention(
     maxP = block_tables.shape[1]
     groups = Nq // Nkv
 
-    # Gather each row's pages: [B, maxP, Nkv, PS, D] -> [B, Nkv, Lmax, D]
-    k = k_pages[block_tables].transpose(0, 2, 1, 3, 4).reshape(
-        B, Nkv, maxP * PS, D)
-    v = v_pages[block_tables].transpose(0, 2, 1, 3, 4).reshape(
-        B, Nkv, maxP * PS, D)
+    def gather(pages):
+        # [B, maxP, Nkv, PS, D] -> [B, Nkv, Lmax, D]; int8 pages dequant
+        # right after the gather (the matmuls below run fp32 anyway)
+        if isinstance(pages, QuantPages):
+            g = (pages.values[block_tables].astype(jnp.float32)
+                 * pages.scale[block_tables]).astype(q.dtype)
+        else:
+            g = pages[block_tables]
+        return g.transpose(0, 2, 1, 3, 4).reshape(B, Nkv, maxP * PS, D)
+
+    k = gather(k_pages)
+    v = gather(v_pages)
 
     qg = q.reshape(B, Nkv, groups, D)
     scores = jnp.einsum("bhgd,bhkd->bhgk", qg, k.astype(q.dtype),
@@ -122,7 +179,8 @@ def write_token_to_pages(
     """Scatter one token per sequence into its page. Rows whose table entry
     is the scratch page (0) — or whose ``active`` mask is False (multi-step
     decode continuing past a row's token budget) — harmlessly overwrite
-    scratch page 0 instead of corrupting pages beyond the block table."""
+    scratch page 0 instead of corrupting pages beyond the block table.
+    ``QuantPages`` get the token quantized per (row, head) on the way in."""
     page_size = pages.shape[2]
     maxP = block_tables.shape[1]
     logical_page = jnp.clip(positions // page_size, 0, maxP - 1)
@@ -131,4 +189,9 @@ def write_token_to_pages(
                                axis=1)[:, 0]                         # [B]
     if active is not None:
         phys = jnp.where(active, phys, 0)
+    if isinstance(pages, QuantPages):
+        qv, scale = quantize_kv_token(new_kv)
+        return QuantPages(
+            pages.values.at[phys, :, offset].set(qv),
+            pages.scale.at[phys, :, offset, 0].set(scale))
     return pages.at[phys, :, offset].set(new_kv.astype(pages.dtype))

@@ -39,18 +39,24 @@ from ..models.layers import NEG_INF
 
 
 def _extend_kernel(tables_ref, starts_ref,        # scalar prefetch
-                   q_ref,                          # [T*G, D] VMEM
-                   k_ref, v_ref,                   # [PS, D] VMEM (one page)
-                   o_ref,                          # [T*G, D] VMEM out
-                   acc_ref, m_ref, l_ref,          # VMEM scratch
-                   *, page_size: int, scale: float, groups: int,
-                   window: int):
+                   *refs,                          # see unpack below
+                   page_size: int, scale: float, groups: int,
+                   window: int, kv_quant: bool):
     """Multi-query variant: ``window`` consecutive query tokens per slot
     (speculative verify / cached-prefix suffix prefill). Each page is
     DMA'd ONCE per (slot, kv head) and scored against all T queries —
     the flattened-row fallback re-streams the prefix T times. Query row
     j (= row // groups) sits at position start + j and attends causally
-    over [0, start + j]."""
+    over [0, start + j].
+
+    ``kv_quant``: pages are int8 with per-token scales [PS, 1] — dequant
+    happens in VMEM right before the fp32 dot, so HBM page traffic is
+    halved (the whole point of the int8 KV cache)."""
+    if kv_quant:
+        (q_ref, k_ref, ks_ref, v_ref, vs_ref,
+         o_ref, acc_ref, m_ref, l_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref = refs
     b = pl.program_id(0)
     p = pl.program_id(2)
 
@@ -68,6 +74,9 @@ def _extend_kernel(tables_ref, starts_ref,        # scalar prefetch
         q = q_ref[...].astype(jnp.float32)            # [T*G, D]
         k = k_ref[...].astype(jnp.float32)            # [PS, D]
         v = v_ref[...].astype(jnp.float32)            # [PS, D]
+        if kv_quant:
+            k = k * ks_ref[...]                       # [PS, 1] broadcast
+            v = v * vs_ref[...]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale      # [T*G, PS]
@@ -104,6 +113,8 @@ def paged_attention_pallas_multi(
 ) -> jax.Array:
     """Returns [B, T, Nq, D]; query j attends over [0, start+j] via pages
     (the window's own K/V must already be written to the pages)."""
+    from .paged_attention import QuantPages
+    kv_quant = isinstance(k_pages, QuantPages)
     B, T, Nq, D = q.shape
     NP, Nkv, PS, _ = k_pages.shape
     maxP = block_tables.shape[1]
@@ -121,17 +132,25 @@ def paged_attention_pallas_multi(
     tables_clamped = jnp.take_along_axis(
         block_tables.astype(jnp.int32), clamped_p, axis=1)
 
+    page_spec = pl.BlockSpec((None, None, PS, D),
+                             lambda b, h, p, t, u: (t[b, p], h, 0, 0))
+    scale_spec = pl.BlockSpec((None, None, PS, 1),
+                              lambda b, h, p, t, u: (t[b, p], h, 0, 0))
+    in_specs = [pl.BlockSpec((None, None, T * groups, D),
+                             lambda b, h, p, t, u: (b, h, 0, 0))]   # q
+    inputs = [qg]
+    if kv_quant:
+        in_specs += [page_spec, scale_spec, page_spec, scale_spec]
+        inputs += [k_pages.values, k_pages.scale,
+                   v_pages.values, v_pages.scale]
+    else:
+        in_specs += [page_spec, page_spec]
+        inputs += [k_pages, v_pages]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,       # tables_clamped, starts
         grid=(B, Nkv, maxP),
-        in_specs=[
-            pl.BlockSpec((None, None, T * groups, D),
-                         lambda b, h, p, t, u: (b, h, 0, 0)),   # q
-            pl.BlockSpec((None, None, PS, D),
-                         lambda b, h, p, t, u: (t[b, p], h, 0, 0)),  # k page
-            pl.BlockSpec((None, None, PS, D),
-                         lambda b, h, p, t, u: (t[b, p], h, 0, 0)),  # v page
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((None, None, T * groups, D),
                                lambda b, h, p, t, u: (b, h, 0, 0)),
         scratch_shapes=[
@@ -143,11 +162,11 @@ def paged_attention_pallas_multi(
 
     out = pl.pallas_call(
         functools.partial(_extend_kernel, page_size=PS, scale=scale,
-                          groups=groups, window=T),
+                          groups=groups, window=T, kv_quant=kv_quant),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Nkv, T * groups, D), q.dtype),
         interpret=interpret,
-    )(tables_clamped, starts, qg, k_pages, v_pages)
+    )(tables_clamped, starts, *inputs)
     return out.reshape(B, Nkv, T, groups, D).transpose(0, 2, 1, 3, 4).reshape(
         B, T, Nq, D)
 

@@ -113,7 +113,8 @@ class InferenceEngine:
             page_size=serve_cfg.kv_block_size,
             num_pages=serve_cfg.kv_num_blocks,
             hbm_budget_gb=serve_cfg.kv_hbm_budget_gb, dtype=dtype,
-            page_sharding=page_sharding)
+            page_sharding=page_sharding,
+            quantized=serve_cfg.kv_quantization == "int8")
 
         self._req_slot: dict[str, int] = {}
         # pages promised to admitted-but-not-yet-prefilled requests; without
@@ -295,8 +296,21 @@ class InferenceEngine:
                 vd = vd[:, 0].reshape(
                     cfg.num_layers, n_pages, self.kv.page_size,
                     cfg.num_kv_heads, cfg.head_dim).transpose(0, 1, 3, 2, 4)
-                k_pages = k_pages.at[:, entries].set(kd)
-                v_pages = v_pages.at[:, entries].set(vd)
+
+                def scatter(pages, dense):
+                    from ..ops.paged_attention import (QuantPages,
+                                                       quantize_kv_token)
+                    if isinstance(pages, QuantPages):
+                        # dense [L, nP, Nkv, PS, D]: absmax over D gives
+                        # the per-token scale [L, nP, Nkv, PS]
+                        qv, sc = quantize_kv_token(dense)
+                        return QuantPages(
+                            pages.values.at[:, entries].set(qv),
+                            pages.scale.at[:, entries].set(sc[..., None]))
+                    return pages.at[:, entries].set(dense)
+
+                k_pages = scatter(k_pages, kd)
+                v_pages = scatter(v_pages, vd)
                 token = sample_tokens(logits[:, 0], key[None], temp[None],
                                       top_k[None], top_p[None])[0]
                 return token, k_pages, v_pages
@@ -393,19 +407,20 @@ class InferenceEngine:
         rids = list(self._partial_prefills)
         rr = getattr(self, "_chunk_rr", 0) % max(len(rids), 1)
         for rid in rids[rr:] + rids[:rr]:
-            if spent > 0 and spent + C > budget:
-                self._chunk_rr = rids.index(rid)   # resume here next step
-                break
-            spent += C
             st = self._partial_prefills[rid]
             req: Request = st["req"]
-            if req.cancel_requested:
+            if req.cancel_requested:        # dispatches nothing: no charge
                 with self.lock:
                     self.scheduler.abort_prefill(rid)   # frees slot + pages
                 del self._partial_prefills[rid]
                 continue
             n, done = req.num_prompt_tokens, st["done"]
-            this = min(n - done, C)
+            this = min(n - done, C)         # charge actual tokens, not C —
+            # a 1-token final chunk must not consume a whole chunk of budget
+            if spent > 0 and spent + this > budget:
+                self._chunk_rr = rids.index(rid)   # resume here next step
+                break
+            spent += this
             bucket = self._suffix_bucket(this)
             tokens = np.zeros((1, bucket), np.int32)
             tokens[0, :this] = req.prompt_tokens[done:done + this]
@@ -794,9 +809,10 @@ class InferenceEngine:
             reallocated = False
             for name in ("k_pages", "v_pages"):
                 buf = getattr(self.kv, name)
-                if buf.is_deleted():
+                if any(leaf.is_deleted()
+                       for leaf in jax.tree_util.tree_leaves(buf)):
                     setattr(self.kv, name,
-                            self.kv._new_pages(buf.shape, buf.dtype))
+                            self.kv._new_pages(buf.shape, self.kv.dtype))
                     reallocated = True
             if reallocated:
                 # zeroed buffers invalidate every cached prefix page — a

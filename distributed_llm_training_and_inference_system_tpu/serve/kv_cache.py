@@ -57,16 +57,23 @@ class PagedKVCache:
         dtype=jnp.bfloat16,
         page_sharding=None,     # NamedSharding over the kv-head axis for
                                 # tensor-parallel serving (None = one device)
+        quantized: bool = False,  # int8 pages + per-token scales
     ):
         self.cfg = cfg
         self.num_slots = num_slots
         self.max_seq_len = max_seq_len
         self.page_size = page_size
         self.max_pages_per_slot = math.ceil(max_seq_len / page_size)
+        self.quantized = quantized
         if num_pages <= 0:
-            bytes_per_page = (2 * cfg.num_layers * page_size
-                              * cfg.num_kv_heads * cfg.head_dim
-                              * jnp.dtype(dtype).itemsize)
+            if quantized:
+                # int8 values + fp32 per-(token, kv-head) scale, K and V
+                bytes_per_page = (2 * cfg.num_layers * page_size
+                                  * cfg.num_kv_heads * (cfg.head_dim + 4))
+            else:
+                bytes_per_page = (2 * cfg.num_layers * page_size
+                                  * cfg.num_kv_heads * cfg.head_dim
+                                  * jnp.dtype(dtype).itemsize)
             num_pages = max(int(hbm_budget_gb * 1e9 // bytes_per_page), 2)
         # never more than every slot fully resident (+1 scratch)
         num_pages = min(num_pages, num_slots * self.max_pages_per_slot + 1)
@@ -99,11 +106,18 @@ class PagedKVCache:
         self.prefix_queries = 0       # full pages looked up
 
     def _new_pages(self, shape, dtype):
-        """Allocate a (possibly tensor-parallel-sharded) page buffer."""
+        """Allocate a (possibly int8-quantized, possibly tensor-parallel-
+        sharded) page buffer."""
         import jax
+        if self.quantized:
+            from ..ops.paged_attention import QuantPages
+            buf = QuantPages(jnp.zeros(shape, jnp.int8),
+                             jnp.zeros((*shape[:-1], 1), jnp.float32))
+        else:
+            buf = jnp.zeros(shape, dtype)
         if self.page_sharding is not None:
-            return jax.device_put(jnp.zeros(shape, dtype), self.page_sharding)
-        return jnp.zeros(shape, dtype)
+            return jax.device_put(buf, self.page_sharding)
+        return buf
 
     # -- accounting ----------------------------------------------------------
 
@@ -123,7 +137,12 @@ class PagedKVCache:
         return self.pages_needed(num_tokens) <= self.num_pages - 1
 
     def hbm_bytes(self) -> int:
-        return 2 * int(np.prod(self.k_pages.shape)) * jnp.dtype(self.dtype).itemsize
+        def one(buf):
+            from ..ops.paged_attention import QuantPages
+            if isinstance(buf, QuantPages):
+                return buf.values.size + buf.scale.size * 4
+            return int(np.prod(buf.shape)) * jnp.dtype(self.dtype).itemsize
+        return one(self.k_pages) + one(self.v_pages)
 
     # -- alloc / grow / free -------------------------------------------------
 

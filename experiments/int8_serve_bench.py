@@ -25,11 +25,13 @@ def main() -> None:
     prompt = [int(t) for t in np.random.default_rng(0).integers(
         1, cfg.vocab_size, 512)]
     out = {"model": model}
-    for quant in ("none", "int8"):
+    modes = [("none", "none"), ("int8", "none"), ("int8", "int8")]
+    for quant, kvq in modes:
         eng = InferenceEngine(cfg, ServeConfig(
             model=model, max_batch_size=4, max_seq_len=1024,
             kv_block_size=64, dtype="bfloat16",
-            decode_steps_per_dispatch=8, quantization=quant), seed=0)
+            decode_steps_per_dispatch=8, quantization=quant,
+            kv_quantization=kvq), seed=0)
         # two untimed passes compile every program this workload touches
         # (dense 512-bucket prefill, suffix extend after the prefix-cache
         # hit, decode); the timed pass then measures serving, not XLA
@@ -42,9 +44,11 @@ def main() -> None:
                                                          max_tokens=128))
         dt = time.perf_counter() - t0
         toks = sum(len(r.generated_tokens) for r in reqs)
-        out[quant] = {
+        out[f"w_{quant}|kv_{kvq}"] = {
             "tokens_per_sec": round(toks / dt, 1),
             "weight_gb": round(eng.stats()["weight_bytes"] / 1e9, 3),
+            "kv_gb": round(eng.kv.hbm_bytes() / 1e9, 3),
+            "kv_pages": eng.kv.num_pages,
         }
     print(json.dumps(out))
 
