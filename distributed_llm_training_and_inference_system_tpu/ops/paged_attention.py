@@ -26,26 +26,22 @@ import jax.numpy as jnp
 from ..models.layers import NEG_INF
 
 
+from .quantization import QuantTensor
+
+
 @jax.tree_util.register_pytree_node_class
-class QuantPages:
+class QuantPages(QuantTensor):
     """int8 KV pages + per-token absmax scales: values [..., NP, Nkv, PS, D]
     int8, scale [..., NP, Nkv, PS, 1] fp32 (~3% overhead at D=128, vs 50%
     saved on the page data — 2x KV capacity per HBM byte and half the
     decode-attention KV streaming).
 
-    Registered as a pytree so it drops into every k_pages/v_pages slot
-    unchanged: jits, donation, ``lax.scan`` carries/xs (the layer-stacked
-    [L, ...] leading axis slices through both leaves), and device_put
-    sharding all treat it as two arrays. Every read path dequantizes where
-    it already casts to fp32; the write path quantizes per token."""
-
-    def __init__(self, values, scale):
-        self.values = values
-        self.scale = scale
-
-    @property
-    def shape(self):
-        return self.values.shape
+    The (values, scale) pytree mechanics come from QuantTensor; the
+    distinct TYPE keeps page buffers out of ``cast_params``' weight-dequant
+    path and marks every k_pages/v_pages consumer's isinstance branch.
+    As a registered pytree it drops into jits, donation, ``lax.scan``
+    carries/xs (the layer-stacked [L, ...] axis slices both leaves), and
+    device_put sharding unchanged."""
 
     @property
     def dtype(self):
@@ -54,17 +50,6 @@ class QuantPages:
     def astype(self, dtype):
         # appease generic tree-casts (ops never cast pages; keep quantized)
         return self
-
-    def dequant(self, dtype=jnp.float32):
-        from .quantization import dequantize_int8
-        return dequantize_int8(self.values, self.scale, dtype)
-
-    def tree_flatten(self):
-        return (self.values, self.scale), None
-
-    @classmethod
-    def tree_unflatten(cls, aux, children):
-        return cls(*children)
 
 
 def quantize_kv_token(new_kv: jax.Array) -> tuple[jax.Array, jax.Array]:

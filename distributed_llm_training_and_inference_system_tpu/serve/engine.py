@@ -415,12 +415,16 @@ class InferenceEngine:
                 del self._partial_prefills[rid]
                 continue
             n, done = req.num_prompt_tokens, st["done"]
-            this = min(n - done, C)         # charge actual tokens, not C —
-            # a 1-token final chunk must not consume a whole chunk of budget
-            if spent > 0 and spent + this > budget:
+            this = min(n - done, C)
+            # charge what the program actually computes — the padded
+            # suffix bucket — not the raw token count (a 33-token final
+            # chunk dispatches a 64-row program) and not the constant C
+            # (a 1-token chunk must not burn a whole chunk of budget)
+            cost = self._suffix_bucket(this)
+            if spent > 0 and spent + cost > budget:
                 self._chunk_rr = rids.index(rid)   # resume here next step
                 break
-            spent += this
+            spent += cost
             bucket = self._suffix_bucket(this)
             tokens = np.zeros((1, bucket), np.int32)
             tokens[0, :this] = req.prompt_tokens[done:done + this]

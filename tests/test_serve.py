@@ -442,3 +442,33 @@ class TestMultiStepDecode:
         assert len(req.generated_tokens) == 5
         assert req.finish_reason == "length"
         assert eng.kv.free_pages == free0
+
+
+class TestMoEServing:
+    """Serving an MoE model: the decode/extend bodies route through
+    moe_block (token-choice top-k experts) — greedy must match the dense
+    training-side forward exactly, like the dense-model tests above."""
+
+    def test_moe_greedy_matches_dense(self):
+        cfg = get_model_config("gpt-test-moe")
+        eng = InferenceEngine(cfg, ServeConfig(
+            model="gpt-test-moe", max_batch_size=2, max_seq_len=64,
+            prefill_chunk=16, kv_block_size=8, dtype="float32"), seed=0)
+        prompt = [5, 17, 99, 3, 42, 7, 23]
+        [req] = eng.generate([prompt], SamplingParams(temperature=0.0,
+                                                      max_tokens=8))
+        assert req.generated_tokens == greedy_reference(
+            eng.params, cfg, prompt, 8)
+
+    def test_moe_with_speculation_and_chunked_prefill(self):
+        cfg = get_model_config("gpt-test-moe")
+        eng = InferenceEngine(cfg, ServeConfig(
+            model="gpt-test-moe", max_batch_size=2, max_seq_len=64,
+            prefill_chunk=16, kv_block_size=8, dtype="float32",
+            speculative="ngram", speculative_tokens=4,
+            chunked_prefill_tokens=8), seed=0)
+        prompt = [7, 8, 9, 10] * 5
+        [req] = eng.generate([prompt], SamplingParams(temperature=0.0,
+                                                      max_tokens=6))
+        assert req.generated_tokens == greedy_reference(
+            eng.params, cfg, prompt, 6)
