@@ -28,6 +28,23 @@ def _take(d: dict, *names, default=None):
     return default
 
 
+def _parse_bool(name: str, v: Any) -> bool:
+    """Strict bool parsing: ``bool("false")`` is True, which silently enabled
+    features the operator disabled via env/string-sourced configs (ADVICE r2).
+    Accepts real bools and the usual string/int spellings; rejects the rest."""
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, int) and v in (0, 1):
+        return bool(v)
+    if isinstance(v, str):
+        s = v.strip().lower()
+        if s in ("true", "1", "yes", "on"):
+            return True
+        if s in ("false", "0", "no", "off"):
+            return False
+    raise ConfigError(f"{name} must be a boolean (got {v!r})")
+
+
 @dataclass
 class RopeConfig:
     base: float = 10000.0
@@ -165,8 +182,8 @@ class ModelConfig:
             rope=RopeConfig.from_dict(d.get("rope")),
             activation=str(_take(d, "activation", "hidden_act", default="silu")),
             norm_eps=float(_take(d, "layer_norm_eps", "norm_eps", "rms_norm_eps", default=1e-5)),
-            tie_word_embeddings=bool(_take(d, "tie_word_embeddings", default=False)),
-            attention_bias=bool(attn.get("bias", _take(d, "attention_bias", default=False))),
+            tie_word_embeddings=_parse_bool("tie_word_embeddings", _take(d, "tie_word_embeddings", default=False)),
+            attention_bias=_parse_bool("attention_bias", attn.get("bias", _take(d, "attention_bias", default=False))),
             dropout=float(attn.get("dropout", _take(d, "dropout", default=0.0))),
             dtype=str(_take(d, "dtype", default="bfloat16")),
             moe=MoEConfig.from_dict(d.get("moe")),
@@ -355,7 +372,7 @@ class DataConfig:
             val=str(_take(d, "val", "val_path", default="synthetic")),
             tokenizer=str(_take(d, "tokenizer", default="gpt2")),
             max_length=int(_take(d, "max_length", "seq_len", default=2048)),
-            pack_sequences=bool(_take(d, "pack_sequences", default=True)),
+            pack_sequences=_parse_bool("pack_sequences", _take(d, "pack_sequences", default=True)),
             num_workers=int(_take(d, "num_workers", default=2)),
             prefetch_factor=int(_take(d, "prefetch_factor", default=2)),
             seed=int(_take(d, "seed", default=0)),
@@ -380,8 +397,8 @@ class CheckpointConfig:
         return cls(
             path=str(_take(d, "path", default="checkpoints")),
             interval_steps=int(_take(d, "interval_steps", "save_interval", default=1000)),
-            sharded=bool(_take(d, "sharded", default=True)),
-            async_save=bool(_take(d, "async", "async_save", default=True)),
+            sharded=_parse_bool("sharded", _take(d, "sharded", default=True)),
+            async_save=_parse_bool("async_save", _take(d, "async", "async_save", default=True)),
             keep_latest=int(_take(d, "keep_latest", "save_total_limit", default=5)),
         )
 
@@ -419,8 +436,8 @@ class TrainingConfig:
             log_interval=int(_take(d, "log_interval", default=10)),
             seed=int(_take(d, "seed", default=42)),
             mixed_precision=str(_take(d, "mixed_precision", default="bf16")),
-            deterministic=bool(_take(d, "deterministic", default=False)),
-            profile=bool(_take(d, "profile", default=False)),
+            deterministic=_parse_bool("deterministic", _take(d, "deterministic", default=False)),
+            profile=_parse_bool("profile", _take(d, "profile", default=False)),
             profile_dir=str(_take(d, "profile_dir", default="traces")),
             eval_steps=int(_take(d, "eval_steps", default=20)),
             attn_impl=str(_take(d, "attn_impl", "attention_impl", default="auto")),
@@ -500,8 +517,10 @@ class ServeConfig:
     # speculative decoding: "off" | "ngram" (host prompt-lookup drafts,
     # device verification — serve/speculative.py). Greedy requests accept
     # up to speculative_tokens-1 drafts + 1 bonus token per dispatch; the
-    # acceptance rule is draft == argmax, so output is bit-identical to
-    # plain greedy decode regardless of draft quality.
+    # acceptance rule is draft == argmax of the verify-pass logits, so the
+    # output is always a valid greedy chain regardless of draft quality
+    # (bitwise-identical to plain decode up to bf16 tiling ties — see
+    # serve/speculative.py module docstring).
     speculative: str = "off"
     speculative_tokens: int = 8     # verify window T (drafts = T-1)
     speculative_ngram: int = 3      # longest n-gram tried by the proposer
@@ -563,7 +582,14 @@ class ServeConfig:
         kw = {}
         for f_ in dataclasses.fields(cls):
             if f_.name in d:
-                kw[f_.name] = type(f_.default)(d[f_.name]) if f_.default is not None else d[f_.name]
+                if isinstance(f_.default, bool):
+                    # bool before the generic coercion: bool is an int
+                    # subclass and type(True)("false") is True (ADVICE r2)
+                    kw[f_.name] = _parse_bool(f_.name, d[f_.name])
+                elif f_.default is not None:
+                    kw[f_.name] = type(f_.default)(d[f_.name])
+                else:
+                    kw[f_.name] = d[f_.name]
         cfg = cls(**kw)
         cfg.validate()
         return cfg

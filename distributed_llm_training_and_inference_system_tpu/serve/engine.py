@@ -405,7 +405,12 @@ class InferenceEngine:
         budget = max(self.serve_cfg.prefill_budget_tokens, C)
         spent = 0
         rids = list(self._partial_prefills)
-        rr = getattr(self, "_chunk_rr", 0) % max(len(rids), 1)
+        # resume point is a request_id, not an index: entries complete or
+        # cancel between steps, so an index into last step's snapshot can
+        # skip or double-advance a request (ADVICE r2)
+        resume_rid = getattr(self, "_chunk_rr", None)
+        rr = rids.index(resume_rid) if resume_rid in rids else 0
+        self._chunk_rr = None
         for rid in rids[rr:] + rids[:rr]:
             st = self._partial_prefills[rid]
             req: Request = st["req"]
@@ -422,7 +427,7 @@ class InferenceEngine:
             # (a 1-token chunk must not burn a whole chunk of budget)
             cost = self._suffix_bucket(this)
             if spent > 0 and spent + cost > budget:
-                self._chunk_rr = rids.index(rid)   # resume here next step
+                self._chunk_rr = rid   # resume at this request next step
                 break
             spent += cost
             bucket = self._suffix_bucket(this)

@@ -14,9 +14,14 @@ workloads (summarisation, code edit, RAG) where the output re-uses prompt
 spans.
 
 Correctness does not depend on draft quality: a draft token j is accepted
-iff it equals the argmax of the verified logits at its position, so for
-greedy requests the emitted stream is bit-identical to plain greedy decode
-(tested in tests/test_speculative.py). Sampled (temperature > 0) requests
+iff it equals the argmax of the verified logits at its position, so every
+emitted greedy stream is a valid greedy chain under the verify-pass logits
+(each token is the argmax of logits conditioned on the accepted prefix;
+tested in tests/test_speculative.py, bitwise vs plain decode on CPU fp32).
+On TPU bf16 the [B,T,H] verify projections may tile/accumulate differently
+from the [B,1,H] decode shapes, so a low-bit logit diff can, in principle,
+flip an argmax at near-ties — the chain remains self-consistent either
+way. Sampled (temperature > 0) requests
 in the same batch fall back to one verified token per dispatch — the
 engine only routes to the speculative path when a greedy request is
 resident. Rejected drafts leave stale KV beyond the accepted position;
