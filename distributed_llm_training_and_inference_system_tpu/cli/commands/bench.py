@@ -91,17 +91,28 @@ def kernels(op, seq_len, hidden, heads, batch):
 @app.command()
 @click.option("--model", "model_name", default="gpt-test", show_default=True)
 @click.option("--mode", default="train", show_default=True,
-              type=click.Choice(["train", "serve", "both"]))
+              type=click.Choice(["train", "serve", "serve-load", "both"]))
 @click.option("--steps", default=10, show_default=True)
 @click.option("--batch", default=4, show_default=True)
 @click.option("--seq-len", default=None, type=int)
 @click.option("--prompt-len", default=128, show_default=True)
 @click.option("--gen-len", default=64, show_default=True)
 @click.option("--requests", default=8, show_default=True)
+@click.option("--rps", default="2,8,32", show_default=True,
+              help="serve-load: comma-separated offered requests/sec sweep.")
+@click.option("--concurrency", default="4,16,64", show_default=True,
+              help="serve-load: comma-separated closed-loop sweep.")
+@click.option("--admission", default="ondemand", show_default=True,
+              type=click.Choice(["ondemand", "reserve"]))
+@click.option("--kv-blocks", default=0, show_default=True,
+              help="serve-load: fixed KV pool size (0 = auto from budget).")
 def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
-        requests):
+        requests, rps, concurrency, admission, kv_blocks):
     """End-to-end train step throughput / serve TTFT+throughput
-    (parity: reference bench.py:35-49)."""
+    (parity: reference bench.py:35-49). ``serve-load`` runs open-loop
+    (Poisson) and closed-loop sweeps with p50/p99 TTFT, per-token latency,
+    goodput, and preemption counts (serve/loadgen.py) — the queueing
+    regime the reference's scheduler could not survive (SURVEY §2.4.1)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -170,6 +181,43 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
             "requests": requests,
         }
 
+    if mode == "serve-load":
+        from ...serve import InferenceEngine, SamplingParams
+        from ...serve.loadgen import run_closed_loop, run_poisson
+
+        def fresh_engine():
+            return InferenceEngine(cfg, ServeConfig(
+                model=model_name, max_batch_size=min(max(requests, 8), 16),
+                max_seq_len=min(prompt_len + gen_len + 16,
+                                cfg.max_position_embeddings),
+                kv_block_size=64 if on_tpu else 16,
+                kv_num_blocks=kv_blocks,
+                admission=admission,
+                dtype="bfloat16" if on_tpu else "float32"))
+
+        # one warmup engine to populate the jit cache (programs are shared
+        # across engines via jax's global compile cache keyed on shapes)
+        warm = fresh_engine()
+        warm.generate([[1, 2, 3] * (prompt_len // 3 + 1)][:1],
+                      SamplingParams(temperature=0.0, max_tokens=2))
+
+        results["serve_load"] = {"admission": admission, "open_loop": [],
+                                 "closed_loop": []}
+        for r in [float(x) for x in str(rps).split(",") if x]:
+            eng = fresh_engine()
+            out = run_poisson(eng, offered_rps=r, num_requests=requests,
+                              prompt_len=prompt_len, max_tokens=gen_len,
+                              seed=0)
+            results["serve_load"]["open_loop"].append(out.summary())
+        for c in [int(x) for x in str(concurrency).split(",") if x]:
+            eng = fresh_engine()
+            out = run_closed_loop(eng, concurrency=c, num_requests=requests,
+                                  prompt_len=prompt_len, max_tokens=gen_len,
+                                  seed=0)
+            s = out.summary()
+            s["concurrency"] = c
+            results["serve_load"]["closed_loop"].append(s)
+
     click.echo(json.dumps(results, indent=2))
 
 
@@ -203,23 +251,48 @@ def comms(pattern, size_mb, n_devices):
 
 
 @app.command()
-@click.option("--path", default="synthetic", show_default=True)
+@click.option("--path", default="synthetic", show_default=True,
+              help="'synthetic', a shard dir, or a remote scheme:// URI.")
 @click.option("--batch", default=8, show_default=True)
 @click.option("--seq-len", default=1024, show_default=True)
 @click.option("--batches", default=50, show_default=True)
-def dataloader(path, batch, seq_len, batches):
-    """Dataset streaming throughput (parity: reference bench.py:66-75)."""
-    from ...io.data import make_dataset
+@click.option("--prefetch", default=0, show_default=True,
+              help="PrefetchLoader depth (0 = synchronous).")
+@click.option("--workers", default=2, show_default=True,
+              help="Remote shard download pool size.")
+@click.option("--step-ms", default=0.0, show_default=True,
+              help="Simulated device step between fetches: reports loader "
+                   "STALL (time the step loop waits on data) — ~0 means "
+                   "the loader keeps up at this step width.")
+def dataloader(path, batch, seq_len, batches, prefetch, workers, step_ms):
+    """Dataset streaming throughput + stall under a simulated step cadence
+    (parity: reference bench.py:66-75)."""
+    from ...io.data import PrefetchLoader, make_dataset
 
-    ds = make_dataset(path, batch, seq_len, vocab_size=50304, seed=0)
+    ds = make_dataset(path, batch, seq_len, vocab_size=50304, seed=0,
+                      num_workers=workers, prefetch=prefetch)
     next(ds)  # warm
+    stall0 = ds.stall_seconds if isinstance(ds, PrefetchLoader) else None
     t0 = time.perf_counter()
+    stall_sync = 0.0
     for _ in range(batches):
+        f0 = time.perf_counter()
         next(ds)
+        stall_sync += time.perf_counter() - f0
+        if step_ms > 0:
+            time.sleep(step_ms / 1e3)      # the simulated device step
     dt = time.perf_counter() - t0
     toks = batches * batch * seq_len
-    click.echo(json.dumps({
+    out = {
         "tokens_per_sec": toks / dt,
         "batches_per_sec": batches / dt,
         "MB_per_sec": toks * 4 / dt / 1e6,
-    }, indent=2))
+    }
+    if isinstance(ds, PrefetchLoader):
+        out["stall_ms_per_batch"] = (ds.stall_seconds - stall0) / batches * 1e3
+        ds.close()
+    else:
+        out["fetch_ms_per_batch"] = stall_sync / batches * 1e3
+    if step_ms > 0:
+        out["step_ms_simulated"] = step_ms
+    click.echo(json.dumps(out, indent=2))

@@ -230,6 +230,19 @@ class OptimizerConfig:
     # precision-sensitive). Measured +0.035 MFU at gpt-750m b4 (BASELINE.md
     # round-2 sweep; batch 6 still OOMs by ~632 MB even with bf16 mu).
     moment_dtype: str = "float32"
+    # dtype of Adam's second moment (nu). bf16 frees another ~1.45 GB at
+    # gpt-750m — HBM that buys less rematerialisation or a bigger batch.
+    # Unlike mu, nu feeds an rsqrt, so bf16 storage costs ~0.4% relative
+    # error on the adaptive scale; the update still COMPUTES in fp32 and
+    # only stores rounded (loss-trajectory equivalence asserted in
+    # tests/test_exec.py). Requires fused=True (optax scale_by_adam has no
+    # nu_dtype; only the fused kernel controls nu storage).
+    nu_dtype: str = "float32"
+    # fused clip+update (exec/fused_update.py): one pass over HBM per leaf
+    # instead of optax's materialised clipped-grads + updates trees.
+    # Numerically identical to the optax chain (tests/test_exec.py);
+    # applies to adamw/adam only, other types fall back to optax.
+    fused: bool = True
     scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
 
     def validate(self) -> None:
@@ -239,6 +252,13 @@ class OptimizerConfig:
             raise ConfigError(f"suspicious learning rate {self.lr}")
         if self.moment_dtype not in ("float32", "bfloat16"):
             raise ConfigError("moment_dtype must be float32|bfloat16")
+        if self.nu_dtype not in ("float32", "bfloat16"):
+            raise ConfigError("nu_dtype must be float32|bfloat16")
+        if self.nu_dtype != "float32" and not (
+                self.fused and self.type in ("adamw", "adam")):
+            raise ConfigError(
+                "nu_dtype=bfloat16 requires fused adamw/adam (the optax "
+                "chain cannot store nu in bf16)")
 
     @classmethod
     def from_dict(cls, d: dict[str, Any] | None) -> "OptimizerConfig":
@@ -253,6 +273,8 @@ class OptimizerConfig:
             weight_decay=float(_take(d, "weight_decay", default=0.1)),
             grad_clip=float(_take(d, "grad_clip", "gradient_clipping", default=1.0)),
             moment_dtype=str(_take(d, "moment_dtype", default="float32")),
+            nu_dtype=str(_take(d, "nu_dtype", default="float32")),
+            fused=_parse_bool("fused", _take(d, "fused", default=True)),
             scheduler=SchedulerConfig.from_dict(d.get("scheduler")),
         )
         cfg.validate()
@@ -551,6 +573,17 @@ class ServeConfig:
     # decode-attention KV streaming. Dequant happens in VMEM inside the
     # paged-attention kernels.
     kv_quantization: str = "none"   # none | int8
+    # KV admission policy:
+    #   ondemand — reserve only the prompt (+ one dispatch of decode
+    #     lookahead) at admission; grow the page chain as decode advances
+    #     and PREEMPT the newest resident request (vLLM-style recompute,
+    #     re-prefilling from prefix-cached pages where possible) when the
+    #     pool runs dry. Strictly higher sustained concurrency for the
+    #     same KV budget (BASELINE.md round-3 load table).
+    #   reserve — round-2 policy: reserve prompt+max_tokens up front;
+    #     decode can never OOM, but worst-case-sized reservations strand
+    #     capacity that requests finishing early never use.
+    admission: str = "ondemand"
 
     def validate(self) -> None:
         if self.kv_quantization not in ("none", "int8"):
@@ -574,6 +607,8 @@ class ServeConfig:
             raise ConfigError("speculative_tokens must be >= 2")
         if self.scheduler not in ("continuous", "static"):
             raise ConfigError("scheduler must be continuous|static")
+        if self.admission not in ("ondemand", "reserve"):
+            raise ConfigError("admission must be ondemand|reserve")
 
     @classmethod
     def from_dict(cls, d: dict[str, Any] | None) -> "ServeConfig":

@@ -1,0 +1,14 @@
+"""Task-based evaluation harness (multiple-choice + greedy-match QA).
+
+Un-stubs the reference's ``eval run --suite --tasks`` promise
+(reference llmctl/cli/commands/eval.py:16-30, "coming soon") with a real
+standard-format scorer. See tasks.py for the JSONL schema.
+"""
+
+from .tasks import (  # noqa: F401
+    TaskExample,
+    load_task_file,
+    run_tasks,
+    score_greedy_match,
+    score_multiple_choice,
+)

@@ -1,0 +1,262 @@
+"""Standard-format task evaluation: multiple-choice by summed
+log-likelihood, greedy-match QA — the scoring conventions of
+lm-evaluation-harness, TPU-shaped (static buckets, batched forwards).
+
+Parity: the reference advertises ``llmctl eval run --suite S --tasks a,b``
+and exits with "coming soon" (reference llmctl/cli/commands/eval.py:16-30).
+This module is the real implementation behind
+``llmctl eval run --suite tasks --tasks file.jsonl``.
+
+## Task file schema (JSONL, one example per line)
+
+Multiple choice (scored by conditional log-likelihood of each choice
+continuation; reports both raw accuracy and length-normalized accuracy):
+
+    {"type": "multiple_choice",
+     "context": [12, 53, 9, ...],        # token ids (or "context_text")
+     "choices": [[4, 2], [7], [1, 1, 3]],
+     "answer": 0}
+
+Greedy match (model must greedily decode the exact target continuation;
+reports exact-match accuracy and mean matched-prefix fraction):
+
+    {"type": "greedy_match",
+     "context": [12, 53, 9, ...],
+     "target": [4, 2, 19]}
+
+Text variants: ``context_text`` / ``choices_text`` / ``target_text`` are
+tokenized with serve.tokenizer.resolve_tokenizer (local HF files if the
+artifact ships them, byte-level fallback — zero egress either way).
+
+## TPU shaping
+
+Every (context ++ continuation) row is right-padded into a power-of-two
+length bucket, and rows are scored in fixed-size batches — a handful of
+compiled programs cover an arbitrary task file. Scores are computed from
+one dense forward per batch: log_softmax over vocab, gathered at the
+continuation positions, masked, summed.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+
+@dataclass
+class TaskExample:
+    type: str                               # multiple_choice | greedy_match
+    context: list[int]
+    choices: list[list[int]] = field(default_factory=list)
+    answer: int = 0
+    target: list[int] = field(default_factory=list)
+
+
+def _tokenize_fields(d: dict, tokenizer) -> dict:
+    """Resolve *_text fields into token ids (in-place on a copy)."""
+    d = dict(d)
+    if "context" not in d and "context_text" in d:
+        d["context"] = tokenizer.encode(d["context_text"])
+    if "choices" not in d and "choices_text" in d:
+        d["choices"] = [tokenizer.encode(c) for c in d["choices_text"]]
+    if "target" not in d and "target_text" in d:
+        d["target"] = tokenizer.encode(d["target_text"])
+    return d
+
+
+def load_task_file(path: str | Path, tokenizer=None) -> list[TaskExample]:
+    """Parse a JSONL task file; raises ValueError with the offending line
+    number on schema violations (a silently-skipped example would bias the
+    reported accuracy)."""
+    examples = []
+    for lineno, line in enumerate(Path(path).read_text().splitlines(), 1):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            d = json.loads(line)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"{path}:{lineno}: invalid JSON: {e}") from e
+        if any(k.endswith("_text") for k in d):
+            if tokenizer is None:
+                from ..serve.tokenizer import load_tokenizer
+                tokenizer = load_tokenizer(None, vocab_size=1 << 30)
+            d = _tokenize_fields(d, tokenizer)
+        t = d.get("type")
+        if t == "multiple_choice":
+            if not d.get("choices") or "answer" not in d:
+                raise ValueError(f"{path}:{lineno}: multiple_choice needs "
+                                 "'choices' and 'answer'")
+            if not 0 <= d["answer"] < len(d["choices"]):
+                raise ValueError(f"{path}:{lineno}: answer index "
+                                 f"{d['answer']} out of range")
+            examples.append(TaskExample(
+                type=t, context=[int(x) for x in d["context"]],
+                choices=[[int(x) for x in c] for c in d["choices"]],
+                answer=int(d["answer"])))
+        elif t == "greedy_match":
+            if not d.get("target"):
+                raise ValueError(f"{path}:{lineno}: greedy_match needs "
+                                 "'target'")
+            examples.append(TaskExample(
+                type=t, context=[int(x) for x in d["context"]],
+                target=[int(x) for x in d["target"]]))
+        else:
+            raise ValueError(f"{path}:{lineno}: unknown task type {t!r}")
+    if not examples:
+        raise ValueError(f"{path}: no examples")
+    return examples
+
+
+def _bucket(n: int, lo: int = 32) -> int:
+    b = lo
+    while b < n:
+        b <<= 1
+    return b
+
+
+def _continuation_logprobs(params, cfg, rows: list[tuple[list[int],
+                                                         list[int]]],
+                           batch_size: int = 16) -> list[float]:
+    """Summed log p(continuation | context) for each (context, cont) row.
+
+    One dense forward per padded batch; positions are scored where the
+    model PREDICTS the continuation token (logits index ctx+j-1).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import gpt
+
+    @jax.jit
+    def score(params, toks, start, length):
+        logits = gpt.forward(params, toks, cfg)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        S = toks.shape[1]
+        pos = jnp.arange(S)[None, :]                       # [1, S]
+        # token at index i is predicted by logits at i-1
+        tgt = jnp.roll(toks, -1, axis=1)                   # tgt[i] = toks[i+1]
+        per = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+        mask = (pos >= start[:, None] - 1) & (pos < (start + length)[:, None] - 1)
+        return jnp.sum(per * mask, axis=1)
+
+    out: list[float] = []
+    order = sorted(range(len(rows)),
+                   key=lambda i: _bucket(len(rows[i][0]) + len(rows[i][1])))
+    for i0 in range(0, len(order), batch_size):
+        chunk = order[i0:i0 + batch_size]
+        B = _bucket(max(len(rows[i][0]) + len(rows[i][1]) for i in chunk))
+        toks = np.zeros((len(chunk), B), np.int32)
+        start = np.zeros(len(chunk), np.int32)
+        length = np.zeros(len(chunk), np.int32)
+        for j, i in enumerate(chunk):
+            ctx, cont = rows[i]
+            seq = ctx + cont
+            toks[j, :len(seq)] = seq
+            start[j], length[j] = len(ctx), len(cont)
+        s = np.asarray(score(params, jnp.asarray(toks), jnp.asarray(start),
+                             jnp.asarray(length)))
+        out.extend(zip(chunk, s.tolist()))
+    out.sort(key=lambda t: t[0])
+    return [s for _, s in out]
+
+
+def score_multiple_choice(params, cfg, examples: Sequence[TaskExample],
+                          batch_size: int = 16) -> dict:
+    """Accuracy (summed ll) + length-normalized accuracy (ll / len)."""
+    mc = [e for e in examples if e.type == "multiple_choice"]
+    if not mc:
+        return {}
+    rows, spans = [], []
+    for e in mc:
+        spans.append((len(rows), len(e.choices)))
+        rows.extend((e.context, c) for c in e.choices)
+    lls = _continuation_logprobs(params, cfg, rows, batch_size)
+    correct = correct_norm = 0
+    for e, (off, k) in zip(mc, spans):
+        scores = lls[off:off + k]
+        norm = [s / max(len(c), 1) for s, c in zip(scores, e.choices)]
+        correct += int(int(np.argmax(scores)) == e.answer)
+        correct_norm += int(int(np.argmax(norm)) == e.answer)
+    return {
+        "examples": len(mc),
+        "acc": correct / len(mc),
+        "acc_norm": correct_norm / len(mc),
+    }
+
+
+def score_greedy_match(params, cfg, examples: Sequence[TaskExample],
+                       batch_size: int = 16) -> dict:
+    """Greedy-decode len(target) tokens from each context; exact match +
+    mean matched-prefix fraction. Decoding recomputes the full prefix per
+    step (dense forward) — eval is offline, simplicity wins over a KV
+    cache here; the serving engine is the fast path."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import gpt
+
+    gm = [e for e in examples if e.type == "greedy_match"]
+    if not gm:
+        return {}
+
+    @jax.jit
+    def next_tok(params, toks, length):
+        logits = gpt.forward(params, toks, cfg)
+        idx = jnp.maximum(length - 1, 0)
+        rows = jnp.take_along_axis(
+            logits, idx[:, None, None].repeat(logits.shape[-1], -1),
+            axis=1)[:, 0]
+        return jnp.argmax(rows, axis=-1).astype(jnp.int32)
+
+    exact = 0
+    prefix_frac = 0.0
+    for i0 in range(0, len(gm), batch_size):
+        chunk = gm[i0:i0 + batch_size]
+        T = max(len(e.target) for e in chunk)
+        B = _bucket(max(len(e.context) for e in chunk) + T)
+        toks = np.zeros((len(chunk), B), np.int32)
+        length = np.zeros(len(chunk), np.int32)
+        for j, e in enumerate(chunk):
+            toks[j, :len(e.context)] = e.context
+            length[j] = len(e.context)
+        outs = [[] for _ in chunk]
+        for _ in range(T):
+            nxt = np.asarray(next_tok(params, jnp.asarray(toks),
+                                      jnp.asarray(length)))
+            for j in range(len(chunk)):
+                if len(outs[j]) < len(chunk[j].target):
+                    outs[j].append(int(nxt[j]))
+                    toks[j, length[j]] = int(nxt[j])
+                    length[j] += 1
+        for e, o in zip(chunk, outs):
+            match = 0
+            for a, b in zip(o, e.target):
+                if a != b:
+                    break
+                match += 1
+            exact += int(match == len(e.target))
+            prefix_frac += match / len(e.target)
+    return {
+        "examples": len(gm),
+        "exact_match": exact / len(gm),
+        "prefix_match": prefix_frac / len(gm),
+    }
+
+
+def run_tasks(params, cfg, path: str | Path, tokenizer=None,
+              batch_size: int = 16) -> dict:
+    """Score one task file; returns {file, n, multiple_choice?, greedy?}."""
+    examples = load_task_file(path, tokenizer)
+    out: dict[str, Any] = {"file": str(path), "examples": len(examples)}
+    mc = score_multiple_choice(params, cfg, examples, batch_size)
+    if mc:
+        out["multiple_choice"] = mc
+    gm = score_greedy_match(params, cfg, examples, batch_size)
+    if gm:
+        out["greedy_match"] = gm
+    return out

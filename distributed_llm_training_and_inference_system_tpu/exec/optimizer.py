@@ -39,13 +39,28 @@ def make_optimizer(cfg: OptimizerConfig) -> tuple[optax.GradientTransformation,
     if cfg.type in ("adamw", "adam"):
         wd = cfg.weight_decay if cfg.type == "adamw" else 0.0
         tx = optax.chain(
-            # mu_dtype=bfloat16 halves the first-moment buffer; nu stays
-            # fp32 (rsqrt precision) — see OptimizerConfig.moment_dtype
+            # mu_dtype=bfloat16 halves the first-moment buffer; nu dtype is
+            # handled below (optax has no nu_dtype; only the fused kernel
+            # can store nu rounded) — see OptimizerConfig.moment_dtype
             optax.scale_by_adam(b1=cfg.betas[0], b2=cfg.betas[1], eps=cfg.eps,
                                 mu_dtype=jnp.dtype(cfg.moment_dtype)),
             optax.add_decayed_weights(wd, mask=_decay_mask) if wd else optax.identity(),
             optax.scale_by_learning_rate(schedule),
         )
+        if cfg.nu_dtype != "float32":
+            # bf16 nu storage (validate() guarantees the fused path, which
+            # preserves leaf dtypes): cast at init, the only place the
+            # optax tx still runs
+            inner_init = tx.init
+
+            def init_with_cast(params):
+                state = inner_init(params)
+                adam = state[0]
+                nu = jax.tree_util.tree_map(
+                    lambda x: x.astype(jnp.dtype(cfg.nu_dtype)), adam.nu)
+                return (adam._replace(nu=nu),) + tuple(state[1:])
+
+            tx = optax.GradientTransformation(init_with_cast, tx.update)
     elif cfg.type == "lion":
         tx = optax.chain(
             optax.scale_by_lion(b1=cfg.betas[0], b2=cfg.betas[1]),

@@ -59,7 +59,9 @@ class TrainingEngine:
         self.train_data = make_dataset(
             cfg.data.train, per_host_batch, cfg.data.max_length,
             cfg.model.vocab_size, seed=cfg.data.seed, host_id=host_id,
-            num_hosts=num_hosts, pack=cfg.data.pack_sequences)
+            num_hosts=num_hosts, pack=cfg.data.pack_sequences,
+            num_workers=cfg.data.num_workers,
+            prefetch=cfg.data.prefetch_factor)
         self.val_data = make_dataset(
             cfg.data.val, per_host_batch, cfg.data.max_length,
             cfg.model.vocab_size, seed=cfg.data.seed + 1, host_id=host_id,

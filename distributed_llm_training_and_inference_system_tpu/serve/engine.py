@@ -156,6 +156,11 @@ class InferenceEngine:
         self._slot_keys = np.zeros((S, 2), np.uint32)
         self._base_seed = seed
         self._admitted_counter = 0
+        # admission sequence per slot: preemption victims are chosen
+        # newest-first so the oldest resident request always progresses
+        # (global progress guarantee under on-demand admission)
+        self._slot_seq = np.zeros(S, np.int64)
+        self.total_preemptions = 0
         # per-slot incremental context (prompt + accepted tokens) for the
         # speculative draft proposer — rebuilding prompt+generated lists
         # per dispatch is O(context) host work in the latency-critical loop
@@ -209,20 +214,42 @@ class InferenceEngine:
 
     # -- prefill -------------------------------------------------------------
 
+    @property
+    def _decode_lookahead(self) -> int:
+        """Tokens one device dispatch may write per slot: the page-growth
+        horizon for on-demand admission."""
+        k = max(self.serve_cfg.decode_steps_per_dispatch, 1)
+        if self.serve_cfg.speculative == "ngram":
+            k = max(k, self.serve_cfg.speculative_tokens)
+        return k
+
+    def _admission_tail(self, req: Request) -> int:
+        """Tokens beyond the prefill context that admission must cover.
+
+        reserve: the full generation budget (prompt+max_tokens pages held
+        for the request's whole life — round-2 policy).
+        ondemand: one dispatch of decode lookahead; later pages are
+        allocated as decode advances (_ensure_decode_capacity), with
+        preemption on pool exhaustion."""
+        if self.serve_cfg.admission == "reserve":
+            return req.remaining_tokens
+        return min(self._decode_lookahead, req.remaining_tokens)
+
     def _try_reserve(self, req: Request) -> bool:
         """Admission hook (runs under self.lock inside admit()): reserve the
-        request's full KV footprint so concurrent admissions can't
-        collectively over-commit the page pool. With prefix caching, cached
-        prompt pages are pinned here (they stop being evictable) and only
-        the remainder is reserved."""
-        n = req.num_prompt_tokens
+        request's admission KV footprint (_admission_tail) so concurrent
+        admissions can't collectively over-commit the page pool. With prefix
+        caching, cached context pages are pinned here (they stop being
+        evictable) and only the remainder is reserved."""
+        ctx = req.context_tokens   # prompt, + generated after a preemption
+        n = len(ctx)
         pins: list[int] = []
         usable = 0
         if self.serve_cfg.prefix_caching:
             if req.prefix_hashes is None:      # once per request, not per retry
                 from .kv_cache import prefix_page_hashes
                 req.prefix_hashes = prefix_page_hashes(
-                    req.prompt_tokens, self.kv.page_size)
+                    ctx, self.kv.page_size)
             # keep >=1 suffix token: the last prompt token must be
             # re-processed to produce the first sampled token's logits
             usable = min(len(req.prefix_hashes),
@@ -246,7 +273,7 @@ class InferenceEngine:
         # allocation later OOMs in _prefill (over-commit)
         if pins:
             self.kv.pin_pages(pins)
-        need = self.kv.pages_needed(n + req.sampling.max_tokens) - len(pins)
+        need = self.kv.pages_needed(n + self._admission_tail(req)) - len(pins)
         if need > self.kv.free_pages - self._reserved_pages:
             if pins:
                 self.kv.unpin_pages(pins)
@@ -368,27 +395,31 @@ class InferenceEngine:
         return self._prefill_cache[key_]
 
     def _start_chunked_prefill(self, req: Request) -> None:
-        """Allocate the slot's pages and enqueue the prompt for chunk-at-a-
+        """Allocate the slot's pages and enqueue the context for chunk-at-a-
         time prefill (one chunk per engine step, interleaved with decode)."""
-        slot, n = req.slot, req.num_prompt_tokens
+        slot = req.slot
+        ctx = req.context_tokens
+        n = len(ctx)
         rid = req.request_id
         with self.lock:
             pins = self._prefix_pins.get(rid, [])
-            self.kv.allocate(slot, n + req.sampling.max_tokens,
+            self.kv.allocate(slot, n + self._admission_tail(req),
                              prefix_pages=pins)
             self._reserved_pages -= self._reserved_by.pop(rid, 0)
             self._req_slot[rid] = slot
             table_row = self.kv.block_tables[slot].copy()
         s = req.sampling
-        seed = s.seed if s.seed is not None else (
-            self._base_seed + self._admitted_counter)
+        if req.assigned_seed is None:
+            req.assigned_seed = s.seed if s.seed is not None else (
+                self._base_seed + self._admitted_counter)
         self._admitted_counter += 1
-        slot_key = jax.random.PRNGKey(seed)
+        self._slot_seq[slot] = self._admitted_counter
+        slot_key = jax.random.PRNGKey(req.assigned_seed)
         self._slot_keys[slot] = np.asarray(jax.random.key_data(slot_key))
         cached = len(pins) * self.kv.page_size
         self.total_prefix_cached_tokens += cached
         self._partial_prefills[rid] = {
-            "req": req, "done": cached, "pins": len(pins),
+            "req": req, "ctx": ctx, "done": cached, "pins": len(pins),
             "table_row": table_row, "slot_key": slot_key}
 
     def _advance_chunked_prefills(self) -> list:
@@ -419,7 +450,8 @@ class InferenceEngine:
                     self.scheduler.abort_prefill(rid)   # frees slot + pages
                 del self._partial_prefills[rid]
                 continue
-            n, done = req.num_prompt_tokens, st["done"]
+            ctx = st["ctx"]
+            n, done = len(ctx), st["done"]
             this = min(n - done, C)
             # charge what the program actually computes — the padded
             # suffix bucket — not the raw token count (a 33-token final
@@ -432,7 +464,7 @@ class InferenceEngine:
             spent += cost
             bucket = self._suffix_bucket(this)
             tokens = np.zeros((1, bucket), np.int32)
-            tokens[0, :this] = req.prompt_tokens[done:done + this]
+            tokens[0, :this] = ctx[done:done + this]
             common = (self.params, jnp.asarray(tokens),
                       jnp.asarray([done], jnp.int32),
                       jnp.asarray([this], jnp.int32),
@@ -467,16 +499,18 @@ class InferenceEngine:
         The first-token fetch is DEFERRED (_finish_prefill) so a burst of
         admitted prompts pays one host round trip total, not one per
         prompt — dispatches pipeline on-device."""
-        slot, n = req.slot, req.num_prompt_tokens
+        slot = req.slot
+        ctx = req.context_tokens   # prompt, + generated after a preemption
+        n = len(ctx)
         rid = req.request_id
         PS = self.kv.page_size
         with self.lock:   # page bookkeeping is shared with cancel/release
             pins = self._prefix_pins.get(rid, [])
-            self.kv.allocate(slot, n + req.sampling.max_tokens,
+            self.kv.allocate(slot, n + self._admission_tail(req),
                              prefix_pages=pins)
             self._reserved_pages -= self._reserved_by.pop(rid, 0)
             self._req_slot[rid] = slot
-            cached = len(pins) * PS       # prompt tokens served from cache
+            cached = len(pins) * PS       # context tokens served from cache
             if cached == 0:
                 # table entries for the bucket: beyond-length -> scratch 0
                 bucket = self._bucket(n)
@@ -486,16 +520,18 @@ class InferenceEngine:
             table_row = self.kv.block_tables[slot].copy()
 
         s = req.sampling
-        seed = s.seed if s.seed is not None else (
-            self._base_seed + self._admitted_counter)
+        if req.assigned_seed is None:
+            req.assigned_seed = s.seed if s.seed is not None else (
+                self._base_seed + self._admitted_counter)
         self._admitted_counter += 1
-        slot_key = jax.random.PRNGKey(seed)
+        self._slot_seq[slot] = self._admitted_counter  # preemption priority
+        slot_key = jax.random.PRNGKey(req.assigned_seed)
         self._slot_keys[slot] = np.asarray(jax.random.key_data(slot_key))
         first_key = jax.random.fold_in(slot_key, n)
 
         if cached == 0:
             tokens = np.zeros((1, bucket), np.int32)
-            tokens[0, :n] = req.prompt_tokens
+            tokens[0, :n] = ctx
             token, self.kv.k_pages, self.kv.v_pages = self._prefill_fn(bucket)(
                 self.params, jnp.asarray(tokens), jnp.asarray([n], jnp.int32),
                 self.kv.k_pages, self.kv.v_pages, jnp.asarray(entries),
@@ -506,7 +542,7 @@ class InferenceEngine:
             computed = n - cached
             bucket = self._suffix_bucket(computed)
             tokens = np.zeros((1, bucket), np.int32)
-            tokens[0, :computed] = req.prompt_tokens[cached:]
+            tokens[0, :computed] = ctx[cached:]
             token, self.kv.k_pages, self.kv.v_pages = \
                 self._extend_prefill_fn(bucket)(
                     self.params, jnp.asarray(tokens),
@@ -532,7 +568,9 @@ class InferenceEngine:
     def _finish_prefill(self, req: Request, token) -> None:
         """Resolve a dispatched prefill: fetch its first token and make the
         slot live for decode."""
-        slot, n = req.slot, req.num_prompt_tokens
+        slot = req.slot
+        ctx = req.context_tokens       # BEFORE recording the new token
+        n = len(ctx)
         s = req.sampling
         req.record_token(int(token))
         if self.on_token is not None:
@@ -540,14 +578,16 @@ class InferenceEngine:
         from .scheduler import RequestState
         req.state = RequestState.RUNNING
         self.last_tokens[slot] = int(token)
-        self._ctx[slot, :n] = req.prompt_tokens
+        self._ctx[slot, :n] = ctx
         self._ctx[slot, n] = int(token)
         self._ctx_len[slot] = n + 1
         self.positions[slot] = n
-        # first position this slot may NOT write: its page reservation
-        # covers prompt + max_tokens, and multi-step decode masks writes
-        # at/past this bound to scratch page 0
-        self.stop_positions[slot] = n + s.max_tokens
+        # first position this slot may NOT write: absolute generation cap
+        # (prompt + max_tokens); multi-step decode masks writes at/past
+        # this bound to scratch page 0. Under on-demand admission the
+        # PHYSICAL page chain may be shorter — _ensure_decode_capacity
+        # grows it one dispatch ahead of the write frontier.
+        self.stop_positions[slot] = req.num_prompt_tokens + s.max_tokens
         self.active[slot] = True
         self.temperature[slot] = s.temperature
         self.top_k[slot] = s.top_k
@@ -708,6 +748,74 @@ class InferenceEngine:
 
     # -- lifecycle -----------------------------------------------------------
 
+    def _preempt(self, slot: int) -> None:
+        """Evict ``slot``'s RUNNING request (newest-first victim policy) so
+        an older stream can grow its page chain. Recompute-style: the
+        request re-enters the waiting queue head and re-prefills
+        prompt+generated on readmission — from prefix-cached pages when
+        caching is on (its fully-written pages are published here, so a
+        prompt re-prefill is usually just the last partial page).
+
+        Caller holds self.lock."""
+        req = self.scheduler.slots[slot]
+        rid = req.request_id
+        written = int(self.positions[slot])   # KV entries actually present
+        if self.serve_cfg.prefix_caching:
+            from .kv_cache import prefix_page_hashes
+            ctx = req.context_tokens
+            full = written // self.kv.page_size
+            hashes = prefix_page_hashes(ctx[:full * self.kv.page_size],
+                                        self.kv.page_size)
+            table = self.kv.block_tables[slot]
+            # register BEFORE release: released pages that carry a hash
+            # stay evictable (content kept) instead of returning to _free
+            self.kv.register_pages(
+                [(hashes[j], int(table[j])) for j in range(full)])
+        pins = self._prefix_pins.pop(rid, None)
+        self.kv.release(slot)
+        if pins:
+            self.kv.unpin_pages(pins)
+        self._req_slot.pop(rid, None)
+        self.active[slot] = False
+        self.positions[slot] = 0
+        self.stop_positions[slot] = 0
+        self._ctx_len[slot] = 0
+        self.scheduler.preempt_slot(slot)
+        self.total_preemptions += 1
+        logger.info("preempted %s (slot %d, %d tokens generated) to free "
+                    "KV pages", rid, slot, len(req.generated_tokens))
+
+    def _ensure_decode_capacity(self) -> None:
+        """Grow every active slot's page chain to cover the next dispatch's
+        writes (on-demand admission). Oldest slots grow first; when the
+        pool is dry the newest resident request is preempted and the grow
+        retried — the oldest stream can always advance, so the system
+        drains even at 100% KV pressure.
+
+        Caller holds self.lock."""
+        if self.serve_cfg.admission != "ondemand":
+            return
+        k = self._decode_lookahead
+        order = sorted(np.flatnonzero(self.active),
+                       key=lambda i: self._slot_seq[i])
+        for i in order:
+            i = int(i)
+            if not self.active[i]:      # already preempted as a victim
+                continue
+            target = min(int(self.positions[i]) + k,
+                         int(self.stop_positions[i]))
+            while not self.kv.extend_slot(i, target):
+                victims = [int(j) for j in np.flatnonzero(self.active)
+                           if int(j) != i]
+                if not victims:
+                    # alone and still can't grow: this request's own
+                    # footprint exceeds the pool — admission's
+                    # can_ever_allocate bounds prompt+max_tokens, so only
+                    # reachable with a pool smaller than one request
+                    self._preempt(i)
+                    break
+                self._preempt(max(victims, key=lambda j: self._slot_seq[j]))
+
     def _on_release(self, req: Request) -> None:
         # admitted-but-never-prefilled (cancel/failure before _prefill):
         # return the admission reservation so capacity can't leak
@@ -745,7 +853,12 @@ class InferenceEngine:
         C = self.serve_cfg.chunked_prefill_tokens
         pending = []
         for req in admitted:
-            if C > 0 and req.num_prompt_tokens > C:
+            # route on the full re-prefill CONTEXT: a preempted request
+            # resumes with prompt+generated, which can exceed the chunk
+            # threshold even when the original prompt didn't — and the
+            # high-KV-pressure regime that preempts is exactly where a
+            # dense multi-thousand-token dispatch would stall residents
+            if C > 0 and len(req.context_tokens) > C:
                 self._start_chunked_prefill(req)
             else:
                 pending.append(self._prefill(req))
@@ -758,6 +871,11 @@ class InferenceEngine:
             with self.lock:
                 # prompt-is-whole-request edge: finished on the first token
                 self.scheduler.step_finished(self.eos_token_id)
+        with self.lock:
+            # on-demand admission: make sure every active slot has pages
+            # for one dispatch of writes, preempting newest-first if the
+            # pool is dry — BEFORE the dispatch reads the block tables
+            self._ensure_decode_capacity()
         if any(self.active):
             # speculative path only when a greedy stream is resident: for
             # sampled rows a verify dispatch yields 1 token vs K from
@@ -863,6 +981,8 @@ class InferenceEngine:
             "quantization": self.serve_cfg.quantization,
             **self.scheduler.stats(),
             "kv": self.kv.stats(),
+            "admission": self.serve_cfg.admission,
+            "preemptions": self.total_preemptions,
             "decode_steps": self.total_decode_steps,
             "prefill_tokens": self.total_prefill_tokens,
             "prefix_cached_tokens": self.total_prefix_cached_tokens,

@@ -92,6 +92,7 @@ class PagedKVCache:
         # host-side state; page 0 is scratch and never allocated
         self._free: list[int] = list(range(1, num_pages))
         self._owned: dict[int, list[int]] = {}            # slot -> pages
+        self._chain_len: dict[int, int] = {}   # slot -> table entries used
         self.block_tables = np.zeros((num_slots, self.max_pages_per_slot),
                                      np.int32)
 
@@ -189,11 +190,37 @@ class PagedKVCache:
         table = list(prefix_pages) + pages
         self.block_tables[slot, :] = 0
         self.block_tables[slot, :len(table)] = table
+        self._chain_len[slot] = len(table)
+
+    def slot_capacity_tokens(self, slot: int) -> int:
+        """Tokens the slot's current page chain can hold."""
+        return self._chain_len.get(slot, 0) * self.page_size
+
+    def extend_slot(self, slot: int, num_tokens: int) -> bool:
+        """Grow ``slot``'s chain to cover ``num_tokens`` (on-demand
+        admission). Returns False — allocating nothing — if the pool can't
+        supply every page needed; the engine then preempts a victim and
+        retries. All-or-nothing keeps the failure path trivial: no partial
+        growth to unwind."""
+        need = self.pages_needed(num_tokens) - self._chain_len.get(slot, 0)
+        if need <= 0:
+            return True
+        if need > self.free_pages:
+            return False
+        start = self._chain_len.get(slot, 0)
+        pages = [self._take_free_page() for _ in range(need)]
+        for p in pages:
+            self._ref[p] = 1
+        self._owned.setdefault(slot, []).extend(pages)
+        self.block_tables[slot, start:start + need] = pages
+        self._chain_len[slot] = start + need
+        return True
 
     def release(self, slot: int) -> None:
         for page in self._owned.pop(slot, []):
             self._drop_ref(page)
         self.block_tables[slot, :] = 0
+        self._chain_len.pop(slot, None)
 
     # -- prefix cache --------------------------------------------------------
 

@@ -1,0 +1,175 @@
+"""Open-loop load generation for the inference engine.
+
+The reference's serving defect (SURVEY §2.4.1) was a *queueing-regime*
+failure: its scheduler popped a batch once and never re-enqueued, which no
+4-request smoke test can expose. This module drives the engine the way a
+production front-end does — open-loop (Poisson) arrivals that do NOT wait
+for earlier requests, so offered load is independent of service rate — and
+reports the latency/goodput distributions that regime produces.
+
+Used by ``llmctl bench e2e --mode serve-load`` (cli/commands/bench.py) and
+tests/test_serve_load.py. Pure host-side: drives ``InferenceEngine.step()``
+directly (no HTTP), so the numbers isolate engine behaviour from the web
+stack.
+
+Metrics per run:
+  - p50/p99 TTFT (wall, arrival -> first token)
+  - p50/p99 per-output-token latency (TPOT: (finish-first_token)/(n-1))
+  - goodput: completed output tokens / wall time
+  - preemptions, KV-pool high-water mark, queue depth high-water mark
+
+Methodology notes:
+  - arrivals are a seeded exponential process (rate = ``offered_rps``);
+    the engine keeps stepping until every admitted request finishes, so
+    late-arrival tail latency is fully counted.
+  - ``concurrency`` variant instead keeps a fixed number in flight
+    (closed-loop), the standard saturation probe.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from .engine import InferenceEngine
+from .scheduler import Request, RequestState, SamplingParams
+
+
+@dataclass
+class LoadResult:
+    offered_rps: float
+    completed: int = 0
+    failed: int = 0
+    duration_s: float = 0.0
+    ttft_ms: list = field(default_factory=list)
+    tpot_ms: list = field(default_factory=list)
+    preemptions: int = 0
+    queue_peak: int = 0
+    goodput_tokens_per_s: float = 0.0
+
+    def percentile(self, xs, q):
+        return float(np.percentile(np.asarray(xs), q)) if xs else float("nan")
+
+    def summary(self) -> dict:
+        return {
+            # None for closed-loop runs (offered load is "as fast as the
+            # engine finishes"); a float('inf') here would serialize as
+            # the non-standard JSON token Infinity
+            "offered_rps": (round(self.offered_rps, 3)
+                            if np.isfinite(self.offered_rps) else None),
+            "completed": self.completed,
+            "failed": self.failed,
+            "duration_s": round(self.duration_s, 2),
+            "p50_ttft_ms": round(self.percentile(self.ttft_ms, 50), 1),
+            "p99_ttft_ms": round(self.percentile(self.ttft_ms, 99), 1),
+            "p50_tpot_ms": round(self.percentile(self.tpot_ms, 50), 2),
+            "p99_tpot_ms": round(self.percentile(self.tpot_ms, 99), 2),
+            "goodput_tok_s": round(self.goodput_tokens_per_s, 1),
+            "preemptions": self.preemptions,
+            "queue_peak": self.queue_peak,
+        }
+
+
+def _finalize(res: LoadResult, reqs: list, engine: InferenceEngine,
+              t0: float) -> LoadResult:
+    res.duration_s = time.monotonic() - t0
+    done_tokens = 0
+    for r in reqs:
+        if r.state is RequestState.FINISHED:
+            res.completed += 1
+            done_tokens += len(r.generated_tokens)
+            if r.ttft_ms is not None:
+                res.ttft_ms.append(r.ttft_ms)
+            if len(r.generated_tokens) > 1 and r.finish_time is not None \
+                    and r.first_token_time is not None:
+                res.tpot_ms.append(
+                    (r.finish_time - r.first_token_time) * 1000.0
+                    / (len(r.generated_tokens) - 1))
+        elif r.state in (RequestState.FAILED, RequestState.CANCELLED):
+            res.failed += 1
+    res.preemptions = engine.total_preemptions
+    res.goodput_tokens_per_s = done_tokens / max(res.duration_s, 1e-9)
+    return res
+
+
+def run_poisson(engine: InferenceEngine, *, offered_rps: float,
+                num_requests: int, prompt_len: int, max_tokens: int,
+                seed: int = 0, vocab_hi: Optional[int] = None,
+                prompt_pool: int = 0) -> LoadResult:
+    """Open-loop run: arrivals follow a seeded Poisson process regardless of
+    engine progress; steps until everything admitted drains.
+
+    ``prompt_pool > 0`` draws prompts from that many distinct prompts
+    (prefix-cache-friendly workloads); 0 = every prompt unique."""
+    rng = np.random.default_rng(seed)
+    hi = vocab_hi or engine.cfg.vocab_size
+    gaps = rng.exponential(1.0 / offered_rps, size=num_requests)
+    arrivals = np.cumsum(gaps)
+    pool = [rng.integers(1, hi, size=prompt_len).tolist()
+            for _ in range(max(prompt_pool, 1))]
+
+    reqs: list[Request] = []
+    res = LoadResult(offered_rps=offered_rps)
+    t0 = time.monotonic()
+    i = 0
+    while i < num_requests or engine.scheduler.active_count > 0 \
+            or engine.scheduler.queue_depth > 0 or engine._partial_prefills:
+        now = time.monotonic() - t0
+        while i < num_requests and arrivals[i] <= now:
+            prompt = (pool[int(rng.integers(len(pool)))] if prompt_pool
+                      else rng.integers(1, hi, size=prompt_len).tolist())
+            r = Request(request_id=f"load-{i}", prompt_tokens=prompt,
+                        sampling=SamplingParams(temperature=0.0,
+                                                max_tokens=max_tokens))
+            if engine.scheduler.add_request(r):
+                reqs.append(r)
+            else:
+                res.failed += 1
+            i += 1
+        res.queue_peak = max(res.queue_peak, engine.scheduler.queue_depth)
+        if engine.step() == 0 and i < num_requests:
+            # idle before the next arrival: sleep to it instead of spinning
+            wait = arrivals[i] - (time.monotonic() - t0)
+            if wait > 0:
+                time.sleep(min(wait, 0.05))
+    return _finalize(res, reqs, engine, t0)
+
+
+def run_closed_loop(engine: InferenceEngine, *, concurrency: int,
+                    num_requests: int, prompt_len: int, max_tokens: int,
+                    seed: int = 0, vocab_hi: Optional[int] = None) -> LoadResult:
+    """Closed-loop run: keep ``concurrency`` requests in flight (a new one
+    arrives the moment one finishes) — the standard saturation probe."""
+    rng = np.random.default_rng(seed)
+    hi = vocab_hi or engine.cfg.vocab_size
+    reqs: list[Request] = []
+    res = LoadResult(offered_rps=float("inf"))
+    submitted = 0
+    t0 = time.monotonic()
+
+    def submit():
+        nonlocal submitted
+        r = Request(request_id=f"load-{submitted}",
+                    prompt_tokens=rng.integers(
+                        1, hi, size=prompt_len).tolist(),
+                    sampling=SamplingParams(temperature=0.0,
+                                            max_tokens=max_tokens))
+        submitted += 1
+        if engine.scheduler.add_request(r):
+            reqs.append(r)
+        else:
+            res.failed += 1
+
+    in_flight = lambda: sum(  # noqa: E731
+        1 for r in reqs if r.state in (RequestState.QUEUED,
+                                       RequestState.PREFILLING,
+                                       RequestState.RUNNING))
+    while submitted < num_requests or in_flight() > 0:
+        while submitted < num_requests and in_flight() < concurrency:
+            submit()
+        res.queue_peak = max(res.queue_peak, engine.scheduler.queue_depth)
+        engine.step()
+    return _finalize(res, reqs, engine, t0)

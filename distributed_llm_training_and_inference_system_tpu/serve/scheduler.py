@@ -53,8 +53,15 @@ class Request:
     cancel_requested: bool = False
     # full-page chain hashes of the prompt, computed once at first admission
     # attempt (engine._try_reserve) — lives on the request so a queued
-    # request retried every step doesn't rehash its prompt under the lock
+    # request retried every step doesn't rehash its prompt under the lock.
+    # Reset on preemption: the resumed context (prompt + generated so far)
+    # has a longer chain.
     prefix_hashes: Optional[list] = field(default=None, repr=False)
+    # PRNG seed fixed at FIRST prefill so a preempted-and-resumed sampled
+    # request continues the same per-position key stream (deterministic
+    # across preemption)
+    assigned_seed: Optional[int] = None
+    preemptions: int = 0
     arrival_time: float = field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None   # for TTFT
     finish_time: Optional[float] = None
@@ -68,6 +75,18 @@ class Request:
     @property
     def total_len(self) -> int:
         return len(self.prompt_tokens) + len(self.generated_tokens)
+
+    @property
+    def context_tokens(self) -> list[int]:
+        """Prefill input: the prompt, plus — after a preemption — every
+        token already generated (recompute-style resume)."""
+        if self.generated_tokens:
+            return self.prompt_tokens + self.generated_tokens
+        return self.prompt_tokens
+
+    @property
+    def remaining_tokens(self) -> int:
+        return self.sampling.max_tokens - len(self.generated_tokens)
 
     @property
     def ttft_ms(self) -> Optional[float]:
@@ -239,9 +258,27 @@ class ContinuousBatchingScheduler:
             req.state = RequestState.PREFILLING
             self.slots[slot] = req
             admitted.append(req)
-            spent += req.num_prompt_tokens
+            # resumed (preempted) requests re-prefill prompt+generated
+            spent += len(req.context_tokens)
             self.total_admitted += 1
         return admitted
+
+    def preempt_slot(self, slot: int) -> Optional[Request]:
+        """Evict the RUNNING request in ``slot`` back to the FRONT of the
+        waiting queue (vLLM-style recompute preemption). The caller (engine)
+        releases the slot's KV pages itself — ``_on_release`` is NOT fired,
+        because the request is not finished and its waiter must keep
+        waiting. Returns the evicted request."""
+        r = self.slots[slot]
+        if r is None:
+            return None
+        self.slots[slot] = None
+        r.slot = None
+        r.state = RequestState.QUEUED
+        r.preemptions += 1
+        r.prefix_hashes = None       # context grew; chain must be rehashed
+        self.waiting.appendleft(r)
+        return r
 
     def running(self) -> list[Request]:
         return [r for r in self.slots if r is not None and r.state == RequestState.RUNNING]

@@ -22,6 +22,8 @@ def main() -> None:
     model_name = sys.argv[3] if len(sys.argv) > 3 else "gpt-750m"
     moment_dtype = sys.argv[4] if len(sys.argv) > 4 else "float32"
     loss_chunk = int(sys.argv[5]) if len(sys.argv) > 5 else 512
+    fused = (sys.argv[6].lower() in ("1", "true", "fused")
+             if len(sys.argv) > 6 else True)
 
     import jax
 
@@ -39,7 +41,8 @@ def main() -> None:
     par = ParallelConfig(activation_checkpoint=remat,
                          micro_batch_size=batch, global_batch_size=batch)
     step_fn, tx, _ = make_train_step(
-        cfg, OptimizerConfig(lr=1e-4, moment_dtype=moment_dtype), par,
+        cfg, OptimizerConfig(lr=1e-4, moment_dtype=moment_dtype,
+                             fused=fused), par,
         attn_impl="flash", loss_chunk=loss_chunk)
     params = init(cfg, jax.random.PRNGKey(0))
     state = TrainState.create(params, tx)
@@ -64,6 +67,7 @@ def main() -> None:
     mfu = tokens_per_sec * flops_per_token(cfg, seq_len) / (peak_tflops * 1e12)
     print(json.dumps({"model": model_name, "batch": batch, "remat": remat,
                       "moment_dtype": moment_dtype, "loss_chunk": loss_chunk,
+                      "fused": fused,
                       "step_ms": round(dt * 1e3, 2),
                       "tok_s": round(tokens_per_sec, 1),
                       "mfu": round(mfu, 4)}))

