@@ -195,25 +195,31 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
                 admission=admission,
                 dtype="bfloat16" if on_tpu else "float32"))
 
-        # one warmup engine to populate the jit cache (programs are shared
-        # across engines via jax's global compile cache keyed on shapes)
-        warm = fresh_engine()
-        warm.generate([[1, 2, 3] * (prompt_len // 3 + 1)][:1],
-                      SamplingParams(temperature=0.0, max_tokens=2))
+        def warmed_engine():
+            # jitted prefill/decode closures are PER-ENGINE (bound methods
+            # key jax's trace cache), so every sweep point's engine must
+            # compile its own programs BEFORE its timed window — a shared
+            # warmup engine would leave compilation inside the measured
+            # TTFT (round-3 review)
+            eng = fresh_engine()
+            eng.generate([list(range(1, prompt_len + 1))],
+                         SamplingParams(temperature=0.0, max_tokens=2))
+            eng.total_prefill_tokens = 0
+            eng.total_decode_steps = 0
+            return eng
 
         results["serve_load"] = {"admission": admission, "open_loop": [],
                                  "closed_loop": []}
         for r in [float(x) for x in str(rps).split(",") if x]:
-            eng = fresh_engine()
-            out = run_poisson(eng, offered_rps=r, num_requests=requests,
-                              prompt_len=prompt_len, max_tokens=gen_len,
-                              seed=0)
+            out = run_poisson(warmed_engine(), offered_rps=r,
+                              num_requests=requests, prompt_len=prompt_len,
+                              max_tokens=gen_len, seed=0)
             results["serve_load"]["open_loop"].append(out.summary())
         for c in [int(x) for x in str(concurrency).split(",") if x]:
-            eng = fresh_engine()
-            out = run_closed_loop(eng, concurrency=c, num_requests=requests,
-                                  prompt_len=prompt_len, max_tokens=gen_len,
-                                  seed=0)
+            out = run_closed_loop(warmed_engine(), concurrency=c,
+                                  num_requests=requests,
+                                  prompt_len=prompt_len,
+                                  max_tokens=gen_len, seed=0)
             s = out.summary()
             s["concurrency"] = c
             results["serve_load"]["closed_loop"].append(s)
@@ -290,9 +296,10 @@ def dataloader(path, batch, seq_len, batches, prefetch, workers, step_ms):
     }
     if isinstance(ds, PrefetchLoader):
         out["stall_ms_per_batch"] = (ds.stall_seconds - stall0) / batches * 1e3
-        ds.close()
     else:
         out["fetch_ms_per_batch"] = stall_sync / batches * 1e3
+    if hasattr(ds, "close"):    # PrefetchLoader closes its inner dataset
+        ds.close()
     if step_ms > 0:
         out["step_ms_simulated"] = step_ms
     click.echo(json.dumps(out, indent=2))

@@ -28,9 +28,11 @@ def app(ctx):
 @click.option("--format", "fmt", default="safetensors", show_default=True,
               type=click.Choice(["safetensors", "npz"]))
 @click.option("--quant", default=None,
-              type=click.Choice(["int8", "int8-awq"]),
-              help="Quantize weights before export (int8-awq = activation-"
-                   "aware channel scaling from a calibration pass).")
+              type=click.Choice(["int8", "int8-awq", "int4", "int4-awq"]),
+              help="Quantize weights before export (*-awq = activation-"
+                   "aware channel scaling from a calibration pass; int4 = "
+                   "group-wise W4A16, the real version of the reference's "
+                   "stubbed int4-gptq choice).")
 @click.option("--model", "model_name", default=None,
               help="Model template (required for int8-awq calibration; "
                    "defaults to the checkpoint's recorded model).")
@@ -55,7 +57,7 @@ def convert(ckpt_dir, fmt, quant, model_name, calib_seq, out_path, step):
     if isinstance(extra, dict) and "config" in extra:
         meta["model"] = str(extra["config"].get("model", ""))
     model_cfg = calib = None
-    if quant == "int8-awq":
+    if quant in ("int8-awq", "int4-awq"):
         import jax
         import jax.numpy as jnp
 
@@ -63,7 +65,7 @@ def convert(ckpt_dir, fmt, quant, model_name, calib_seq, out_path, step):
         name = model_name or meta.get("model") or ""
         if not name:
             raise click.ClickException(
-                "--quant int8-awq needs --model for calibration")
+                f"--quant {quant} needs --model for calibration")
         from ...io.checkpoint import apply_ckpt_model_overrides
         model_cfg = apply_ckpt_model_overrides(get_model_config(name), extra)
         calib = jax.random.randint(

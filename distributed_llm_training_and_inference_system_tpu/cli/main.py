@@ -21,28 +21,7 @@ import click
 from .. import __version__
 
 
-def _honor_jax_platforms() -> None:
-    """Make JAX_PLATFORMS reliable for every subcommand.
-
-    Some environments pre-import jax in sitecustomize and latch a device
-    plugin; the env var is then silently ignored (first observed with the
-    tunneled TPU plugin: `JAX_PLATFORMS=cpu llmctl bench comms` still got
-    the 1-chip TPU backend). Backends are created lazily, so a live config
-    update before first use always wins."""
-    import sys
-
-    plat = os.environ.get("JAX_PLATFORMS")
-    # only needed when something (sitecustomize) already imported jax and
-    # latched a platform; otherwise the env var works natively — and
-    # importing jax here would break the lazy-import invariant below
-    if plat and "jax" in sys.modules:
-        try:
-            import jax
-
-            jax.config.update("jax_platforms", plat)
-        except Exception:
-            pass   # command may not need jax at all
-
+from ..utils.platform import honor_jax_platforms as _honor_jax_platforms
 
 _honor_jax_platforms()
 

@@ -590,14 +590,14 @@ class ServeConfig:
             raise ConfigError("kv_quantization must be none|int8")
         if self.tensor_parallel < 1:
             raise ConfigError("tensor_parallel must be >= 1")
-        if self.quantization not in ("none", "int8"):
-            raise ConfigError("quantization must be none|int8")
+        if self.quantization not in ("none", "int8", "int4", "int4-awq"):
+            raise ConfigError("quantization must be none|int8|int4|int4-awq")
         if self.chunked_prefill_tokens < 0:
             raise ConfigError("chunked_prefill_tokens must be >= 0")
         if self.quantization != "none" and self.tensor_parallel > 1:
             raise ConfigError(
-                "int8 serving + tensor_parallel is not supported yet "
-                "(PARAM_RULES shard plain kernels, not QuantTensor leaves)")
+                "quantized serving + tensor_parallel is not supported yet "
+                "(PARAM_RULES shard plain kernels, not Quant[4]Tensor leaves)")
         # the engine checks `speculative == "ngram"`, so a config-file typo
         # ("n-gram", "medusa") would otherwise silently disable speculation
         if self.speculative not in ("off", "ngram"):

@@ -400,6 +400,7 @@ class PrefetchLoader(DatasetIterator):
         self.depth = max(depth, 1)
         self._resume_state = inner.state_dict()
         self.stall_seconds = 0.0       # consumer wait (loader not ready)
+        self._failed: Optional[Exception] = None
         self._start_worker()
 
     def _start_worker(self) -> None:
@@ -437,10 +438,16 @@ class PrefetchLoader(DatasetIterator):
 
     def __next__(self) -> dict[str, np.ndarray]:
         import time
+        # the worker EXITS after delivering an exception; a retried
+        # next() would otherwise block forever on a producerless queue —
+        # keep re-raising the terminal error instead (round-3 review)
+        if self._failed is not None and self._q.empty():
+            raise self._failed
         t0 = time.perf_counter()
         batch, state = self._q.get()
         self.stall_seconds += time.perf_counter() - t0
         if isinstance(batch, Exception):
+            self._failed = batch
             raise batch
         self._resume_state = state
         return batch
@@ -455,6 +462,7 @@ class PrefetchLoader(DatasetIterator):
         self._shutdown_worker(timeout=30.0, must_die=True)
         self.inner.load_state_dict(state)
         self._resume_state = self.inner.state_dict()
+        self._failed = None
         self._start_worker()
 
     def _shutdown_worker(self, timeout: float, must_die: bool = False) -> None:

@@ -90,8 +90,9 @@ def export_params(params: Any, out_path: str | Path, fmt: str = "safetensors",
                   quant: str | None = None, metadata: dict | None = None,
                   model_cfg=None, calib_tokens=None) -> Path:
     """Export a param pytree. fmt: safetensors | npz.
-    quant: None | int8 | int8-awq (activation-aware; needs model_cfg +
-    calib_tokens for the calibration forward pass)."""
+    quant: None | int8 | int8-awq | int4 | int4-awq (awq variants are
+    activation-aware; they need model_cfg + calib_tokens for the
+    calibration forward pass; int4 is group-wise W4A16)."""
     from ..utils.tree import flatten_with_paths
     out_path = Path(out_path)
     meta = dict(metadata or {})
@@ -108,14 +109,28 @@ def export_params(params: Any, out_path: str | Path, fmt: str = "safetensors",
                     "activation-aware calibration pass")
             from ..ops.quantization import quantize_tree_int8_awq
             params = quantize_tree_int8_awq(params, model_cfg, calib_tokens)
+        elif quant in ("int4", "int4-awq"):
+            if quant == "int4-awq" and (model_cfg is None
+                                        or calib_tokens is None):
+                raise ValueError(
+                    "int4-awq needs model_cfg and calib_tokens for the "
+                    "activation-aware calibration pass")
+            from ..ops.quantization import quantize_tree_int4
+            params = quantize_tree_int4(
+                params,
+                model_cfg=model_cfg if quant == "int4-awq" else None,
+                calib_tokens=calib_tokens if quant == "int4-awq" else None)
         else:
             raise ValueError(
-                f"unsupported quant {quant!r} (int8 | int8-awq)")
+                f"unsupported quant {quant!r} "
+                "(int8 | int8-awq | int4 | int4-awq)")
     flat = dict(flatten_with_paths(params))
-    # quantized leaves carry a "__quant__": "int8" string marker; markers are
+    # quantized leaves carry a "__quant__" string marker; markers are
     # metadata, not tensors (the ".values"/".scale" suffix pair identifies
-    # quantized weights on load)
-    flat = {k: v for k, v in flat.items() if not k.endswith("__quant__")}
+    # quantized weights on load). int4 leaves also carry a python-int
+    # "group" — stored as an int32 scalar tensor so both formats accept it
+    flat = {k: (np.asarray(v, np.int32) if isinstance(v, int) else v)
+            for k, v in flat.items() if not k.endswith("__quant__")}
     if fmt == "safetensors":
         save_safetensors(flat, out_path, metadata=meta)
     elif fmt == "npz":

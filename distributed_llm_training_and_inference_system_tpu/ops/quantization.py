@@ -66,6 +66,81 @@ def dequantize_int4_blockwise(packed: jax.Array, scale: jax.Array,
     return out.reshape(*q.shape[:-1], n).astype(dtype)
 
 
+def quantize_int4_groupwise(
+    w: jax.Array,            # [..., in, out] kernel(s)
+    group: int = 128,
+    act_scale: jax.Array | None = None,   # [..., in] AWQ channel statistic
+    alpha: float = 0.5,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Group-wise symmetric int4 along the INPUT axis (the matmul reduction
+    dim — the W4A16 convention: each [group]-sized slice of input channels
+    shares one scale, so dequant error stays local to a partial sum).
+
+    With ``act_scale`` the AWQ channel trick is applied first (salient
+    input channels scaled up before quantization, inverse folded into
+    dequant) — the int4 counterpart of quantize_int8_awq and the real
+    version of the reference's stubbed ``--quant int4-gptq`` choice
+    (reference llmctl/cli/commands/export.py:23-29).
+
+    Returns (packed uint8 [..., out, in/2], scales fp32 [..., out, in/group],
+    chan fp32 [..., in]); W ≈ swapaxes(unpack(packed)*scales) / chan[:,None].
+    """
+    if act_scale is not None:
+        chan = act_scale.astype(jnp.float32) ** alpha
+        chan = chan / jnp.exp(jnp.mean(jnp.log(chan), axis=-1, keepdims=True))
+    else:
+        chan = jnp.ones(w.shape[:-2] + (w.shape[-2],), jnp.float32)
+    w_scaled = w.astype(jnp.float32) * chan[..., :, None]
+    wt = jnp.swapaxes(w_scaled, -1, -2)            # [..., out, in]
+    packed, scale = quantize_int4_blockwise(wt, block=group)
+    return packed, scale, chan
+
+
+def dequantize_int4_groupwise(packed: jax.Array, scale: jax.Array,
+                              chan: jax.Array, group: int = 128,
+                              dtype=jnp.bfloat16) -> jax.Array:
+    """Inverse of quantize_int4_groupwise -> [..., in, out]."""
+    wt = dequantize_int4_blockwise(packed, scale, block=group,
+                                   dtype=jnp.float32)
+    w = jnp.swapaxes(wt, -1, -2)                   # [..., in, out]
+    return (w / chan[..., :, None]).astype(dtype)
+
+
+@jax.tree_util.register_pytree_node_class
+class Quant4Tensor:
+    """Runtime form of a W4A16 weight: packed int4 nibbles + group scales
+    (+ AWQ channel scales), registered as a pytree so it rides the stacked-
+    layer ``lax.scan`` like QuantTensor. Logical shape/ndim are the
+    ORIGINAL kernel's ([..., in, out]) so shape-inspecting code (sharding
+    rules, planners) sees the matmul geometry, not the packed layout."""
+
+    def __init__(self, packed, scale, chan, group: int = 128):
+        self.packed = packed
+        self.scale = scale
+        self.chan = chan
+        self.group = group
+
+    @property
+    def shape(self):
+        s = self.packed.shape            # [..., out, in/2]
+        return (*s[:-2], s[-1] * 2, s[-2])
+
+    @property
+    def ndim(self):
+        return self.packed.ndim
+
+    def dequant(self, dtype=jnp.bfloat16):
+        return dequantize_int4_groupwise(self.packed, self.scale, self.chan,
+                                         self.group, dtype)
+
+    def tree_flatten(self):
+        return (self.packed, self.scale, self.chan), self.group
+
+    @classmethod
+    def tree_unflatten(cls, group, children):
+        return cls(*children, group=group)
+
+
 @jax.tree_util.register_pytree_node_class
 class QuantTensor:
     """Runtime form of an int8 weight: (values int8, scale fp32), leaves of
@@ -97,44 +172,50 @@ class QuantTensor:
 
 
 def _is_quant_marker(x: Any) -> bool:
-    return isinstance(x, dict) and x.get("__quant__") == "int8"
+    return isinstance(x, dict) and x.get("__quant__") in ("int8", "int4")
 
 
 def to_runtime_quant(tree: Any) -> Any:
-    """Convert export-form ``{"__quant__": "int8", values, scale}`` leaves
-    into scan-compatible QuantTensor leaves."""
-    return jax.tree_util.tree_map(
-        lambda x: QuantTensor(x["values"], x["scale"])
-        if _is_quant_marker(x) else x,
-        tree, is_leaf=_is_quant_marker)
+    """Convert export-form ``{"__quant__": ..., values, scale}`` leaves
+    into scan-compatible QuantTensor / Quant4Tensor leaves."""
+    def conv(x):
+        if not _is_quant_marker(x):
+            return x
+        if x["__quant__"] == "int4":
+            return Quant4Tensor(x["values"], x["scale"], x["chan"],
+                                group=int(x.get("group", 128)))
+        return QuantTensor(x["values"], x["scale"])
+    return jax.tree_util.tree_map(conv, tree, is_leaf=_is_quant_marker)
+
+
+def _is_runtime_quant(x: Any) -> bool:
+    return isinstance(x, (QuantTensor, Quant4Tensor))
 
 
 def cast_params(tree: Any, dtype) -> Any:
-    """Cast a (possibly mixed plain/QuantTensor) param tree for compute:
-    plain leaves are cast; QuantTensor leaves are DEQUANTIZED. Call this
+    """Cast a (possibly mixed plain/Quant[4]Tensor) param tree for compute:
+    plain leaves are cast; quantized leaves are DEQUANTIZED. Call this
     per layer inside the scan body so only one layer's bf16 weights are
-    ever materialised (the whole-tree int8 storage saving survives)."""
+    ever materialised (the whole-tree int8/int4 storage saving survives)."""
     def one(x):
-        if isinstance(x, QuantTensor):
+        if _is_runtime_quant(x):
             return x.dequant(dtype)
         return x.astype(dtype)
-    return jax.tree_util.tree_map(
-        one, tree, is_leaf=lambda x: isinstance(x, QuantTensor))
+    return jax.tree_util.tree_map(one, tree, is_leaf=_is_runtime_quant)
 
 
 def precast_params(tree: Any, dtype) -> Any:
-    """Cast PLAIN leaves to the compute dtype, leaving QuantTensor leaves
+    """Cast PLAIN leaves to the compute dtype, leaving quantized leaves
     quantized. Run this once OUTSIDE the layer scan: casting inside the
     scan body would stream the fp32 master weights from HBM every layer
     (measured -0.05 MFU on the training step, BASELINE.md round 2); the
-    int8 leaves still dequantize per-layer inside the body via
+    int8/int4 leaves still dequantize per-layer inside the body via
     ``cast_params``."""
     def one(x):
-        if isinstance(x, QuantTensor):
+        if _is_runtime_quant(x):
             return x
         return x.astype(dtype)
-    return jax.tree_util.tree_map(
-        one, tree, is_leaf=lambda x: isinstance(x, QuantTensor))
+    return jax.tree_util.tree_map(one, tree, is_leaf=_is_runtime_quant)
 
 
 def tree_weight_bytes(tree: Any) -> int:
@@ -164,13 +245,55 @@ def quantize_tree_int8(params: Any, min_size: int = 4096,
     return jax.tree_util.tree_map(q, params)
 
 
+def quantize_tree_int4(params: Any, model_cfg=None,
+                       calib_tokens: jax.Array | None = None,
+                       group: int = 128, alpha: float = 0.5,
+                       min_size: int = 4096) -> Any:
+    """Group-wise int4 (W4A16) over a FULL param pytree; only the stacked
+    [L, in, out] block kernels quantize (embedding/lm_head/norms keep full
+    precision — same policy as the int8 path). Odd input dims fall back
+    to int8.
+
+    With ``model_cfg`` + ``calib_tokens`` the AWQ channel statistic is
+    calibrated (activation_channel_scales, needs the full tree) and
+    applied to the kernels it covers. Group size is clamped to the input
+    dim when needed."""
+    act = {}
+    if model_cfg is not None and calib_tokens is not None:
+        act = activation_channel_scales(params, model_cfg, calib_tokens)
+
+    def q(path_entries, x):
+        path = ".".join(str(getattr(k, "key", k)) for k in path_entries)
+        if (hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+                and x.size >= min_size and x.ndim == 3):   # [L, in, out]
+            g = group
+            while x.shape[-2] % g and g > 2:
+                g //= 2
+            if x.shape[-2] % g or g < 2:
+                return quantize_tree_int8(x, min_size=min_size, min_ndim=3)
+            packed, scale, chan = quantize_int4_groupwise(
+                x, group=g, act_scale=act.get(path), alpha=alpha)
+            return {"__quant__": "int4", "values": packed, "scale": scale,
+                    "chan": chan, "group": g}
+        # norm scales / biases ([L, H]) stay full precision, mirroring
+        # the engine's int8 path (min_ndim=3)
+        return (quantize_tree_int8(x, min_size=min_size, min_ndim=3)
+                if hasattr(x, "dtype") else x)
+
+    return jax.tree_util.tree_map_with_path(q, params)
+
+
 def dequantize_tree(params: Any, dtype=jnp.bfloat16) -> Any:
     def is_qleaf(x):
         return isinstance(x, dict) and str(
-            x.get("__quant__", "")).startswith("int8")
+            x.get("__quant__", "")).startswith(("int8", "int4"))
 
     def dq(x):
         if is_qleaf(x):
+            if x["__quant__"] == "int4":
+                return dequantize_int4_groupwise(
+                    x["values"], x["scale"], x["chan"],
+                    group=int(x.get("group", 128)), dtype=dtype)
             if x["__quant__"] == "int8-awq":
                 return dequantize_int8_awq(x["values"], x["scale"],
                                            x["chan"], dtype)

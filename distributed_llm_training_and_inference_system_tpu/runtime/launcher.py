@@ -49,7 +49,12 @@ def _train_env(cfg: LaunchConfig, host_id: int = 0,
                coordinator: str = "localhost") -> dict[str, str]:
     env = dict(os.environ)
     flags = env.get("XLA_FLAGS", "")
-    env["XLA_FLAGS"] = (flags + " " + overlap_flags()).strip()
+    # the async-collective overlap flags are TPU-only; the CPU backend
+    # hard-aborts on unknown XLA_FLAGS (parse_flags_from_env.cc), so a
+    # CPU child (tests, local smoke runs) must not inherit them
+    if env.get("JAX_PLATFORMS", "").strip().lower() != "cpu":
+        flags = (flags + " " + overlap_flags()).strip()
+    env["XLA_FLAGS"] = flags
     if cfg.num_hosts > 1:
         env["LLMCTL_COORDINATOR"] = f"{coordinator}:{cfg.coordinator_port}"
         env["LLMCTL_NUM_HOSTS"] = str(cfg.num_hosts)
@@ -92,19 +97,64 @@ class BaseLauncher:
 
 
 class LocalLauncher(BaseLauncher):
-    """One training process on this host (all local chips, SPMD)."""
+    """Training process(es) on this host (all local chips, SPMD).
+
+    ``num_hosts > 1`` runs a real multi-process SPMD job on one machine:
+    N processes, each with the launcher env contract
+    (LLMCTL_COORDINATOR/NUM_HOSTS/HOST_ID → jax.distributed.initialize in
+    train_entry.maybe_init_distributed) — the same rendezvous the SLURM /
+    k8s / MPI launchers drive across machines, testable without a
+    cluster. ``launch()`` returns process 0; ``launch_all()`` returns
+    every process."""
 
     def launch(self, capture_output: bool = True) -> Optional[subprocess.Popen]:
+        """Returns process 0; with num_hosts>1 the siblings live in
+        ``self.children`` and the orchestrator reaps them via
+        ``stop_children`` — returning only the head would otherwise orphan
+        hosts 1..N-1 from stop()/restart supervision."""
+        procs = self.launch_all(capture_output)
+        return procs[0] if procs else None
+
+    def launch_all(self,
+                   capture_output: bool = True) -> list[subprocess.Popen]:
         cmd = _train_cmd(self.cfg)
         if self.cfg.dry_run:
-            return None
-        return subprocess.Popen(
-            cmd, env=_train_env(self.cfg),
-            stdout=self._pipe(capture_output),
-            stderr=subprocess.STDOUT if capture_output else None, text=True)
+            self.children = []
+            return []
+        self.children = [
+            subprocess.Popen(
+                cmd, env=_train_env(self.cfg, host_id=i),
+                # only host 0's output is streamed; siblings inherit
+                # stderr so a crash is still visible
+                stdout=self._pipe(capture_output) if i == 0 else
+                subprocess.DEVNULL,
+                stderr=subprocess.STDOUT if (capture_output and i == 0)
+                else None,
+                text=True)
+            for i in range(max(self.cfg.num_hosts, 1))]
+        return self.children
+
+    def stop_children(self, grace_seconds: float = 5.0) -> None:
+        """SIGTERM (then SIGKILL) every spawned process — called by the
+        orchestrator's stop/restart paths so a dead host 0 never leaves
+        hosts 1..N-1 holding the rendezvous port."""
+        import signal as _signal
+        import time as _time
+        children = getattr(self, "children", [])
+        for p in children:
+            if p.poll() is None:
+                p.send_signal(_signal.SIGTERM)
+        deadline = _time.monotonic() + grace_seconds
+        for p in children:
+            while p.poll() is None and _time.monotonic() < deadline:
+                _time.sleep(0.1)
+            if p.poll() is None:
+                p.kill()
 
     def describe(self) -> str:
-        return shlex.join(_train_cmd(self.cfg))
+        n = max(self.cfg.num_hosts, 1)
+        prefix = f"{n}x local: " if n > 1 else ""
+        return prefix + shlex.join(_train_cmd(self.cfg))
 
 
 class SlurmLauncher(BaseLauncher):
@@ -254,7 +304,13 @@ class ProcessOrchestrator:
         if stream_output and self.process.stdout is not None:
             for line in self.process.stdout:
                 print(line, end="")
-        return self.process.wait()
+        rc = self.process.wait()
+        # multi-process local jobs: host 0 exiting (ok or crash) must take
+        # the sibling hosts with it — a stale sibling would hold the
+        # rendezvous port and hang the restarted job's initialize()
+        if hasattr(self.launcher, "stop_children"):
+            self.launcher.stop_children()
+        return rc
 
     def run_with_restarts(self, max_restarts: int = 0,
                           backoff_seconds: float = 5.0,
@@ -281,6 +337,8 @@ class ProcessOrchestrator:
             time.sleep(backoff_seconds)
 
     def stop(self, grace_seconds: float = 5.0) -> None:
+        if hasattr(self.launcher, "stop_children"):
+            self.launcher.stop_children(grace_seconds)   # all hosts
         if self.process is None or self.process.poll() is not None:
             return
         self.process.send_signal(signal.SIGTERM)

@@ -14,6 +14,36 @@ import os
 import sys
 
 
+from ..utils.platform import honor_jax_platforms as _honor_jax_platforms
+
+
+def maybe_init_distributed() -> bool:
+    """Join the jax.distributed rendezvous when the launcher provided one.
+
+    Env contract (written by runtime/launcher.py:_train_env, exported by
+    the SLURM script / k8s manifest / mpirun -x): LLMCTL_COORDINATOR is
+    host:port of process 0, LLMCTL_NUM_HOSTS the world size,
+    LLMCTL_HOST_ID this process's id (falls back to the OpenMPI rank).
+    This is the TPU-native equivalent of the reference's MASTER_ADDR
+    TCP rendezvous (reference llmctl/runtime/launcher.py:73-79), and —
+    unlike the reference's, which no test ever spawns — it is exercised
+    by a REAL two-process test (tests/test_runtime.py::
+    test_two_process_rendezvous_psum_and_checkpoint).
+
+    Returns True when distributed init ran."""
+    coord = os.environ.get("LLMCTL_COORDINATOR")
+    if not coord:
+        return False
+    import jax
+    jax.distributed.initialize(
+        coordinator_address=coord,
+        num_processes=int(os.environ["LLMCTL_NUM_HOSTS"]),
+        process_id=int(os.environ.get(
+            "LLMCTL_HOST_ID",
+            os.environ.get("OMPI_COMM_WORLD_RANK", "0"))))
+    return True
+
+
 def parse_overrides(pairs: list[str]) -> dict:
     """--set section.field=value overrides."""
     out: dict = {}
@@ -40,15 +70,8 @@ def main(argv: list[str] | None = None) -> int:
         format="%(asctime)s %(name)s %(levelname)s %(message)s")
 
     # multi-host rendezvous (set by runtime/launcher.py)
-    coord = os.environ.get("LLMCTL_COORDINATOR")
-    if coord:
-        import jax
-        jax.distributed.initialize(
-            coordinator_address=coord,
-            num_processes=int(os.environ["LLMCTL_NUM_HOSTS"]),
-            process_id=int(os.environ.get(
-                "LLMCTL_HOST_ID",
-                os.environ.get("OMPI_COMM_WORLD_RANK", "0"))))
+    _honor_jax_platforms()
+    maybe_init_distributed()
 
     from ..config.loader import load_run_config
     overrides = parse_overrides(args.set)
@@ -62,7 +85,10 @@ def main(argv: list[str] | None = None) -> int:
     from ..metrics.observability import engine_observer
     from .engine import TrainingEngine
     engine = TrainingEngine(cfg, observer=engine_observer())
-    final = engine.train(resume=not args.no_resume)
+    try:
+        final = engine.train(resume=not args.no_resume)
+    finally:
+        engine.close()
     logging.getLogger("llmctl.train").info("finished: %s", final)
     return 0
 

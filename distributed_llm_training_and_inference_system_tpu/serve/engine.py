@@ -77,6 +77,26 @@ class InferenceEngine:
             params["blocks"] = to_runtime_quant(
                 quantize_tree_int8(params["blocks"], min_ndim=3))
             logger.info("serving with int8 block weights (W8A16)")
+        elif serve_cfg.quantization in ("int4", "int4-awq"):
+            from ..ops.quantization import (quantize_tree_int4,
+                                            to_runtime_quant)
+            calib = None
+            awq_cfg = None
+            if serve_cfg.quantization == "int4-awq":
+                # one synthetic calibration pass for the AWQ channel
+                # statistic (same approach as `llmctl export --quant
+                # int8-awq` without a dataset)
+                import jax.random as jrandom
+                calib = jrandom.randint(
+                    jrandom.PRNGKey(0), (2, min(256, serve_cfg.max_seq_len)),
+                    1, model_cfg.vocab_size)
+                awq_cfg = model_cfg
+            # full-tree call (the AWQ calibration forward needs embed +
+            # blocks); only the stacked [L, in, out] kernels quantize
+            params = to_runtime_quant(quantize_tree_int4(
+                dict(params), model_cfg=awq_cfg, calib_tokens=calib))
+            logger.info("serving with int4 block weights (W4A16%s)",
+                        "+awq" if calib is not None else "")
 
         # tensor-parallel serving: one tp-axis mesh; params shard per
         # PARAM_RULES (column/row-parallel kernels), pages per kv head.

@@ -1,0 +1,27 @@
+"""Platform selection guard shared by the CLI and launcher children.
+
+Some environments pre-import jax in sitecustomize and latch a device
+plugin; the JAX_PLATFORMS env var is then silently ignored (first observed
+with the tunneled TPU plugin: ``JAX_PLATFORMS=cpu llmctl bench comms``
+still got the 1-chip TPU backend). Backends are created lazily, so a live
+config update before first use always wins.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def honor_jax_platforms() -> None:
+    plat = os.environ.get("JAX_PLATFORMS")
+    # only needed when something (sitecustomize) already imported jax and
+    # latched a platform; otherwise the env var works natively — and
+    # importing jax here would break callers' lazy-import invariants
+    if plat and "jax" in sys.modules:
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", plat)
+        except Exception:
+            pass   # caller may not need jax at all

@@ -55,7 +55,10 @@ def main() -> None:
             return params, opt_state, gnorm
     else:
         use_pallas = mode == "pallas"
-        fused_update._update_leaf_pallas.__defaults__ = (br, bc)
+        # block_rows/block_cols are keyword-only (after the bare *):
+        # their defaults live in __kwdefaults__, NOT __defaults__
+        fused_update._update_leaf_pallas.__kwdefaults__.update(
+            block_rows=br, block_cols=bc)
 
         def apply(params, opt_state, grads):
             gnorm = global_norm(grads)

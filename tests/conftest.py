@@ -54,6 +54,7 @@ SLOW_TESTS = {
     "test_multi_step_matches_single_step",
     "test_greedy_matches_dense_forward",
     "test_engine_end_to_end_with_resume",
+    "test_two_process_rendezvous_psum_and_checkpoint",
     "test_1f1b_matches_gpipe_trajectory",
     "test_sharded_step_matches_single_device",
     "test_diverging_suffix_still_correct",

@@ -1,0 +1,157 @@
+"""W4A16 int4 weight path (round-3, VERDICT r2 missing #3): group-wise
+quant/dequant correctness, AWQ channel variant, engine serving parity,
+export round trip."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llm_training_and_inference_system_tpu.config import (
+    get_model_config)
+from distributed_llm_training_and_inference_system_tpu.config.schema import (
+    ConfigError, ServeConfig)
+from distributed_llm_training_and_inference_system_tpu.ops.quantization import (
+    Quant4Tensor, dequantize_int4_groupwise, dequantize_tree,
+    quantize_int4_groupwise, quantize_tree_int4, to_runtime_quant,
+    tree_weight_bytes)
+from distributed_llm_training_and_inference_system_tpu.serve import (
+    InferenceEngine, SamplingParams)
+
+
+class TestGroupwiseInt4:
+    def test_exact_roundtrip_of_representable_values(self):
+        # values already on the int4 grid * scale round-trip exactly when
+        # every group attains the grid max (so the absmax scale is exact)
+        rng = np.random.default_rng(0)
+        q = rng.integers(-7, 8, size=(4, 64, 32)).astype(np.float32)
+        q[:, 0::32, :] = 7          # first in-channel of each group
+        w = jnp.asarray(q * 0.25)
+        packed, scale, chan = quantize_int4_groupwise(w, group=32)
+        back = dequantize_int4_groupwise(packed, scale, chan, group=32,
+                                         dtype=jnp.float32)
+        np.testing.assert_allclose(np.asarray(back), np.asarray(w),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_error_bounded_for_gaussian_weights(self):
+        w = jax.random.normal(jax.random.PRNGKey(1), (2, 256, 128))
+        packed, scale, chan = quantize_int4_groupwise(w, group=64)
+        back = dequantize_int4_groupwise(packed, scale, chan, group=64,
+                                         dtype=jnp.float32)
+        rel = float(jnp.linalg.norm(back - w) / jnp.linalg.norm(w))
+        assert rel < 0.12, rel       # ~7% typical for absmax int4
+
+    def test_awq_channel_scaling_helps_skewed_activations(self):
+        # channels with large activations get finer weight resolution:
+        # error measured in the ACTIVATION-WEIGHTED metric must shrink
+        key = jax.random.PRNGKey(2)
+        w = jax.random.normal(key, (1, 128, 64))
+        # saliency must vary WITHIN a quant group for channel scaling to
+        # matter (a uniformly-scaled group rescales its absmax too)
+        act = jnp.where(jnp.arange(128) % 2 == 0, 8.0, 0.1)[None, :]
+        plain = dequantize_int4_groupwise(
+            *quantize_int4_groupwise(w, group=64), group=64,
+            dtype=jnp.float32)
+        awq = dequantize_int4_groupwise(
+            *quantize_int4_groupwise(w, group=64, act_scale=act),
+            group=64, dtype=jnp.float32)
+
+        def weighted_err(back):
+            d = (back - w) * act[..., :, None]
+            return float(jnp.linalg.norm(d))
+        assert weighted_err(awq) < weighted_err(plain)
+
+    def test_quant4tensor_reports_logical_shape(self):
+        w = jnp.zeros((3, 256, 128))
+        t = Quant4Tensor(*quantize_int4_groupwise(w, group=64), group=64)
+        assert t.shape == (3, 256, 128)
+        assert t.ndim == 3
+
+    def test_tree_quant_skips_small_and_2d_leaves(self):
+        cfg = get_model_config("gpt-test")
+        from distributed_llm_training_and_inference_system_tpu.models import (
+            gpt)
+        params = gpt.init(cfg, jax.random.PRNGKey(0))
+        qt = quantize_tree_int4(dict(params))
+        # embedding/lm_head stay float
+        assert hasattr(qt["embed"]["embedding"], "dtype")
+        rt = to_runtime_quant(qt)
+        kernels = [l for l in jax.tree_util.tree_leaves(
+            rt["blocks"], is_leaf=lambda x: isinstance(x, Quant4Tensor))
+            if isinstance(x := l, Quant4Tensor)]
+        assert kernels, "no block kernel was int4-quantized"
+        # storage shrank: blocks at <=0.75 byte/param incl. scales
+        n_params = sum(int(np.prod(t.shape)) for t in kernels)
+        q_bytes = tree_weight_bytes(kernels)
+        assert q_bytes < n_params * 0.75
+
+    def test_dequantize_tree_handles_int4(self):
+        w = jax.random.normal(jax.random.PRNGKey(3), (2, 128, 64))
+        qt = quantize_tree_int4({"k": w})
+        back = dequantize_tree(qt, dtype=jnp.float32)["k"]
+        rel = float(jnp.linalg.norm(back - w) / jnp.linalg.norm(w))
+        assert back.shape == w.shape and rel < 0.12
+
+
+class TestInt4Serving:
+    @pytest.fixture(scope="class")
+    def model_cfg(self):
+        return get_model_config("gpt-test")
+
+    def _engine(self, model_cfg, **kw):
+        base = dict(model="gpt-test", max_batch_size=2, max_seq_len=128,
+                    prefill_chunk=32, kv_block_size=8, dtype="float32")
+        base.update(kw)
+        return InferenceEngine(model_cfg, ServeConfig(**base), seed=0)
+
+    @pytest.mark.parametrize("mode", ["int4", "int4-awq"])
+    def test_int4_decode_tracks_fp_logits(self, model_cfg, mode):
+        prompt = [5, 17, 99, 3, 42, 7, 11, 23]
+        fp = self._engine(model_cfg)
+        [want] = fp.generate([prompt], SamplingParams(temperature=0.0,
+                                                      max_tokens=8))
+        q = self._engine(model_cfg, quantization=mode)
+        [got] = q.generate([prompt], SamplingParams(temperature=0.0,
+                                                   max_tokens=8))
+        assert len(got.generated_tokens) == 8
+        # int4 on random-init weights: token streams may diverge at a
+        # near-tie, but the leading tokens should agree
+        agree = sum(a == b for a, b in zip(want.generated_tokens,
+                                           got.generated_tokens))
+        assert agree >= 4, (want.generated_tokens, got.generated_tokens)
+        # weight storage really shrank vs the fp engine
+        assert q.stats()["weight_bytes"] < fp.stats()["weight_bytes"] * 0.45
+
+    def test_int4_with_features_stacked(self, model_cfg):
+        eng = self._engine(model_cfg, quantization="int4",
+                           prefix_caching=True, chunked_prefill_tokens=16,
+                           admission="ondemand")
+        prompt = [7, 8, 9, 10] * 8
+        for _ in range(2):
+            [r] = eng.generate([prompt], SamplingParams(temperature=0.0,
+                                                        max_tokens=6))
+            assert len(r.generated_tokens) == 6
+        assert eng.kv.prefix_hits > 0
+
+    def test_tp_plus_quant_rejected(self):
+        with pytest.raises(ConfigError, match="tensor_parallel"):
+            ServeConfig(model="gpt-test", quantization="int4",
+                        tensor_parallel=2).validate()
+
+
+class TestInt4Export:
+    def test_export_roundtrip_npz_and_safetensors(self, tmp_path):
+        from distributed_llm_training_and_inference_system_tpu.io.export import (  # noqa: E501
+            export_params, load_safetensors)
+        w = jax.random.normal(jax.random.PRNGKey(4), (2, 128, 64))
+        params = {"blocks": {"q": {"kernel": w}}}
+        p1 = export_params(params, tmp_path / "m.safetensors",
+                           fmt="safetensors", quant="int4")
+        tensors, meta = load_safetensors(p1)
+        assert meta["quant"] == "int4"
+        assert any(k.endswith(".values") for k in tensors)
+        assert any(k.endswith(".group") for k in tensors)
+        p2 = export_params(params, tmp_path / "m.npz", fmt="npz",
+                           quant="int4")
+        loaded = np.load(p2)
+        assert any(k.endswith(".values") for k in loaded.files)

@@ -276,3 +276,16 @@ class TestRound3ReviewFixes:
         pre.close()
         for w, g in zip(want, got):
             np.testing.assert_array_equal(w["tokens"], g["tokens"])
+
+    def test_retry_after_worker_exception_reraises_not_hangs(self):
+        class Boom:
+            def state_dict(self):
+                return {}
+
+            def __next__(self):
+                raise RuntimeError("shard corrupted")
+        pre = PrefetchLoader(Boom(), depth=2)
+        for _ in range(3):                 # every retry re-raises promptly
+            with pytest.raises(RuntimeError, match="shard corrupted"):
+                next(pre)
+        pre.close()
