@@ -106,8 +106,12 @@ def kernels(op, seq_len, hidden, heads, batch):
               type=click.Choice(["ondemand", "reserve"]))
 @click.option("--kv-blocks", default=0, show_default=True,
               help="serve-load: fixed KV pool size (0 = auto from budget).")
+@click.option("--device-times/--no-device-times", default=True,
+              show_default=True,
+              help="serve-load: calibrate on-device prefill/decode times "
+                   "and report ttft_device_ms (link RTT excluded).")
 def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
-        requests, rps, concurrency, admission, kv_blocks):
+        requests, rps, concurrency, admission, kv_blocks, device_times):
     """End-to-end train step throughput / serve TTFT+throughput
     (parity: reference bench.py:35-49). ``serve-load`` runs open-loop
     (Poisson) and closed-loop sweeps with p50/p99 TTFT, per-token latency,
@@ -213,13 +217,15 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
         for r in [float(x) for x in str(rps).split(",") if x]:
             out = run_poisson(warmed_engine(), offered_rps=r,
                               num_requests=requests, prompt_len=prompt_len,
-                              max_tokens=gen_len, seed=0)
+                              max_tokens=gen_len, seed=0,
+                              device_times=device_times)
             results["serve_load"]["open_loop"].append(out.summary())
         for c in [int(x) for x in str(concurrency).split(",") if x]:
             out = run_closed_loop(warmed_engine(), concurrency=c,
                                   num_requests=requests,
                                   prompt_len=prompt_len,
-                                  max_tokens=gen_len, seed=0)
+                                  max_tokens=gen_len, seed=0,
+                                  device_times=device_times)
             s = out.summary()
             s["concurrency"] = c
             results["serve_load"]["closed_loop"].append(s)

@@ -64,6 +64,13 @@ class Request:
     preemptions: int = 0
     arrival_time: float = field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None   # for TTFT
+    # when the engine dispatched this request's prefill (host clock, no
+    # device RTT in it): queue wait = prefill_dispatch_time - arrival_time.
+    # Device-time TTFT = queue wait + the calibrated on-device prefill
+    # time of the request's bucket (engine.measure_device_times) — the
+    # co-located-host TTFT figure, with the tunnel RTT excluded.
+    prefill_dispatch_time: Optional[float] = None
+    prefill_bucket: Optional[int] = None
     finish_time: Optional[float] = None
     finish_reason: Optional[str] = None
     error: Optional[str] = None

@@ -1,0 +1,131 @@
+"""Speculation crossover: fused verify+decode vs plain multi-step decode as
+a function of draft acceptance (round-3, VERDICT r2 weak #1).
+
+Usage: python experiments/spec_crossover.py [model] [T] [R]
+
+Acceptance is dialled EXACTLY via oracle drafts: a plain greedy run
+precomputes each request's token stream; the speculative run's draft_fn
+then proposes the true continuation with each draft token independently
+corrupted with probability p. Measured acceptance therefore sweeps the
+whole range on ANY weights (prompt-content tricks can't control a
+random-init model).
+
+For each p it measures decode tok/s with speculative="ngram" (fused
+verify + R decode steps per dispatch) vs speculative="off" at EQUAL
+forward passes per dispatch (T-1+R plain steps), prints one JSON line per
+point, and ends with the interpolated crossover acceptance. BASELINE.md
+records the curve.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def main() -> None:
+    import numpy as np
+
+    from distributed_llm_training_and_inference_system_tpu.config import (
+        get_model_config)
+    from distributed_llm_training_and_inference_system_tpu.config.schema import (
+        ServeConfig)
+    from distributed_llm_training_and_inference_system_tpu.serve import (
+        InferenceEngine, SamplingParams)
+
+    model = sys.argv[1] if len(sys.argv) > 1 else "gpt-1b"
+    T = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    R = int(sys.argv[3]) if len(sys.argv) > 3 else 7
+    import jax
+    on_tpu = jax.default_backend() == "tpu"
+    prompt_len, gen_len, n_req = (512, 128, 4) if on_tpu else (48, 16, 2)
+
+    cfg = get_model_config(model if on_tpu else "gpt-test")
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(2, cfg.vocab_size,
+                            size=prompt_len).tolist() for _ in range(n_req)]
+
+    def make_engine(spec: bool):
+        return InferenceEngine(cfg, ServeConfig(
+            model=model, max_batch_size=max(n_req, 4),
+            max_seq_len=prompt_len + gen_len + 64,
+            kv_block_size=64 if on_tpu else 16,
+            dtype="bfloat16" if on_tpu else "float32",
+            speculative="ngram" if spec else "off",
+            speculative_tokens=T,
+            speculative_min_acceptance=0.0,   # never self-disable: we
+                                              # WANT the losing regions
+            # equal forward passes per dispatch: verify(1)+R vs T-1+R
+            decode_steps_per_dispatch=R if spec else (T - 1 + R),
+        ), seed=0)
+
+    sp = SamplingParams(temperature=0.0, max_tokens=gen_len)
+
+    def timed_generate(eng):
+        eng.generate([prompts[0]], SamplingParams(temperature=0.0,
+                                                  max_tokens=2))  # warm
+        t0 = time.perf_counter()
+        reqs = eng.generate(prompts, sp)
+        dt = time.perf_counter() - t0
+        return reqs, sum(len(r.generated_tokens) for r in reqs) / dt
+
+    # plain baseline + oracle streams
+    plain_reqs, plain_tok_s = timed_generate(make_engine(False))
+    oracle = {tuple(p[:16]): list(r.generated_tokens)
+              for p, r in zip(prompts, plain_reqs)}
+
+    def run_fused(p_corrupt: float):
+        eng = make_engine(True)
+        crng = np.random.default_rng(7)
+
+        def draft_fn(ctx, n_draft, _max_ngram):
+            stream = oracle.get(tuple(int(t) for t in ctx[:16]))
+            if stream is None:
+                return None
+            g = len(ctx) - prompt_len          # tokens already generated
+            tail = stream[g:g + n_draft]
+            if not tail:
+                return None
+            d = np.asarray(tail + [tail[-1]] * (n_draft - len(tail)),
+                           np.int32)
+            corrupt = crng.random(n_draft) < p_corrupt
+            d = np.where(corrupt, (d + 1) % cfg.vocab_size, d)
+            return d.astype(np.int32)
+
+        eng.draft_fn = draft_fn
+        reqs, tok_s = timed_generate(eng)
+        # oracle acceptance requires outputs identical to the plain run
+        for p, r in zip(prompts, reqs):
+            assert r.generated_tokens == oracle[tuple(p[:16])], \
+                "speculative output diverged from plain greedy"
+        return tok_s, eng.stats()["spec_acceptance"]
+
+    points = []
+    for p_c in (1.0, 0.75, 0.5, 0.25, 0.1, 0.0):
+        fused_tok_s, acc = run_fused(p_c)
+        row = {"p_corrupt": p_c, "acceptance": round(float(acc), 3),
+               "plain_tok_s": round(plain_tok_s, 1),
+               "fused_tok_s": round(fused_tok_s, 1),
+               "ratio": round(fused_tok_s / plain_tok_s, 3)}
+        points.append(row)
+        print(json.dumps(row), flush=True)
+
+    cross = None
+    pts = sorted(points, key=lambda r: r["acceptance"])
+    for a, b in zip(pts, pts[1:]):
+        if a["ratio"] < 1.0 <= b["ratio"]:
+            da = (1.0 - a["ratio"]) / max(b["ratio"] - a["ratio"], 1e-9)
+            cross = a["acceptance"] + da * (b["acceptance"] - a["acceptance"])
+            break
+    if pts and pts[0]["ratio"] >= 1.0:
+        cross = pts[0]["acceptance"]
+    print(json.dumps({"crossover_acceptance":
+                      None if cross is None else round(cross, 3),
+                      "verify_window": T, "decode_steps_after_verify": R}))
+
+
+if __name__ == "__main__":
+    main()

@@ -80,6 +80,37 @@ SLOW_TESTS = {
     "test_chunked_with_prefix_cache_and_speculation",
     "test_flash_gqa_folded_matches_xla",
     "test_tp2_greedy_matches_single_device",
+    # round-3 additions (>= ~6 s in the not-slow durations run)
+    "test_int4_decode_tracks_fp_logits",
+    "test_bf16_nu_loss_trajectory_close_to_fp32",
+    "test_decode_consistent_with_quantized_dense",
+    "test_fused_adamw_bitwise_matches_optax",
+    "test_page_aligned_prompt_recomputes_last_token",
+    "test_grad_accum_matches_full_batch",
+    "test_seeded_sampling_survives_preemption",
+    "test_checkpoint_roundtrip_sharded",
+    "test_tp2_sampled_matches_single_device",
+    "test_negative_top_k_means_disabled_not_greedy",
+    "test_ondemand_coschedules_what_reserve_serializes",
+    "test_short_prompts_stay_on_single_dispatch",
+    "test_orchestrator_restart_on_failure",
+    "test_train_writes_checkpoints_and_manifest",
+    "test_top_p_zero_is_greedy",
+    "test_per_step_chunk_budget_round_robins",
+    "test_kv_cache_decode_matches_full_forward",
+    "test_close_to_fp_generation",
+    "test_replay_reproduces_loss",
+    "test_preempted_greedy_matches_unconstrained",
+    "test_long_prompt_burst_does_not_stall_resident_stream",
+    "test_all_features_on_quantized_kv",
+    "test_batched_scores_match_manual",
+    "test_loss_goes_down",
+    "test_int4_with_features_stacked",
+    "test_preemption_preserves_waiters_and_metadata",
+    "test_speculation_and_prefix_cache_on_int8",
+    "test_grad_clipping_applied",
+    "test_ring_attention_gradients",
+    "test_closed_loop_under_pressure_completes",
 }
 
 
