@@ -26,6 +26,7 @@ from ..models.layers import (
 from ..ops.paged_attention import (
     paged_attention_multi,
     write_token_to_pages,
+    write_window_to_pages,
 )
 from ..ops.quantization import cast_params, precast_params
 
@@ -69,6 +70,12 @@ def extend_step_forward(
                               # tensor-parallel engine forces "gather" (the
                               # Pallas kernel is opaque to GSPMD and would
                               # be replicated, gathering all pages per chip)
+    write_mode: str = "paged",  # "paged" (2B whole-page DMAs) | "scatter"
+                              # (B*T row scatter). A traced constant: the
+                              # caller fixes it at program-build time (the
+                              # engine reads LLMCTL_EXTEND_WRITE once at
+                              # construction) — reading env HERE would
+                              # bake a stale value into cached programs
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Paged forward over T tokens per slot: the multi-token sibling of
     ``decode_step_forward``. Returns (logits [B, T, V] fp32, k_pages, v_pages).
@@ -95,6 +102,11 @@ def extend_step_forward(
     flat_pos = positions.reshape(B * T)
     flat_tables = jnp.repeat(block_tables, T, axis=0)        # [B*T, maxP]
     flat_ok = None if write_ok is None else write_ok.reshape(B * T)
+    from ..ops.paged_attention import QuantPages
+    use_window_write = (
+        T > 1 and T <= k_pages.shape[-2]
+        and not isinstance(k_pages, QuantPages)
+        and write_mode != "scatter")
 
     x = params["embed"]["embedding"][tokens].astype(compute_dtype)  # [B,T,H]
     inv_freq = rope_frequencies(cfg.head_dim, cfg.rope.base,
@@ -116,10 +128,21 @@ def extend_step_forward(
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
 
-        kp = write_token_to_pages(kp, k.reshape(B * T, Nkv, D), flat_tables,
-                                  flat_pos, flat_ok)
-        vp = write_token_to_pages(vp, v.reshape(B * T, Nkv, D), flat_tables,
-                                  flat_pos, flat_ok)
+        if use_window_write:
+            # page-granular write (2B whole-page DMAs) instead of a
+            # B*T-row scatter — the r2-measured verify-window suspect;
+            # A/B via LLMCTL_EXTEND_WRITE=paged|scatter (default paged on
+            # plain pages; QuantPages always scatter — per-token quant
+            # rides the row path)
+            kp = write_window_to_pages(kp, k, block_tables,
+                                       start_positions, write_ok)
+            vp = write_window_to_pages(vp, v, block_tables,
+                                       start_positions, write_ok)
+        else:
+            kp = write_token_to_pages(kp, k.reshape(B * T, Nkv, D),
+                                      flat_tables, flat_pos, flat_ok)
+            vp = write_token_to_pages(vp, v.reshape(B * T, Nkv, D),
+                                      flat_tables, flat_pos, flat_ok)
         attn = paged_attention_multi(q, kp, vp, block_tables,
                                      start_positions, impl=attn_impl)
         attn = attn.reshape(B, T, Nq * D)

@@ -187,6 +187,11 @@ class InferenceEngine:
         self._ctx = np.zeros((S, serve_cfg.max_seq_len), np.int32)
         self._ctx_len = np.zeros(S, np.int64)
 
+        # extend-path KV write mode, fixed at construction so every
+        # compiled program in this engine uses one mode (a trace-time env
+        # read would bake stale values into cached programs)
+        import os as _os
+        self._extend_write = _os.environ.get("LLMCTL_EXTEND_WRITE", "paged")
         self._prefill_cache: dict[int, callable] = {}
         # chunked prefill: request_id -> progress state (one chunk advances
         # per engine step, interleaved with decode)
@@ -381,7 +386,8 @@ class InferenceEngine:
                             < m[:, None])
                 logits, k_pages, v_pages = extend_step_forward(
                     params, tokens, start, k_pages, v_pages, table, cfg,
-                    write_ok=write_ok, attn_impl=self._attn_impl)
+                    write_ok=write_ok, attn_impl=self._attn_impl,
+                    write_mode=self._extend_write)
                 last = jnp.take_along_axis(
                     logits, (m - 1)[:, None, None], axis=1)[:, 0]   # [1, V]
                 token = sample_tokens(last, key[None], temp[None],
@@ -407,7 +413,8 @@ class InferenceEngine:
                             < m[:, None])
                 _, k_pages, v_pages = extend_step_forward(
                     params, tokens, start, k_pages, v_pages, table, cfg,
-                    write_ok=write_ok, attn_impl=self._attn_impl)
+                    write_ok=write_ok, attn_impl=self._attn_impl,
+                    write_mode=self._extend_write)
                 return k_pages, v_pages
 
             self._prefill_cache[key_] = jax.jit(
@@ -573,8 +580,10 @@ class InferenceEngine:
             bucket = self._suffix_bucket(computed)
             tokens = np.zeros((1, bucket), np.int32)
             tokens[0, :computed] = ctx[cached:]
-            if first_prefill:
-                req.prefill_bucket = bucket
+            # NO prefill_bucket here: this is the suffix-extend program,
+            # whose bucket ints collide with dense calibration keys —
+            # attach_device_times must skip prefix-hit requests rather
+            # than bill them a full dense prefill
             token, self.kv.k_pages, self.kv.v_pages = \
                 self._extend_prefill_fn(bucket)(
                     self.params, jnp.asarray(tokens),
@@ -671,7 +680,7 @@ class InferenceEngine:
             slot_keys, temp, top_k, top_p, self.cfg,
             num_decode_steps=max(
                 self.serve_cfg.decode_steps_per_dispatch - 1, 0),
-            attn_impl=self._attn_impl)
+            attn_impl=self._attn_impl, write_mode=self._extend_write)
 
     def _spec_device(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         """One fused speculative dispatch: propose drafts on host (prompt-
@@ -1005,6 +1014,9 @@ class InferenceEngine:
         device-time number, not RTT arithmetic)."""
         out: dict = {"prefill_ms": {}, "iters": iters}
         kp, vp = self.kv.k_pages, self.kv.v_pages
+        # probes DONATE the page buffers: keep self.kv pointed at the
+        # live arrays after every dispatch so an exception mid-
+        # calibration can't leave the engine holding deleted buffers
         # dense-prefill programs only: the cache also holds
         # ("extend", b)/("chunk", b) tuple keys, which are different
         # programs (and unsortable against ints)
@@ -1017,6 +1029,7 @@ class InferenceEngine:
                     jax.random.PRNGKey(0), jnp.float32(0.0),
                     jnp.int32(0), jnp.float32(1.0))
             token, kp, vp = fn(self.params, tokens, *args)   # warm/compile
+            self.kv.k_pages, self.kv.v_pages = kp, vp
             int(token)
             t0 = time.perf_counter()
             for _ in range(iters):
@@ -1025,6 +1038,7 @@ class InferenceEngine:
                                    entries, jax.random.PRNGKey(0),
                                    jnp.float32(0.0), jnp.int32(0),
                                    jnp.float32(1.0))
+            self.kv.k_pages, self.kv.v_pages = kp, vp
             int(token)                                        # one fence
             out["prefill_ms"][bucket] = (time.perf_counter() - t0) \
                 / iters * 1e3
@@ -1042,11 +1056,13 @@ class InferenceEngine:
                  jnp.ones(self.serve_cfg.max_batch_size, jnp.float32))
         sampled, kp, vp = self._decode_jit(
             self.params, kp, vp, zeros_i, zeros_i, *dargs)
+        self.kv.k_pages, self.kv.v_pages = kp, vp
         np.asarray(sampled)
         t0 = time.perf_counter()
         for _ in range(iters):
             sampled, kp, vp = self._decode_jit(
                 self.params, kp, vp, zeros_i, zeros_i, *dargs)
+        self.kv.k_pages, self.kv.v_pages = kp, vp
         np.asarray(sampled)
         out["decode_ms_per_token"] = (time.perf_counter() - t0) \
             / (iters * K) * 1e3

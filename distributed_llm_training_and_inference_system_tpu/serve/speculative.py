@@ -86,6 +86,7 @@ def speculative_verify(
     top_p: jax.Array,
     cfg: ModelConfig,
     attn_impl: str = "auto",
+    write_mode: str = "paged",
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """One verification pass. Returns (emitted [B, T], n_emit [B], kp, vp).
 
@@ -103,7 +104,7 @@ def speculative_verify(
     write_ok = (positions[:, None] + offs) < stop_positions[:, None]
     logits, k_pages, v_pages = extend_step_forward(
         params, tokens, positions, k_pages, v_pages, block_tables, cfg,
-        write_ok=write_ok, attn_impl=attn_impl)
+        write_ok=write_ok, attn_impl=attn_impl, write_mode=write_mode)
 
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)     # [B, T]
     is_greedy = temperature <= 0.0
@@ -136,6 +137,7 @@ def verify_and_decode(
     cfg: ModelConfig,
     num_decode_steps: int,
     attn_impl: str = "auto",
+    write_mode: str = "paged",
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """Fused dispatch: one verification window + ``num_decode_steps`` plain
     decode iterations, all on device.
@@ -157,7 +159,7 @@ def verify_and_decode(
     emitted, n_emit, k_pages, v_pages = speculative_verify(
         params, tokens, positions, k_pages, v_pages, block_tables,
         stop_positions, slot_keys, temperature, top_k, top_p, cfg,
-        attn_impl=attn_impl)
+        attn_impl=attn_impl, write_mode=write_mode)
     if num_decode_steps < 1:
         B = tokens.shape[0]
         return (emitted, n_emit,
