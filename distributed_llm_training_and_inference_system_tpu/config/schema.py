@@ -594,10 +594,12 @@ class ServeConfig:
             raise ConfigError("quantization must be none|int8|int4|int4-awq")
         if self.chunked_prefill_tokens < 0:
             raise ConfigError("chunked_prefill_tokens must be >= 0")
-        if self.quantization != "none" and self.tensor_parallel > 1:
+        if self.quantization.startswith("int4") and self.tensor_parallel > 1:
             raise ConfigError(
-                "quantized serving + tensor_parallel is not supported yet "
-                "(PARAM_RULES shard plain kernels, not Quant[4]Tensor leaves)")
+                "int4 serving + tensor_parallel is not supported yet (the "
+                "packed [L, out, in/2] nibble layout doesn't map onto the "
+                "kernel PARAM_RULES; int8+tp works — param_specs shards "
+                "QuantTensor leaves like the kernels they replace)")
         # the engine checks `speculative == "ngram"`, so a config-file typo
         # ("n-gram", "medusa") would otherwise silently disable speculation
         if self.speculative not in ("off", "ngram"):

@@ -93,16 +93,31 @@ def _shrink_to_fit(spec: P, shape: tuple[int, ...], mesh: Mesh) -> P:
 
 
 def param_specs(params: Any, mesh: Mesh) -> Any:
-    """PartitionSpec pytree matching *params* (stacked-layer layout)."""
-    from ..utils.tree import flatten_with_paths
-    flat = flatten_with_paths(params)
-    specs = {}
+    """PartitionSpec pytree matching *params* (stacked-layer layout).
+
+    int8 ``QuantTensor`` leaves (W8A16 serving) shard like the plain
+    kernel they replace: values [L, in, out] get the kernel's spec; the
+    per-(L, in) scale keeps the leading axes and replicates its size-1
+    tail — so Megatron-style tp serving works on quantized weights too
+    (the round-2 engine refused the combination)."""
+    from ..ops.quantization import QuantTensor
+    from ..utils.tree import path_str
+
+    def is_q(x):
+        return isinstance(x, QuantTensor)
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params,
+                                                         is_leaf=is_q)
+    leaves = []
     for path, leaf in flat:
-        spec = spec_for_path(path, stacked=True)
-        specs[path] = _shrink_to_fit(spec, leaf.shape, mesh)
-    # rebuild tree with same structure
-    treedef = jax.tree_util.tree_structure(params)
-    return jax.tree_util.tree_unflatten(treedef, [specs[p] for p, _ in flat])
+        spec = spec_for_path(path_str(path), stacked=True)
+        if is_q(leaf):
+            v = _shrink_to_fit(spec, leaf.values.shape, mesh)
+            s = _shrink_to_fit(P(*v[:-1], None), leaf.scale.shape, mesh)
+            leaves.append(QuantTensor(v, s))
+        else:
+            leaves.append(_shrink_to_fit(spec, leaf.shape, mesh))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
 def param_shardings(params: Any, mesh: Mesh) -> Any:

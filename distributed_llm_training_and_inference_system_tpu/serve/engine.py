@@ -192,6 +192,11 @@ class InferenceEngine:
         # read would bake stale values into cached programs)
         import os as _os
         self._extend_write = _os.environ.get("LLMCTL_EXTEND_WRITE", "paged")
+        if self._extend_write not in ("paged", "scatter"):
+            raise ValueError(
+                f"LLMCTL_EXTEND_WRITE={self._extend_write!r} "
+                "(must be paged|scatter) — a typo here would silently "
+                "select the paged path and poison A/B data")
         self._prefill_cache: dict[int, callable] = {}
         # chunked prefill: request_id -> progress state (one chunk advances
         # per engine step, interleaved with decode)
@@ -1038,7 +1043,7 @@ class InferenceEngine:
                                    entries, jax.random.PRNGKey(0),
                                    jnp.float32(0.0), jnp.int32(0),
                                    jnp.float32(1.0))
-            self.kv.k_pages, self.kv.v_pages = kp, vp
+                self.kv.k_pages, self.kv.v_pages = kp, vp
             int(token)                                        # one fence
             out["prefill_ms"][bucket] = (time.perf_counter() - t0) \
                 / iters * 1e3
@@ -1062,11 +1067,10 @@ class InferenceEngine:
         for _ in range(iters):
             sampled, kp, vp = self._decode_jit(
                 self.params, kp, vp, zeros_i, zeros_i, *dargs)
-        self.kv.k_pages, self.kv.v_pages = kp, vp
+            self.kv.k_pages, self.kv.v_pages = kp, vp
         np.asarray(sampled)
         out["decode_ms_per_token"] = (time.perf_counter() - t0) \
             / (iters * K) * 1e3
-        self.kv.k_pages, self.kv.v_pages = kp, vp
         return out
 
     def run_until_idle(self, max_steps: int = 100_000) -> None:

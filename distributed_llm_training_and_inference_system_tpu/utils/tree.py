@@ -32,20 +32,26 @@ def tree_cast(tree: Any, dtype) -> Any:
     return jax.tree_util.tree_map(cast, tree)
 
 
-def flatten_with_paths(tree: Any) -> list[tuple[str, Any]]:
+def path_str(path) -> str:
+    """Dotted string for a jax key path — THE format PARAM_RULES regexes
+    match against; every flattener must share it."""
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return ".".join(parts)
+
+
+def flatten_with_paths(tree: Any, is_leaf=None) -> list[tuple[str, Any]]:
     """Flatten a pytree to (dotted-path, leaf) pairs, stable order."""
-    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree, is_leaf=is_leaf)
     out = []
     for path, leaf in flat:
-        parts = []
-        for p in path:
-            if hasattr(p, "key"):
-                parts.append(str(p.key))
-            elif hasattr(p, "idx"):
-                parts.append(str(p.idx))
-            elif hasattr(p, "name"):
-                parts.append(str(p.name))
-            else:
-                parts.append(str(p))
-        out.append((".".join(parts), leaf))
+        out.append((path_str(path), leaf))
     return out
