@@ -562,12 +562,15 @@ class ServeConfig:
     # kv-head axis, GSPMD inserts the per-layer collectives. Requires
     # num_kv_heads % tensor_parallel == 0 and that many local devices.
     tensor_parallel: int = 1
-    # weight-only int8 serving (W8A16): block kernels are stored int8 in
-    # HBM (~2x model memory freed for KV pages / bigger models) and
-    # dequantized one layer at a time inside the forward scan. Embeddings
-    # and lm_head stay bf16 (quantizing the tied unembed costs the most
-    # output quality for the least memory).
-    quantization: str = "none"      # none | int8
+    # weight-only quantized serving: block kernels are stored int8
+    # (W8A16, ~2x block memory freed) or group-wise int4 / int4-awq
+    # (W4A16, ~4x; awq = activation-aware channel scaling from a
+    # synthetic calibration pass) and dequantized one layer at a time
+    # inside the forward scan. Embeddings and lm_head stay bf16
+    # (quantizing the tied unembed costs the most output quality for the
+    # least memory). Composes with tensor_parallel (param_specs shards
+    # the quantized leaves like the kernels they replace).
+    quantization: str = "none"      # none | int8 | int4 | int4-awq
     # int8 KV cache: pages stored int8 with per-token absmax scales (~3%
     # overhead at D=128) — 2x KV capacity per HBM byte and half the
     # decode-attention KV streaming. Dequant happens in VMEM inside the
@@ -594,12 +597,10 @@ class ServeConfig:
             raise ConfigError("quantization must be none|int8|int4|int4-awq")
         if self.chunked_prefill_tokens < 0:
             raise ConfigError("chunked_prefill_tokens must be >= 0")
-        if self.quantization.startswith("int4") and self.tensor_parallel > 1:
-            raise ConfigError(
-                "int4 serving + tensor_parallel is not supported yet (the "
-                "packed [L, out, in/2] nibble layout doesn't map onto the "
-                "kernel PARAM_RULES; int8+tp works — param_specs shards "
-                "QuantTensor leaves like the kernels they replace)")
+        # quantized + tensor_parallel is supported for int8 AND int4:
+        # param_specs shards Quant[4]Tensor leaves like the kernels they
+        # replace (the int4 packed layout maps transposed onto the kernel
+        # rules) — equivalence in tests/test_tp_serve.py
         # the engine checks `speculative == "ngram"`, so a config-file typo
         # ("n-gram", "medusa") would otherwise silently disable speculation
         if self.speculative not in ("off", "ngram"):

@@ -95,23 +95,40 @@ def _shrink_to_fit(spec: P, shape: tuple[int, ...], mesh: Mesh) -> P:
 def param_specs(params: Any, mesh: Mesh) -> Any:
     """PartitionSpec pytree matching *params* (stacked-layer layout).
 
-    int8 ``QuantTensor`` leaves (W8A16 serving) shard like the plain
-    kernel they replace: values [L, in, out] get the kernel's spec; the
-    per-(L, in) scale keeps the leading axes and replicates its size-1
-    tail — so Megatron-style tp serving works on quantized weights too
-    (the round-2 engine refused the combination)."""
-    from ..ops.quantization import QuantTensor
+    Quantized serving leaves shard like the plain kernels they replace
+    (the round-2 engine refused quantized+tp entirely):
+
+    - int8 ``QuantTensor``: values [L, in, out] get the kernel's spec;
+      the per-(L, in) scale keeps the leading axes and replicates its
+      size-1 tail.
+    - int4 ``Quant4Tensor`` stores TRANSPOSED packed nibbles
+      [L, out, in/2] with group scales [L, out, in/group] and channel
+      scales [L, in]: the kernel spec (layer, in_ax, out_ax) maps to
+      (layer, out_ax, in_ax) for packed+scales and (layer, in_ax) for
+      chan — the same tp/fsdp placement as the dequantized kernel.
+    """
+    from ..ops.quantization import Quant4Tensor, QuantTensor
     from ..utils.tree import path_str
 
     def is_q(x):
-        return isinstance(x, QuantTensor)
+        return isinstance(x, (QuantTensor, Quant4Tensor))
 
     flat, treedef = jax.tree_util.tree_flatten_with_path(params,
                                                          is_leaf=is_q)
     leaves = []
     for path, leaf in flat:
         spec = spec_for_path(path_str(path), stacked=True)
-        if is_q(leaf):
+        if isinstance(leaf, Quant4Tensor):
+            layer_ax, in_ax, out_ax = (spec + (None, None, None))[:3]
+            packed = _shrink_to_fit(P(layer_ax, out_ax, in_ax),
+                                    leaf.packed.shape, mesh)
+            scale = _shrink_to_fit(P(layer_ax, out_ax, in_ax),
+                                   leaf.scale.shape, mesh)
+            chan = _shrink_to_fit(P(layer_ax, in_ax), leaf.chan.shape,
+                                  mesh)
+            leaves.append(Quant4Tensor(packed, scale, chan,
+                                       group=leaf.group))
+        elif isinstance(leaf, QuantTensor):
             v = _shrink_to_fit(spec, leaf.values.shape, mesh)
             s = _shrink_to_fit(P(*v[:-1], None), leaf.scale.shape, mesh)
             leaves.append(QuantTensor(v, s))

@@ -48,9 +48,11 @@ def path_str(path) -> str:
     return ".".join(parts)
 
 
-def flatten_with_paths(tree: Any, is_leaf=None) -> list[tuple[str, Any]]:
-    """Flatten a pytree to (dotted-path, leaf) pairs, stable order."""
-    flat, _ = jax.tree_util.tree_flatten_with_path(tree, is_leaf=is_leaf)
+def flatten_with_paths(tree: Any) -> list[tuple[str, Any]]:
+    """Flatten a pytree to (dotted-path, leaf) pairs, stable order.
+    (Quant-aware flattening lives in parallel/sharding.param_specs, which
+    needs the treedef too and calls tree_flatten_with_path + path_str.)"""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
     out = []
     for path, leaf in flat:
         out.append((path_str(path), leaf))

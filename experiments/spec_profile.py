@@ -35,7 +35,6 @@ def main() -> None:
     write_mode = os.environ.get("LLMCTL_EXTEND_WRITE", "paged")
     if write_mode not in ("paged", "scatter"):
         raise SystemExit(f"bad LLMCTL_EXTEND_WRITE {write_mode!r}")
-    print(json.dumps({"write_mode": write_mode}))
 
     model = sys.argv[1] if len(sys.argv) > 1 else "gpt-1b"
     cfg = get_model_config(model)
@@ -80,6 +79,7 @@ def main() -> None:
         params, toksT, pos, kp_, vp_, tables, cfg,
         write_mode=write_mode)[0])
 
+    out["write_mode"] = write_mode
     which = (sys.argv[2] if len(sys.argv) > 2 else "d8,v8").split(",")
     progs = {"d1": ("decode1_ms", d1), "d8": ("decode8_ms", d8),
              "v8": ("verify8_ms", v8), "e8": ("extend8_ms", e8)}

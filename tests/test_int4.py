@@ -133,10 +133,19 @@ class TestInt4Serving:
             assert len(r.generated_tokens) == 6
         assert eng.kv.prefix_hits > 0
 
-    def test_tp_plus_quant_rejected(self):
-        with pytest.raises(ConfigError, match="tensor_parallel"):
-            ServeConfig(model="gpt-test", quantization="int4",
-                        tensor_parallel=2).validate()
+    @pytest.mark.parametrize("mode", ["int4", "int4-awq"])
+    def test_tp2_int4_matches_single_device(self, model_cfg, mode):
+        """int4[-awq] + tensor-parallel: the packed layout (and the awq
+        chan scales) shard transposed onto the kernel rules; tp=2 greedy
+        output must equal the single-device engine's."""
+        prompt = [5, 17, 99, 3, 42, 7, 11, 23]
+        [want] = self._engine(model_cfg, quantization=mode).generate(
+            [prompt], SamplingParams(temperature=0.0, max_tokens=8))
+        tp2 = self._engine(model_cfg, quantization=mode,
+                           tensor_parallel=2, max_batch_size=2)
+        [got] = tp2.generate([prompt], SamplingParams(temperature=0.0,
+                                                      max_tokens=8))
+        assert got.generated_tokens == want.generated_tokens
 
 
 class TestInt4Export:

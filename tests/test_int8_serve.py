@@ -126,10 +126,9 @@ class TestInt8Engine:
         assert s["spec_dispatches"] > 0
         assert s["kv"]["prefix_hits"] > 0
 
-    def test_tp_plus_int8_now_supported_int4_rejected(self):
-        """Round 3: int8+tp is a supported combination (param_specs shards
-        QuantTensor leaves — equivalence in tests/test_tp_serve.py); the
-        packed int4 layout remains rejected with a reason."""
+    def test_tp_plus_quantization_supported(self):
+        """Round 3: quantized + tp validates for int8 AND int4
+        (param_specs shards Quant[4]Tensor leaves — equivalence in
+        tests/test_tp_serve.py)."""
         ServeConfig(quantization="int8", tensor_parallel=2).validate()
-        with pytest.raises(ConfigError, match="not supported yet"):
-            ServeConfig(quantization="int4", tensor_parallel=2).validate()
+        ServeConfig(quantization="int4", tensor_parallel=2).validate()
