@@ -50,8 +50,11 @@ def app(ctx):
               help="Shard the model over this many local devices "
                    "(Megatron TP; needs num_kv_heads % tp == 0).")
 @click.option("--quantization", default="none", show_default=True,
-              type=click.Choice(["none", "int8"]),
-              help="Weight-only int8 (W8A16): ~2x model HBM freed for KV.")
+              type=click.Choice(["none", "int8", "int4", "int4-awq"]),
+              help="Weight-only quantization: int8 (W8A16, ~2x block HBM "
+                   "freed) or group-wise int4 / int4-awq (W4A16, ~4x; awq "
+                   "= activation-aware channel scaling). Composes with "
+                   "--tensor-parallel.")
 @click.option("--chunked-prefill", default=0, show_default=True, type=int,
               help="Prefill prompts longer than this in chunks of this "
                    "many tokens, interleaved with decode (0 = off).")
@@ -59,10 +62,16 @@ def app(ctx):
               type=click.Choice(["none", "int8"]),
               help="int8 KV pages (+per-token scales): 2x KV capacity, "
                    "half the decode KV streaming.")
+@click.option("--admission", default="ondemand", show_default=True,
+              type=click.Choice(["ondemand", "reserve"]),
+              help="KV admission: ondemand grows page chains as decode "
+                   "advances and preempts newest-first under pressure "
+                   "(higher sustained concurrency); reserve holds "
+                   "prompt+max_tokens up front.")
 def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
           kv_block_size, kv_hbm_gb, scheduler, dtype, prometheus_port,
           speculative, spec_tokens, prefix_cache, tensor_parallel,
-          quantization, chunked_prefill, kv_quantization):
+          quantization, chunked_prefill, kv_quantization, admission):
     """Start the OpenAI-compatible inference server."""
     import jax
 
@@ -83,7 +92,7 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
         speculative_tokens=spec_tokens, prefix_caching=prefix_cache,
         tensor_parallel=tensor_parallel, quantization=quantization,
         chunked_prefill_tokens=chunked_prefill,
-        kv_quantization=kv_quantization)
+        kv_quantization=kv_quantization, admission=admission)
     serve_cfg.validate()
 
     observer = None
