@@ -104,6 +104,9 @@ def kernels(op, seq_len, hidden, heads, batch):
               help="serve-load: comma-separated closed-loop sweep.")
 @click.option("--admission", default="ondemand", show_default=True,
               type=click.Choice(["ondemand", "reserve"]))
+@click.option("--preemption", default="recompute", show_default=True,
+              type=click.Choice(["recompute", "swap"]),
+              help="serve-load: evicted-KV policy under ondemand.")
 @click.option("--kv-blocks", default=0, show_default=True,
               help="serve-load: fixed KV pool size (0 = auto from budget).")
 @click.option("--device-times/--no-device-times", default=True,
@@ -111,7 +114,8 @@ def kernels(op, seq_len, hidden, heads, batch):
               help="serve-load: calibrate on-device prefill/decode times "
                    "and report ttft_device_ms (link RTT excluded).")
 def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
-        requests, rps, concurrency, admission, kv_blocks, device_times):
+        requests, rps, concurrency, admission, kv_blocks, device_times,
+        preemption):
     """End-to-end train step throughput / serve TTFT+throughput
     (parity: reference bench.py:35-49). ``serve-load`` runs open-loop
     (Poisson) and closed-loop sweeps with p50/p99 TTFT, per-token latency,
@@ -196,7 +200,7 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
                                 cfg.max_position_embeddings),
                 kv_block_size=64 if on_tpu else 16,
                 kv_num_blocks=kv_blocks,
-                admission=admission,
+                admission=admission, preemption=preemption,
                 dtype="bfloat16" if on_tpu else "float32"))
 
         def warmed_engine():
@@ -212,8 +216,9 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
             eng.total_decode_steps = 0
             return eng
 
-        results["serve_load"] = {"admission": admission, "open_loop": [],
-                                 "closed_loop": []}
+        results["serve_load"] = {"admission": admission,
+                                 "preemption": preemption,
+                                 "open_loop": [], "closed_loop": []}
         for r in [float(x) for x in str(rps).split(",") if x]:
             out = run_poisson(warmed_engine(), offered_rps=r,
                               num_requests=requests, prompt_len=prompt_len,

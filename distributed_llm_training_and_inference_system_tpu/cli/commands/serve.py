@@ -68,10 +68,16 @@ def app(ctx):
                    "advances and preempts newest-first under pressure "
                    "(higher sustained concurrency); reserve holds "
                    "prompt+max_tokens up front.")
+@click.option("--preemption", default="recompute", show_default=True,
+              type=click.Choice(["recompute", "swap"]),
+              help="Evicted-KV policy: recompute re-prefills on "
+                   "readmission (prefix-cache-cheap); swap round-trips "
+                   "the pages through host memory (zero re-prefill).")
 def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
           kv_block_size, kv_hbm_gb, scheduler, dtype, prometheus_port,
           speculative, spec_tokens, prefix_cache, tensor_parallel,
-          quantization, chunked_prefill, kv_quantization, admission):
+          quantization, chunked_prefill, kv_quantization, admission,
+          preemption):
     """Start the OpenAI-compatible inference server."""
     import jax
 
@@ -92,7 +98,8 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
         speculative_tokens=spec_tokens, prefix_caching=prefix_cache,
         tensor_parallel=tensor_parallel, quantization=quantization,
         chunked_prefill_tokens=chunked_prefill,
-        kv_quantization=kv_quantization, admission=admission)
+        kv_quantization=kv_quantization, admission=admission,
+        preemption=preemption)
     serve_cfg.validate()
 
     observer = None

@@ -587,6 +587,20 @@ class ServeConfig:
     #     decode can never OOM, but worst-case-sized reservations strand
     #     capacity that requests finishing early never use.
     admission: str = "ondemand"
+    # what eviction does with a preempted request's KV (ondemand only):
+    #   recompute — drop the pages and re-prefill prompt+generated on
+    #     readmission (cheap when prefix caching still holds the pages;
+    #     zero host memory)
+    #   swap — copy the slot's pages to HOST memory and write them back on
+    #     readmission: no re-prefill compute at all. Wins when
+    #     host<->device bandwidth beats re-prefill FLOPs (co-located
+    #     hosts, long contexts); falls back to recompute if the pool
+    #     can't hold the restore.
+    preemption: str = "recompute"
+    # host-memory budget for swapped-out KV (preemption=swap): above it,
+    # further evictions fall back to recompute (vLLM's swap_space analog
+    # — unbounded host copies would grow with queue depth x context)
+    swap_space_gb: float = 4.0
 
     def validate(self) -> None:
         if self.kv_quantization not in ("none", "int8"):
@@ -612,6 +626,8 @@ class ServeConfig:
             raise ConfigError("scheduler must be continuous|static")
         if self.admission not in ("ondemand", "reserve"):
             raise ConfigError("admission must be ondemand|reserve")
+        if self.preemption not in ("recompute", "swap"):
+            raise ConfigError("preemption must be recompute|swap")
 
     @classmethod
     def from_dict(cls, d: dict[str, Any] | None) -> "ServeConfig":

@@ -181,6 +181,7 @@ class InferenceEngine:
         # (global progress guarantee under on-demand admission)
         self._slot_seq = np.zeros(S, np.int64)
         self.total_preemptions = 0
+        self.total_swap_ins = 0
         # per-slot incremental context (prompt + accepted tokens) for the
         # speculative draft proposer — rebuilding prompt+generated lists
         # per dispatch is O(context) host work in the latency-critical loop
@@ -273,6 +274,17 @@ class InferenceEngine:
         evictable) and only the remainder is reserved."""
         ctx = req.context_tokens   # prompt, + generated after a preemption
         n = len(ctx)
+        if req.swapped_kv is not None:
+            # swap-in admission: the request brings its own pages — no
+            # prefix pinning (it would double-count against the restore
+            # allocation); reserve the restore footprint + lookahead
+            need = max(self.kv.pages_needed(n + self._admission_tail(req)),
+                       req.swapped_kv["pages"]["num_pages"])
+            if need > self.kv.free_pages - self._reserved_pages:
+                return False
+            self._reserved_pages += need
+            self._reserved_by[req.request_id] = need
+            return True
         pins: list[int] = []
         usable = 0
         if self.serve_cfg.prefix_caching:
@@ -611,23 +623,21 @@ class InferenceEngine:
         self.total_prefill_tokens += computed
         return req, token
 
-    def _finish_prefill(self, req: Request, token) -> None:
-        """Resolve a dispatched prefill: fetch its first token and make the
-        slot live for decode."""
+    def _arm_slot(self, req: Request, last_token: int, n_written: int,
+                  ctx: list) -> None:
+        """Make a slot live for decode — the ONE place the per-slot decode
+        invariants are set (prefill completion AND swap-in restore; a
+        field added here reaches both paths). ``n_written`` is the number
+        of KV entries present; ``ctx`` the full token context including
+        ``last_token`` (whose KV is written on its decode step)."""
         slot = req.slot
-        ctx = req.context_tokens       # BEFORE recording the new token
-        n = len(ctx)
         s = req.sampling
-        req.record_token(int(token))
-        if self.on_token is not None:
-            self.on_token(req, [int(token)])
         from .scheduler import RequestState
         req.state = RequestState.RUNNING
-        self.last_tokens[slot] = int(token)
-        self._ctx[slot, :n] = ctx
-        self._ctx[slot, n] = int(token)
-        self._ctx_len[slot] = n + 1
-        self.positions[slot] = n
+        self.last_tokens[slot] = last_token
+        self._ctx[slot, :len(ctx)] = ctx
+        self._ctx_len[slot] = len(ctx)
+        self.positions[slot] = n_written
         # first position this slot may NOT write: absolute generation cap
         # (prompt + max_tokens); multi-step decode masks writes at/past
         # this bound to scratch page 0. Under on-demand admission the
@@ -638,6 +648,16 @@ class InferenceEngine:
         self.temperature[slot] = s.temperature
         self.top_k[slot] = s.top_k
         self.top_p[slot] = s.top_p
+
+    def _finish_prefill(self, req: Request, token) -> None:
+        """Resolve a dispatched prefill: fetch its first token and make the
+        slot live for decode."""
+        ctx = req.context_tokens       # BEFORE recording the new token
+        n = len(ctx)
+        req.record_token(int(token))
+        if self.on_token is not None:
+            self.on_token(req, [int(token)])
+        self._arm_slot(req, int(token), n, ctx + [int(token)])
 
     # -- decode --------------------------------------------------------------
 
@@ -802,6 +822,45 @@ class InferenceEngine:
 
     # -- lifecycle -----------------------------------------------------------
 
+    def _swap_bytes_in_queue(self) -> int:
+        """Host bytes currently held by swapped-out waiting requests.
+        Computed lazily (the queue is bounded and preemption is rare)
+        rather than via incremental counters that cancel paths could
+        leave stale."""
+        total = 0
+        for r in self.scheduler.waiting:
+            if r.swapped_kv is not None:
+                for part in (r.swapped_kv["pages"]["k"],
+                             r.swapped_kv["pages"]["v"]):
+                    if isinstance(part, dict):
+                        total += sum(a.nbytes for a in part.values())
+                    else:
+                        total += part.nbytes
+        return total
+
+    def _restore_swapped(self, req: Request) -> bool:
+        """Swap-in (preemption=swap readmission): allocate pages, write the
+        saved K/V back, and make the slot live for decode — NO prefill
+        compute. Returns False when the pool can't hold the restore; the
+        caller clears swapped_kv and falls back to recompute-prefill."""
+        slot = req.slot
+        rid = req.request_id
+        saved = req.swapped_kv
+        with self.lock:
+            if not self.kv.restore_slot(slot, saved["pages"]):
+                return False
+            self._reserved_pages -= self._reserved_by.pop(rid, 0)
+            self._req_slot[rid] = slot
+        self._admitted_counter += 1
+        self._slot_seq[slot] = self._admitted_counter
+        slot_key = jax.random.PRNGKey(req.assigned_seed)
+        self._slot_keys[slot] = np.asarray(jax.random.key_data(slot_key))
+        self._arm_slot(req, saved["last_token"], saved["positions"],
+                       req.context_tokens)
+        req.swapped_kv = None
+        self.total_swap_ins += 1
+        return True
+
     def _preempt(self, slot: int) -> None:
         """Evict ``slot``'s RUNNING request (newest-first victim policy) so
         an older stream can grow its page chain. Recompute-style: the
@@ -814,6 +873,18 @@ class InferenceEngine:
         req = self.scheduler.slots[slot]
         rid = req.request_id
         written = int(self.positions[slot])   # KV entries actually present
+        if self.serve_cfg.preemption == "swap" and \
+                self._swap_bytes_in_queue() < \
+                self.serve_cfg.swap_space_gb * 1e9:
+            # swap-out: pages to host memory; readmission writes them
+            # back instead of re-prefilling (zero recompute). Over the
+            # host budget, fall back to recompute (the swap dict stays
+            # unset, so readmission takes the prefill path)
+            req.swapped_kv = {
+                "pages": self.kv.extract_slot(slot),
+                "positions": written,
+                "last_token": int(self.last_tokens[slot]),
+            }
         if self.serve_cfg.prefix_caching:
             from .kv_cache import prefix_page_hashes
             ctx = req.context_tokens
@@ -907,6 +978,12 @@ class InferenceEngine:
         C = self.serve_cfg.chunked_prefill_tokens
         pending = []
         for req in admitted:
+            if req.swapped_kv is not None:
+                # preemption=swap readmission: write the saved KV back
+                # (no prefill); on pool pressure fall back to recompute
+                if self._restore_swapped(req):
+                    continue
+                req.swapped_kv = None
             # route on the full re-prefill CONTEXT: a preempted request
             # resumes with prompt+generated, which can exceed the chunk
             # threshold even when the original prompt didn't — and the
@@ -1105,6 +1182,9 @@ class InferenceEngine:
             "kv": self.kv.stats(),
             "admission": self.serve_cfg.admission,
             "preemptions": self.total_preemptions,
+            "preemption_mode": self.serve_cfg.preemption,
+            "swap_ins": self.total_swap_ins,
+            "swapped_host_bytes": self._swap_bytes_in_queue(),
             "decode_steps": self.total_decode_steps,
             "prefill_tokens": self.total_prefill_tokens,
             "prefix_cached_tokens": self.total_prefix_cached_tokens,

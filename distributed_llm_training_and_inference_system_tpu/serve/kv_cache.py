@@ -222,6 +222,86 @@ class PagedKVCache:
         self.block_tables[slot, :] = 0
         self._chain_len.pop(slot, None)
 
+    # -- swap (preemption to host memory) ------------------------------------
+
+    def extract_slot(self, slot: int) -> dict:
+        """Copy ``slot``'s written pages to HOST memory (swap-out half of
+        preemption=swap). One device fetch per buffer — the page gather
+        runs on-device, only the slot's own pages cross the link."""
+        import jax
+        n = self._chain_len.get(slot, 0)
+        pages = self.block_tables[slot, :n].copy()
+        idx = jnp.asarray(pages)
+
+        def grab(buf):
+            from ..ops.paged_attention import QuantPages
+            if isinstance(buf, QuantPages):
+                return {"values": np.asarray(buf.values[:, idx]),
+                        "scale": np.asarray(buf.scale[:, idx])}
+            return np.asarray(buf[:, idx])
+        return {"k": grab(self.k_pages), "v": grab(self.v_pages),
+                "num_pages": int(n)}
+
+    def _restore_fn(self, n_bucket: int):
+        """Jitted donated page-write for swap-in: out-of-place .at[].set
+        outside jit would copy the WHOLE pool per restore (transient 2x
+        HBM + O(pool) traffic); under jit with donation XLA scatters in
+        place. One program per power-of-two page-count bucket; short
+        restores pad with scratch page 0 (writing zeros there is the
+        cache's documented no-op)."""
+        import jax
+        if not hasattr(self, "_restore_cache"):
+            self._restore_cache = {}
+        if n_bucket not in self._restore_cache:
+            def write(k_pages, v_pages, idx, kd, vd):
+                from ..ops.paged_attention import QuantPages
+
+                def put(buf, data):
+                    if isinstance(buf, QuantPages):
+                        return QuantPages(
+                            buf.values.at[:, idx].set(data["values"]),
+                            buf.scale.at[:, idx].set(data["scale"]))
+                    return buf.at[:, idx].set(data.astype(buf.dtype))
+                return put(k_pages, kd), put(v_pages, vd)
+            self._restore_cache[n_bucket] = jax.jit(
+                write, donate_argnums=(0, 1))
+        return self._restore_cache[n_bucket]
+
+    def restore_slot(self, slot: int, content: dict) -> bool:
+        """Swap-in: allocate fresh pages for the slot and write the saved
+        K/V back. Returns False (allocating nothing) when the pool can't
+        supply the pages — the caller falls back to recompute."""
+        n = content["num_pages"]
+        if n > self.free_pages:
+            return False
+        self.allocate(slot, n * self.page_size)
+        bucket = 1
+        while bucket < n:
+            bucket <<= 1
+        idx = np.zeros(bucket, np.int32)        # pad -> scratch page 0
+        idx[:n] = self.block_tables[slot, :n]
+
+        def pad(data):
+            if isinstance(data, dict):
+                return {k: pad(v) for k, v in data.items()}
+            out = np.zeros((data.shape[0], bucket, *data.shape[2:]),
+                           data.dtype)
+            out[:, :n] = data
+            return out
+        kd, vd = pad(content["k"]), pad(content["v"])
+        to_dev = (lambda d: {k: jnp.asarray(v) for k, v in d.items()}
+                  if isinstance(d, dict) else jnp.asarray(d))
+        from ..ops.paged_attention import QuantPages
+        def as_arg(buf, d):
+            if isinstance(buf, QuantPages):
+                return {"values": jnp.asarray(d["values"]),
+                        "scale": jnp.asarray(d["scale"])}
+            return jnp.asarray(d)
+        self.k_pages, self.v_pages = self._restore_fn(bucket)(
+            self.k_pages, self.v_pages, jnp.asarray(idx),
+            as_arg(self.k_pages, kd), as_arg(self.v_pages, vd))
+        return True
+
     # -- prefix cache --------------------------------------------------------
 
     def lookup_prefix(self, hashes: list[bytes]) -> list[int]:

@@ -62,6 +62,9 @@ class Request:
     # across preemption)
     assigned_seed: Optional[int] = None
     preemptions: int = 0
+    # preemption=swap: the evicted slot's KV pages + decode cursor, held
+    # in host memory until readmission (engine._preempt/_restore_swapped)
+    swapped_kv: Optional[dict] = field(default=None, repr=False)
     arrival_time: float = field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None   # for TTFT
     # when the engine dispatched this request's prefill (host clock, no
@@ -265,8 +268,11 @@ class ContinuousBatchingScheduler:
             req.state = RequestState.PREFILLING
             self.slots[slot] = req
             admitted.append(req)
-            # resumed (preempted) requests re-prefill prompt+generated
-            spent += len(req.context_tokens)
+            # resumed (preempted) requests re-prefill prompt+generated;
+            # swap-in resumes dispatch ZERO prefill — charging their
+            # context would stall genuine prefills behind phantom work
+            if req.swapped_kv is None:
+                spent += len(req.context_tokens)
             self.total_admitted += 1
         return admitted
 

@@ -175,3 +175,70 @@ class TestLoadgen:
                               prompt_len=16, max_tokens=12, seed=5)
         assert res.completed == 10
         assert res.failed == 0
+
+
+class TestSwapPreemption:
+    PROMPTS = TestPreemption.PROMPTS
+    GEN = TestPreemption.GEN
+
+    def test_swap_resume_matches_unconstrained_no_reprefill(self, model_cfg):
+        """preemption=swap: evicted KV returns from host memory — outputs
+        bitwise-equal to an unconstrained run AND zero prefill compute
+        spent on resume (the whole point of swapping)."""
+        big = make_engine(model_cfg, kv_num_blocks=64,
+                          decode_steps_per_dispatch=4)
+        want = [r.generated_tokens for r in big.generate(
+            self.PROMPTS, SamplingParams(temperature=0.0,
+                                         max_tokens=self.GEN))]
+        eng = make_engine(model_cfg, admission="ondemand",
+                          preemption="swap", kv_num_blocks=11,
+                          decode_steps_per_dispatch=4)
+        reqs = eng.generate(self.PROMPTS,
+                            SamplingParams(temperature=0.0,
+                                           max_tokens=self.GEN))
+        assert eng.total_preemptions > 0
+        assert eng.total_swap_ins > 0, "no swap-in happened"
+        assert [r.generated_tokens for r in reqs] == want
+        # prefill compute = the two initial 16-token prompts ONLY —
+        # resume added zero prefill tokens
+        assert eng.total_prefill_tokens == 2 * 16
+
+    def test_swap_seeded_sampling_deterministic(self, model_cfg):
+        sp = SamplingParams(temperature=0.9, top_k=20, max_tokens=self.GEN,
+                            seed=77)
+        big = make_engine(model_cfg, kv_num_blocks=64,
+                          decode_steps_per_dispatch=4)
+        want = [r.generated_tokens for r in big.generate(self.PROMPTS, sp)]
+        eng = make_engine(model_cfg, admission="ondemand",
+                          preemption="swap", kv_num_blocks=11,
+                          decode_steps_per_dispatch=4)
+        got = [r.generated_tokens for r in eng.generate(self.PROMPTS, sp)]
+        assert eng.total_swap_ins > 0
+        assert got == want
+
+    def test_swap_with_quantized_kv(self, model_cfg):
+        """QuantPages swap path: int8 pages + scales round-trip through
+        host memory."""
+        eng = make_engine(model_cfg, admission="ondemand",
+                          preemption="swap", kv_num_blocks=11,
+                          kv_quantization="int8",
+                          decode_steps_per_dispatch=4)
+        reqs = eng.generate(self.PROMPTS,
+                            SamplingParams(temperature=0.0,
+                                           max_tokens=self.GEN))
+        assert eng.total_swap_ins > 0
+        assert all(len(r.generated_tokens) == self.GEN for r in reqs)
+
+    def test_swap_space_budget_falls_back_to_recompute(self, model_cfg):
+        """swap_space_gb=0: every eviction must take the recompute path
+        (no host copies) and still produce correct output."""
+        eng = make_engine(model_cfg, admission="ondemand",
+                          preemption="swap", swap_space_gb=0.0,
+                          kv_num_blocks=11, decode_steps_per_dispatch=4)
+        reqs = eng.generate(self.PROMPTS,
+                            SamplingParams(temperature=0.0,
+                                           max_tokens=self.GEN))
+        assert eng.total_preemptions > 0
+        assert eng.total_swap_ins == 0
+        assert eng.stats()["swapped_host_bytes"] == 0
+        assert all(len(r.generated_tokens) == self.GEN for r in reqs)
