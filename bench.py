@@ -102,5 +102,42 @@ def main() -> None:
     }))
 
 
+def _watchdog(seconds: float):
+    """Hard deadline for the whole bench: the tunneled device backend can
+    WEDGE (every jax op blocks forever — observed 2026-07-30 when killed
+    processes stranded a relay claim). A hung bench records nothing; this
+    prints an explicit failure line and exits instead, so the driver's
+    BENCH capture shows WHAT happened rather than an empty timeout.
+
+    Returns the Timer (cancel it once the measurement prints — a success
+    landing near the deadline must not emit a second line), or None when
+    disabled (seconds <= 0, the usual timeout-env convention)."""
+    import os
+    import threading
+
+    if seconds <= 0:
+        return None
+
+    def fire():
+        print(json.dumps({
+            "metric": "bench watchdog",
+            "value": 0.0,
+            "unit": "tokens/sec/chip",
+            "vs_baseline": 0.0,
+            "error": f"device did not respond within {seconds:.0f}s "
+                     "(tunnel wedged?); no measurement taken",
+        }), flush=True)
+        os._exit(3)
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
 if __name__ == "__main__":
+    import os
+    _timer = _watchdog(float(os.environ.get("LLMCTL_BENCH_WATCHDOG_S",
+                                            "900")))
     main()
+    if _timer is not None:
+        _timer.cancel()
