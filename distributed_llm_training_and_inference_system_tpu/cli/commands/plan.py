@@ -272,8 +272,13 @@ def verify(model, hardware, batch, seq_len, steps, save_calib):
               type=click.Choice(["none", "int8"]))
 @click.option("--tensor-parallel", "-tp", default=1, show_default=True)
 @click.option("--candidates", default=6, show_default=True)
+@click.option("--calibrate", is_flag=True,
+              help="Measure (decode_efficiency, mfu_prefill) on the live "
+                   "device via a small engine's device-time probes and "
+                   "persist to tuning_results/serve_calibration.json; "
+                   "later plan serve runs use the measured values.")
 def serve(model, hardware, context_len, prompt_len, page_size, batch,
-          quant, kv_quant, tensor_parallel, candidates):
+          quant, kv_quant, tensor_parallel, candidates, calibrate):
     """Price SERVING configs: weight/KV HBM budget, max residency, and
     analytic TTFT + decode throughput per (quant, kv-quant, batch) — the
     serve counterpart of `plan compute` (round-2 verdict weak #8: serving
@@ -282,9 +287,36 @@ def serve(model, hardware, context_len, prompt_len, page_size, batch,
     efficiencies calibratable from `bench e2e --mode serve-load`."""
     import json as _json
 
-    from ...parallel.planner import ServePlanner
+    from ...parallel.planner import (ServePlanner, calibrate_serve_planner,
+                                     save_serve_calibration)
 
-    planner = ServePlanner(_load_model(model), _load_hw(hardware))
+    model_cfg = _load_model(model)
+    hw_cfg = _load_hw(hardware)
+    if calibrate:
+        import jax
+
+        from ...config.schema import ServeConfig
+        from ...serve import InferenceEngine
+        if jax.default_backend() != "tpu" and hw_cfg.platform == "tpu":
+            # same refusal as `plan verify --save-calib`: CPU-measured
+            # times stamped with a TPU chip type would poison every
+            # future serve prediction
+            raise click.ClickException(
+                f"refusing to calibrate a {hw_cfg.chip_type} profile on "
+                f"the {jax.default_backend()} backend — run on the real "
+                "chip, or pass a --hardware profile with platform=cpu")
+        eng = InferenceEngine(model_cfg, ServeConfig(
+            model=model_cfg.name, max_batch_size=4,
+            max_seq_len=min(1024, model_cfg.max_position_embeddings),
+            quantization=quant or "none",
+            kv_quantization=kv_quant or "none",
+            tensor_parallel=tensor_parallel))
+        cal = calibrate_serve_planner(model_cfg, hw_cfg, eng)
+        path = save_serve_calibration(cal)
+        click.echo(_json.dumps({"saved": path, **cal}, indent=2))
+        return
+
+    planner = ServePlanner(model_cfg, hw_cfg)
     if batch is not None or quant is not None or kv_quant is not None:
         est = planner.estimate(
             batch=batch or 8, context_len=context_len,

@@ -23,7 +23,9 @@ timeout 90 python -c "import jax; print(jax.devices())" || {
   echo "TPU unreachable; aborting battery"; exit 1; }
 
 # 1. headline train bench (flagship MFU) — the BENCH_r03 statistic
-run bench_headline 900 python bench.py
+# outer timeout ABOVE the watchdog's 900s default so a wedge produces
+# the watchdog's self-describing failure line, not an empty SIGTERM
+run bench_headline 1200 python bench.py
 
 # 2. optimizer: fused vs optax at full step + the new nu_dtype lever;
 #    then the memory-unlocked configs (b6/b8, remat none)
@@ -141,5 +143,11 @@ for S in (8192, 16384):
     print(json.dumps({'S': S, 'ring_compute_ms_per_device': round(ring_step*sp*1e3, 2),
                       'ulysses_compute_ms_per_device': round(uly*1e3, 2)}))
 "
+
+# 7. serve-planner calibration on the real chip, then the priced sweep
+run plan_serve_calibrate 700 python -m distributed_llm_training_and_inference_system_tpu.cli.main \
+    plan serve --model gpt-1b --hardware v5e-8 --calibrate
+run plan_serve_sweep 300 python -m distributed_llm_training_and_inference_system_tpu.cli.main \
+    plan serve --model gpt-1b --hardware v5e-8 --candidates 6
 
 echo "battery complete; results in $OUT/"

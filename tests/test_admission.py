@@ -242,3 +242,28 @@ class TestSwapPreemption:
         assert eng.total_swap_ins == 0
         assert eng.stats()["swapped_host_bytes"] == 0
         assert all(len(r.generated_tokens) == self.GEN for r in reqs)
+
+
+class TestRound3FeatureStack:
+    def test_everything_at_once(self, model_cfg):
+        """The round-3 serving stack composed: int4-awq weights + int8 KV +
+        ondemand admission + swap preemption + prefix caching + chunked
+        prefill + speculation, under a pool tight enough to preempt.
+        Every request must complete full-length, twice (second pass hits
+        the prefix cache)."""
+        eng = make_engine(model_cfg, quantization="int4-awq",
+                          kv_quantization="int8", admission="ondemand",
+                          preemption="swap", prefix_caching=True,
+                          chunked_prefill_tokens=16, speculative="ngram",
+                          speculative_tokens=4, kv_num_blocks=11,
+                          decode_steps_per_dispatch=4)
+        prompts = [[7 + i, 11, 13, 17] * 6 for i in range(2)]
+        for _ in range(2):
+            reqs = eng.generate(prompts, SamplingParams(temperature=0.0,
+                                                        max_tokens=24))
+            assert all(len(r.generated_tokens) == 24 for r in reqs)
+        s = eng.stats()
+        assert s["quantization"] == "int4-awq"
+        assert s["kv"]["prefix_hits"] > 0
+        assert s["spec_dispatches"] > 0
+        assert s["preemptions"] > 0
