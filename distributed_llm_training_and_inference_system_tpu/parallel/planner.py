@@ -78,31 +78,46 @@ class Plan:
 CALIBRATION_FILE = "tuning_results/calibration.json"
 
 
-def load_calibration(path: str | None = None) -> dict | None:
-    """Load the measured compute-efficiency calibration written by
-    `llmctl plan verify` (or None if never calibrated)."""
+def _load_json_calibration(env_var: str, default_path: str,
+                           path: str | None) -> dict | None:
+    """Shared calibration persistence: None on missing/corrupt/non-object
+    files (a truncated or list-shaped JSON must not crash the planner)."""
     import json
     import os
     from pathlib import Path
 
-    p = Path(path or os.environ.get("LLMCTL_CALIBRATION", CALIBRATION_FILE))
+    p = Path(path or os.environ.get(env_var, default_path))
     if p.exists():
         try:
-            return json.loads(p.read_text())
+            data = json.loads(p.read_text())
         except (ValueError, OSError):
             return None
+        return data if isinstance(data, dict) else None
     return None
 
 
-def save_calibration(data: dict, path: str | None = None) -> str:
+def _save_json_calibration(data: dict, env_var: str, default_path: str,
+                           path: str | None) -> str:
     import json
     import os
     from pathlib import Path
 
-    p = Path(path or os.environ.get("LLMCTL_CALIBRATION", CALIBRATION_FILE))
+    p = Path(path or os.environ.get(env_var, default_path))
     p.parent.mkdir(parents=True, exist_ok=True)
     p.write_text(json.dumps(data, indent=2))
     return str(p)
+
+
+def load_calibration(path: str | None = None) -> dict | None:
+    """Load the measured compute-efficiency calibration written by
+    `llmctl plan verify` (or None if never calibrated)."""
+    return _load_json_calibration("LLMCTL_CALIBRATION", CALIBRATION_FILE,
+                                  path)
+
+
+def save_calibration(data: dict, path: str | None = None) -> str:
+    return _save_json_calibration(data, "LLMCTL_CALIBRATION",
+                                  CALIBRATION_FILE, path)
 
 
 class MeshPlanner:
@@ -391,29 +406,13 @@ SERVE_CALIBRATION_FILE = "tuning_results/serve_calibration.json"
 def load_serve_calibration(path: str | None = None) -> dict | None:
     """Measured (decode_efficiency, mfu_prefill) written by
     ``llmctl plan serve --calibrate`` — None if never calibrated."""
-    import json
-    import os
-    from pathlib import Path
-    p = Path(path or os.environ.get("LLMCTL_SERVE_CALIBRATION",
-                                    SERVE_CALIBRATION_FILE))
-    if p.exists():
-        try:
-            data = json.loads(p.read_text())
-        except (ValueError, OSError):
-            return None
-        return data if isinstance(data, dict) else None
-    return None
+    return _load_json_calibration("LLMCTL_SERVE_CALIBRATION",
+                                  SERVE_CALIBRATION_FILE, path)
 
 
 def save_serve_calibration(data: dict, path: str | None = None) -> str:
-    import json
-    import os
-    from pathlib import Path
-    p = Path(path or os.environ.get("LLMCTL_SERVE_CALIBRATION",
-                                    SERVE_CALIBRATION_FILE))
-    p.parent.mkdir(parents=True, exist_ok=True)
-    p.write_text(json.dumps(data, indent=2))
-    return str(p)
+    return _save_json_calibration(data, "LLMCTL_SERVE_CALIBRATION",
+                                  SERVE_CALIBRATION_FILE, path)
 
 
 def calibrate_serve_planner(model: ModelConfig, hw: HardwareConfig,
@@ -447,6 +446,14 @@ def calibrate_serve_planner(model: ModelConfig, hw: HardwareConfig,
     out = {
         "chip_type": hw.chip_type,
         "model": model.name,
+        # the configuration the efficiencies were MEASURED under — a
+        # mismatch (e.g. int8-calibrated efficiencies pricing bf16 rows)
+        # is diagnosable from the file instead of silently skewing sweeps
+        "measured_with": {
+            "quantization": serve_cfg.quantization,
+            "kv_quantization": serve_cfg.kv_quantization,
+            "tensor_parallel": serve_cfg.tensor_parallel,
+        },
         "prefill_bucket": bucket,
         "prefill_ms": round(prefill_ms, 3),
         "decode_ms_per_token": round(decode_ms, 4),

@@ -120,3 +120,16 @@ class TestTensorParallelServe:
                    for l in jax.tree_util.tree_leaves(
                        tp2.params["blocks"],
                        is_leaf=lambda x: isinstance(x, QuantTensor)))
+
+    def test_tp2_int8_kv_matches_single_device(self, model_cfg, params):
+        """int8 KV pages + tensor-parallel: QuantPages (values+scales)
+        shard over the kv-head axis via the page sharding broadcast; tp=2
+        greedy output must equal the single-device int8-KV engine's."""
+        prompt = [5, 17, 99, 3, 42, 7, 11, 23]
+        single = make_engine(model_cfg, params, kv_quantization="int8")
+        [want] = single.generate([prompt], SamplingParams(
+            temperature=0.0, max_tokens=8))
+        tp2 = make_engine(model_cfg, params, tp=2, kv_quantization="int8")
+        [got] = tp2.generate([prompt], SamplingParams(
+            temperature=0.0, max_tokens=8))
+        assert got.generated_tokens == want.generated_tokens
