@@ -74,6 +74,22 @@ MODEL_TEMPLATES: dict[str, ModelConfig] = {
         max_position_embeddings=8192, activation="silu", norm_eps=1e-5,
         rope=RopeConfig(base=500000.0),
     ),
+    # Mistral-7B-shaped: llama architecture with GQA-8 and a 32k context
+    # window (the HF llama-format import path covers it unchanged).
+    "mistral-7b": ModelConfig(
+        name="mistral-7b", num_layers=32, hidden_size=4096, ffn_size=14336,
+        num_heads=32, num_kv_heads=8, head_dim=128, vocab_size=32000,
+        max_position_embeddings=32768, activation="silu", norm_eps=1e-5,
+        rope=RopeConfig(base=1000000.0),
+    ),
+    # Qwen2-7B-shaped: GQA-4 + ATTENTION BIAS on q/k/v (the bias flag the
+    # other families leave off) + 1M rope base + large vocab.
+    "qwen2-7b": ModelConfig(
+        name="qwen2-7b", num_layers=28, hidden_size=3584, ffn_size=18944,
+        num_heads=28, num_kv_heads=4, head_dim=128, vocab_size=152064,
+        max_position_embeddings=32768, activation="silu", norm_eps=1e-6,
+        rope=RopeConfig(base=1000000.0), attention_bias=True,
+    ),
     # MoE template exercising the expert-parallel mesh axis (no reference
     # equivalent; SURVEY §2.2 row EP).
     "gpt-moe-8x1b": ModelConfig(

@@ -52,6 +52,14 @@ def infer_tied(tensors: dict[str, np.ndarray]) -> bool:
     return "lm_head.weight" not in tensors
 
 
+def infer_attention_bias(tensors: dict[str, np.ndarray]) -> bool:
+    """qwen2-family checkpoints carry q/k/v projection biases; every other
+    llama-family model omits them. Aligning the config to the checkpoint
+    (like infer_tied) prevents present biases from being silently DROPPED
+    under a template that left attention_bias off."""
+    return "model.layers.0.self_attn.q_proj.bias" in tensors
+
+
 def hf_llama_to_params(tensors: dict[str, np.ndarray],
                        cfg: ModelConfig, dtype=np.float32) -> Any:
     """Map HF llama tensor names to this framework's stacked param tree.
@@ -101,6 +109,12 @@ def hf_llama_to_params(tensors: dict[str, np.ndarray],
         blocks[name] = {"kernel": stack(
             f"model.layers.{{i}}.self_attn.{name}_proj.weight",
             transpose=True)}
+    if cfg.attention_bias:
+        # qwen2-family checkpoints carry q/k/v projection biases (o has
+        # none); models.layers adds them per head after the matmul
+        for name in ("q", "k", "v"):
+            blocks[name]["bias"] = stack(
+                f"model.layers.{{i}}.self_attn.{name}_proj.bias")
 
     params = {
         "embed": {"embedding": get("model.embed_tokens.weight")},
@@ -129,21 +143,25 @@ def import_hf_checkpoint(src: str | Path, cfg: ModelConfig,
     (step 0) that every downstream command consumes.
 
     Returns (checkpoint dir, effective model config) — tie_word_embeddings
-    is aligned to what the checkpoint actually contains (HF tied models
-    omit lm_head.weight), so downstream commands must use the returned
-    config's tying."""
+    AND attention_bias are aligned to what the checkpoint actually
+    contains (HF tied models omit lm_head.weight; qwen2-family models
+    carry q/k/v biases), so downstream commands must use the returned
+    config."""
     import dataclasses
 
     from .checkpoint import CheckpointManager
 
     tensors = _collect_tensors(src)
     tied = infer_tied(tensors)
-    if tied != cfg.tie_word_embeddings:
-        cfg = dataclasses.replace(cfg, tie_word_embeddings=tied)
+    bias = infer_attention_bias(tensors)
+    if tied != cfg.tie_word_embeddings or bias != cfg.attention_bias:
+        cfg = dataclasses.replace(cfg, tie_word_embeddings=tied,
+                                  attention_bias=bias)
     params = hf_llama_to_params(tensors, cfg)
     mgr = CheckpointManager(out_dir, async_save=False)
     mgr.save(0, {"params": params},
              extra={"config": {"model": cfg.name, "source": str(src),
                                "imported": "hf-llama",
-                               "tie_word_embeddings": tied}})
+                               "tie_word_embeddings": tied,
+                               "attention_bias": bias}})
     return Path(out_dir), cfg

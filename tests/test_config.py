@@ -88,3 +88,36 @@ def test_toml_roundtrip():
     text = dump_toml(d)
     back = loads_toml(text)
     assert back == d
+
+
+def test_model_family_templates_validate_and_run():
+    """Every user-facing template validates; the family-specific features
+    (mistral GQA-8/32k, qwen2 attention-bias + GQA-4) flow through a real
+    forward pass on a shrunken copy."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_llm_training_and_inference_system_tpu.config.presets import (
+        MODEL_TEMPLATES)
+    from distributed_llm_training_and_inference_system_tpu.models import gpt
+
+    for name, cfg in MODEL_TEMPLATES.items():
+        cfg.validate()
+        assert cfg.param_count > 1e8, name
+
+    for name in ("mistral-7b", "qwen2-7b"):
+        big = MODEL_TEMPLATES[name]
+        assert big.num_heads > big.num_kv_heads          # GQA
+        tiny = dataclasses.replace(
+            big, num_layers=2, hidden_size=64, ffn_size=128,
+            num_heads=4, num_kv_heads=2, head_dim=16, vocab_size=256,
+            max_position_embeddings=128, dtype="float32")
+        params = gpt.init(tiny, jax.random.PRNGKey(0))
+        if big.attention_bias:
+            assert "bias" in params["blocks"]["q"], name
+        logits = gpt.forward(
+            params, jnp.asarray([[5, 9, 2, 7]], jnp.int32), tiny)
+        assert logits.shape == (1, 4, 256)
+        assert bool(jnp.isfinite(logits).all()), name

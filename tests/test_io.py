@@ -161,6 +161,35 @@ def test_native_packer_matches_numpy(tmp_path, monkeypatch, pack, drop_tail):
                                           err_msg=f"batch {i} {key}")
 
 
+
+
+def params_to_hf_dict(params, cfg):
+    """Write a native param tree under HF llama names (HF stores [out, in];
+    bias rows emitted when cfg.attention_bias) — shared by the import
+    round-trip tests."""
+    hf = {"model.embed_tokens.weight": np.asarray(
+        params["embed"]["embedding"]),
+        "model.norm.weight": np.asarray(params["final_norm"]["scale"])}
+    for i in range(cfg.num_layers):
+        b = params["blocks"]
+        hf[f"model.layers.{i}.input_layernorm.weight"] = np.asarray(
+            b["attn_norm"]["scale"][i])
+        hf[f"model.layers.{i}.post_attention_layernorm.weight"] = np.asarray(
+            b["mlp_norm"]["scale"][i])
+        for n in ("q", "k", "v", "o"):
+            hf[f"model.layers.{i}.self_attn.{n}_proj.weight"] = np.asarray(
+                b[n]["kernel"][i]).T
+        if cfg.attention_bias:
+            for n in ("q", "k", "v"):
+                hf[f"model.layers.{i}.self_attn.{n}_proj.bias"] = np.asarray(
+                    b[n]["bias"][i])
+        for n in ("gate", "up", "down"):
+            hf[f"model.layers.{i}.mlp.{n}_proj.weight"] = np.asarray(
+                b["mlp"][n]["kernel"][i]).T
+    if not cfg.tie_word_embeddings:
+        hf["lm_head.weight"] = np.asarray(params["lm_head"]["kernel"]).T
+    return hf
+
 def test_hf_llama_import_roundtrip(tmp_path):
     """HF llama-format safetensors (local, written with our own writer)
     must import into a param tree that produces IDENTICAL logits to the
@@ -182,23 +211,8 @@ def test_hf_llama_import_roundtrip(tmp_path):
                               tie_word_embeddings=True)   # llama-style + GQA
     params = init(cfg, jax.random.PRNGKey(0))
 
-    # write our params under HF llama names (HF stores [out, in])
-    hf = {"model.embed_tokens.weight": np.asarray(
-        params["embed"]["embedding"]),
-        "model.norm.weight": np.asarray(params["final_norm"]["scale"])}
-    for i in range(cfg.num_layers):
-        b = params["blocks"]
-        hf[f"model.layers.{i}.input_layernorm.weight"] = np.asarray(
-            b["attn_norm"]["scale"][i])
-        hf[f"model.layers.{i}.post_attention_layernorm.weight"] = np.asarray(
-            b["mlp_norm"]["scale"][i])
-        for n in ("q", "k", "v", "o"):
-            hf[f"model.layers.{i}.self_attn.{n}_proj.weight"] = np.asarray(
-                b[n]["kernel"][i]).T
-        for n in ("gate", "up", "down"):
-            hf[f"model.layers.{i}.mlp.{n}_proj.weight"] = np.asarray(
-                b["mlp"][n]["kernel"][i]).T
-    save_safetensors(hf, tmp_path / "model.safetensors")
+    save_safetensors(params_to_hf_dict(params, cfg),
+                     tmp_path / "model.safetensors")
 
     out, eff = import_hf_checkpoint(tmp_path / "model.safetensors", cfg,
                                     tmp_path / "ckpt")
@@ -213,3 +227,69 @@ def test_hf_llama_import_roundtrip(tmp_path):
     got = forward(imported, tokens, cfg)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_hf_qwen_style_import_with_attention_bias(tmp_path):
+    """qwen2-family checkpoints carry q/k/v projection biases; with
+    attention_bias=True the importer must map them and the forward must
+    match the native tree exactly (round 3, qwen2 template support)."""
+    import dataclasses
+
+    from distributed_llm_training_and_inference_system_tpu.config import (
+        get_model_config)
+    from distributed_llm_training_and_inference_system_tpu.io.export import (
+        save_safetensors)
+    from distributed_llm_training_and_inference_system_tpu.io.hf_import import (
+        hf_llama_to_params)
+    from distributed_llm_training_and_inference_system_tpu.models import (
+        forward, init)
+
+    cfg = dataclasses.replace(get_model_config("gpt-test"),
+                              attention_bias=True,
+                              tie_word_embeddings=True)
+    params = init(cfg, jax.random.PRNGKey(2))
+    # make biases visibly nonzero so a dropped mapping can't pass
+    for n in ("q", "k", "v"):
+        params["blocks"][n]["bias"] = jax.random.normal(
+            jax.random.PRNGKey(hash(n) % 2**31),
+            params["blocks"][n]["bias"].shape) * 0.5
+
+    save_safetensors(params_to_hf_dict(params, cfg),
+                     tmp_path / "model.safetensors")
+
+    from distributed_llm_training_and_inference_system_tpu.io.hf_import import (
+        _collect_tensors)
+    imported = hf_llama_to_params(_collect_tensors(
+        tmp_path / "model.safetensors"), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (2, 24), 1,
+                                cfg.vocab_size)
+    ref = forward(params, tokens, cfg)
+    got = forward(jax.tree_util.tree_map(jnp.asarray, imported), tokens, cfg)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_hf_import_infers_attention_bias(tmp_path):
+    """A qwen-style checkpoint imported under a bias-less template must
+    come back with attention_bias=True (config aligned from the tensors,
+    like tie inference) — not silently drop the biases."""
+    import dataclasses
+
+    from distributed_llm_training_and_inference_system_tpu.config import (
+        get_model_config)
+    from distributed_llm_training_and_inference_system_tpu.io.export import (
+        save_safetensors)
+    from distributed_llm_training_and_inference_system_tpu.io.hf_import import (
+        import_hf_checkpoint)
+    from distributed_llm_training_and_inference_system_tpu.models import init
+
+    biased = dataclasses.replace(get_model_config("gpt-test"),
+                                 attention_bias=True,
+                                 tie_word_embeddings=True)
+    params = init(biased, jax.random.PRNGKey(4))
+    save_safetensors(params_to_hf_dict(params, biased),
+                     tmp_path / "m.safetensors")
+    plain = dataclasses.replace(biased, attention_bias=False)
+    out, eff = import_hf_checkpoint(tmp_path / "m.safetensors", plain,
+                                    tmp_path / "ckpt")
+    assert eff.attention_bias is True
