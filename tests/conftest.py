@@ -111,6 +111,15 @@ SLOW_TESTS = {
     "test_grad_clipping_applied",
     "test_ring_attention_gradients",
     "test_closed_loop_under_pressure_completes",
+    # round-3 second wave (>= ~8 s)
+    "test_everything_at_once",
+    "test_tp2_int4_matches_single_device",
+    "test_tp2_int8_matches_single_device_int8",
+    "test_tp2_int8_kv_matches_single_device",
+    "test_swap_seeded_sampling_deterministic",
+    "test_swap_resume_matches_unconstrained_no_reprefill",
+    "test_reserve_mode_never_preempts",
+    "test_swap_space_budget_falls_back_to_recompute",
 }
 
 
