@@ -43,7 +43,7 @@ def devices8():
 SLOW_TESTS = {
     "test_admission_counts_pinned_pages_not_as_free",
     "test_resident_stream_advances_during_long_prefill",
-    "test_long_context_32k_memory_scales_linearly",
+    "test_long_context_64k_memory_scales_linearly",
     "test_eviction_under_pressure_still_correct",
     "test_greedy_matches_with_concurrent_requests",
     "test_1f1b_memory_constant_in_microbatches",
