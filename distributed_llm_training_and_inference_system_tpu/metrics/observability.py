@@ -177,6 +177,18 @@ class PrometheusExporter:
         self.infer_queue = g("llmctl_inference_queue_depth", "Queued requests")
         self.decode_tokens_per_sec = g("llmctl_decode_tokens_per_sec",
                                        "Decode throughput")
+        # on-demand admission telemetry (round 3): preemption pressure and
+        # swap-in counts are the KV-capacity health signals. Cumulative
+        # counts are COUNTERS (prometheus appends _total; rate() works);
+        # the engine reports running totals, so export_inference incs the
+        # delta since the last report
+        self.infer_preemptions = c("llmctl_inference_preemptions",
+                                   "KV preemptions")
+        self.infer_swap_ins = c("llmctl_inference_swap_ins",
+                                "Swap-in restores")
+        self.infer_swapped_bytes = g("llmctl_inference_swapped_host_bytes",
+                                     "Host bytes held by swapped-out KV")
+        self._last_totals: dict[str, float] = {}
         self._server_started = False
 
     def serve(self) -> None:
@@ -216,6 +228,15 @@ class PrometheusExporter:
             self.infer_queue.set(m["queue_depth"])
         if "decode_tokens_per_sec" in m:
             self.decode_tokens_per_sec.set(m["decode_tokens_per_sec"])
+        for key, counter in (("preemptions", self.infer_preemptions),
+                             ("swap_ins", self.infer_swap_ins)):
+            if key in m:
+                delta = m[key] - self._last_totals.get(key, 0)
+                if delta > 0:
+                    counter.inc(delta)
+                self._last_totals[key] = m[key]
+        if "swapped_host_bytes" in m:
+            self.infer_swapped_bytes.set(m["swapped_host_bytes"])
 
 
 class OTLPExporter:

@@ -310,10 +310,20 @@ class InferenceServer:
             self._recent_latencies + [latency_ms])[-1000:]
         if req.ttft_ms is not None:
             self._recent_ttfts = (self._recent_ttfts + [req.ttft_ms])[-1000:]
+        # engine.stats() is the one locked accessor for admission
+        # telemetry — the engine thread mutates the waiting deque and
+        # swapped_kv under this lock, so reading them lock-free here
+        # would race (and private-field reads would drift from /health)
+        with self._lock:
+            st = self.engine.stats()
         self.observer("inference_request", {
             "latency_ms": latency_ms, "ttft_ms": req.ttft_ms,
             "prompt_tokens": req.num_prompt_tokens,
             "tokens": len(req.generated_tokens),
+            "queue_depth": st["queue_depth"],
+            "preemptions": st["preemptions"],
+            "swap_ins": st["swap_ins"],
+            "swapped_host_bytes": st["swapped_host_bytes"],
         })
 
     async def handle_models(self, request: web.Request) -> web.Response:
