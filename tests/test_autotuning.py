@@ -79,8 +79,26 @@ class TestPersistence:
         assert "best_latency_ms" in blob[key]
 
     def test_convergence_early_stop(self):
+        """Early-stop must trigger after `patience` configs without
+        improvement. DETERMINISTIC timings (monkeypatched benchmark):
+        real matmul latencies jitter under host load, which kept
+        resetting the patience counter and flaked this test."""
         cfg = TuningConfig(num_warmup=0, num_trials=1,
                            convergence_patience=1)
-        res = AutoTuner(cfg).grid_search(MatMulTuner(64, 64, 64))
-        # patience 1: stops quickly, well under the full space
-        assert res.num_evaluated <= 4
+        import itertools
+        tuner = MatMulTuner(64, 64, 64)
+        space = tuner.parameter_space()
+        n_combos = len(list(itertools.product(*space.values())))
+        assert n_combos > 2          # early-stop must beat the full grid
+        # config 0 is best; everything after is strictly worse
+        calls = []
+
+        def fixed_benchmark(params, warmup, trials):
+            calls.append(dict(params))
+            return 1.0 if len(calls) == 1 else 2.0 + len(calls) * 0.1
+        tuner.benchmark = fixed_benchmark
+        res = AutoTuner(cfg).grid_search(tuner)
+        # first config improves (1.0), second doesn't -> patience 1
+        # exhausted -> stop at exactly 2 evaluations
+        assert res.num_evaluated == 2, res.num_evaluated
+        assert res.best_latency_ms == 1.0
