@@ -1,7 +1,8 @@
 """One-config MFU probe for the remat-policy x batch sweep (round 2).
 
 Run as a subprocess per config so an OOM kills only the probe:
-    python experiments/mfu_sweep.py <batch> <remat> [model]
+    python experiments/mfu_sweep.py <batch> <remat> [model] [mu_dtype]
+                                    [loss_chunk] [fused] [nu_dtype]
 Prints one JSON line mirroring bench.py's statistic (min of 3 windows x 4
 steps after a compile+fence warmup). Results recorded in BASELINE.md.
 """
@@ -24,6 +25,7 @@ def main() -> None:
     loss_chunk = int(sys.argv[5]) if len(sys.argv) > 5 else 512
     fused = (sys.argv[6].lower() in ("1", "true", "fused")
              if len(sys.argv) > 6 else True)
+    nu_dtype = sys.argv[7] if len(sys.argv) > 7 else "float32"
 
     import jax
 
@@ -42,7 +44,7 @@ def main() -> None:
                          micro_batch_size=batch, global_batch_size=batch)
     step_fn, tx, _ = make_train_step(
         cfg, OptimizerConfig(lr=1e-4, moment_dtype=moment_dtype,
-                             fused=fused), par,
+                             nu_dtype=nu_dtype, fused=fused), par,
         attn_impl="flash", loss_chunk=loss_chunk)
     params = init(cfg, jax.random.PRNGKey(0))
     state = TrainState.create(params, tx)
@@ -67,7 +69,7 @@ def main() -> None:
     mfu = tokens_per_sec * flops_per_token(cfg, seq_len) / (peak_tflops * 1e12)
     print(json.dumps({"model": model_name, "batch": batch, "remat": remat,
                       "moment_dtype": moment_dtype, "loss_chunk": loss_chunk,
-                      "fused": fused,
+                      "fused": fused, "nu_dtype": nu_dtype,
                       "step_ms": round(dt * 1e3, 2),
                       "tok_s": round(tokens_per_sec, 1),
                       "mfu": round(mfu, 4)}))

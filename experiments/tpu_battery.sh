@@ -30,43 +30,9 @@ run bench_headline 1200 python bench.py
 # 2. optimizer: fused vs optax at full step + the new nu_dtype lever;
 #    then the memory-unlocked configs (b6/b8, remat none)
 run mfu_b4_nufp32 700 python experiments/mfu_sweep.py 4 selective gpt-750m bfloat16 1024 true
-run mfu_b4_nubf16 700 python -c "
-import subprocess, sys
-# nu_dtype needs a code-level flag; mfu_sweep reads argv[4] as moment dtype
-# — run via a small inline driver instead
-import os, json, time
-sys.path.insert(0, '.')
-import jax
-from distributed_llm_training_and_inference_system_tpu.config import (
-    OptimizerConfig, ParallelConfig, get_model_config)
-from distributed_llm_training_and_inference_system_tpu.exec import TrainState, make_train_step
-from distributed_llm_training_and_inference_system_tpu.models import init
-from distributed_llm_training_and_inference_system_tpu.models.gpt import flops_per_token
-cfg = get_model_config('gpt-750m'); batch, seq = 4, 2048
-for remat in ('selective', 'none'):
-    try:
-        step, tx, _ = make_train_step(cfg, OptimizerConfig(lr=1e-4,
-            moment_dtype='bfloat16', nu_dtype='bfloat16', fused=True),
-            ParallelConfig(activation_checkpoint=remat,
-                           micro_batch_size=batch, global_batch_size=batch),
-            attn_impl='flash', loss_chunk=1024)
-        state = TrainState.create(init(cfg, jax.random.PRNGKey(0)), tx)
-        jstep = jax.jit(step, donate_argnums=(0,))
-        tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq), 1, cfg.vocab_size)
-        b = {'tokens': tokens}
-        state, m = jstep(state, b); float(m['loss'])
-        best = 1e9
-        for _ in range(3):
-            t0 = time.perf_counter()
-            for _ in range(4): state, m = jstep(state, b)
-            float(m['loss']); best = min(best, (time.perf_counter()-t0)/4)
-        tps = batch*seq/best
-        print(json.dumps({'remat': remat, 'nu': 'bf16', 'step_ms': round(best*1e3,1),
-                          'mfu': round(tps*flops_per_token(cfg, seq)/197e12, 4)}))
-    except Exception as e:
-        print(json.dumps({'remat': remat, 'error': str(e)[:200]}))
-"
-run mfu_b6_nubf16 700 python experiments/mfu_sweep.py 6 selective gpt-750m bfloat16 1024 true
+run mfu_b4_nubf16_sel 700 python experiments/mfu_sweep.py 4 selective gpt-750m bfloat16 1024 true bfloat16
+run mfu_b4_nubf16_none 700 python experiments/mfu_sweep.py 4 none gpt-750m bfloat16 1024 true bfloat16
+run mfu_b6_nubf16 700 python experiments/mfu_sweep.py 6 selective gpt-750m bfloat16 1024 true bfloat16
 
 # 3. serving under load: ondemand vs reserve at the same KV budget,
 #    with device-time TTFT (the co-located figure)
