@@ -139,6 +139,20 @@ def compute(model, hardware, seq_len, global_batch, long_context,
     breakdown.add_row("pp bubble", f"{e.pp_bubble_frac * 100:.0f}%", "")
     console.print(breakdown)
 
+    if best.parallel.sequence_parallel > 1:
+        from ...parallel.planner import choose_sp_scheme
+        scheme, costs = choose_sp_scheme(
+            model_cfg, best.parallel.sequence_parallel, seq_len,
+            best.parallel.micro_batch_size, hw=hw)
+        src = "measured (tune sp)" if costs["calibrated"] else "analytic"
+        uly = ("infeasible (heads % sp != 0)"
+               if not costs["ulysses_feasible"]
+               else f"{costs['ulysses_ms']:.0f} ms")
+        console.print(
+            f"sp scheme: [bold]{scheme}[/bold] — ring "
+            f"{costs['ring_ms']:.0f} ms vs ulysses {uly} per step "
+            f"attention ({src})")
+
     if not e.fits:
         # remediation hints (parity: reference plan.py:366-377)
         console.print("[yellow]Plan exceeds limits. Consider:[/yellow]")

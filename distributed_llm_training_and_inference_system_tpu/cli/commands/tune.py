@@ -86,6 +86,103 @@ def comms(size_mb, n_devices, max_iterations, timeout, trials, output_dir):
 
 
 @app.command()
+@click.option("--seq-lens", default="8192,16384", show_default=True,
+              help="Comma-separated probe sequence lengths.")
+@click.option("--sp", default=8, show_default=True,
+              help="Sequence-parallel degree the probe shapes model.")
+@click.option("--heads", default=16, show_default=True)
+@click.option("--head-dim", default=128, show_default=True)
+@click.option("--repeats", default=8, show_default=True)
+@click.option("--save/--no-save", "save_calib", default=True,
+              show_default=True)
+def sp(seq_lens, sp, heads, head_dim, repeats, save_calib):
+    """Measure ring-vs-Ulysses per-device attention cost and persist the
+    per-scheme efficiencies the planner's selection rule uses
+    (`parallel.planner.choose_sp_scheme`).
+
+    Single-chip proxy: ring = sp lock-step (S/sp x S/sp) unmasked flash
+    blocks (causal pruning can't shorten the ppermute-serialised critical
+    path); ulysses = full-S causal flash over heads/sp. The measured
+    efficiency vs each scheme's ideal FLOPs time extrapolates to any
+    (model, S, sp) through the same FLOPs model the planner prices with.
+    """
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from ...config.presets import get_hardware_preset
+    from ...ops.attention import flash_attention
+    from ...parallel.planner import (
+        calibrate_sp_schemes, choose_sp_scheme, save_sp_calibration)
+
+    if jax.default_backend() != "tpu":
+        raise click.ClickException(
+            "refusing to calibrate SP schemes on a "
+            f"{jax.default_backend()} backend — efficiencies are measured "
+            "against the TPU MXU peak and a CPU run would poison every "
+            "future scheme choice")
+    if sp < 2 or heads % sp or any(int(x) % sp for x in seq_lens.split(",")):
+        raise click.ClickException(
+            f"probe needs sp >= 2, heads ({heads}) % sp == 0 and every "
+            f"seq len % sp == 0 — got sp={sp}, seq_lens={seq_lens}")
+    # derive the peak from the ATTACHED chip, not an assumed generation —
+    # efficiencies divided by the wrong peak poison every future choice
+    kind = jax.devices()[0].device_kind.lower()
+    if "v5 lite" in kind or "v5e" in kind:
+        hw = get_hardware_preset("v5e-1")
+    else:
+        raise click.ClickException(
+            f"no hardware preset for device kind '{kind}' — add its peak "
+            "to config/presets.py HARDWARE_PRESETS before calibrating")
+
+    def _time(fn, *args):
+        fn(*args).block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            out = fn(*args)
+        out.block_until_ready()
+        return (time.perf_counter() - t0) / repeats * 1e3
+
+    rows = []
+    for s in (int(x) for x in seq_lens.split(",")):
+        key = jax.random.PRNGKey(0)
+        # ring step shape: local q against one rotating kv chunk, unmasked
+        q = jax.random.normal(key, (1, s // sp, heads, head_dim),
+                              jnp.bfloat16)
+        k = jax.random.normal(key, (1, s // sp, heads, head_dim),
+                              jnp.bfloat16)
+        ring_step = _time(jax.jit(
+            lambda q, k: flash_attention(q, k, k, causal=False)), q, k)
+        # ulysses shape: full sequence, heads/sp, causal
+        qU = jax.random.normal(key, (1, s, heads // sp, head_dim),
+                               jnp.bfloat16)
+        kU = jax.random.normal(key, (1, s, heads // sp, head_dim),
+                               jnp.bfloat16)
+        uly = _time(jax.jit(
+            lambda q, k: flash_attention(q, k, k, causal=True)), qU, kU)
+        rows.append({"S": s,
+                     "ring_compute_ms_per_device": round(ring_step * sp, 3),
+                     "ulysses_compute_ms_per_device": round(uly, 3)})
+        click.echo(json.dumps(rows[-1]))
+
+    calib = calibrate_sp_schemes(rows, hw, num_heads=heads,
+                                 head_dim=head_dim, sp=sp)
+    click.echo(json.dumps(calib))
+    if save_calib:
+        path = save_sp_calibration(calib)
+        click.echo(f"sp calibration saved to {path}")
+        from ...config.presets import get_model_config
+        m = get_model_config("gpt-7b")
+        for s in (8192, 16384, 32768):
+            scheme, costs = choose_sp_scheme(m, sp, s, hw=hw,
+                                             calibration=calib)
+            click.echo(f"gpt-7b S={s} sp={sp}: {scheme} "
+                       f"(ring {costs['ring_ms']:.0f} ms vs ulysses "
+                       f"{costs['ulysses_ms']:.0f} ms)")
+
+
+@app.command()
 @click.option("--output-dir", default="tuning_results", show_default=True)
 @click.option("--max-iterations", default=32, show_default=True)
 @click.option("--timeout", default=300.0, show_default=True)

@@ -439,13 +439,13 @@ class TrainingConfig:
     profile: bool = False
     profile_dir: str = "traces"
     eval_steps: int = 20            # batches per eval
-    attn_impl: str = "auto"         # auto | xla | flash | ring
+    attn_impl: str = "auto"         # auto | xla | flash | ring | ulysses
 
     def validate(self) -> None:
         if self.mixed_precision not in ("bf16", "fp32", "no"):
             raise ConfigError("mixed_precision must be bf16|fp32|no")
-        if self.attn_impl not in ("auto", "xla", "flash", "ring"):
-            raise ConfigError("attn_impl must be auto|xla|flash|ring")
+        if self.attn_impl not in ("auto", "xla", "flash", "ring", "ulysses"):
+            raise ConfigError("attn_impl must be auto|xla|flash|ring|ulysses")
 
     @classmethod
     def from_dict(cls, d: dict[str, Any] | None) -> "TrainingConfig":

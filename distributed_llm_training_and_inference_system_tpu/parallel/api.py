@@ -87,7 +87,8 @@ class ShardedTrainer:
             donate_argnums=(0,),
         )
         self.eval_step = jax.jit(make_eval_step(
-            model_cfg, attn_impl if attn_impl != "ring" else "xla"))
+            model_cfg,
+            attn_impl if attn_impl not in ("ring", "ulysses") else "xla"))
         if self.pipelined:
             from .pipeline import pipeline_batch_specs
             self._batch_spec_fn = functools.partial(pipeline_batch_specs,

@@ -375,6 +375,152 @@ def manual_plan(model: ModelConfig, hw: HardwareConfig, par: ParallelConfig,
 
 
 # ---------------------------------------------------------------------------
+# Sequence-parallel scheme selection (ring vs Ulysses)
+# ---------------------------------------------------------------------------
+
+SP_CALIBRATION_FILE = "tuning_results/sp_calibration.json"
+
+
+def load_sp_calibration(path: str | None = None) -> dict | None:
+    """Measured per-scheme attention efficiencies written by
+    ``llmctl tune sp`` — None if never calibrated."""
+    return _load_json_calibration("LLMCTL_SP_CALIBRATION",
+                                  SP_CALIBRATION_FILE, path)
+
+
+def save_sp_calibration(data: dict, path: str | None = None) -> str:
+    return _save_json_calibration(data, "LLMCTL_SP_CALIBRATION",
+                                  SP_CALIBRATION_FILE, path)
+
+
+def _sp_attn_flops_per_device(scheme: str, b: int, s: int, sp: int,
+                              n_heads: int, head_dim: int) -> float:
+    """Forward attention FLOPs on the critical path of one device.
+
+    ring: sp lock-step ppermute rounds, each bounded by one full
+    (S/sp x S/sp) unmasked block — causal block-pruning idles devices on
+    dead chunks but cannot shorten the ppermute-serialised critical path,
+    so the wall-clock bound is the unmasked 4*b*(S/sp)*S*n*d.
+
+    ulysses: one device runs full-S causal flash over n/sp heads; the
+    kernel's block pruning halves the visited tiles -> 2*b*S^2*(n/sp)*d.
+    """
+    if scheme == "ring":
+        return 4.0 * b * (s / sp) * s * n_heads * head_dim
+    return 2.0 * b * float(s) * s * (n_heads / sp) * head_dim
+
+
+def calibrate_sp_schemes(rows: list[dict], hw: HardwareConfig, *,
+                         batch: int = 1, num_heads: int = 16,
+                         head_dim: int = 128, sp: int = 8) -> dict:
+    """Derive per-scheme compute efficiencies from measured per-device
+    attention times (the ``llmctl tune sp`` probe / round-3 battery step
+    ``attn_ring_vs_ulysses``). *rows* =
+    ``[{"S": n, "ring_compute_ms_per_device": x,
+    "ulysses_compute_ms_per_device": y}, ...]`` measured at the probe
+    shape (batch, num_heads, head_dim, sp). Efficiency = ideal FLOPs time
+    / measured time, so ``sp_scheme_costs`` extrapolates the measurement
+    to any (model, S, sp) through the same FLOPs model it prices with."""
+    peak = hw.peak_bf16_tflops * 1e12
+    effs: dict[str, list[float]] = {"ring": [], "ulysses": []}
+    for r in rows:
+        s = int(r["S"])
+        for scheme, key in (("ring", "ring_compute_ms_per_device"),
+                            ("ulysses", "ulysses_compute_ms_per_device")):
+            meas_ms = float(r.get(key, 0.0))
+            if meas_ms <= 0:
+                continue
+            ideal_ms = _sp_attn_flops_per_device(
+                scheme, batch, s, sp, num_heads, head_dim) / peak * 1e3
+            effs[scheme].append(min(max(ideal_ms / meas_ms, 1e-3), 1.0))
+    if not effs["ring"] or not effs["ulysses"]:
+        raise ValueError("need at least one measured row per scheme")
+    return {
+        "chip_type": hw.chip_type,
+        "probe": {"batch": batch, "num_heads": num_heads,
+                  "head_dim": head_dim, "sp": sp,
+                  "seq_lens": [int(r["S"]) for r in rows]},
+        "ring_efficiency": round(sum(effs["ring"]) / len(effs["ring"]), 4),
+        "ulysses_efficiency": round(
+            sum(effs["ulysses"]) / len(effs["ulysses"]), 4),
+    }
+
+
+# flash backward ~= 2.5x forward (score recompute + dq/dk/dv passes);
+# identical multiplier for both schemes so it never flips the choice,
+# but it keeps the absolute ms meaningful next to step budgets.
+_SP_BWD_MULT = 2.5
+
+
+def sp_scheme_costs(model: ModelConfig, sp: int, seq_len: int,
+                    micro_batch: int = 1, hw: HardwareConfig | None = None,
+                    calibration: dict | None = None) -> dict:
+    """Price one training step's attention under each SP scheme
+    (per device, all layers, fwd+bwd, compute + ICI comm, ms)."""
+    hw = hw or HardwareConfig()
+    if calibration is None:
+        calibration = load_sp_calibration()
+    if calibration and calibration.get("chip_type") != hw.chip_type:
+        calibration = None
+    cal = calibration or {}
+    # uncalibrated default: both schemes assumed to sustain the same
+    # fraction of peak, so the analytic FLOPs/comm model decides
+    ring_eff = float(cal.get("ring_efficiency", 0.4))
+    uly_eff = float(cal.get("ulysses_efficiency", 0.4))
+    peak = hw.peak_bf16_tflops * 1e12
+    ici = hw.ici_bw_gbps * 1e9
+    b, s = micro_batch, seq_len
+    n, nkv, d = model.num_heads, model.num_kv_heads, model.head_dim
+    layers = model.num_layers
+
+    ulysses_ok = (n % sp == 0) and (nkv % sp == 0)
+
+    ring_compute = (_sp_attn_flops_per_device("ring", b, s, sp, n, d)
+                    * (1 + _SP_BWD_MULT) / (peak * ring_eff))
+    kv_local = 2 * b * (s / sp) * nkv * d * BYTES_BF16
+    # fwd ring rotates kv; bwd ring rotates kv AND the dk/dv accumulators;
+    # hops overlap with the current chunk's compute (price 50%, matching
+    # MeshPlanner.estimate's sp_t)
+    ring_comm = 0.5 * 3 * (sp - 1) * kv_local / ici
+
+    if ulysses_ok:
+        uly_compute = (_sp_attn_flops_per_device("ulysses", b, s, sp, n, d)
+                       * (1 + _SP_BWD_MULT) / (peak * uly_eff))
+        # 4 all-to-alls fwd (q/k/v scatter + out gather), mirrored in bwd;
+        # each moves (sp-1)/sp of the local tensor and BLOCKS the layer
+        qkvo = b * (s / sp) * (2 * n + 2 * nkv) * d * BYTES_BF16
+        uly_comm = 2.0 * ((sp - 1) / sp) * qkvo / ici
+        uly_ms = (uly_compute + uly_comm) * layers * 1e3
+    else:
+        uly_comm = 0.0
+        uly_ms = float("inf")
+
+    return {
+        "sp": sp, "seq_len": s,
+        "ulysses_feasible": ulysses_ok,
+        "ring_ms": (ring_compute + ring_comm) * layers * 1e3,
+        "ulysses_ms": uly_ms,
+        "ring_comm_ms": ring_comm * layers * 1e3,
+        "ulysses_comm_ms": uly_comm * layers * 1e3,
+        "calibrated": bool(cal),
+    }
+
+
+def choose_sp_scheme(model: ModelConfig, sp: int, seq_len: int,
+                     micro_batch: int = 1,
+                     hw: HardwareConfig | None = None,
+                     calibration: dict | None = None) -> tuple[str, dict]:
+    """The ring-vs-Ulysses selection rule (round-2 verdict #10): returns
+    ('ring'|'ulysses', costs). Ulysses requires heads % sp == 0; otherwise
+    the cheaper predicted attention time wins, using measured per-scheme
+    efficiencies when ``llmctl tune sp`` has calibrated this chip."""
+    costs = sp_scheme_costs(model, sp, seq_len, micro_batch, hw, calibration)
+    scheme = ("ulysses" if costs["ulysses_feasible"]
+              and costs["ulysses_ms"] < costs["ring_ms"] else "ring")
+    return scheme, costs
+
+
+# ---------------------------------------------------------------------------
 # Serving planner
 # ---------------------------------------------------------------------------
 

@@ -44,7 +44,14 @@ class TrainingEngine:
         attn_impl = cfg.training.attn_impl
         if attn_impl == "auto":
             if self.par.sequence_parallel > 1:
-                attn_impl = "ring"
+                # ring vs ulysses by the planner's priced selection rule
+                # (measured per-scheme efficiencies when `tune sp` has
+                # calibrated this chip; analytic FLOPs/comm model otherwise)
+                from ..parallel.planner import choose_sp_scheme
+                attn_impl, _ = choose_sp_scheme(
+                    cfg.model, self.par.sequence_parallel,
+                    cfg.data.max_length, self.par.micro_batch_size,
+                    hw=cfg.hardware)
             elif devices and devices[0].platform == "tpu":
                 attn_impl = "flash"       # the Pallas kernel, compiled
             else:
