@@ -203,17 +203,28 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
                 admission=admission, preemption=preemption,
                 dtype="bfloat16" if on_tpu else "float32"))
 
+        last_engine: list = []
+
         def warmed_engine():
             # jitted prefill/decode closures are PER-ENGINE (bound methods
             # key jax's trace cache), so every sweep point's engine must
             # compile its own programs BEFORE its timed window — a shared
             # warmup engine would leave compilation inside the measured
-            # TTFT (round-3 review)
+            # TTFT (round-3 review). The PREVIOUS point's engine must be
+            # released first: dead engines' weights/pool/executables
+            # otherwise stack up until the chip RESOURCE_EXHAUSTs.
+            if last_engine:
+                import gc
+                last_engine.pop().release()
+                gc.collect()        # the popped ref is gone — cycle dies now
+                jax.clear_caches()  # whole-process: fine here, engines are
+                #                     built strictly one-at-a-time in bench
             eng = fresh_engine()
             eng.generate([list(range(1, prompt_len + 1))],
                          SamplingParams(temperature=0.0, max_tokens=2))
             eng.total_prefill_tokens = 0
             eng.total_decode_steps = 0
+            last_engine.append(eng)
             return eng
 
         results["serve_load"] = {"admission": admission,

@@ -822,6 +822,31 @@ class InferenceEngine:
 
     # -- lifecycle -----------------------------------------------------------
 
+    def release(self) -> None:
+        """Free this engine's device memory: weights, KV pool, and every
+        compiled program. The engine is unusable afterwards.
+
+        Benchmark sweeps build engines back-to-back in one process (each
+        sweep point needs its own compile-before-timing warmup); without an
+        explicit release the dead engine's weights + pool + executables
+        survive until GC, and the next engine's pool allocation can
+        RESOURCE_EXHAUST the chip — observed on the 4th engine of a
+        round-3 serve-load sweep.
+
+        Only THIS engine's references are dropped (the jitted wrappers own
+        their executables, so they die with the attributes). The
+        engine<->scheduler host cycle is collectable once the caller drops
+        its own reference — a caller needing immediate reclamation should
+        `gc.collect()` after that, and may additionally
+        `jax.clear_caches()` if (and only if) no other live jitted code in
+        the process would mind losing its compilation cache."""
+        self.params = None
+        self.kv = None
+        self._decode_jit = None
+        self._spec_jit = None
+        self._prefill_cache.clear()
+        self._partial_prefills.clear()
+
     def _swap_bytes_in_queue(self) -> int:
         """Host bytes currently held by swapped-out waiting requests.
         Computed lazily (the queue is bounded and preemption is rare)

@@ -1,0 +1,182 @@
+"""Where does the decode step's device time go? (round-3 serve-perf probe)
+
+The serve-load battery measured ~35 ms device time per whole-batch decode
+step on gpt-1b — ~10x the ~3.5 ms weight-streaming floor (2.9 GB bf16 /
+819 GB/s). This script ablates the step's components as separate jitted
+K-step scan programs over the same paged state (mirroring
+serve/decode.py's body, pipelined dispatches, one fence):
+
+  full       decode forward: writes + paged attention + matmuls + unembed
+  no_write   page writes skipped (attention reads the pre-filled pages)
+  no_attn    attention output replaced by zeros (writes kept)
+  mats_only  matmuls + norms only (no attention, no writes)
+  no_unembed full minus the LM head / final norm
+  embed_only embedding lookup + final norm + unembed (head cost alone)
+
+Usage: python experiments/decode_profile.py [model] [batch] [ctx] [K]
+Prints one JSON line per variant; differences between lines attribute the
+step time. Numbers land in BASELINE.md round-3 serving notes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_llm_training_and_inference_system_tpu.config import (
+        get_model_config)
+    from distributed_llm_training_and_inference_system_tpu.models import init
+    from distributed_llm_training_and_inference_system_tpu.models.layers import (
+        apply_rope, mlp_block, rms_norm, rope_frequencies)
+    from distributed_llm_training_and_inference_system_tpu.ops.paged_attention import (
+        paged_attention_multi, write_token_to_pages)
+
+    model_name = sys.argv[1] if len(sys.argv) > 1 else "gpt-1b"
+    B = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    ctx = int(sys.argv[3]) if len(sys.argv) > 3 else 512
+    K = int(sys.argv[4]) if len(sys.argv) > 4 else 8
+
+    cfg = get_model_config(model_name)
+    PS = 64
+    pages_per_slot = (ctx + K + PS - 1) // PS + 1
+    NP = B * pages_per_slot + 1          # +1 scratch page 0
+    L, Nq, Nkv, D, H = (cfg.num_layers, cfg.num_heads, cfg.num_kv_heads,
+                        cfg.head_dim, cfg.hidden_size)
+    dt = jnp.dtype(cfg.dtype)
+
+    params = init(cfg, jax.random.PRNGKey(0))
+    params = jax.tree_util.tree_map(
+        lambda x: x.astype(dt) if x.dtype == jnp.float32 and x.ndim >= 2
+        else x, params)
+    key = jax.random.PRNGKey(1)
+    k_pages = jax.random.normal(key, (L, NP, Nkv, PS, D), dt) * 0.02
+    v_pages = jax.random.normal(key, (L, NP, Nkv, PS, D), dt) * 0.02
+    # sequential block tables: slot b owns pages [1 + b*pps, ...)
+    tables = np.zeros((B, pages_per_slot), np.int32)
+    for b in range(B):
+        tables[b] = 1 + b * pages_per_slot + np.arange(pages_per_slot)
+    block_tables = jnp.asarray(tables)
+    positions0 = jnp.full((B,), ctx, jnp.int32)
+    tokens0 = jnp.ones((B,), jnp.int32)
+    inv_freq = rope_frequencies(cfg.head_dim, cfg.rope.base,
+                                cfg.rope.scaling, cfg.rope.scaling_factor)
+
+    def step_forward(tokens, positions, kp_all, vp_all, *, write, attn,
+                     mats, unembed_on):
+        """One decode token for all slots — serve/decode.py body with
+        components switchable (experiment-only copy; the product path is
+        decode_step_forward)."""
+        x = params["embed"]["embedding"][tokens].astype(dt)[:, None, :]
+        pos2 = positions[:, None]
+
+        def body(x, layer_and_pages):
+            layer, kp, vp = layer_and_pages
+            h = rms_norm(x, layer["attn_norm"]["scale"], cfg.norm_eps)
+            if mats:
+                q = (h @ layer["q"]["kernel"]).reshape(B, 1, Nq, D)
+                k = (h @ layer["k"]["kernel"]).reshape(B, 1, Nkv, D)
+                v = (h @ layer["v"]["kernel"]).reshape(B, 1, Nkv, D)
+                q = apply_rope(q, pos2, inv_freq)
+                k = apply_rope(k, pos2, inv_freq)
+            else:
+                q = jnp.zeros((B, 1, Nq, D), dt)
+                k = jnp.zeros((B, 1, Nkv, D), dt)
+                v = k
+            if write:
+                kp = write_token_to_pages(kp, k.reshape(B, Nkv, D),
+                                          block_tables, positions, None)
+                vp = write_token_to_pages(vp, v.reshape(B, Nkv, D),
+                                          block_tables, positions, None)
+            if attn:
+                a = paged_attention_multi(q, kp, vp, block_tables, positions)
+                a = a.reshape(B, 1, Nq * D)
+            else:
+                a = jnp.zeros((B, 1, Nq * D), dt)
+            if mats:
+                x = x + (a @ layer["o"]["kernel"]).astype(x.dtype)
+                h = rms_norm(x, layer["mlp_norm"]["scale"], cfg.norm_eps)
+                x = x + mlp_block(h, layer["mlp"], cfg).astype(x.dtype)
+            else:
+                x = x + a
+            return x, (kp, vp)
+
+        x, (kp_all, vp_all) = jax.lax.scan(
+            body, x, (params["blocks"], kp_all, vp_all))
+        if unembed_on:
+            x = rms_norm(x, params["final_norm"]["scale"].astype(x.dtype),
+                         cfg.norm_eps)
+            w = (params["embed"]["embedding"] if cfg.tie_word_embeddings
+                 else params["lm_head"]["kernel"])
+            eq = "bth,vh->btv" if cfg.tie_word_embeddings else "bth,hv->btv"
+            logits = jnp.einsum(eq, x, w.astype(x.dtype),
+                                preferred_element_type=jnp.float32)
+            nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+        else:
+            nxt = tokens
+        return nxt, kp_all, vp_all
+
+    def make_scan(**flags):
+        def prog(tokens, positions, kp, vp):
+            def one(carry, _):
+                t, p, kp, vp = carry
+                t, kp, vp = step_forward(t, p, kp, vp, **flags)
+                return (t, p + 1, kp, vp), t
+            (t, p, kp, vp), seq = jax.lax.scan(
+                one, (tokens, positions, kp, vp), None, length=K)
+            return seq, kp, vp
+        return jax.jit(prog, donate_argnums=(2, 3))
+
+    variants = {
+        "full": dict(write=True, attn=True, mats=True, unembed_on=True),
+        "no_write": dict(write=False, attn=True, mats=True, unembed_on=True),
+        "no_attn": dict(write=True, attn=False, mats=True, unembed_on=True),
+        "mats_only": dict(write=False, attn=False, mats=True,
+                          unembed_on=True),
+        "no_unembed": dict(write=True, attn=True, mats=True,
+                           unembed_on=False),
+        "embed_only": dict(write=False, attn=False, mats=False,
+                           unembed_on=True),
+    }
+    iters = 6
+    results = {}
+    for name, flags in variants.items():
+        prog = make_scan(**flags)
+        kp, vp = k_pages, v_pages
+        seq, kp, vp = prog(tokens0, positions0, kp, vp)   # compile+warm
+        np.asarray(seq)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            seq, kp, vp = prog(tokens0, positions0, kp, vp)
+        np.asarray(seq)                                    # one fence
+        ms_per_step = (time.perf_counter() - t0) / (iters * K) * 1e3
+        results[name] = round(ms_per_step, 3)
+        print(json.dumps({"variant": name, "ms_per_step": results[name],
+                          "model": model_name, "batch": B, "ctx": ctx,
+                          "K": K}))
+        k_pages, v_pages = kp, vp     # donated away; reuse returned buffers
+
+    full = results.get("full", 0.0)
+    print(json.dumps({
+        "attributed": {
+            "page_writes_ms": round(full - results["no_write"], 3),
+            "paged_attention_ms": round(full - results["no_attn"], 3),
+            "unembed_ms": round(full - results["no_unembed"], 3),
+            "matmuls_ms": round(results["mats_only"]
+                                - results["embed_only"], 3),
+            "head_floor_ms": results["embed_only"],
+        },
+        "full_ms": full}))
+
+
+if __name__ == "__main__":
+    main()

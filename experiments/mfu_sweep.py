@@ -2,7 +2,11 @@
 
 Run as a subprocess per config so an OOM kills only the probe:
     python experiments/mfu_sweep.py <batch> <remat> [model] [mu_dtype]
-                                    [loss_chunk] [fused] [nu_dtype]
+                                    [loss_chunk] [fused] [nu_dtype] [accum]
+
+``accum`` > 1 scans <accum> microbatches of size <batch> per optimizer
+step (exec/train_step.py lax.scan) — amortises the optimizer + collective
+tail over more tokens.
 Prints one JSON line mirroring bench.py's statistic (min of 3 windows x 4
 steps after a compile+fence warmup). Results recorded in BASELINE.md.
 """
@@ -26,6 +30,7 @@ def main() -> None:
     fused = (sys.argv[6].lower() in ("1", "true", "fused")
              if len(sys.argv) > 6 else True)
     nu_dtype = sys.argv[7] if len(sys.argv) > 7 else "float32"
+    accum = int(sys.argv[8]) if len(sys.argv) > 8 else 1
 
     import jax
 
@@ -41,7 +46,9 @@ def main() -> None:
     peak_tflops = 197.0
     cfg = get_model_config(model_name)
     par = ParallelConfig(activation_checkpoint=remat,
-                         micro_batch_size=batch, global_batch_size=batch)
+                         micro_batch_size=batch,
+                         global_batch_size=batch * accum,
+                         gradient_accumulation_steps=accum)
     step_fn, tx, _ = make_train_step(
         cfg, OptimizerConfig(lr=1e-4, moment_dtype=moment_dtype,
                              nu_dtype=nu_dtype, fused=fused), par,
@@ -50,7 +57,8 @@ def main() -> None:
     state = TrainState.create(params, tx)
     jstep = jax.jit(step_fn, donate_argnums=(0,))
 
-    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq_len), 1,
+    tokens = jax.random.randint(jax.random.PRNGKey(1),
+                                (batch * accum, seq_len), 1,
                                 cfg.vocab_size)
     b = {"tokens": tokens}
     state, m = jstep(state, b)
@@ -65,11 +73,11 @@ def main() -> None:
         windows.append((time.perf_counter() - t0) / 4)
 
     dt = min(windows)
-    tokens_per_sec = batch * seq_len / dt
+    tokens_per_sec = batch * accum * seq_len / dt
     mfu = tokens_per_sec * flops_per_token(cfg, seq_len) / (peak_tflops * 1e12)
     print(json.dumps({"model": model_name, "batch": batch, "remat": remat,
                       "moment_dtype": moment_dtype, "loss_chunk": loss_chunk,
-                      "fused": fused, "nu_dtype": nu_dtype,
+                      "fused": fused, "nu_dtype": nu_dtype, "accum": accum,
                       "step_ms": round(dt * 1e3, 2),
                       "tok_s": round(tokens_per_sec, 1),
                       "mfu": round(mfu, 4)}))

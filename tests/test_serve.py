@@ -510,3 +510,23 @@ class TestMoEServing:
                                                       max_tokens=6))
         assert req.generated_tokens == greedy_reference(
             eng.params, cfg, prompt, 6)
+
+
+def test_engine_release_frees_and_next_engine_works(model_cfg):
+    """Bench sweeps build engines back-to-back; release() must drop the dead
+    engine's device buffers/programs so the next engine's pool allocation
+    can't RESOURCE_EXHAUST (observed on the 4th engine of a round-3 TPU
+    serve-load sweep)."""
+    outputs = []
+    prev = None
+    for _ in range(3):
+        if prev is not None:
+            prev.release()
+            assert prev.params is None and prev.kv is None
+            assert prev._decode_jit is None and not prev._prefill_cache
+        eng = make_engine(model_cfg)
+        [req] = eng.generate([[5, 17, 99, 3]],
+                             SamplingParams(temperature=0.0, max_tokens=4))
+        outputs.append(req.generated_tokens)
+        prev = eng
+    assert outputs[0] == outputs[1] == outputs[2]
