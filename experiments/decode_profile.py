@@ -71,11 +71,13 @@ def main() -> None:
     inv_freq = rope_frequencies(cfg.head_dim, cfg.rope.base,
                                 cfg.rope.scaling, cfg.rope.scaling_factor)
 
-    def step_forward(tokens, positions, kp_all, vp_all, *, write, attn,
-                     mats, unembed_on):
+    def step_forward(params, tokens, positions, kp_all, vp_all, *, write,
+                     attn, mats, unembed_on):
         """One decode token for all slots — serve/decode.py body with
         components switchable (experiment-only copy; the product path is
-        decode_step_forward)."""
+        decode_step_forward). params is threaded as an argument: a closure
+        capture would bake the weights into the program as constants
+        (minutes of lowering + duplicated HBM residency)."""
         x = params["embed"]["embedding"][tokens].astype(dt)[:, None, :]
         pos2 = positions[:, None]
 
@@ -126,15 +128,15 @@ def main() -> None:
         return nxt, kp_all, vp_all
 
     def make_scan(**flags):
-        def prog(tokens, positions, kp, vp):
+        def prog(params, tokens, positions, kp, vp):
             def one(carry, _):
                 t, p, kp, vp = carry
-                t, kp, vp = step_forward(t, p, kp, vp, **flags)
+                t, kp, vp = step_forward(params, t, p, kp, vp, **flags)
                 return (t, p + 1, kp, vp), t
             (t, p, kp, vp), seq = jax.lax.scan(
                 one, (tokens, positions, kp, vp), None, length=K)
             return seq, kp, vp
-        return jax.jit(prog, donate_argnums=(2, 3))
+        return jax.jit(prog, donate_argnums=(3, 4))
 
     variants = {
         "full": dict(write=True, attn=True, mats=True, unembed_on=True),
@@ -152,11 +154,11 @@ def main() -> None:
     for name, flags in variants.items():
         prog = make_scan(**flags)
         kp, vp = k_pages, v_pages
-        seq, kp, vp = prog(tokens0, positions0, kp, vp)   # compile+warm
+        seq, kp, vp = prog(params, tokens0, positions0, kp, vp)  # compile
         np.asarray(seq)
         t0 = time.perf_counter()
         for _ in range(iters):
-            seq, kp, vp = prog(tokens0, positions0, kp, vp)
+            seq, kp, vp = prog(params, tokens0, positions0, kp, vp)
         np.asarray(seq)                                    # one fence
         ms_per_step = (time.perf_counter() - t0) / (iters * K) * 1e3
         results[name] = round(ms_per_step, 3)

@@ -66,17 +66,21 @@ def main() -> None:
             best = min(best, (time.perf_counter() - t0) / 4)
         out[name] = round(best * 1e3, 1)
 
-    d1 = jax.jit(lambda kp_, vp_: decode_multi_step(
-        params, toks1, pos, kp_, vp_, tables, stops, keys, temp, tk, tp_,
+    # params is a jit ARGUMENT everywhere: closing over it would bake the
+    # 2.9 GB weight pytree into each program as captured constants —
+    # minutes of lowering per program and a duplicated weight residency
+    # (the first round-3 battery run timed out exactly this way)
+    d1 = jax.jit(lambda p, kp_, vp_: decode_multi_step(
+        p, toks1, pos, kp_, vp_, tables, stops, keys, temp, tk, tp_,
         cfg, num_steps=1)[0])
-    d8 = jax.jit(lambda kp_, vp_: decode_multi_step(
-        params, toks1, pos, kp_, vp_, tables, stops, keys, temp, tk, tp_,
+    d8 = jax.jit(lambda p, kp_, vp_: decode_multi_step(
+        p, toks1, pos, kp_, vp_, tables, stops, keys, temp, tk, tp_,
         cfg, num_steps=8)[0])
-    v8 = jax.jit(lambda kp_, vp_: speculative_verify(
-        params, toksT, pos, kp_, vp_, tables, stops, keys, temp, tk, tp_,
+    v8 = jax.jit(lambda p, kp_, vp_: speculative_verify(
+        p, toksT, pos, kp_, vp_, tables, stops, keys, temp, tk, tp_,
         cfg, write_mode=write_mode)[0])
-    e8 = jax.jit(lambda kp_, vp_: extend_step_forward(
-        params, toksT, pos, kp_, vp_, tables, cfg,
+    e8 = jax.jit(lambda p, kp_, vp_: extend_step_forward(
+        p, toksT, pos, kp_, vp_, tables, cfg,
         write_mode=write_mode)[0])
 
     out["write_mode"] = write_mode
@@ -85,7 +89,7 @@ def main() -> None:
              "v8": ("verify8_ms", v8), "e8": ("extend8_ms", e8)}
     for w in which:
         name, fn = progs[w]
-        timed(name, fn, kp, vp)
+        timed(name, fn, params, kp, vp)
     print(json.dumps(out))
 
 

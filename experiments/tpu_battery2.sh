@@ -9,17 +9,8 @@ cd "$(dirname "$0")/.."
 OUT=${1:-experiments/results_r3}
 mkdir -p "$OUT"
 
-run() {  # run <name> <timeout-s> <cmd...>
-  local name=$1 to=$2; shift 2
-  echo "=== $name ==="
-  timeout "$to" "$@" > "$OUT/$name.log" 2>&1
-  local rc=$?
-  tail -3 "$OUT/$name.log"
-  echo "rc=$rc" >> "$OUT/$name.log"
-}
-
-timeout 90 python -c "import jax; print(jax.devices())" || {
-  echo "TPU unreachable; aborting battery2"; exit 1; }
+source experiments/battery_lib.sh   # cwd is the repo root after the cd
+tpu_guard
 
 # selective_attn with both moments bf16 (untested combination)
 run mfu_b4_selattn_nubf16 700 python experiments/mfu_sweep.py 4 selective_attn gpt-750m bfloat16 1024 true bfloat16
@@ -29,6 +20,11 @@ run mfu_b4_selattn_nubf16_c2048 700 python experiments/mfu_sweep.py 4 selective_
 run mfu_b4_accum2 700 python experiments/mfu_sweep.py 4 selective gpt-750m bfloat16 1024 true bfloat16 2
 run mfu_b4_accum4 900 python experiments/mfu_sweep.py 4 selective gpt-750m bfloat16 1024 true bfloat16 4
 run mfu_b4_selattn_accum4 900 python experiments/mfu_sweep.py 4 selective_attn gpt-750m bfloat16 1024 true bfloat16 4
+
+# spec-profile rerun: the first battery's runs timed out lowering 2.9 GB
+# of closure-captured weights (fixed: params passed as a jit argument)
+LLMCTL_EXTEND_WRITE=paged   run spec_profile_paged 700 python experiments/spec_profile.py gpt-1b
+LLMCTL_EXTEND_WRITE=scatter run spec_profile_scatter 700 python experiments/spec_profile.py gpt-1b
 
 # reserve-admission load sweep rerun: the first battery's run died
 # RESOURCE_EXHAUSTED on its 4th engine (fixed: engine.release() between
