@@ -45,6 +45,11 @@ run serve_load_light 900 python -m distributed_llm_training_and_inference_system
     --prompt-len 512 --gen-len 64 --rps 0.25,0.5 --concurrency 1,2 \
     --admission ondemand --kv-blocks 96
 
+# speculation crossover rerun: the first battery's run tripped a bitwise
+# assert on the TPU verify-vs-decode tiling divergence (now reported as
+# diverged_streams instead — the curve keys on MEASURED acceptance)
+run spec_crossover 1200 python experiments/spec_crossover.py gpt-1b 8 7
+
 # ring-vs-ulysses per-scheme efficiencies, persisted for the planner
 run tune_sp 700 python -m distributed_llm_training_and_inference_system_tpu.cli.main \
     tune sp --seq-lens 8192,16384 --sp 8
