@@ -136,13 +136,27 @@ def sp(seq_lens, sp, heads, head_dim, repeats, save_calib):
             f"no hardware preset for device kind '{kind}' — add its peak "
             "to config/presets.py HARDWARE_PRESETS before calibrating")
 
-    def _time(fn, *args):
-        fn(*args).block_until_ready()
+    def _time(causal, q, k):
+        # scan the kernel `repeats` times inside ONE jitted program,
+        # feeding each output back as the next query: serialises the
+        # iterations and defeats DCE, so the figure is device compute —
+        # per-call dispatch on the tunneled chip (~ms) otherwise dwarfs
+        # these sub-ms kernels (the first round-3 battery measured a 16k
+        # causal attention at an impossible 0.02 ms this way)
+        def scanned(q_, k_):
+            def body(carry, _):
+                out = flash_attention(carry, k_, k_, causal=causal)
+                return out.astype(carry.dtype), None
+            return jax.lax.scan(body, q_, None, length=repeats)[0]
+
+        prog = jax.jit(scanned)          # k as an ARG, not a baked constant
+        prog(q, k).block_until_ready()
         t0 = time.perf_counter()
-        for _ in range(repeats):
-            out = fn(*args)
+        dispatches = 4
+        for _ in range(dispatches):
+            out = prog(q, k)
         out.block_until_ready()
-        return (time.perf_counter() - t0) / repeats * 1e3
+        return (time.perf_counter() - t0) / (dispatches * repeats) * 1e3
 
     rows = []
     for s in (int(x) for x in seq_lens.split(",")):
@@ -152,15 +166,13 @@ def sp(seq_lens, sp, heads, head_dim, repeats, save_calib):
                               jnp.bfloat16)
         k = jax.random.normal(key, (1, s // sp, heads, head_dim),
                               jnp.bfloat16)
-        ring_step = _time(jax.jit(
-            lambda q, k: flash_attention(q, k, k, causal=False)), q, k)
+        ring_step = _time(False, q, k)
         # ulysses shape: full sequence, heads/sp, causal
         qU = jax.random.normal(key, (1, s, heads // sp, head_dim),
                                jnp.bfloat16)
         kU = jax.random.normal(key, (1, s, heads // sp, head_dim),
                                jnp.bfloat16)
-        uly = _time(jax.jit(
-            lambda q, k: flash_attention(q, k, k, causal=True)), qU, kU)
+        uly = _time(True, qU, kU)
         rows.append({"S": s,
                      "ring_compute_ms_per_device": round(ring_step * sp, 3),
                      "ulysses_compute_ms_per_device": round(uly, 3)})

@@ -613,8 +613,9 @@ class ServeConfig:
             raise ConfigError("chunked_prefill_tokens must be >= 0")
         # quantized + tensor_parallel is supported for int8 AND int4:
         # param_specs shards Quant[4]Tensor leaves like the kernels they
-        # replace (the int4 packed layout maps transposed onto the kernel
-        # rules) — equivalence in tests/test_tp_serve.py
+        # replace (the int4 packed layout is kernel-oriented [L, in/2, out]
+        # and takes the kernel spec directly) — equivalence in
+        # tests/test_tp_serve.py
         # the engine checks `speculative == "ngram"`, so a config-file typo
         # ("n-gram", "medusa") would otherwise silently disable speculation
         if self.speculative not in ("off", "ngram"):

@@ -99,6 +99,14 @@ def export_params(params: Any, out_path: str | Path, fmt: str = "safetensors",
     meta["format"] = fmt
     if quant:
         meta["quant"] = quant
+        if quant in ("int4", "int4-awq"):
+            # packed-nibble orientation marker: "kernel" = [L, in/2, out]
+            # (round-3 layout — quantize_int4_groupwise docstring). The
+            # pre-marker round-3 layout was [L, out, in/2]; a consumer
+            # seeing no marker, or a different value, must not dequantize
+            # blindly — the shapes are plausible either way and the
+            # mistake produces garbage weights with no error
+            meta["int4_layout"] = "kernel"
         if quant == "int8":
             from ..ops.quantization import quantize_tree_int8
             params = quantize_tree_int8(params)

@@ -51,14 +51,17 @@ def quantize_int4_blockwise(x: jax.Array, block: int = 32) -> tuple[jax.Array, j
     return packed, scale[..., 0].astype(jnp.float32)
 
 
+def _unnibble(v: jax.Array) -> jax.Array:
+    """Sign-extend a 4-bit two's-complement nibble (shared by both int4
+    dequant paths — the encoding must never diverge between them)."""
+    v = v.astype(jnp.int8)
+    return jnp.where(v >= 8, v - 16, v)
+
+
 def dequantize_int4_blockwise(packed: jax.Array, scale: jax.Array,
                               block: int = 32, dtype=jnp.bfloat16) -> jax.Array:
-    def unnibble(v):
-        # sign-extend a 4-bit two's-complement nibble
-        v = v.astype(jnp.int8)
-        return jnp.where(v >= 8, v - 16, v)
-    lo = unnibble(packed & 0xF)
-    hi = unnibble(packed >> 4)
+    lo = _unnibble(packed & 0xF)
+    hi = _unnibble(packed >> 4)
     n = packed.shape[-1] * 2
     q = jnp.stack([lo, hi], axis=-1).reshape(*packed.shape[:-1], n)
     qb = q.reshape(*q.shape[:-1], n // block, block).astype(jnp.float32)
@@ -82,8 +85,17 @@ def quantize_int4_groupwise(
     version of the reference's stubbed ``--quant int4-gptq`` choice
     (reference llmctl/cli/commands/export.py:23-29).
 
-    Returns (packed uint8 [..., out, in/2], scales fp32 [..., out, in/group],
-    chan fp32 [..., in]); W ≈ swapaxes(unpack(packed)*scales) / chan[:,None].
+    Storage is KERNEL-oriented: packed uint8 [..., in/2, out] (nibble pair
+    (2i, 2i+1) of input channels at row i), scales fp32 [..., in/group,
+    out], chan fp32 [..., in]. The first round-3 chip measurement of the
+    original [..., out, in/2] layout showed why this matters: its dequant
+    needed a per-layer fp32 ``swapaxes`` of every kernel INSIDE the decode
+    scan, turning W4A16 into 19.6 tok/s vs bf16's 91 — the transpose
+    materialised ~8x the traffic int4 was supposed to save. The quant-time
+    transpose below is one-time; dequant is a pure elementwise chain in
+    the matmul's own orientation.
+
+    Returns (packed, scale, chan); W ≈ unpack(packed)*scales / chan[:,None].
     """
     if act_scale is not None:
         chan = act_scale.astype(jnp.float32) ** alpha
@@ -93,26 +105,40 @@ def quantize_int4_groupwise(
     w_scaled = w.astype(jnp.float32) * chan[..., :, None]
     wt = jnp.swapaxes(w_scaled, -1, -2)            # [..., out, in]
     packed, scale = quantize_int4_blockwise(wt, block=group)
+    packed = jnp.swapaxes(packed, -1, -2)          # [..., in/2, out]
+    scale = jnp.swapaxes(scale, -1, -2)            # [..., in/group, out]
     return packed, scale, chan
 
 
 def dequantize_int4_groupwise(packed: jax.Array, scale: jax.Array,
                               chan: jax.Array, group: int = 128,
                               dtype=jnp.bfloat16) -> jax.Array:
-    """Inverse of quantize_int4_groupwise -> [..., in, out]."""
-    wt = dequantize_int4_blockwise(packed, scale, block=group,
-                                   dtype=jnp.float32)
-    w = jnp.swapaxes(wt, -1, -2)                   # [..., in, out]
-    return (w / chan[..., :, None]).astype(dtype)
+    """Inverse of quantize_int4_groupwise -> [..., in, out].
+
+    Transpose-free: nibble pairs interleave along the second-minor axis,
+    so ``stack(axis=-2) + reshape`` is a free row-major relabel and the
+    whole unpack * scale * (1/chan) chain stays elementwise in *dtype* —
+    fusable into the consuming matmul's operand read."""
+    lo = _unnibble(packed & 0xF)                   # input channels 2i
+    hi = _unnibble(packed >> 4)                    # input channels 2i+1
+    n = packed.shape[-2] * 2
+    out = packed.shape[-1]
+    q = jnp.stack([lo, hi], axis=-2).reshape(*packed.shape[:-2], n, out)
+    qg = q.reshape(*q.shape[:-2], n // group, group, out).astype(dtype)
+    w = (qg * scale[..., :, None, :].astype(dtype)).reshape(q.shape)
+    inv_chan = (1.0 / chan).astype(dtype)
+    return w * inv_chan[..., :, None]
 
 
 @jax.tree_util.register_pytree_node_class
 class Quant4Tensor:
     """Runtime form of a W4A16 weight: packed int4 nibbles + group scales
     (+ AWQ channel scales), registered as a pytree so it rides the stacked-
-    layer ``lax.scan`` like QuantTensor. Logical shape/ndim are the
-    ORIGINAL kernel's ([..., in, out]) so shape-inspecting code (sharding
-    rules, planners) sees the matmul geometry, not the packed layout."""
+    layer ``lax.scan`` like QuantTensor. Storage is kernel-oriented
+    ([..., in/2, out] — see quantize_int4_groupwise). Logical shape/ndim
+    are the ORIGINAL kernel's ([..., in, out]) so shape-inspecting code
+    (sharding rules, planners) sees the matmul geometry, not the packed
+    layout."""
 
     def __init__(self, packed, scale, chan, group: int = 128):
         self.packed = packed
@@ -122,8 +148,8 @@ class Quant4Tensor:
 
     @property
     def shape(self):
-        s = self.packed.shape            # [..., out, in/2]
-        return (*s[:-2], s[-1] * 2, s[-2])
+        s = self.packed.shape            # [..., in/2, out]
+        return (*s[:-2], s[-2] * 2, s[-1])
 
     @property
     def ndim(self):

@@ -101,11 +101,11 @@ def param_specs(params: Any, mesh: Mesh) -> Any:
     - int8 ``QuantTensor``: values [L, in, out] get the kernel's spec;
       the per-(L, in) scale keeps the leading axes and replicates its
       size-1 tail.
-    - int4 ``Quant4Tensor`` stores TRANSPOSED packed nibbles
-      [L, out, in/2] with group scales [L, out, in/group] and channel
-      scales [L, in]: the kernel spec (layer, in_ax, out_ax) maps to
-      (layer, out_ax, in_ax) for packed+scales and (layer, in_ax) for
-      chan — the same tp/fsdp placement as the dequantized kernel.
+    - int4 ``Quant4Tensor`` stores KERNEL-oriented packed nibbles
+      [L, in/2, out] with group scales [L, in/group, out] and channel
+      scales [L, in]: packed+scales take the kernel spec
+      (layer, in_ax, out_ax) directly and chan takes (layer, in_ax) —
+      the same tp/fsdp placement as the dequantized kernel.
     """
     from ..ops.quantization import Quant4Tensor, QuantTensor
     from ..utils.tree import path_str
@@ -120,9 +120,9 @@ def param_specs(params: Any, mesh: Mesh) -> Any:
         spec = spec_for_path(path_str(path), stacked=True)
         if isinstance(leaf, Quant4Tensor):
             layer_ax, in_ax, out_ax = (spec + (None, None, None))[:3]
-            packed = _shrink_to_fit(P(layer_ax, out_ax, in_ax),
+            packed = _shrink_to_fit(P(layer_ax, in_ax, out_ax),
                                     leaf.packed.shape, mesh)
-            scale = _shrink_to_fit(P(layer_ax, out_ax, in_ax),
+            scale = _shrink_to_fit(P(layer_ax, in_ax, out_ax),
                                    leaf.scale.shape, mesh)
             chan = _shrink_to_fit(P(layer_ax, in_ax), leaf.chan.shape,
                                   mesh)

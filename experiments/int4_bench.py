@@ -1,0 +1,61 @@
+"""int4 / int4-awq decode throughput rows (the battery's int4_only step,
+extracted from tpu_battery.sh's inline form so reruns track the script).
+
+Complements experiments/int8_serve_bench.py's bf16/int8 rows: same
+workload — 4 requests, 512-token prompts, 128 greedy tokens, multi-step
+decode K=8.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import numpy as np
+
+    from distributed_llm_training_and_inference_system_tpu.config import (
+        get_model_config)
+    from distributed_llm_training_and_inference_system_tpu.config.schema import (
+        ServeConfig)
+    from distributed_llm_training_and_inference_system_tpu.ops.quantization import (
+        tree_weight_bytes)
+    from distributed_llm_training_and_inference_system_tpu.serve import (
+        InferenceEngine, SamplingParams)
+
+    model = sys.argv[1] if len(sys.argv) > 1 else "gpt-1b"
+    cfg = get_model_config(model)
+    for q in ("int4", "int4-awq"):
+        eng = InferenceEngine(cfg, ServeConfig(
+            model=model, max_batch_size=4, max_seq_len=704,
+            kv_block_size=64, dtype="bfloat16", quantization=q,
+            decode_steps_per_dispatch=8), seed=0)
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(1, cfg.vocab_size, 512).tolist()
+                   for _ in range(4)]
+        eng.generate([prompts[0]],
+                     SamplingParams(temperature=0.0, max_tokens=2))
+        t0 = time.perf_counter()
+        reqs = eng.generate(prompts,
+                            SamplingParams(temperature=0.0, max_tokens=128))
+        dt = time.perf_counter() - t0
+        print(json.dumps({
+            "quant": q,
+            "decode_tok_s": round(
+                sum(len(r.generated_tokens) for r in reqs) / dt, 1),
+            "weight_gb": round(tree_weight_bytes(eng.params) / 1e9, 3)}))
+        eng.release()
+        del eng
+        import gc
+        import jax
+        gc.collect()
+        jax.clear_caches()
+
+
+if __name__ == "__main__":
+    main()

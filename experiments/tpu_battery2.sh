@@ -34,6 +34,11 @@ run serve_load_reserve 900 python -m distributed_llm_training_and_inference_syst
     --prompt-len 512 --gen-len 128 --rps 2,6,12 --concurrency 4,8,16 \
     --admission reserve --kv-blocks 96
 
+# int4 rerun with the kernel-oriented packed layout (the first battery
+# measured 19.6 tok/s — the old layout's per-layer fp32 transpose inside
+# the decode scan)
+run int4_only 900 python experiments/int4_bench.py
+
 # decode-step component ablation: where the ~35 ms device step goes
 run decode_profile 700 python experiments/decode_profile.py gpt-1b 8 512 8
 

@@ -135,9 +135,9 @@ class TestInt4Serving:
 
     @pytest.mark.parametrize("mode", ["int4", "int4-awq"])
     def test_tp2_int4_matches_single_device(self, model_cfg, mode):
-        """int4[-awq] + tensor-parallel: the packed layout (and the awq
-        chan scales) shard transposed onto the kernel rules; tp=2 greedy
-        output must equal the single-device engine's."""
+        """int4[-awq] + tensor-parallel: the kernel-oriented packed layout
+        (and the awq chan scales) shard directly onto the kernel rules;
+        tp=2 greedy output must equal the single-device engine's."""
         prompt = [5, 17, 99, 3, 42, 7, 11, 23]
         [want] = self._engine(model_cfg, quantization=mode).generate(
             [prompt], SamplingParams(temperature=0.0, max_tokens=8))
