@@ -9,18 +9,11 @@ cd "$(dirname "$0")/.."
 OUT=${1:-experiments/results_r3}
 mkdir -p "$OUT"
 
-run() {  # run <name> <timeout-s> <cmd...>
-  local name=$1 to=$2; shift 2
-  echo "=== $name ==="
-  timeout "$to" "$@" > "$OUT/$name.log" 2>&1
-  local rc=$?
-  tail -3 "$OUT/$name.log"
-  echo "rc=$rc" >> "$OUT/$name.log"
-}
-
-# 0. chip sanity (fail the whole battery fast if the tunnel is wedged)
-timeout 90 python -c "import jax; print(jax.devices())" || {
-  echo "TPU unreachable; aborting battery"; exit 1; }
+# 0. chip sanity (fail the whole battery fast if the tunnel is wedged or
+#    jax silently fell back to CPU — CPU times against TPU peaks would
+#    fill the logs with nonsense)
+source experiments/battery_lib.sh   # cwd is the repo root after the cd
+tpu_guard
 
 # 1. headline train bench (flagship MFU) — the BENCH_r03 statistic
 # outer timeout ABOVE the watchdog's 900s default so a wedge produces
