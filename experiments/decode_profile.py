@@ -39,7 +39,7 @@ def main() -> None:
     from distributed_llm_training_and_inference_system_tpu.models.layers import (
         apply_rope, mlp_block, rms_norm, rope_frequencies)
     from distributed_llm_training_and_inference_system_tpu.ops.paged_attention import (
-        paged_attention_multi, write_token_to_pages)
+        paged_attention_multi, write_token_to_pages, write_window_to_pages)
 
     model_name = sys.argv[1] if len(sys.argv) > 1 else "gpt-1b"
     B = int(sys.argv[2]) if len(sys.argv) > 2 else 8
@@ -72,7 +72,8 @@ def main() -> None:
                                 cfg.rope.scaling, cfg.rope.scaling_factor)
 
     def step_forward(params, tokens, positions, kp_all, vp_all, *, write,
-                     attn, mats, unembed_on):
+                     attn, mats, unembed_on, attn_impl="auto",
+                     write_impl="scatter"):
         """One decode token for all slots — serve/decode.py body with
         components switchable (experiment-only copy; the product path is
         decode_step_forward). params is threaded as an argument: a closure
@@ -94,13 +95,21 @@ def main() -> None:
                 q = jnp.zeros((B, 1, Nq, D), dt)
                 k = jnp.zeros((B, 1, Nkv, D), dt)
                 v = k
-            if write:
+            if write and write_impl == "window":
+                # whole-page merge (gather 2B pages, merge row, scatter
+                # whole pages) instead of the B-row scatter
+                kp = write_window_to_pages(kp, k, block_tables, positions,
+                                           None)
+                vp = write_window_to_pages(vp, v, block_tables, positions,
+                                           None)
+            elif write:
                 kp = write_token_to_pages(kp, k.reshape(B, Nkv, D),
                                           block_tables, positions, None)
                 vp = write_token_to_pages(vp, v.reshape(B, Nkv, D),
                                           block_tables, positions, None)
             if attn:
-                a = paged_attention_multi(q, kp, vp, block_tables, positions)
+                a = paged_attention_multi(q, kp, vp, block_tables, positions,
+                                          impl=attn_impl)
                 a = a.reshape(B, 1, Nq * D)
             else:
                 a = jnp.zeros((B, 1, Nq * D), dt)
@@ -148,6 +157,16 @@ def main() -> None:
                            unembed_on=False),
         "embed_only": dict(write=False, attn=False, mats=False,
                            unembed_on=True),
+        # alternatives for the two measured hot spots (round-3 ablation:
+        # pallas attention 12.3 ms, row-scatter writes 7.5 ms of a
+        # 24.2 ms step): XLA gather attention + whole-page merge writes
+        "full_gather": dict(write=True, attn=True, mats=True,
+                            unembed_on=True, attn_impl="gather"),
+        "full_winwrite": dict(write=True, attn=True, mats=True,
+                              unembed_on=True, write_impl="window"),
+        "full_gather_winwrite": dict(write=True, attn=True, mats=True,
+                                     unembed_on=True, attn_impl="gather",
+                                     write_impl="window"),
     }
     iters = 6
     results = {}

@@ -1,0 +1,35 @@
+#!/bin/bash
+# Round-3 third-wave MFU probes: remat=none nearly fit in battery 2 (OOM
+# by one 264 MB bf16 gate tensor at CE chunk 1024). Shrinking the CE
+# chunk frees ~412 MB of live logits per halving — if no-remat fits, the
+# ~42 ms selective-remat recompute disappears from the backward pass.
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-experiments/results_r3}
+mkdir -p "$OUT"
+source experiments/battery_lib.sh
+tpu_guard
+
+run mfu_b4_none_c512 700 python experiments/mfu_sweep.py 4 none gpt-750m bfloat16 512 true bfloat16
+run mfu_b4_none_c256 700 python experiments/mfu_sweep.py 4 none gpt-750m bfloat16 256 true bfloat16
+# if none still OOMs, b3 trades 25% tokens for the recompute win
+run mfu_b3_none_c512 700 python experiments/mfu_sweep.py 3 none gpt-750m bfloat16 512 true bfloat16
+
+# accumulation stacked on the best remat (battery 2: accum4 alone hit
+# 0.5111 — per-microbatch cost fell to 400 ms vs 416 standalone)
+run mfu_b4_sel_accum8 1200 python experiments/mfu_sweep.py 4 selective gpt-750m bfloat16 1024 true bfloat16 8
+run mfu_b4_none_c512_accum4 1200 python experiments/mfu_sweep.py 4 none gpt-750m bfloat16 512 true bfloat16 4
+
+# decode-step alternatives for the two measured hot spots (gather
+# attention vs the pallas kernel; whole-page merge writes vs row scatter)
+run decode_profile_alts 900 python experiments/decode_profile.py gpt-1b 8 512 8
+
+# reserve-admission closed-loop points only (battery 2's full sweep hit
+# its 900 s box — reserve serialises residents, so each point runs
+# longer; open-loop adds nothing to the ondemand-vs-reserve comparison)
+run serve_load_reserve 1500 python -m distributed_llm_training_and_inference_system_tpu.cli.main \
+    bench e2e --model gpt-1b --mode serve-load --requests 32 \
+    --prompt-len 512 --gen-len 128 --rps "" --concurrency 4,8,16 \
+    --admission reserve --kv-blocks 96
+
+echo "battery3 complete; results in $OUT/"
