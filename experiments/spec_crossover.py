@@ -74,14 +74,22 @@ def main() -> None:
         dt = time.perf_counter() - t0
         return reqs, sum(len(r.generated_tokens) for r in reqs) / dt
 
-    # plain baseline + oracle streams
+    # throughput baseline: the plain multi-step-decode engine
     plain_reqs, plain_tok_s = timed_generate(make_engine(False))
-    oracle = {tuple(p[:16]): list(r.generated_tokens)
-              for p, r in zip(prompts, plain_reqs)}
+
+    oracle: dict = {}
 
     def run_fused(p_corrupt: float):
         eng = make_engine(True)
-        crng = np.random.default_rng(7)
+        import hashlib
+
+        def corrupted(rid_key: tuple, g: int) -> bool:
+            # keyed by (request, generated-index): deterministic and
+            # call-order independent (a sequential RNG would desync when
+            # acceptance shifts how often draft_fn is called)
+            h = hashlib.blake2b(repr((rid_key, g)).encode(),
+                                digest_size=8).digest()
+            return int.from_bytes(h, "big") / 2**64 < p_corrupt
 
         def draft_fn(ctx, n_draft, _max_ngram):
             stream = oracle.get(tuple(int(t) for t in ctx[:16]))
@@ -93,28 +101,38 @@ def main() -> None:
                 return None
             d = np.asarray(tail + [tail[-1]] * (n_draft - len(tail)),
                            np.int32)
-            corrupt = crng.random(n_draft) < p_corrupt
+            key = tuple(int(t) for t in ctx[:16])
+            corrupt = np.asarray([corrupted(key, g + j)
+                                  for j in range(n_draft)])
             d = np.where(corrupt, (d + 1) % cfg.vocab_size, d)
             return d.astype(np.int32)
 
         eng.draft_fn = draft_fn
         reqs, tok_s = timed_generate(eng)
-        # On CPU fp32 the spec stream is bitwise-identical to plain greedy.
-        # On TPU bf16 the [B,T,H] verify matmuls may tile/accumulate
-        # differently from the [B,1,H] decode pass and flip a near-tie
-        # argmax (ADVICE r2 #4; the engine guarantees a valid greedy chain
-        # under the VERIFY-pass logits, not the decode-pass logits), after
-        # which the oracle's drafts stop matching that stream's true
-        # continuation. The crossover axis is the MEASURED acceptance, so
-        # the curve stays valid — divergence is reported, not asserted.
+        # Divergence vs the oracle (the fused engine's own p=1.0 stream):
+        # on TPU bf16 the verify pass's [B,T,H] matmuls can flip near-tie
+        # argmaxes vs the [B,1,H] decode pass (ADVICE r2 #4), so the
+        # PLAIN stream cannot serve as the oracle — the first battery run
+        # measured acceptance 0.0 at every p because all four streams
+        # left the plain trajectory early and the drafts never matched
+        # again. The crossover axis is the MEASURED acceptance either
+        # way; divergence is reported, not asserted.
         diverged = sum(
-            r.generated_tokens != oracle[tuple(p[:16])]
+            r.generated_tokens != oracle.get(tuple(p[:16]))
             for p, r in zip(prompts, reqs))
-        return tok_s, eng.stats()["spec_acceptance"], diverged
+        return reqs, tok_s, eng.stats()["spec_acceptance"], diverged
+
+    # oracle pass: all drafts corrupted -> every token comes from the
+    # fused engine's own verify-pass greedy path; lower-p runs then draft
+    # THIS stream's continuation, so acceptance tracks 1-p instead of
+    # collapsing at the first verify-vs-decode numeric divergence
+    oracle_reqs, _, _, _ = run_fused(1.0)
+    for p, r in zip(prompts, oracle_reqs):
+        oracle[tuple(p[:16])] = list(r.generated_tokens)
 
     points = []
     for p_c in (1.0, 0.75, 0.5, 0.25, 0.1, 0.0):
-        fused_tok_s, acc, diverged = run_fused(p_c)
+        _, fused_tok_s, acc, diverged = run_fused(p_c)
         row = {"p_corrupt": p_c, "acceptance": round(float(acc), 3),
                "plain_tok_s": round(plain_tok_s, 1),
                "fused_tok_s": round(fused_tok_s, 1),

@@ -24,6 +24,12 @@ run mfu_b4_none_c512_accum4 1200 python experiments/mfu_sweep.py 4 none gpt-750m
 # attention vs the pallas kernel; whole-page merge writes vs row scatter)
 run decode_profile_alts 900 python experiments/decode_profile.py gpt-1b 8 512 8
 
+# crossover rerun: oracle = the fused engine's own p=1.0 stream +
+# position-keyed corruption (battery 2's run measured acceptance 0.0 at
+# every p — the plain-stream oracle broke at the first verify-vs-decode
+# numeric divergence)
+run spec_crossover 1500 python experiments/spec_crossover.py gpt-1b 8 7
+
 # reserve-admission closed-loop points only (battery 2's full sweep hit
 # its 900 s box — reserve serialises residents, so each point runs
 # longer; open-loop adds nothing to the ondemand-vs-reserve comparison)
