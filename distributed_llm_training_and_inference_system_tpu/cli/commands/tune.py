@@ -106,7 +106,6 @@ def sp(seq_lens, sp, heads, head_dim, repeats, save_calib):
     efficiency vs each scheme's ideal FLOPs time extrapolates to any
     (model, S, sp) through the same FLOPs model the planner prices with.
     """
-    import time
 
     import jax
     import jax.numpy as jnp
@@ -150,13 +149,13 @@ def sp(seq_lens, sp, heads, head_dim, repeats, save_calib):
             return jax.lax.scan(body, q_, None, length=repeats)[0]
 
         prog = jax.jit(scanned)          # k as an ARG, not a baked constant
-        prog(q, k).block_until_ready()
-        t0 = time.perf_counter()
-        dispatches = 4
-        for _ in range(dispatches):
-            out = prog(q, k)
-        out.block_until_ready()
-        return (time.perf_counter() - t0) / (dispatches * repeats) * 1e3
+        # utils.timing fences by fetching a REDUCTION over the result:
+        # battery-2 measured a 1024x1024 flash call at an impossible 4 us
+        # through block_until_ready's early-return hole on the tunneled
+        # backend (the same hole bench.py works around)
+        from ...utils.timing import time_fn
+        return time_fn(prog, q, k, warmup=1, iters=4,
+                       windows=2) / repeats * 1e3
 
     rows = []
     for s in (int(x) for x in seq_lens.split(",")):

@@ -432,7 +432,19 @@ def calibrate_sp_schemes(rows: list[dict], hw: HardwareConfig, *,
                 continue
             ideal_ms = _sp_attn_flops_per_device(
                 scheme, batch, s, sp, num_heads, head_dim) / peak * 1e3
-            effs[scheme].append(min(max(ideal_ms / meas_ms, 1e-3), 1.0))
+            eff = ideal_ms / meas_ms
+            if eff > 1.02:
+                # faster than the FLOPs ideal is physically impossible:
+                # the fence returned early or the probe shape is wrong.
+                # Clamping would silently persist "100% of peak" and
+                # poison every future scheme choice (battery-2 did
+                # exactly this through block_until_ready's early return)
+                raise ValueError(
+                    f"{scheme} probe at S={s} measured {meas_ms:.3f} ms, "
+                    f"faster than the {ideal_ms:.3f} ms FLOPs ideal at "
+                    f"{hw.chip_type} peak — fence broken or probe shape "
+                    "wrong; refusing to calibrate")
+            effs[scheme].append(max(eff, 1e-3))
     if not effs["ring"] or not effs["ulysses"]:
         raise ValueError("need at least one measured row per scheme")
     return {

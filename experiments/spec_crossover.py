@@ -92,16 +92,21 @@ def main() -> None:
             return int.from_bytes(h, "big") / 2**64 < p_corrupt
 
         def draft_fn(ctx, n_draft, _max_ngram):
-            stream = oracle.get(tuple(int(t) for t in ctx[:16]))
-            if stream is None:
-                return None
+            key = tuple(int(t) for t in ctx[:16])
             g = len(ctx) - prompt_len          # tokens already generated
-            tail = stream[g:g + n_draft]
+            stream = oracle.get(key)
+            tail = stream[g:g + n_draft] if stream else []
             if not tail:
-                return None
+                # oracle pass (or stream exhausted): EXPLICIT garbage
+                # drafts, all-rejected by construction. Returning None
+                # would leave the engine's repeat-fallback drafts in
+                # place — occasionally accepted, so the "p=1.0" oracle
+                # stream would not be the all-rejected trajectory
+                last = int(ctx[-1])
+                return ((last + 1 + np.arange(n_draft, dtype=np.int32))
+                        % (cfg.vocab_size - 2) + 1)
             d = np.asarray(tail + [tail[-1]] * (n_draft - len(tail)),
                            np.int32)
-            key = tuple(int(t) for t in ctx[:16])
             corrupt = np.asarray([corrupted(key, g + j)
                                   for j in range(n_draft)])
             d = np.where(corrupt, (d + 1) % cfg.vocab_size, d)
@@ -150,8 +155,23 @@ def main() -> None:
             break
     if pts and pts[0]["ratio"] >= 1.0:
         cross = pts[0]["acceptance"]
+
+    # Analytic crossover from the measured zero-acceptance point: a fused
+    # dispatch emits 1 + a*(T-1) + R tokens at constant cost, the plain
+    # engine emits T-1+R per equal-forward-pass dispatch, so
+    # ratio(a) = ratio(0) * (1 + R + a*(T-1)) / (1 + R) and the break-even
+    # acceptance is a* = (1+R) * (1/ratio(0) - 1) / (T-1). Robust to the
+    # TPU dial collapse (verify-vs-decode bf16 argmax divergence makes
+    # high-acceptance points unreachable with an open-loop oracle there —
+    # diverged_streams in the rows tells that story).
+    lo = min(points, key=lambda r: r["acceptance"])
+    analytic = None
+    if lo["ratio"] > 0:
+        analytic = (1 + R) * (1.0 / lo["ratio"] - 1.0) / (T - 1)
     print(json.dumps({"crossover_acceptance":
                       None if cross is None else round(cross, 3),
+                      "analytic_crossover_from_a0":
+                      None if analytic is None else round(analytic, 3),
                       "verify_window": T, "decode_steps_after_verify": R}))
 
 

@@ -38,4 +38,10 @@ run serve_load_reserve 1500 python -m distributed_llm_training_and_inference_sys
     --prompt-len 512 --gen-len 128 --rps "" --concurrency 4,8,16 \
     --admission reserve --kv-blocks 96
 
+# tune sp rerun: battery-2's run timed through block_until_ready's
+# early-return hole (4 us for a 1024x1024 flash); now value-fenced via
+# utils.timing
+run tune_sp 700 python -m distributed_llm_training_and_inference_system_tpu.cli.main \
+    tune sp --seq-lens 8192,16384 --sp 8
+
 echo "battery3 complete; results in $OUT/"
