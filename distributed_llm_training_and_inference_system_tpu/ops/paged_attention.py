@@ -148,34 +148,42 @@ def write_window_to_pages(
     maxP = block_tables.shape[1]
     if T > PS:
         raise ValueError(f"window {T} exceeds page size {PS}")
+    # T == 1 never crosses a page boundary: one staging page per slot
+    # (the second page would be gathered and rewritten byte-identical —
+    # pure no-op DMA on the hottest per-step path)
+    n_stage = 1 if T == 1 else 2
     offs = jnp.arange(T, dtype=jnp.int32)
     pos = start_positions[:, None] + offs                     # [B, T]
     p0 = jnp.clip(start_positions // PS, 0, maxP - 1)         # [B]
-    lp = jnp.stack([p0, jnp.clip(p0 + 1, 0, maxP - 1)], 1)    # [B, 2]
-    phys = jnp.take_along_axis(block_tables, lp, axis=1)      # [B, 2]
-    # duplicate-page edge (window entirely in the last logical page):
-    # the second staging half would rewrite the SAME page with stale
-    # content — redirect it to scratch instead
-    phys = phys.at[:, 1].set(jnp.where(lp[:, 1] == lp[:, 0], 0,
-                                       phys[:, 1]))
-    staging = pages[phys]                                     # [B,2,Nkv,PS,D]
+    if n_stage == 1:
+        lp = p0[:, None]                                      # [B, 1]
+        phys = jnp.take_along_axis(block_tables, lp, axis=1)
+    else:
+        lp = jnp.stack([p0, jnp.clip(p0 + 1, 0, maxP - 1)], 1)  # [B, 2]
+        phys = jnp.take_along_axis(block_tables, lp, axis=1)    # [B, 2]
+        # duplicate-page edge (window entirely in the last logical page):
+        # the second staging half would rewrite the SAME page with stale
+        # content — redirect it to scratch instead
+        phys = phys.at[:, 1].set(jnp.where(lp[:, 1] == lp[:, 0], 0,
+                                           phys[:, 1]))
+    staging = pages[phys]                              # [B,n,Nkv,PS,D]
 
-    off = pos - p0[:, None] * PS                              # [B,T] in [0,2PS)
+    off = pos - p0[:, None] * PS                       # [B,T] in [0,n*PS)
     ok = jnp.ones((B, T), bool) if write_ok is None else write_ok
-    tok_half = jnp.clip(off // PS, 0, 1)                      # [B, T]
+    tok_half = jnp.clip(off // PS, 0, n_stage - 1)            # [B, T]
     tok_phys = jnp.take_along_axis(phys, tok_half, axis=1)    # [B, T]
     ok = ok & (tok_phys != 0)
-    onehot = (off[:, :, None] == jnp.arange(2 * PS)[None, None]) \
-        & ok[:, :, None]                                      # [B,T,2PS]
-    hit = onehot.any(axis=1)                                  # [B, 2PS]
+    onehot = (off[:, :, None] == jnp.arange(n_stage * PS)[None, None]) \
+        & ok[:, :, None]                                      # [B,T,nPS]
+    hit = onehot.any(axis=1)                                  # [B, nPS]
     upd = jnp.einsum("bts,btnd->bsnd", onehot.astype(new_kv.dtype),
-                     new_kv)                                  # [B,2PS,Nkv,D]
-    stag = staging.transpose(0, 1, 3, 2, 4).reshape(B, 2 * PS, Nkv, D)
+                     new_kv)                                  # [B,nPS,Nkv,D]
+    stag = staging.transpose(0, 1, 3, 2, 4).reshape(B, n_stage * PS, Nkv, D)
     merged = jnp.where(hit[:, :, None, None], upd.astype(pages.dtype),
                        stag)
-    merged = merged.reshape(B, 2, PS, Nkv, D).transpose(0, 1, 3, 2, 4)
+    merged = merged.reshape(B, n_stage, PS, Nkv, D).transpose(0, 1, 3, 2, 4)
     return pages.at[phys.reshape(-1)].set(
-        merged.reshape(B * 2, Nkv, PS, D))
+        merged.reshape(B * n_stage, Nkv, PS, D))
 
 
 def paged_attention_multi(
@@ -190,12 +198,12 @@ def paged_attention_multi(
     [0, start_b + j] through the pages (the window's own K/V must already
     be written). Returns [B, T, Nq, D].
 
-    On TPU this runs the dedicated Pallas kernel (each page DMA'd once per
-    slot/kv-head for ALL T queries); the fallback flattens to [B*T] rows of
-    the single-token path — correct everywhere, but it re-streams the
-    prefix T times (measured ~9 decode-steps of overhead for a T=8 verify
-    window at gpt-1b, BASELINE.md round 2 — the motivation for the
-    kernel).
+    On TPU this runs the head-folded Pallas kernel (each page DMA'd once
+    per SLOT — all kv heads, all T queries); the fallback flattens to
+    [B*T] rows of the single-token path — correct everywhere, but it
+    re-streams the prefix T times (measured ~9 decode-steps of overhead
+    for a T=8 verify window at gpt-1b, BASELINE.md round 2 — the
+    motivation for the kernel).
     """
     B, T, Nq, D = q.shape
     if impl == "auto":

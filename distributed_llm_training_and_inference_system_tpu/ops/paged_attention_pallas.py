@@ -5,18 +5,24 @@ The gather baseline (ops/paged_attention.py) materialises every slot's full
 token regardless of the sequence's actual length. This kernel reads only the
 pages a sequence owns:
 
-- Grid (B, Nkv, maxP), page index innermost. The page arrays stay in HBM;
-  each grid step's BlockSpec uses the scalar-prefetched block table to DMA
-  exactly one physical page [PS, D] into VMEM (``PrefetchScalarGridSpec``
-  — the pallas_guide.md pattern for data-dependent addressing). Pallas
-  double-buffers the copies, overlapping page DMA with compute.
+- Grid (B, maxP), page index innermost. The page arrays stay in HBM; each
+  grid step's BlockSpec uses the scalar-prefetched block table to DMA one
+  physical page — ALL kv heads, [Nkv, PS, D] — into VMEM
+  (``PrefetchScalarGridSpec`` — the pallas_guide.md pattern for
+  data-dependent addressing). Pallas double-buffers the copies,
+  overlapping page DMA with compute. Heads are folded into one dot pair
+  per page (cross-head blocks masked): the earlier (B, Nkv, maxP) grid
+  paid ~10 us of pipeline overhead per [1,128]x[128,64] dot at MHA decode
+  — 12.3 ms of a 24.2 ms gpt-1b decode step (round-3 ablation,
+  BASELINE.md).
 - Pages past a sequence's live length are CLAMPED to its last used page in
   the index map. Consecutive identical block indices elide the re-fetch
   entirely (the pipeline emitter skips the DMA), so per-token HBM traffic is
   proportional to the sequence's true length — the whole point of paging.
 - Online softmax in fp32 VMEM scratch across pages (same recurrence as the
-  training-side flash kernel); GQA folds the q-head group into the tile so
-  each KV page is loaded ONCE per kv head, not once per q head.
+  training-side flash kernel); GQA folds the q-head group into the tile,
+  and head folding means each KV page is loaded ONCE per slot — not per
+  kv head, let alone per q head.
 
 Numerics match ops.paged_attention.paged_attention (the gather baseline) —
 asserted in tests/test_serve.py. The baseline remains the CPU/interpret
@@ -41,13 +47,26 @@ from ..models.layers import NEG_INF
 def _extend_kernel(tables_ref, starts_ref,        # scalar prefetch
                    *refs,                          # see unpack below
                    page_size: int, scale: float, groups: int,
-                   window: int, kv_quant: bool):
+                   window: int, num_kv: int, kv_quant: bool):
     """Multi-query variant: ``window`` consecutive query tokens per slot
     (speculative verify / cached-prefix suffix prefill). Each page is
-    DMA'd ONCE per (slot, kv head) and scored against all T queries —
+    DMA'd ONCE per slot and scored against all T queries of ALL kv heads —
     the flattened-row fallback re-streams the prefix T times. Query row
-    j (= row // groups) sits at position start + j and attends causally
-    over [0, start + j].
+    j (= row // groups within a head) sits at position start + j and
+    attends causally over [0, start + j].
+
+    Head folding (round-3 redesign): the original grid (B, Nkv, maxP) ran
+    one [T*G, D] x [D, PS] dot per grid step — at MHA decode (T=G=1)
+    that is a [1,128]x[128,64] dot per step and 1,280 grid steps/layer,
+    measured 12.3 ms of a 24.2 ms decode step in pure per-step pipeline
+    overhead (the data floor is ~1.2 ms). This kernel folds ALL kv heads
+    into one grid step: q rows [Nkv*T*G, D] against the whole page
+    [Nkv*PS, D] in ONE dot pair per page. Cross-head score blocks are
+    masked to NEG_INF, so their post-softmax probabilities are exactly
+    zero and the folded AV dot needs no block-diagonal bookkeeping. The
+    dot does Nkv x the useful FLOPs, but decode attention FLOPs are
+    trivia next to per-grid-step overhead (16 GFLOPs/step at gpt-1b B=8
+    vs a ~100 us MXU budget).
 
     ``kv_quant``: pages are int8 with per-token scales [PS, 1] — dequant
     happens in VMEM right before the fp32 dot, so HBM page traffic is
@@ -58,7 +77,9 @@ def _extend_kernel(tables_ref, starts_ref,        # scalar prefetch
     else:
         q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref = refs
     b = pl.program_id(0)
-    p = pl.program_id(2)
+    p = pl.program_id(1)
+    tg = window * groups                  # query rows per kv head
+    d = q_ref.shape[-1]
 
     @pl.when(p == 0)
     def _init():
@@ -71,21 +92,25 @@ def _extend_kernel(tables_ref, starts_ref,        # scalar prefetch
 
     @pl.when(p * page_size < max_len)
     def _body():
-        q = q_ref[...].astype(jnp.float32)            # [T*G, D]
-        k = k_ref[...].astype(jnp.float32)            # [PS, D]
-        v = v_ref[...].astype(jnp.float32)            # [PS, D]
+        q = q_ref[...].astype(jnp.float32).reshape(num_kv * tg, d)
+        k = k_ref[...].astype(jnp.float32)            # [Nkv, PS, D]
+        v = v_ref[...].astype(jnp.float32)
         if kv_quant:
-            k = k * ks_ref[...]                       # [PS, 1] broadcast
+            k = k * ks_ref[...]                       # [Nkv, PS, 1]
             v = v * vs_ref[...]
+        k = k.reshape(num_kv * page_size, d)
+        v = v.reshape(num_kv * page_size, d)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale      # [T*G, PS]
-        pos = p * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 1)
-        row_j = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // groups
-        s = jnp.where(pos <= start + row_j, s, NEG_INF)  # causal per query
+            preferred_element_type=jnp.float32) * scale  # [Nkv*TG, Nkv*PS]
+        row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        pos = p * page_size + col % page_size
+        row_j = (row % tg) // groups
+        same_head = (row // tg) == (col // page_size)
+        s = jnp.where(same_head & (pos <= start + row_j), s, NEG_INF)
 
-        m_prev = m_ref[...]                            # [T*G, 1]
+        m_prev = m_ref[...]                            # [Nkv*TG, 1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p_ = jnp.exp(jnp.where(m_new > NEG_INF / 2, s - m_new, NEG_INF))
         alpha = jnp.exp(jnp.where(m_new > NEG_INF / 2, m_prev - m_new, 0.0))
@@ -95,11 +120,11 @@ def _extend_kernel(tables_ref, starts_ref,        # scalar prefetch
             preferred_element_type=jnp.float32)
         m_ref[...] = m_new
 
-    @pl.when(p == pl.num_programs(2) - 1)
+    @pl.when(p == pl.num_programs(1) - 1)
     def _finalize():
         l = l_ref[...]
         o_ref[...] = (acc_ref[...] / jnp.where(l > 0, l, 1.0)).astype(
-            o_ref.dtype)
+            o_ref.dtype).reshape(o_ref.shape)
 
 
 def paged_attention_pallas_multi(
@@ -132,12 +157,13 @@ def paged_attention_pallas_multi(
     tables_clamped = jnp.take_along_axis(
         block_tables.astype(jnp.int32), clamped_p, axis=1)
 
-    page_spec = pl.BlockSpec((None, None, PS, D),
-                             lambda b, h, p, t, u: (t[b, p], h, 0, 0))
-    scale_spec = pl.BlockSpec((None, None, PS, 1),
-                              lambda b, h, p, t, u: (t[b, p], h, 0, 0))
-    in_specs = [pl.BlockSpec((None, None, T * groups, D),
-                             lambda b, h, p, t, u: (b, h, 0, 0))]   # q
+    # head-folded grid (B, maxP): one whole page (all kv heads) per step
+    page_spec = pl.BlockSpec((None, Nkv, PS, D),
+                             lambda b, p, t, u: (t[b, p], 0, 0, 0))
+    scale_spec = pl.BlockSpec((None, Nkv, PS, 1),
+                              lambda b, p, t, u: (t[b, p], 0, 0, 0))
+    in_specs = [pl.BlockSpec((None, Nkv, T * groups, D),
+                             lambda b, p, t, u: (b, 0, 0, 0))]      # q
     inputs = [qg]
     if kv_quant:
         in_specs += [page_spec, scale_spec, page_spec, scale_spec]
@@ -149,20 +175,21 @@ def paged_attention_pallas_multi(
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,       # tables_clamped, starts
-        grid=(B, Nkv, maxP),
+        grid=(B, maxP),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((None, None, T * groups, D),
-                               lambda b, h, p, t, u: (b, h, 0, 0)),
+        out_specs=pl.BlockSpec((None, Nkv, T * groups, D),
+                               lambda b, p, t, u: (b, 0, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((T * groups, D), jnp.float32),
-            pltpu.VMEM((T * groups, 1), jnp.float32),
-            pltpu.VMEM((T * groups, 1), jnp.float32),
+            pltpu.VMEM((Nkv * T * groups, D), jnp.float32),
+            pltpu.VMEM((Nkv * T * groups, 1), jnp.float32),
+            pltpu.VMEM((Nkv * T * groups, 1), jnp.float32),
         ],
     )
 
     out = pl.pallas_call(
         functools.partial(_extend_kernel, page_size=PS, scale=scale,
-                          groups=groups, window=T, kv_quant=kv_quant),
+                          groups=groups, window=T, num_kv=Nkv,
+                          kv_quant=kv_quant),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Nkv, T * groups, D), q.dtype),
         interpret=interpret,

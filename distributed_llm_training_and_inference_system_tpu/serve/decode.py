@@ -41,6 +41,7 @@ def decode_step_forward(
     cfg: ModelConfig,
     active: Any = None,       # [B] bool — inactive rows write scratch page
     attn_impl: str = "auto",
+    write_mode: str = "paged",
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Returns (logits [B, V] fp32, new k_pages, new v_pages).
 
@@ -53,7 +54,7 @@ def decode_step_forward(
     write_ok = None if active is None else active[:, None]
     logits, new_k, new_v = extend_step_forward(
         params, tokens[:, None], positions, k_pages, v_pages, block_tables,
-        cfg, write_ok=write_ok, attn_impl=attn_impl)
+        cfg, write_ok=write_ok, attn_impl=attn_impl, write_mode=write_mode)
     return logits[:, 0], new_k, new_v
 
 
@@ -89,10 +90,10 @@ def extend_step_forward(
     so T<=8 tokens cost nearly the same as 1) and cached-prefix suffix
     prefill (only the un-cached tail of a prompt is computed).
 
-    Attention goes through ops.paged_attention_multi: on TPU a dedicated
-    Pallas kernel streams each page once per (slot, kv head) for ALL T
-    queries; elsewhere a flattened [B*T]-row fallback of the single-token
-    path (correct, but re-streams the prefix T-fold).
+    Attention goes through ops.paged_attention_multi: on TPU the
+    head-folded Pallas kernel streams each page ONCE PER SLOT (all kv
+    heads, all T queries); elsewhere a flattened [B*T]-row fallback of
+    the single-token path (correct, but re-streams the prefix T-fold).
     """
     compute_dtype = jnp.dtype(cfg.dtype)
     B, T = tokens.shape
@@ -103,8 +104,11 @@ def extend_step_forward(
     flat_tables = jnp.repeat(block_tables, T, axis=0)        # [B*T, maxP]
     flat_ok = None if write_ok is None else write_ok.reshape(B * T)
     from ..ops.paged_attention import QuantPages
+    # T == 1 (plain decode) included: the whole-page merge beat the B-row
+    # scatter by ~1 ms/step in the round-3 decode ablation once the
+    # folded attention kernel removed the larger overheads
     use_window_write = (
-        T > 1 and T <= k_pages.shape[-2]
+        T <= k_pages.shape[-2]
         and not isinstance(k_pages, QuantPages)
         and write_mode != "scatter")
 
@@ -186,6 +190,7 @@ def decode_multi_step(
     cfg: ModelConfig,
     num_steps: int,
     attn_impl: str = "auto",
+    write_mode: str = "paged",
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Run ``num_steps`` decode+sample iterations in ONE compiled program.
 
@@ -209,13 +214,14 @@ def decode_multi_step(
     (_, _, k_pages, v_pages), toks_seq = decode_scan(
         params, tokens, positions, k_pages, v_pages, block_tables,
         stop_positions, slot_keys, temperature, top_k, top_p, cfg,
-        num_steps, attn_impl)
+        num_steps, attn_impl, write_mode)
     return toks_seq, k_pages, v_pages
 
 
 def decode_scan(params, tokens, positions, k_pages, v_pages, block_tables,
                 stop_positions, slot_keys, temperature, top_k, top_p,
-                cfg: ModelConfig, num_steps: int, attn_impl: str = "auto"):
+                cfg: ModelConfig, num_steps: int, attn_impl: str = "auto",
+                write_mode: str = "paged"):
     """The decode+sample scan shared by ``decode_multi_step`` and the fused
     speculative dispatch (speculative.verify_and_decode). Returns
     ((tokens, positions, k_pages, v_pages), toks_seq [K, B])."""
@@ -226,7 +232,7 @@ def decode_scan(params, tokens, positions, k_pages, v_pages, block_tables,
         act = pos < stop_positions
         logits, kp, vp = decode_step_forward(
             params, toks, pos, kp, vp, block_tables, cfg, active=act,
-            attn_impl=attn_impl)
+            attn_impl=attn_impl, write_mode=write_mode)
         keys = jax.vmap(jax.random.fold_in)(
             jax.vmap(jax.random.wrap_key_data)(slot_keys), pos + 1)
         nxt = sample_tokens(logits, keys, temperature, top_k, top_p)

@@ -667,7 +667,7 @@ class InferenceEngine:
             params, tokens, positions, k_pages, v_pages, tables, stops,
             slot_keys, temp, top_k, top_p, self.cfg,
             num_steps=max(self.serve_cfg.decode_steps_per_dispatch, 1),
-            attn_impl=self._attn_impl)
+            attn_impl=self._attn_impl, write_mode=self._extend_write)
 
     def _decode_device(self) -> np.ndarray:
         """Dispatch K decode steps for every slot; lock-free device work.

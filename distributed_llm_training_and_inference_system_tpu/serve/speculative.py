@@ -171,5 +171,5 @@ def verify_and_decode(
     (_, _, k_pages, v_pages), decode_seq = decode_scan(
         params, last, positions + n_emit, k_pages, v_pages, block_tables,
         stop_positions, slot_keys, temperature, top_k, top_p, cfg,
-        num_decode_steps, attn_impl)
+        num_decode_steps, attn_impl, write_mode)
     return emitted, n_emit, decode_seq, k_pages, v_pages
