@@ -14,9 +14,12 @@ matmul shapes cap at 17-30% of the v5e MXU peak in isolation (measured via
 matmul-probe sweeps, BASELINE.md round-2 notes), so its 0.34 MFU was a
 model-shape ceiling, not a framework one. bf16 compute, flash attention
 Pallas kernel, selective remat, chunked cross-entropy (the [B,S,V] fp32
-logits pair is never materialised), bf16 Adam first moment
-(OptimizerConfig.moment_dtype — measured +0.035 MFU at this scale, the
-freed HBM improves XLA scheduling) — the same code path `llmctl train`
+logits pair is never materialised), bf16 Adam moments
+(OptimizerConfig.moment_dtype/nu_dtype — measured +0.035 MFU at this
+scale, the freed HBM improves XLA scheduling), and 16-microbatch gradient
+accumulation (global batch 64 — the round-3 sweep: the optimizer +
+fixed-cost tail amortises over microbatches, per-microbatch cost falls
+416 -> 391 ms, MFU 0.494 -> 0.524) — the same code path `llmctl train`
 uses. Runs anywhere jax runs; on CPU it reports CPU numbers.
 
 Timing: pipelined windows of 5 steps, each fenced by a scalar fetch (on the
@@ -49,19 +52,24 @@ def main() -> None:
     model_name = "gpt-750m" if on_tpu else "gpt-test"
     seq_len = 2048 if on_tpu else 128
     batch = 4
+    accum = 16 if on_tpu else 2
     peak_tflops = 197.0 if on_tpu else 0.2   # v5e bf16 peak
 
     cfg = get_model_config(model_name)
     par = ParallelConfig(activation_checkpoint="selective",
-                         micro_batch_size=batch, global_batch_size=batch)
+                         micro_batch_size=batch,
+                         global_batch_size=batch * accum,
+                         gradient_accumulation_steps=accum)
     step_fn, tx, _ = make_train_step(
-        cfg, OptimizerConfig(lr=1e-4, moment_dtype="bfloat16"), par,
+        cfg, OptimizerConfig(lr=1e-4, moment_dtype="bfloat16",
+                             nu_dtype="bfloat16"), par,
         attn_impl="flash" if on_tpu else "xla", loss_chunk=1024)
     params = init(cfg, jax.random.PRNGKey(0))
     state = TrainState.create(params, tx)
     jstep = jax.jit(step_fn, donate_argnums=(0,))
 
-    tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, seq_len), 1,
+    tokens = jax.random.randint(jax.random.PRNGKey(1),
+                                (batch * accum, seq_len), 1,
                                 cfg.vocab_size)
     b = {"tokens": tokens}
 
@@ -85,7 +93,7 @@ def main() -> None:
     dt = min(windows)
     spread = (max(windows) - dt) / dt
     steps_per_sec = 1.0 / dt
-    tokens_per_sec = steps_per_sec * batch * seq_len
+    tokens_per_sec = steps_per_sec * batch * accum * seq_len
     fpt = flops_per_token(cfg, seq_len)
     mfu = tokens_per_sec * fpt / (peak_tflops * 1e12)
 
