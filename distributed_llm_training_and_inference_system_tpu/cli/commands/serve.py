@@ -73,11 +73,16 @@ def app(ctx):
               help="Evicted-KV policy: recompute re-prefills on "
                    "readmission (prefix-cache-cheap); swap round-trips "
                    "the pages through host memory (zero re-prefill).")
+@click.option("--latency-dispatch-steps", default=2, show_default=True,
+              type=int,
+              help="Shrink decode dispatches to this many steps while "
+                   "requests wait in the queue with a free slot, so "
+                   "prefill windows open sooner (0 disables).")
 def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
           kv_block_size, kv_hbm_gb, scheduler, dtype, prometheus_port,
           speculative, spec_tokens, prefix_cache, tensor_parallel,
           quantization, chunked_prefill, kv_quantization, admission,
-          preemption):
+          preemption, latency_dispatch_steps):
     """Start the OpenAI-compatible inference server."""
     import jax
 
@@ -99,7 +104,8 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
         tensor_parallel=tensor_parallel, quantization=quantization,
         chunked_prefill_tokens=chunked_prefill,
         kv_quantization=kv_quantization, admission=admission,
-        preemption=preemption)
+        preemption=preemption,
+        latency_dispatch_steps=latency_dispatch_steps)
     serve_cfg.validate()
 
     observer = None

@@ -526,6 +526,16 @@ class ServeConfig:
     # waste at most K-1 trailing iterations; admission happens between
     # dispatches, so K also bounds admission latency in decode steps.
     decode_steps_per_dispatch: int = 8
+    # latency-adaptive dispatch: while an ADMISSIBLE request waits in the
+    # queue, decode dispatches shrink to min(this, K-1) steps so a
+    # prefill slot opens sooner — an arrival landing just after a K=8
+    # dispatch began otherwise waits out the whole ~K*step_time window
+    # (the measured open-loop p99 device TTFT was 249 ms vs a 26 ms
+    # prefill floor, BASELINE.md round 3). Splitting a dispatch is
+    # bitwise-identical output (the scan is literally the same per-step
+    # program). 0 disables; values >= K clamp to K-1 (never a silent
+    # no-op); K = 1 has nothing to shrink.
+    latency_dispatch_steps: int = 2
     # tokens per KV-cache page: 64 makes each page a [64, D] DMA tile for
     # the Pallas decode kernel (16-token pages measured 2.4x slower — DMA
     # too small); internal fragmentation is at most page_size-1 tokens/seq
@@ -611,6 +621,8 @@ class ServeConfig:
             raise ConfigError("quantization must be none|int8|int4|int4-awq")
         if self.chunked_prefill_tokens < 0:
             raise ConfigError("chunked_prefill_tokens must be >= 0")
+        if self.latency_dispatch_steps < 0:
+            raise ConfigError("latency_dispatch_steps must be >= 0")
         # quantized + tensor_parallel is supported for int8 AND int4:
         # param_specs shards Quant[4]Tensor leaves like the kernels they
         # replace (the int4 packed layout is kernel-oriented [L, in/2, out]

@@ -203,6 +203,19 @@ class InferenceEngine:
         # per engine step, interleaved with decode)
         self._partial_prefills: dict[str, dict] = {}
         self._decode_jit = jax.jit(self._decode_impl, donate_argnums=(1, 2))
+        # latency-adaptive dispatch: a second compiled decode program with
+        # a short scan, used while requests wait in the queue so a prefill
+        # slot opens after ~L steps instead of K (splitting a dispatch is
+        # bitwise-identical output — the scan is the same per-step program)
+        K = max(serve_cfg.decode_steps_per_dispatch, 1)
+        # L is a CAP: clamp to K-1 so a misconfigured L >= K still helps
+        # instead of silently disabling; K == 1 has nothing to shrink
+        L = min(serve_cfg.latency_dispatch_steps, K - 1)
+        self._decode_jit_short = (
+            jax.jit(functools.partial(self._decode_impl_n, L),
+                    donate_argnums=(1, 2)) if L > 0 else None)
+        self._short_warmed = self._decode_jit_short is None
+        self.total_short_dispatches = 0
         self._spec_jit = (jax.jit(self._spec_impl, donate_argnums=(1, 2))
                           if serve_cfg.speculative == "ngram" else None)
         self.total_decode_steps = 0
@@ -663,19 +676,75 @@ class InferenceEngine:
 
     def _decode_impl(self, params, k_pages, v_pages, tokens, positions,
                      tables, stops, slot_keys, temp, top_k, top_p):
+        return self._decode_impl_n(
+            max(self.serve_cfg.decode_steps_per_dispatch, 1),
+            params, k_pages, v_pages, tokens, positions, tables, stops,
+            slot_keys, temp, top_k, top_p)
+
+    def _decode_impl_n(self, num_steps, params, k_pages, v_pages, tokens,
+                       positions, tables, stops, slot_keys, temp, top_k,
+                       top_p):
         return decode_multi_step(
             params, tokens, positions, k_pages, v_pages, tables, stops,
-            slot_keys, temp, top_k, top_p, self.cfg,
-            num_steps=max(self.serve_cfg.decode_steps_per_dispatch, 1),
+            slot_keys, temp, top_k, top_p, self.cfg, num_steps=num_steps,
             attn_impl=self._attn_impl, write_mode=self._extend_write)
 
-    def _decode_device(self) -> np.ndarray:
+    def _short_dispatch_ok(self) -> bool:
+        """Should the next decode dispatch run the SHORT program? (caller
+        holds self.lock.) True only when shortening can actually help: a
+        request waits in the queue, a slot is free, and the queue head's
+        admission reservation would fit the free pool right now (a
+        pages-starved head can't be admitted at any boundary, so paying
+        K/L x the host round trips would buy nothing). The page probe
+        ignores prefix-cache pins — pessimistic, so the failure mode is
+        keeping the long program, never wasted RTT."""
+        if self._decode_jit_short is None:
+            return False
+        if (self.scheduler.queue_depth == 0
+                or self.scheduler.active_count
+                >= self.serve_cfg.max_batch_size):
+            return False
+        head = self.scheduler.waiting[0]
+        need = self.kv.pages_needed(
+            len(head.context_tokens) + self._admission_tail(head))
+        return need <= self.kv.free_pages - self._reserved_pages
+
+    def _warm_short_program(self) -> None:
+        """One short dispatch against scratch tables (all rows inactive,
+        writes land on reserved page 0 — the measure_device_times probe
+        pattern) purely to compile + warm the program."""
+        S = self.serve_cfg.max_batch_size
+        zeros_i = jnp.zeros(S, jnp.int32)
+        scratch_tables = jnp.zeros_like(jnp.asarray(self.kv.block_tables))
+        _, self.kv.k_pages, self.kv.v_pages = self._decode_jit_short(
+            self.params, self.kv.k_pages, self.kv.v_pages, zeros_i,
+            zeros_i, scratch_tables, zeros_i,
+            jnp.asarray(self._slot_keys),
+            jnp.ones(S, jnp.float32), jnp.zeros(S, jnp.int32),
+            jnp.ones(S, jnp.float32))
+        self._short_warmed = True
+
+    def _decode_device(self, use_short: bool = False) -> np.ndarray:
         """Dispatch K decode steps for every slot; lock-free device work.
 
         One dispatch + one device->host fetch per K tokens: the
         host-round-trip cost (the decode bottleneck on remote devices) is
-        amortised K-fold (see decode.decode_multi_step)."""
-        sampled_seq, self.kv.k_pages, self.kv.v_pages = self._decode_jit(
+        amortised K-fold (see decode.decode_multi_step). While requests
+        WAIT in the queue the short program runs instead, so the next
+        admit/prefill window opens after latency_dispatch_steps instead
+        of K — the measured open-loop p99 device TTFT was dominated by
+        arrivals waiting out a full in-flight dispatch (BASELINE.md r3)."""
+        if not self._short_warmed and self._decode_jit_short is not None:
+            # compile the short program OFF the latency path (piggybacked
+            # on the warmup phase): its first queue-pressure use would
+            # otherwise pay a multi-second XLA compile exactly when a
+            # request is waiting — the opposite of the feature's goal
+            self._warm_short_program()
+        jit = self._decode_jit
+        if use_short and self._decode_jit_short is not None:
+            jit = self._decode_jit_short
+            self.total_short_dispatches += 1
+        sampled_seq, self.kv.k_pages, self.kv.v_pages = jit(
             self.params, self.kv.k_pages, self.kv.v_pages,
             jnp.asarray(self.last_tokens), jnp.asarray(self.positions),
             jnp.asarray(self.kv.block_tables),
@@ -843,6 +912,7 @@ class InferenceEngine:
         self.params = None
         self.kv = None
         self._decode_jit = None
+        self._decode_jit_short = None
         self._spec_jit = None
         self._prefill_cache.clear()
         self._partial_prefills.clear()
@@ -1032,6 +1102,9 @@ class InferenceEngine:
             # for one dispatch of writes, preempting newest-first if the
             # pool is dry — BEFORE the dispatch reads the block tables
             self._ensure_decode_capacity()
+            # latency-adaptive dispatch decision (needs the lock: it
+            # inspects the queue head's admissibility)
+            use_short = self._short_dispatch_ok()
         if any(self.active):
             # speculative path only when a greedy stream is resident: for
             # sampled rows a verify dispatch yields 1 token vs K from
@@ -1058,7 +1131,7 @@ class InferenceEngine:
                     self._apply_speculative(emitted, n_emit, decode_seq)
                     self.scheduler.step_finished(self.eos_token_id)
             else:
-                sampled = self._decode_device()
+                sampled = self._decode_device(use_short)
                 with self.lock:
                     self._apply_decode(sampled)
                     self.scheduler.step_finished(self.eos_token_id)
@@ -1211,6 +1284,7 @@ class InferenceEngine:
             "swap_ins": self.total_swap_ins,
             "swapped_host_bytes": self._swap_bytes_in_queue(),
             "decode_steps": self.total_decode_steps,
+            "short_dispatches": self.total_short_dispatches,
             "prefill_tokens": self.total_prefill_tokens,
             "prefix_cached_tokens": self.total_prefix_cached_tokens,
             "padded_slot_steps": self.total_padded_slot_steps,

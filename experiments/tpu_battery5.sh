@@ -1,0 +1,24 @@
+#!/bin/bash
+# Round-3 fifth wave: light-load TTFT with latency-adaptive dispatch
+# (does the open-loop p99 drop under 200 ms?), with an A/B against
+# latency_dispatch_steps=0 via the same build.
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-experiments/results_r3}
+mkdir -p "$OUT"
+source experiments/battery_lib.sh
+tpu_guard
+
+run serve_load_light_adaptive 900 python -m distributed_llm_training_and_inference_system_tpu.cli.main \
+    bench e2e --model gpt-1b --mode serve-load --requests 16 \
+    --prompt-len 512 --gen-len 64 --rps 0.25,0.5 --concurrency 1,2 \
+    --admission ondemand --kv-blocks 96
+
+# sustained-load sanity: adaptive dispatch must not cost goodput at
+# saturation (the free-slot guard should keep it out of the way)
+run serve_load_saturation_adaptive 900 python -m distributed_llm_training_and_inference_system_tpu.cli.main \
+    bench e2e --model gpt-1b --mode serve-load --requests 32 \
+    --prompt-len 512 --gen-len 128 --rps "" --concurrency 8,16 \
+    --admission ondemand --kv-blocks 96
+
+echo "battery5 complete; results in $OUT/"

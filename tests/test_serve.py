@@ -530,3 +530,53 @@ def test_engine_release_frees_and_next_engine_works(model_cfg):
         outputs.append(req.generated_tokens)
         prev = eng
     assert outputs[0] == outputs[1] == outputs[2]
+
+
+def test_latency_adaptive_dispatch_identical_and_engaged(model_cfg):
+    """Splitting a decode dispatch must be BITWISE identical output (the
+    scan runs the same per-step program), and the short program engages
+    exactly when it can help: queued head + free slot + admissible pages
+    (round-3: open-loop p99 device TTFT was bound by arrivals waiting out
+    a full K-step dispatch)."""
+    prompts = [[5, 17, 99, 3], [7, 23, 41, 2]]
+    kw = dict(max_batch_size=1, decode_steps_per_dispatch=8)
+    base = make_engine(model_cfg, latency_dispatch_steps=0, **kw)
+    want = [r.generated_tokens for r in base.generate(
+        prompts, SamplingParams(temperature=0.0, max_tokens=24))]
+    eng = make_engine(model_cfg, latency_dispatch_steps=2, **kw)
+    got = [r.generated_tokens for r in eng.generate(
+        prompts, SamplingParams(temperature=0.0, max_tokens=24))]
+    assert got == want
+
+    # engagement probe (the synchronous generate() loop admits before
+    # every dispatch, so the queued+admissible state only arises from
+    # mid-dispatch arrivals — construct it directly)
+    from distributed_llm_training_and_inference_system_tpu.serve import (
+        Request)
+    eng2 = make_engine(model_cfg, latency_dispatch_steps=2,
+                       max_batch_size=2, decode_steps_per_dispatch=8)
+    with eng2.lock:
+        assert not eng2._short_dispatch_ok()        # empty queue
+    r1 = Request(request_id="r1", prompt_tokens=[5, 6, 7, 8],
+                 sampling=SamplingParams(temperature=0.0, max_tokens=8))
+    assert eng2.scheduler.add_request(r1)
+    with eng2.lock:
+        # queued + free slot + pages available -> short dispatch
+        assert eng2._short_dispatch_ok()
+    # a pages-starved head must NOT shorten (paying extra round trips
+    # cannot admit it at any boundary)
+    eng3 = make_engine(model_cfg, latency_dispatch_steps=2,
+                       max_batch_size=2, decode_steps_per_dispatch=8,
+                       kv_block_size=8, kv_num_blocks=10,
+                       admission="reserve")
+    r_big_hold = Request(request_id="hold", prompt_tokens=[5, 17, 99, 3],
+                         sampling=SamplingParams(temperature=0.0,
+                                                 max_tokens=56))
+    assert eng3.scheduler.add_request(r_big_hold)
+    eng3.step()          # admit + prefill + first decode dispatch
+    big = Request(request_id="big", prompt_tokens=list(range(2, 40)),
+                  sampling=SamplingParams(temperature=0.0, max_tokens=30))
+    assert eng3.scheduler.add_request(big)
+    with eng3.lock:
+        # hold reserves 8 of 9 usable pages; big needs 9 -> starved
+        assert not eng3._short_dispatch_ok()
