@@ -1,0 +1,22 @@
+#!/bin/bash
+# Round-3 sixth wave: re-certify the occupancy-gated latency-adaptive
+# dispatch — saturation goodput must be back at the no-adaptive level,
+# light-load p99 must keep its win.
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-experiments/results_r3}
+mkdir -p "$OUT"
+source experiments/battery_lib.sh
+tpu_guard
+
+run serve_load_saturation_gated 900 python -m distributed_llm_training_and_inference_system_tpu.cli.main \
+    bench e2e --model gpt-1b --mode serve-load --requests 32 \
+    --prompt-len 512 --gen-len 128 --rps "" --concurrency 8,16 \
+    --admission ondemand --kv-blocks 96
+
+run serve_load_light_gated 900 python -m distributed_llm_training_and_inference_system_tpu.cli.main \
+    bench e2e --model gpt-1b --mode serve-load --requests 16 \
+    --prompt-len 512 --gen-len 64 --rps 0.25 --concurrency 1,2 \
+    --admission ondemand --kv-blocks 96
+
+echo "battery6 complete; results in $OUT/"
