@@ -113,9 +113,12 @@ def kernels(op, seq_len, hidden, heads, batch):
               show_default=True,
               help="serve-load: calibrate on-device prefill/decode times "
                    "and report ttft_device_ms (link RTT excluded).")
+@click.option("--latency-dispatch-steps", default=2, show_default=True,
+              type=int, help="serve-load: latency-adaptive short-dispatch "
+                             "cap (0 disables).")
 def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
         requests, rps, concurrency, admission, kv_blocks, device_times,
-        preemption):
+        preemption, latency_dispatch_steps):
     """End-to-end train step throughput / serve TTFT+throughput
     (parity: reference bench.py:35-49). ``serve-load`` runs open-loop
     (Poisson) and closed-loop sweeps with p50/p99 TTFT, per-token latency,
@@ -201,6 +204,7 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
                 kv_block_size=64 if on_tpu else 16,
                 kv_num_blocks=kv_blocks,
                 admission=admission, preemption=preemption,
+                latency_dispatch_steps=latency_dispatch_steps,
                 dtype="bfloat16" if on_tpu else "float32"))
 
         last_engine: list = []

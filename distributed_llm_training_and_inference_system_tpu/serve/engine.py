@@ -706,9 +706,12 @@ class InferenceEngine:
         # far more than the queued request gains (measured: c8 goodput
         # 144 -> 113.5 tok/s with the queue-only guard, battery 5) — the
         # latency win is real only when few streams share the overhead.
+        S = self.serve_cfg.max_batch_size
+        # threshold capped at S-1 so a FULL batch never shortens (S=1:
+        # threshold 0 — the sole slot busy means nothing can be admitted)
+        occupancy_cap = min(max(S // 4, 1), S - 1)
         if (self.scheduler.queue_depth == 0
-                or self.scheduler.active_count
-                > max(self.serve_cfg.max_batch_size // 4, 1)):
+                or self.scheduler.active_count > occupancy_cap):
             return False
         head = self.scheduler.waiting[0]
         need = self.kv.pages_needed(

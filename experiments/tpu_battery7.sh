@@ -1,0 +1,29 @@
+#!/bin/bash
+# Round-3 seventh wave: CLEAN sequential A/B of the occupancy-gated
+# latency-adaptive dispatch (battery-6's light run was polluted by an
+# accidentally concurrent bench process). Same chip hour, adjacent runs.
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-experiments/results_r3}
+mkdir -p "$OUT"
+source experiments/battery_lib.sh
+tpu_guard
+
+run serve_c8_adapt_on 700 python -m distributed_llm_training_and_inference_system_tpu.cli.main \
+    bench e2e --model gpt-1b --mode serve-load --requests 32 \
+    --prompt-len 512 --gen-len 128 --rps "" --concurrency 8 \
+    --admission ondemand --kv-blocks 96 --latency-dispatch-steps 2
+run serve_c8_adapt_off 700 python -m distributed_llm_training_and_inference_system_tpu.cli.main \
+    bench e2e --model gpt-1b --mode serve-load --requests 32 \
+    --prompt-len 512 --gen-len 128 --rps "" --concurrency 8 \
+    --admission ondemand --kv-blocks 96 --latency-dispatch-steps 0
+run serve_light_adapt_on 900 python -m distributed_llm_training_and_inference_system_tpu.cli.main \
+    bench e2e --model gpt-1b --mode serve-load --requests 16 \
+    --prompt-len 512 --gen-len 64 --rps 0.25 --concurrency 1,2 \
+    --admission ondemand --kv-blocks 96 --latency-dispatch-steps 2
+run serve_light_adapt_off 900 python -m distributed_llm_training_and_inference_system_tpu.cli.main \
+    bench e2e --model gpt-1b --mode serve-load --requests 16 \
+    --prompt-len 512 --gen-len 64 --rps 0.25 --concurrency 1,2 \
+    --admission ondemand --kv-blocks 96 --latency-dispatch-steps 0
+
+echo "battery7 complete; results in $OUT/"

@@ -580,3 +580,33 @@ def test_latency_adaptive_dispatch_identical_and_engaged(model_cfg):
     with eng3.lock:
         # hold reserves 8 of 9 usable pages; big needs 9 -> starved
         assert not eng3._short_dispatch_ok()
+
+    # occupancy gate: near-full batches must NOT shorten even with a
+    # queued admissible head (the queue-only guard measured -21%
+    # saturation goodput, BASELINE.md battery 5) — pin the threshold
+    eng4 = make_engine(model_cfg, latency_dispatch_steps=2,
+                       max_batch_size=8, decode_steps_per_dispatch=8)
+    for i in range(3):
+        r = Request(request_id=f"occ{i}", prompt_tokens=[5 + i, 6, 7, 8],
+                    sampling=SamplingParams(temperature=0.0, max_tokens=40))
+        assert eng4.scheduler.add_request(r)
+    eng4.step()                       # 3 residents decoding (cap is 2)
+    q = Request(request_id="q", prompt_tokens=[9, 9, 9, 9],
+                sampling=SamplingParams(temperature=0.0, max_tokens=4))
+    assert eng4.scheduler.add_request(q)
+    with eng4.lock:
+        assert eng4.scheduler.active_count == 3
+        assert not eng4._short_dispatch_ok()
+    # and a single-slot engine never shortens while its slot is busy
+    eng5 = make_engine(model_cfg, latency_dispatch_steps=2,
+                       max_batch_size=1, decode_steps_per_dispatch=8)
+    r = Request(request_id="solo", prompt_tokens=[5, 6, 7, 8],
+                sampling=SamplingParams(temperature=0.0, max_tokens=40))
+    assert eng5.scheduler.add_request(r)
+    eng5.step()
+    q2 = Request(request_id="q2", prompt_tokens=[9, 9, 9, 9],
+                 sampling=SamplingParams(temperature=0.0, max_tokens=4))
+    assert eng5.scheduler.add_request(q2)
+    with eng5.lock:
+        assert eng5.scheduler.active_count == 1
+        assert not eng5._short_dispatch_ok()
