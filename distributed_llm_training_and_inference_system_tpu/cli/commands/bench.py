@@ -113,7 +113,7 @@ def kernels(op, seq_len, hidden, heads, batch):
               show_default=True,
               help="serve-load: calibrate on-device prefill/decode times "
                    "and report ttft_device_ms (link RTT excluded).")
-@click.option("--latency-dispatch-steps", default=2, show_default=True,
+@click.option("--latency-dispatch-steps", default=0, show_default=True,
               type=int, help="serve-load: latency-adaptive short-dispatch "
                              "cap (0 disables).")
 def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,

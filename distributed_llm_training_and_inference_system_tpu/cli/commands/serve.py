@@ -73,7 +73,7 @@ def app(ctx):
               help="Evicted-KV policy: recompute re-prefills on "
                    "readmission (prefix-cache-cheap); swap round-trips "
                    "the pages through host memory (zero re-prefill).")
-@click.option("--latency-dispatch-steps", default=2, show_default=True,
+@click.option("--latency-dispatch-steps", default=0, show_default=True,
               type=int,
               help="Shrink decode dispatches to this many steps while "
                    "requests wait in the queue with a free slot, so "

@@ -534,8 +534,12 @@ class ServeConfig:
     # prefill floor, BASELINE.md round 3). Splitting a dispatch is
     # bitwise-identical output (the scan is literally the same per-step
     # program). 0 disables; values >= K clamp to K-1 (never a silent
-    # no-op); K = 1 has nothing to shrink.
-    latency_dispatch_steps: int = 2
+    # no-op); K = 1 has nothing to shrink. DEFAULT OFF: measured +12-16%
+    # p99 TTFT at c<=2 but -15% goodput at c8 on the r3 chip (mechanism
+    # under investigation — CPU repro shows zero short dispatches at c8,
+    # so the cost is not the shortening itself); opt in for low-occupancy
+    # latency-sensitive deployments.
+    latency_dispatch_steps: int = 0
     # tokens per KV-cache page: 64 makes each page a [64, D] DMA tile for
     # the Pallas decode kernel (16-token pages measured 2.4x slower — DMA
     # too small); internal fragmentation is at most page_size-1 tokens/seq
