@@ -116,9 +116,22 @@ def kernels(op, seq_len, hidden, heads, batch):
 @click.option("--latency-dispatch-steps", default=0, show_default=True,
               type=int, help="serve-load: latency-adaptive short-dispatch "
                              "cap (0 disables).")
+@click.option("--artifact", default="", help="serve-load: checkpoint dir or "
+              "`llmctl export` file (pre-quantized exports load straight "
+              "to device).")
+@click.option("--quant", default="none", show_default=True,
+              type=click.Choice(["none", "int8", "int4", "int4-awq"]),
+              help="serve-load: weight quantization.")
+@click.option("--kv-quant", default="none", show_default=True,
+              type=click.Choice(["none", "int8"]),
+              help="serve-load: KV page quantization.")
+@click.option("--slots", default=0, show_default=True, type=int,
+              help="serve-load: decode slot count (max_batch_size); "
+                   "0 = auto from --requests (capped at 16).")
 def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
         requests, rps, concurrency, admission, kv_blocks, device_times,
-        preemption, latency_dispatch_steps):
+        preemption, latency_dispatch_steps, artifact, quant, kv_quant,
+        slots):
     """End-to-end train step throughput / serve TTFT+throughput
     (parity: reference bench.py:35-49). ``serve-load`` runs open-loop
     (Poisson) and closed-loop sweeps with p50/p99 TTFT, per-token latency,
@@ -198,13 +211,16 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
 
         def fresh_engine():
             return InferenceEngine(cfg, ServeConfig(
-                model=model_name, max_batch_size=min(max(requests, 8), 16),
+                model=model_name,
+                max_batch_size=slots or min(max(requests, 8), 16),
                 max_seq_len=min(prompt_len + gen_len + 16,
                                 cfg.max_position_embeddings),
                 kv_block_size=64 if on_tpu else 16,
                 kv_num_blocks=kv_blocks,
                 admission=admission, preemption=preemption,
                 latency_dispatch_steps=latency_dispatch_steps,
+                artifact=artifact, quantization=quant,
+                kv_quantization=kv_quant,
                 dtype="bfloat16" if on_tpu else "float32"))
 
         last_engine: list = []

@@ -26,7 +26,12 @@ def app(ctx):
               type=click.Path(exists=True, file_okay=False),
               help="Checkpoint directory (CheckpointManager layout).")
 @click.option("--format", "fmt", default="safetensors", show_default=True,
-              type=click.Choice(["safetensors", "npz"]))
+              type=click.Choice(["safetensors", "npz", "gguf"]),
+              help="gguf writes a llama-architecture GGUF v3 container "
+                   "(io/gguf.py) — the real version of the reference's "
+                   "stubbed gguf choice; f16/bf16 payloads, no ggml "
+                   "quant blocks (quantized serving uses safetensors "
+                   "int8/int4).")
 @click.option("--quant", default=None,
               type=click.Choice(["int8", "int8-awq", "int4", "int4-awq"]),
               help="Quantize weights before export (*-awq = activation-"
@@ -56,23 +61,36 @@ def convert(ckpt_dir, fmt, quant, model_name, calib_seq, out_path, step):
     meta = {"source_step": str(step or ckpt.latest_step())}
     if isinstance(extra, dict) and "config" in extra:
         meta["model"] = str(extra["config"].get("model", ""))
+    def resolved_model_cfg(why: str):
+        from ...config.presets import get_model_config
+        from ...io.checkpoint import apply_ckpt_model_overrides
+        name = model_name or meta.get("model") or ""
+        if not name:
+            raise click.ClickException(f"{why} needs --model "
+                                       "(or a checkpoint that records it)")
+        return apply_ckpt_model_overrides(get_model_config(name), extra)
+
     model_cfg = calib = None
     if quant in ("int8-awq", "int4-awq"):
         import jax
-        import jax.numpy as jnp
 
-        from ...config.presets import get_model_config
-        name = model_name or meta.get("model") or ""
-        if not name:
-            raise click.ClickException(
-                f"--quant {quant} needs --model for calibration")
-        from ...io.checkpoint import apply_ckpt_model_overrides
-        model_cfg = apply_ckpt_model_overrides(get_model_config(name), extra)
+        model_cfg = resolved_model_cfg(f"--quant {quant} calibration")
         calib = jax.random.randint(
             jax.random.PRNGKey(0), (1, calib_seq), 1, model_cfg.vocab_size)
-    path = export_params(params, out_path, fmt=fmt, quant=quant,
-                         metadata=meta, model_cfg=model_cfg,
-                         calib_tokens=calib)
+    if fmt == "gguf":
+        if quant:
+            raise click.ClickException(
+                "gguf export is f16/bf16-only (no ggml quant blocks); "
+                "quantized serving artifacts use --format safetensors")
+        from ...io.gguf import export_gguf
+        gcfg = resolved_model_cfg("--format gguf")
+        tok_dir = ckpt_dir if (Path(ckpt_dir) / "tokenizer.json").exists() \
+            else None
+        path = export_gguf(params, gcfg, out_path, tokenizer_dir=tok_dir)
+    else:
+        path = export_params(params, out_path, fmt=fmt, quant=quant,
+                             metadata=meta, model_cfg=model_cfg,
+                             calib_tokens=calib)
     size_mb = Path(path).stat().st_size / 1e6
     click.echo(f"exported {fmt}{'+' + quant if quant else ''} artifact: "
                f"{path} ({size_mb:.1f} MB)")
@@ -104,3 +122,109 @@ def import_hf(src, model_name, out_dir):
                      f"{eff.tie_word_embeddings} from the checkpoint)")
     click.echo(f"imported HF checkpoint -> {path} (step 0, model "
                f"{eff.name}){tie_note}")
+
+
+@app.command()
+@click.option("--model", "model_name", required=True,
+              help="Model template to synthesize (e.g. gpt-7b).")
+@click.option("--quant", default="int8", show_default=True,
+              type=click.Choice(["none", "int8"]),
+              help="Quantize block kernels at synthesis (int8 = the "
+                   "serve engine's W8A16 policy, bit-identical to "
+                   "quantizing a real checkpoint of the same values).")
+@click.option("--seed", default=0, show_default=True, type=int)
+@click.option("--out", "out_path", required=True,
+              type=click.Path(dir_okay=False))
+def synth(model_name, quant, seed, out_path):
+    """Synthesize a random-init deployment artifact (no checkpoint).
+
+    The benchmark path for models too big to initialise in full precision
+    on one chip: a 7B model's bf16 params (13.4 GB) plus an int8 copy
+    cannot coexist in 16 GB HBM during in-process requantization, but the
+    pre-quantized artifact this writes (~6.7 GB) loads straight to device.
+    Weights are generated host-side with numpy mirroring models.gpt.init
+    (truncated-normal 0.02, residual projections scaled 1/sqrt(2L)) and
+    quantized with the exact absmax-int8 semantics of
+    ops.quantization.quantize_int8.
+    """
+    import numpy as np
+
+    try:
+        import ml_dtypes
+        bf16 = ml_dtypes.bfloat16
+    except ImportError:          # pragma: no cover
+        bf16 = np.float32
+
+    from ...config.presets import get_model_config
+    from ...io.export import export_params
+
+    cfg = get_model_config(model_name)
+    if cfg.is_moe:
+        raise click.ClickException("synth does not cover MoE templates yet")
+    H, D = cfg.hidden_size, cfg.head_dim
+    Nq, Nkv, F, V, L = (cfg.num_heads, cfg.num_kv_heads, cfg.ffn_size,
+                        cfg.vocab_size, cfg.num_layers)
+    std = 0.02
+    resid_std = std / float(np.sqrt(2.0 * L))
+    rng = np.random.Generator(np.random.PCG64(seed))
+
+    def dense(*shape, scale=std, dtype=bf16):
+        # clipped normal ~= gpt.init's truncated_normal(-3, 3): the tail
+        # mass beyond 3 sigma is 0.27% — immaterial for a synthetic
+        # benchmark artifact
+        x = rng.standard_normal(shape, dtype=np.float32)
+        np.clip(x, -3.0, 3.0, out=x)
+        x *= scale
+        return x.astype(dtype) if dtype is not np.float32 else x
+
+    def q8(*shape, scale=std):
+        """Generate layer-by-layer and int8-quantize (absmax over the
+        output axis, exactly quantize_int8's axis=-1 keepdims semantics);
+        peak host memory is one layer's fp32, not the stacked tensor."""
+        if quant == "none":
+            return {"kernel": dense(*shape, scale=scale)}
+        vals = np.empty(shape, np.int8)
+        scales = np.empty((shape[0], shape[1], 1), np.float32)
+        for layer in range(shape[0]):
+            x = dense(*shape[1:], scale=scale, dtype=np.float32)
+            absmax = np.abs(x).max(axis=-1, keepdims=True)
+            s = np.maximum(absmax / 127.0, 1e-12).astype(np.float32)
+            np.clip(np.round(x / s), -127, 127, out=x)
+            vals[layer] = x.astype(np.int8)
+            scales[layer] = s
+        return {"kernel": {"__quant__": "int8", "values": vals,
+                           "scale": scales}}
+
+    blocks = {
+        "attn_norm": {"scale": np.zeros((L, H), bf16)},
+        "q": q8(L, H, Nq * D),
+        "k": q8(L, H, Nkv * D),
+        "v": q8(L, H, Nkv * D),
+        "o": q8(L, Nq * D, H, scale=resid_std),
+        "mlp_norm": {"scale": np.zeros((L, H), bf16)},
+        "mlp": {
+            "gate": q8(L, H, F),
+            "up": q8(L, H, F),
+            "down": q8(L, F, H, scale=resid_std),
+        },
+    }
+    if cfg.attention_bias:
+        blocks["q"]["bias"] = np.zeros((L, Nq * D), bf16)
+        blocks["k"]["bias"] = np.zeros((L, Nkv * D), bf16)
+        blocks["v"]["bias"] = np.zeros((L, Nkv * D), bf16)
+    params = {
+        "embed": {"embedding": dense(V, H)},
+        "blocks": blocks,
+        "final_norm": {"scale": np.zeros((H,), bf16)},
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = {"kernel": dense(H, V)}
+
+    meta = {"model": model_name, "synthetic": "random-init",
+            "seed": str(seed)}
+    if quant != "none":
+        meta["quant"] = quant
+    path = export_params(params, out_path, fmt="safetensors", metadata=meta)
+    size_gb = Path(path).stat().st_size / 1e9
+    click.echo(f"synthesized {model_name} artifact "
+               f"({quant or 'bf16'}): {path} ({size_gb:.2f} GB)")

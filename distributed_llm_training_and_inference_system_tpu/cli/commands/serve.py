@@ -21,7 +21,10 @@ def app(ctx):
 @app.command()
 @click.option("--model", "model_name", default="gpt-125m", show_default=True,
               help="Model template name.")
-@click.option("--artifact", default="", help="Checkpoint dir to load.")
+@click.option("--artifact", default="",
+              help="Checkpoint dir, or an `llmctl export` safetensors/npz "
+                   "file (pre-quantized exports load straight to device — "
+                   "bf16 weights never materialise, the 7B-on-16GB path).")
 @click.option("--host", default="0.0.0.0", show_default=True)
 @click.option("--port", default=8080, show_default=True, type=int)
 @click.option("--max-batch-size", default=8, show_default=True, type=int)
@@ -78,11 +81,15 @@ def app(ctx):
               help="Shrink decode dispatches to this many steps while "
                    "requests wait in the queue with a free slot, so "
                    "prefill windows open sooner (0 disables).")
+@click.option("--cors-origins", default="*", show_default=True,
+              help="CORS allowed origins for browser clients: '*', a "
+                   "comma-separated list, or '' to disable (parity: the "
+                   "reference installs allow-all CORSMiddleware).")
 def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
           kv_block_size, kv_hbm_gb, scheduler, dtype, prometheus_port,
           speculative, spec_tokens, prefix_cache, tensor_parallel,
           quantization, chunked_prefill, kv_quantization, admission,
-          preemption, latency_dispatch_steps):
+          preemption, latency_dispatch_steps, cors_origins):
     """Start the OpenAI-compatible inference server."""
     import jax
 
@@ -105,7 +112,8 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
         chunked_prefill_tokens=chunked_prefill,
         kv_quantization=kv_quantization, admission=admission,
         preemption=preemption,
-        latency_dispatch_steps=latency_dispatch_steps)
+        latency_dispatch_steps=latency_dispatch_steps,
+        cors_origins=cors_origins)
     serve_cfg.validate()
 
     observer = None

@@ -98,6 +98,15 @@ MODEL_TEMPLATES: dict[str, ModelConfig] = {
         max_position_embeddings=4096, activation="silu",
         moe=MoEConfig(num_experts=8, experts_per_token=2),
     ),
+    # Chip-sized MoE for single-chip measurement (BASELINE round-4 MoE
+    # rows): ~0.94B total params, ~0.33B active/token (8 experts, top-2) —
+    # params + AdamW state fit one 16 GB v5e the way gpt-750m does.
+    "gpt-moe-1b": ModelConfig(
+        name="gpt-moe-1b", num_layers=12, hidden_size=1024, ffn_size=2816,
+        num_heads=8, num_kv_heads=8, head_dim=128, vocab_size=50304,
+        max_position_embeddings=4096, activation="silu",
+        moe=MoEConfig(num_experts=8, experts_per_token=2),
+    ),
 }
 
 # Tiny models for tests/CI (not listed in user-facing templates).

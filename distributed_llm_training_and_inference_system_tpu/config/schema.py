@@ -549,6 +549,10 @@ class ServeConfig:
     max_queue: int = 256
     dtype: str = "bfloat16"
     scheduler: str = "continuous"   # continuous | static
+    # CORS for browser clients (reference serve/server.py:276-282 installs
+    # an allow-all CORSMiddleware): "*" = any origin, a comma-separated
+    # origin list restricts, "" disables the middleware entirely
+    cors_origins: str = "*"
     temperature: float = 1.0
     # speculative decoding: "off" | "ngram" (host prompt-lookup drafts,
     # device verification — serve/speculative.py). Greedy requests accept

@@ -109,7 +109,12 @@ def export_params(params: Any, out_path: str | Path, fmt: str = "safetensors",
             meta["int4_layout"] = "kernel"
         if quant == "int8":
             from ..ops.quantization import quantize_tree_int8
-            params = quantize_tree_int8(params)
+            # min_ndim=3: only the stacked [L, in, out] block kernels —
+            # the SAME policy as the serve engine's in-process int8 path
+            # and the int4 exporter (norm scales are [L, H] and embedding
+            # lookups cannot index a QuantTensor), so a pre-quantized
+            # artifact is bit-identical to serving `--quantization int8`
+            params = quantize_tree_int8(params, min_ndim=3)
         elif quant == "int8-awq":
             if model_cfg is None or calib_tokens is None:
                 raise ValueError(
@@ -146,3 +151,78 @@ def export_params(params: Any, out_path: str | Path, fmt: str = "safetensors",
     else:
         raise ValueError(f"unsupported export format {fmt!r}")
     return out_path
+
+
+def unflatten_exported(flat: dict[str, Any], quant: str | None) -> Any:
+    """Rebuild the param pytree from an export's dotted-path tensors,
+    re-forming ``{"__quant__": ..., values, scale[, chan, group]}`` marker
+    leaves that ``export_params`` flattened (the marker string itself is
+    dropped at save time; the ``.values``/``.scale`` suffix pair identifies
+    a quantized weight — model params only ever use kernel / bias / scale /
+    embedding leaf names, so the pair cannot collide with a real subtree).
+
+    ``quant`` is the artifact metadata value (may be None for unquantized
+    exports); per-leaf kind is refined structurally: ``chan``+``group`` =>
+    int4, ``chan`` alone => int8-awq, else the metadata kind.
+    """
+    root: dict = {}
+    for key, arr in flat.items():
+        parts = key.split(".")
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = arr
+
+    def walk(node: Any) -> Any:
+        if not isinstance(node, dict):
+            return node
+        if "values" in node and "scale" in node:
+            if "chan" in node and "group" in node:
+                kind = "int4"
+            elif "chan" in node:
+                kind = "int8-awq"
+            else:
+                kind = quant or "int8"
+            out = {"__quant__": kind, "values": node["values"],
+                   "scale": node["scale"]}
+            if "chan" in node:
+                out["chan"] = node["chan"]
+            if "group" in node:
+                out["group"] = int(np.asarray(node["group"]))
+            return out
+        return {k: walk(v) for k, v in node.items()}
+
+    return walk(root)
+
+
+def load_exported(path: str | Path) -> tuple[Any, dict]:
+    """Load an ``export_params`` artifact back into a param pytree with
+    quant-marker leaves (feed to ``ops.quantization.to_runtime_quant`` for
+    serving). Returns (tree, metadata). safetensors carries the metadata;
+    npz artifacts reconstruct quant kinds structurally (int4 artifacts are
+    REFUSED without the layout marker — the packed-nibble orientation is
+    ambiguous from shapes alone and a wrong guess silently produces
+    garbage weights)."""
+    path = Path(path)
+    if path.suffix == ".npz":
+        with np.load(path) as z:
+            flat = {k: z[k] for k in z.files}
+        meta: dict = {"format": "npz"}
+    else:
+        flat, meta = load_safetensors(path)
+    tree = unflatten_exported(flat, meta.get("quant"))
+
+    def has_int4(node):
+        if isinstance(node, dict):
+            if node.get("__quant__") == "int4":
+                return True
+            return any(has_int4(v) for v in node.values()
+                       if isinstance(v, dict))
+        return False
+
+    if has_int4(tree) and meta.get("int4_layout") != "kernel":
+        raise ValueError(
+            f"int4 artifact {path} lacks int4_layout='kernel' metadata "
+            "(pre-round-3 [out, in/2] layout or npz without metadata); "
+            "refusing to guess the packed-nibble orientation")
+    return tree, meta

@@ -198,19 +198,30 @@ class QuantTensor:
 
 
 def _is_quant_marker(x: Any) -> bool:
-    return isinstance(x, dict) and x.get("__quant__") in ("int8", "int4")
+    return isinstance(x, dict) and "__quant__" in x
 
 
 def to_runtime_quant(tree: Any) -> Any:
     """Convert export-form ``{"__quant__": ..., values, scale}`` leaves
-    into scan-compatible QuantTensor / Quant4Tensor leaves."""
+    into scan-compatible QuantTensor / Quant4Tensor leaves.
+
+    ``int8-awq`` markers are REFUSED, not silently narrowed: dropping the
+    ``chan`` channel scaling the exporter divided out would serve garbage
+    weights with no error (awq is an interchange format — the serve
+    runtime consumes int8 / int4 / int4-awq, whose awq scaling is already
+    folded into the stored values)."""
     def conv(x):
         if not _is_quant_marker(x):
             return x
-        if x["__quant__"] == "int4":
+        kind = x["__quant__"]
+        if kind == "int4":
             return Quant4Tensor(x["values"], x["scale"], x["chan"],
                                 group=int(x.get("group", 128)))
-        return QuantTensor(x["values"], x["scale"])
+        if kind == "int8":
+            return QuantTensor(x["values"], x["scale"])
+        raise ValueError(
+            f"quant marker {kind!r} has no runtime form (int8-awq "
+            "artifacts are interchange-only; re-export as int8 or int4)")
     return jax.tree_util.tree_map(conv, tree, is_leaf=_is_quant_marker)
 
 

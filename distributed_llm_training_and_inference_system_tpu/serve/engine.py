@@ -68,7 +68,18 @@ class InferenceEngine:
                                                   seed, dtype)
         self.cfg = model_cfg
 
-        if serve_cfg.quantization == "int8":
+        from ..ops.quantization import _is_runtime_quant
+        pre_quantized = any(
+            _is_runtime_quant(leaf) for leaf in jax.tree_util.tree_leaves(
+                params, is_leaf=_is_runtime_quant))
+        if pre_quantized:
+            # pre-quantized export artifact (load_exported): the weights
+            # never existed in bf16 on this device — exactly the path a
+            # 7B-class model needs on a 16 GB chip, where bf16 params +
+            # a quantized copy cannot coexist during requantization
+            logger.info("serving pre-quantized artifact weights (%s)",
+                        serve_cfg.quantization or "int8")
+        elif serve_cfg.quantization == "int8":
             from ..ops.quantization import (quantize_tree_int8,
                                             to_runtime_quant)
             params = dict(params)
@@ -238,6 +249,57 @@ class InferenceEngine:
         reference errors without an artifact; random init keeps bench/smoke
         paths self-contained)."""
         art = serve_cfg.artifact
+        if art and Path(art).is_file():
+            # `llmctl export` artifact (safetensors/npz), possibly
+            # pre-quantized: quantized leaves go straight to device as
+            # (int8, scale) runtime tensors — bf16 never materialises
+            from ..io.export import load_exported
+            from ..ops.quantization import to_runtime_quant
+            tree, meta = load_exported(art)
+            art_quant = meta.get("quant") or ""
+            want = serve_cfg.quantization
+            want = "" if want in ("", "none") else want
+            if art_quant and want and art_quant != want:
+                raise ValueError(
+                    f"artifact {art} is {art_quant}-quantized but serve "
+                    f"config asks for {want!r}; requantization from a "
+                    "quantized artifact would compound error — re-export")
+            if art_quant == "int8-awq":
+                raise ValueError(
+                    "int8-awq exports are an interchange format; the serve "
+                    "runtime consumes int8 / int4 / int4-awq artifacts "
+                    "(the awq channel scaling is already folded for int4)")
+            if art_quant and not want:
+                serve_cfg.quantization = art_quant
+            params = to_runtime_quant(tree)
+
+            def cast(x):
+                # dtype probe on the HOST array — jnp.asarray here would
+                # device-transfer every float leaf twice in exactly the
+                # memory-constrained 7B path this branch exists for
+                x = np.asarray(x)
+                if jnp.issubdtype(x.dtype, jnp.floating):
+                    return jnp.asarray(x, dtype)
+                return jnp.asarray(x)
+
+            # device_put everything up front (incl. the int8 payloads —
+            # leaving them as numpy would re-transfer per compiled program)
+            from ..ops.quantization import _is_runtime_quant
+            def put(x):
+                if _is_runtime_quant(x):
+                    children, aux = x.tree_flatten()
+                    return type(x).tree_unflatten(
+                        aux, [jnp.asarray(c) for c in children])
+                return cast(x)
+
+            params = jax.tree_util.tree_map(put, params,
+                                            is_leaf=_is_runtime_quant)
+            if meta.get("model") and meta["model"] != model_cfg.name:
+                logger.warning("artifact was exported from model %r, "
+                               "serving as %r", meta["model"], model_cfg.name)
+            logger.info("loaded exported artifact %s (quant=%s)", art,
+                        art_quant or "none")
+            return params, model_cfg
         if art and Path(art).exists():
             from ..io.checkpoint import (CheckpointManager,
                                          apply_ckpt_model_overrides,

@@ -257,9 +257,13 @@ class InferenceServer:
         """Server-sent events (OpenAI `stream: true` wire format): one
         `data: {...}` chunk per decoded token batch, `data: [DONE]` at the
         end. Multi-step decode delivers tokens in bursts of up to K."""
+        # CORS headers must land BEFORE prepare() — the middleware's
+        # post-handler pass is too late for a prepared stream (headers are
+        # already on the wire)
         resp = web.StreamResponse(headers={
             "Content-Type": "text/event-stream",
             "Cache-Control": "no-cache",
+            **self._cors_headers(http_req),
         })
         await resp.prepare(http_req)
 
@@ -367,8 +371,56 @@ class InferenceServer:
             payload = b""
         return web.Response(body=payload, content_type="text/plain")
 
+    def _cors_headers(self, request) -> dict:
+        """CORS headers for this request, or {} when the origin is not
+        allowed. Allow-Credentials is only asserted for an EXPLICIT origin
+        list: reflecting any origin AND asserting credentials would be
+        strictly more permissive than the reference's allow-all middleware
+        (a literal '*' ACAO makes browsers refuse credentialed reads)."""
+        origins = self.serve_cfg.cors_origins
+        if not origins:
+            return {}
+        origin = request.headers.get("Origin", "")
+        explicit = origins != "*"
+        if explicit and origin not in {
+                o.strip() for o in origins.split(",") if o.strip()}:
+            return {}
+        headers = {
+            "Access-Control-Allow-Origin":
+                (origin if explicit else "*") or "*",
+            "Access-Control-Allow-Methods": "GET, POST, OPTIONS",
+            "Access-Control-Allow-Headers":
+                request.headers.get(
+                    "Access-Control-Request-Headers", "*") or "*",
+        }
+        if explicit:
+            headers["Access-Control-Allow-Credentials"] = "true"
+        return headers
+
     def _build_app(self) -> web.Application:
-        app = web.Application()
+        # CORS parity with the reference's allow-all CORSMiddleware
+        # (reference serve/server.py:276-282): browser clients can call the
+        # API cross-origin. aiohttp has no built-in CORS, so a middleware
+        # stamps the headers (SSE streams stamp theirs pre-prepare in
+        # _stream_response). Configurable via ServeConfig.cors_origins
+        # ("" disables; "*" = any origin, the reference's default).
+        origins = self.serve_cfg.cors_origins
+
+        @web.middleware
+        async def cors_middleware(request, handler):
+            if request.method == "OPTIONS":
+                return web.Response(status=204,
+                                    headers=self._cors_headers(request))
+            resp = await handler(request)
+            # prepared responses (SSE streams) stamped their own headers
+            # in _stream_response — headers are already on the wire here
+            if not resp.prepared:
+                for k, v in self._cors_headers(request).items():
+                    resp.headers.setdefault(k, v)
+            return resp
+
+        app = web.Application(middlewares=[cors_middleware] if origins
+                              else [])
         app.router.add_post("/v1/completions", self.handle_completions)
         app.router.add_get("/v1/models", self.handle_models)
         app.router.add_get("/v1/stats", self.handle_stats)

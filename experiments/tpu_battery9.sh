@@ -1,0 +1,37 @@
+#!/bin/bash
+# Round-4 battery 9: settle the latency-adaptive dispatch A/B (round-3
+# verdict weak #1). n=3 INTERLEAVED on/off trials per regime — the single
+# committed pair (112.0 vs 128.3 goodput at c8) sat inside a 112-144
+# round-long spread, so one pair proves nothing. Interleaving controls
+# chip-hour drift; mean +/- spread decides: neutral-at-saturation ships,
+# a real deficit defaults the gate off.
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-experiments/results_r4}
+mkdir -p "$OUT"
+source experiments/battery_lib.sh
+tpu_guard
+
+for i in 1 2 3; do
+  run serve_c8_adapt_on_$i 700 python -m distributed_llm_training_and_inference_system_tpu.cli.main \
+      bench e2e --model gpt-1b --mode serve-load --requests 32 \
+      --prompt-len 512 --gen-len 128 --rps "" --concurrency 8 \
+      --admission ondemand --kv-blocks 96 --latency-dispatch-steps 2
+  run serve_c8_adapt_off_$i 700 python -m distributed_llm_training_and_inference_system_tpu.cli.main \
+      bench e2e --model gpt-1b --mode serve-load --requests 32 \
+      --prompt-len 512 --gen-len 128 --rps "" --concurrency 8 \
+      --admission ondemand --kv-blocks 96 --latency-dispatch-steps 0
+done
+
+for i in 1 2 3; do
+  run serve_light_adapt_on_$i 900 python -m distributed_llm_training_and_inference_system_tpu.cli.main \
+      bench e2e --model gpt-1b --mode serve-load --requests 16 \
+      --prompt-len 512 --gen-len 64 --rps 0.25 --concurrency 1 \
+      --admission ondemand --kv-blocks 96 --latency-dispatch-steps 2
+  run serve_light_adapt_off_$i 900 python -m distributed_llm_training_and_inference_system_tpu.cli.main \
+      bench e2e --model gpt-1b --mode serve-load --requests 16 \
+      --prompt-len 512 --gen-len 64 --rps 0.25 --concurrency 1 \
+      --admission ondemand --kv-blocks 96 --latency-dispatch-steps 0
+done
+
+echo "battery9 complete; results in $OUT/"

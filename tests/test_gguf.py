@@ -1,0 +1,148 @@
+"""GGUF v3 export tests (writer verified by the in-repo reader).
+
+The reference advertises a gguf export choice but ships a stub
+(reference cli/commands/export.py:29). io/gguf.py writes real GGUF v3
+containers; these tests hold the format invariants that make the file
+consumable by external ggml loaders: magic/version, alignment of every
+tensor payload, ggml dim order (ne[0] = contiguous axis), llama.*
+metadata completeness, canonical tensor names, and exact payload
+round-trip.
+"""
+
+import struct
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_llm_training_and_inference_system_tpu.config import get_model_config
+from distributed_llm_training_and_inference_system_tpu.io.gguf import (
+    ALIGNMENT,
+    GGUF_MAGIC,
+    export_gguf,
+    read_gguf,
+    write_gguf,
+)
+from distributed_llm_training_and_inference_system_tpu.models import init
+
+
+@pytest.fixture(scope="module")
+def model_cfg():
+    return get_model_config("gpt-test")
+
+
+@pytest.fixture(scope="module")
+def params(model_cfg):
+    return init(model_cfg, jax.random.PRNGKey(0))
+
+
+class TestContainer:
+    def test_roundtrip_meta_and_tensors(self, tmp_path):
+        tensors = {
+            "a.weight": np.arange(12, dtype=np.float32).reshape(3, 4),
+            "b.weight": np.ones((7,), np.float32),
+            "c.weight": np.random.default_rng(0)
+            .standard_normal((5, 6)).astype(np.float32),
+        }
+        meta = {"general.architecture": "llama", "llama.block_count": 2,
+                "x.flag": True, "x.pi": 3.5, "x.names": ["a", "b"],
+                "x.ids": [1, 2, 3]}
+        p = write_gguf(tmp_path / "t.gguf", meta, tensors, dtype="f32")
+        rmeta, rtensors = read_gguf(p)
+        assert rmeta["general.architecture"] == "llama"
+        assert rmeta["llama.block_count"] == 2
+        assert rmeta["x.flag"] is True
+        assert rmeta["x.names"] == ["a", "b"]
+        assert rmeta["x.ids"] == [1, 2, 3]
+        assert rmeta["general.alignment"] == ALIGNMENT
+        for k in tensors:
+            np.testing.assert_array_equal(rtensors[k], tensors[k])
+
+    def test_magic_version_and_alignment(self, tmp_path):
+        p = write_gguf(tmp_path / "t.gguf", {},
+                       {"w": np.zeros((3, 5), np.float32)}, dtype="f32")
+        raw = p.read_bytes()
+        magic, version = struct.unpack_from("<II", raw)
+        assert magic == GGUF_MAGIC and version == 3
+        _, infos = read_gguf(p, load_tensors=False)
+        for name, info in infos.items():
+            assert info["offset"] % ALIGNMENT == 0, name
+
+    def test_ggml_dim_order_reversed(self, tmp_path):
+        """On disk ne[0] must be the contiguous (last numpy) axis; the
+        reader restores numpy order."""
+        arr = np.arange(6, dtype=np.float32).reshape(2, 3)
+        p = write_gguf(tmp_path / "t.gguf", {}, {"w": arr}, dtype="f32")
+        _, infos = read_gguf(p, load_tensors=False)
+        assert tuple(infos["w"]["shape"]) == (2, 3)
+        raw = p.read_bytes()
+        # dims as stored: find the tensor-info record's dims (little
+        # endian u64 pair) — ne[0]=3 (contiguous), ne[1]=2
+        idx = raw.find(b"w\x02\x00\x00\x00")  # name + n_dims=2
+        dims = struct.unpack_from("<2Q", raw, idx + 5)
+        assert dims == (3, 2)
+
+    def test_f16_payload(self, tmp_path):
+        arr = np.linspace(-1, 1, 32, dtype=np.float32).reshape(4, 8)
+        p = write_gguf(tmp_path / "t.gguf", {}, {"w": arr}, dtype="f16")
+        _, t = read_gguf(p)
+        assert t["w"].dtype == np.float16
+        np.testing.assert_allclose(t["w"].astype(np.float32), arr,
+                                   atol=1e-3)
+
+
+class TestLlamaExport:
+    def test_export_names_and_meta(self, model_cfg, params, tmp_path):
+        p = export_gguf(params, model_cfg, tmp_path / "m.gguf")
+        meta, infos = read_gguf(p, load_tensors=False)
+        assert meta["general.architecture"] == "llama"
+        assert meta["llama.block_count"] == model_cfg.num_layers
+        assert meta["llama.embedding_length"] == model_cfg.hidden_size
+        assert meta["llama.attention.head_count"] == model_cfg.num_heads
+        assert meta["llama.attention.head_count_kv"] == \
+            model_cfg.num_kv_heads
+        assert len(meta["tokenizer.ggml.tokens"]) == model_cfg.vocab_size
+        names = set(infos)
+        assert "token_embd.weight" in names
+        assert "output_norm.weight" in names
+        for i in range(model_cfg.num_layers):
+            for t in ("attn_norm", "attn_q", "attn_k", "attn_v",
+                      "attn_output", "ffn_norm", "ffn_gate", "ffn_up",
+                      "ffn_down"):
+                assert f"blk.{i}.{t}.weight" in names
+        # untied test model: explicit output matrix
+        assert ("output.weight" in names) == (
+            not model_cfg.tie_word_embeddings)
+
+    def test_kernels_transposed_to_out_in(self, model_cfg, params,
+                                          tmp_path):
+        p = export_gguf(params, model_cfg, tmp_path / "m.gguf",
+                        dtype="f32")
+        _, t = read_gguf(p)
+        H = model_cfg.hidden_size
+        qdim = model_cfg.num_heads * model_cfg.head_dim
+        assert t["blk.0.attn_q.weight"].shape == (qdim, H)
+        np.testing.assert_allclose(
+            t["blk.0.attn_q.weight"],
+            np.asarray(params["blocks"]["q"]["kernel"][0]).T, atol=0)
+
+    def test_norms_stay_f32_and_shifted(self, model_cfg, params, tmp_path):
+        p = export_gguf(params, model_cfg, tmp_path / "m.gguf",
+                        dtype="f16")
+        _, t = read_gguf(p)
+        w = t["blk.0.attn_norm.weight"]
+        assert w.dtype == np.float32
+        # stored (1 + s) with zero-init s => exported weight is 1.0
+        np.testing.assert_allclose(
+            w, 1.0 + np.asarray(params["blocks"]["attn_norm"]["scale"][0]))
+
+    def test_quantized_tree_refused(self, model_cfg, params, tmp_path):
+        from distributed_llm_training_and_inference_system_tpu.ops.quantization import (
+            quantize_tree_int8,
+            to_runtime_quant,
+        )
+        qp = dict(params)
+        qp["blocks"] = to_runtime_quant(
+            quantize_tree_int8(params["blocks"], min_ndim=3))
+        with pytest.raises(ValueError, match="full-precision"):
+            export_gguf(qp, model_cfg, tmp_path / "m.gguf")

@@ -190,6 +190,63 @@ class TestHTTPServer:
         assert r.json()["status"] == "healthy"
         assert r.json()["engine"]["finished"] >= 1
 
+    def test_cors_preflight_and_headers(self, server):
+        """Browser cross-origin parity (reference serve/server.py:276-282):
+        preflight OPTIONS answers 204 with allow headers; responses carry
+        Access-Control-Allow-Origin."""
+        import requests as rq
+        srv, port = server
+        base = f"http://127.0.0.1:{port}"
+
+        r = rq.options(f"{base}/v1/completions", headers={
+            "Origin": "http://example.com",
+            "Access-Control-Request-Method": "POST",
+            "Access-Control-Request-Headers": "content-type",
+        }, timeout=10)
+        assert r.status_code == 204
+        # wildcard mode: literal "*" and NO Allow-Credentials (reflecting
+        # the origin while asserting credentials would be a credentialed-
+        # wildcard misconfiguration, more permissive than the reference)
+        assert r.headers["Access-Control-Allow-Origin"] == "*"
+        assert "Access-Control-Allow-Credentials" not in r.headers
+        assert "POST" in r.headers["Access-Control-Allow-Methods"]
+        assert r.headers["Access-Control-Allow-Headers"] == "content-type"
+
+        r = rq.get(f"{base}/health",
+                   headers={"Origin": "http://example.com"}, timeout=10)
+        assert r.headers["Access-Control-Allow-Origin"] == "*"
+
+        # SSE streams: headers go out at prepare() — CORS must be on the
+        # stream response itself, not added post-handler
+        r = rq.post(f"{base}/v1/completions", json={
+            "prompt": [1, 2, 3], "max_tokens": 2, "temperature": 0.0,
+            "stream": True,
+        }, headers={"Origin": "http://example.com"}, stream=True,
+            timeout=60)
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        assert r.headers["Access-Control-Allow-Origin"] == "*"
+        r.close()
+
+    def test_cors_explicit_origin_list(self):
+        """Explicit origin lists: reflect only listed origins, assert
+        credentials; unlisted origins get nothing."""
+        from types import SimpleNamespace
+        from distributed_llm_training_and_inference_system_tpu.serve.server import (
+            InferenceServer)
+        fake = SimpleNamespace(serve_cfg=SimpleNamespace(
+            cors_origins="http://a.com, http://b.com"))
+
+        def req(origin):
+            return SimpleNamespace(headers={"Origin": origin})
+
+        h = InferenceServer._cors_headers(fake, req("http://a.com"))
+        assert h["Access-Control-Allow-Origin"] == "http://a.com"
+        assert h["Access-Control-Allow-Credentials"] == "true"
+        assert InferenceServer._cors_headers(
+            fake, req("http://evil.com")) == {}
+        fake.serve_cfg.cors_origins = ""
+        assert InferenceServer._cors_headers(fake, req("http://a.com")) == {}
+
     def test_text_prompt_roundtrip(self, server):
         import requests as rq
         srv, port = server
