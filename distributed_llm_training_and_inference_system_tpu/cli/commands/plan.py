@@ -291,8 +291,14 @@ def verify(model, hardware, batch, seq_len, steps, save_calib):
                    "device via a small engine's device-time probes and "
                    "persist to tuning_results/serve_calibration.json; "
                    "later plan serve runs use the measured values.")
+@click.option("--artifact", default="",
+              help="Calibrate: load weights from a checkpoint dir or "
+                   "export file instead of random init (required for "
+                   "models whose bf16 init exceeds HBM, e.g. gpt-7b "
+                   "int8 on one 16 GB chip).")
 def serve(model, hardware, context_len, prompt_len, page_size, batch,
-          quant, kv_quant, tensor_parallel, candidates, calibrate):
+          quant, kv_quant, tensor_parallel, candidates, calibrate,
+          artifact):
     """Price SERVING configs: weight/KV HBM budget, max residency, and
     analytic TTFT + decode throughput per (quant, kv-quant, batch) — the
     serve counterpart of `plan compute` (round-2 verdict weak #8: serving
@@ -322,6 +328,7 @@ def serve(model, hardware, context_len, prompt_len, page_size, batch,
         eng = InferenceEngine(model_cfg, ServeConfig(
             model=model_cfg.name, max_batch_size=4,
             max_seq_len=min(1024, model_cfg.max_position_embeddings),
+            artifact=artifact,
             quantization=quant or "none",
             kv_quantization=kv_quant or "none",
             tensor_parallel=tensor_parallel))

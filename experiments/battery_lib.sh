@@ -1,4 +1,10 @@
 # Shared helpers for the TPU measurement batteries (sourced, not run).
+#
+# Persistent XLA compilation cache: every battery step is its own process
+# and gpt-7b program compilation costs ~6 min over the tunnel; identical
+# programs (same engine config) hit the cache and build in seconds.
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)/.jaxcache}"
+mkdir -p "$JAX_COMPILATION_CACHE_DIR"
 #   run <name> <timeout-s> <cmd...>   — timeboxed step, log + rc to $OUT
 #   tpu_guard                          — abort unless the ACTIVE backend is
 #                                        TPU (jax.devices() printing a CPU

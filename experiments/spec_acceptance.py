@@ -36,49 +36,50 @@ VOCAB = 2048          # ids 2..2049 within every template's vocab
 ORDER = 2
 
 
-def _chain(rng, peak):
+def _chain(rng, peak, vocab=VOCAB):
     """Order-2 transition table: for each (a, b) context a peaked
     categorical over 8 candidate next tokens."""
     import numpy as np
-    cands = rng.integers(2, VOCAB, size=(VOCAB, 8))
-    logits = rng.normal(0, 1, size=(VOCAB, 8))
+    cands = rng.integers(2, vocab, size=(vocab, 8))
+    logits = rng.normal(0, 1, size=(vocab, 8))
     logits[:, 0] += peak          # mode gets +peak nats
     p = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
     return cands, p
 
 
-def _sample_doc(rng, cands, p, length):
+def _sample_doc(rng, cands, p, length, vocab=VOCAB):
     import numpy as np
-    out = [int(rng.integers(2, VOCAB)), int(rng.integers(2, VOCAB))]
+    out = [int(rng.integers(2, vocab)), int(rng.integers(2, vocab))]
     for _ in range(length - 2):
-        ctx = (out[-2] * 31 + out[-1]) % VOCAB
+        ctx = (out[-2] * 31 + out[-1]) % vocab
         j = rng.choice(8, p=p[ctx])
         out.append(int(cands[ctx, j]))
     return np.asarray(out, np.uint16)
 
 
 def gen_corpus(out_dir: str, peak: float, num_docs: int,
-               doc_len: int) -> None:
+               doc_len: int, vocab: int = VOCAB) -> None:
     import numpy as np
 
     from distributed_llm_training_and_inference_system_tpu.io.data import (
         write_token_shard)
 
     rng = np.random.default_rng(0)
-    cands, p = _chain(rng, peak)
+    cands, p = _chain(rng, peak, vocab)
     os.makedirs(out_dir, exist_ok=True)
     for s in range(4):
-        docs = [_sample_doc(rng, cands, p, doc_len)
+        docs = [_sample_doc(rng, cands, p, doc_len, vocab)
                 for _ in range(num_docs // 4)]
         write_token_shard(os.path.join(out_dir, f"shard{s:02d}.bin"), docs)
     # held-out prompts from the SAME chain (unseen continuations)
-    prompts = [_sample_doc(rng, cands, p, 256).tolist() for _ in range(8)]
+    prompts = [_sample_doc(rng, cands, p, 256, vocab).tolist()
+               for _ in range(8)]
     with open(os.path.join(out_dir, "prompts.json"), "w") as f:
         json.dump(prompts, f)
     # chain determinism = how often the mode continues the context;
     # an upper bound on greedy-model n-gram acceptance
     print(json.dumps({"corpus": out_dir, "docs": num_docs,
-                      "doc_len": doc_len, "peak": peak,
+                      "doc_len": doc_len, "peak": peak, "vocab": vocab,
                       "mode_prob": round(float(p.max(-1).mean()), 3)}))
 
 
@@ -140,6 +141,7 @@ def main() -> None:
     g.add_argument("--peak", type=float, default=2.5)
     g.add_argument("--num-docs", type=int, default=2000)
     g.add_argument("--doc-len", type=int, default=1024)
+    g.add_argument("--vocab", type=int, default=VOCAB)
     m = sub.add_parser("measure")
     m.add_argument("--ckpt", required=True)
     m.add_argument("--model", default="gpt-350m")
@@ -147,7 +149,8 @@ def main() -> None:
     m.add_argument("--gen-len", type=int, default=128)
     args = ap.parse_args()
     if args.cmd == "gen-corpus":
-        gen_corpus(args.out, args.peak, args.num_docs, args.doc_len)
+        gen_corpus(args.out, args.peak, args.num_docs, args.doc_len,
+                   args.vocab)
     else:
         measure(args.ckpt, args.model, args.spec_tokens, args.gen_len)
 

@@ -1,0 +1,46 @@
+#!/bin/bash
+# Round-4 battery 11: (a) MoE measured rows — train MFU + a serve row for
+# the chip-sized gpt-moe-1b template (round-3 verdict weak #6: EP/MoE was
+# a compiled capability with zero measured numbers); (b) REAL speculation
+# acceptance — train gpt-350m on the Markov corpus until greedy
+# continuations are learnable, then measure n-gram draft acceptance and
+# fused-spec throughput vs plain decode (verdict weak #3 / next #5).
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-experiments/results_r4}
+mkdir -p "$OUT"
+source experiments/battery_lib.sh
+tpu_guard
+
+# (a) MoE train MFU: same probe harness as the dense rows
+# (batch, remat, model, mu_dtype, loss_chunk, fused, nu_dtype, accum)
+run moe_mfu_b8 1800 python experiments/mfu_sweep.py 8 selective gpt-moe-1b \
+    bfloat16 1024 1 bfloat16 4
+run moe_mfu_b16 1800 python experiments/mfu_sweep.py 16 selective gpt-moe-1b \
+    bfloat16 1024 1 bfloat16 4
+
+# MoE serve row (random init is fine for perf): decode throughput +
+# latency under the standard mixed load
+run moe_serve 1800 python -m distributed_llm_training_and_inference_system_tpu.cli.main \
+    bench e2e --model gpt-moe-1b --mode serve-load --requests 24 \
+    --prompt-len 512 --gen-len 128 --rps "" --concurrency 8 \
+    --admission ondemand --kv-blocks 96
+
+# (b) speculation: corpus -> train -> measure. ~2k steps of gpt-350m
+# (b8 s1024) on the order-2 Markov corpus; loss falling = the chain is
+# being learned; held-out prompts then measure REAL n-gram acceptance.
+[ -d experiments/artifacts/markov ] || \
+    python experiments/spec_acceptance.py gen-corpus
+run spec_train 5400 python -m distributed_llm_training_and_inference_system_tpu.cli.main \
+    train launch --model gpt-350m --in-process --max-steps 2000 --no-resume \
+    --set data.train=experiments/artifacts/markov \
+    --set data.max_length=1024 \
+    --set parallel.micro_batch_size=8 \
+    --set parallel.global_batch_size=8 \
+    --set checkpoint.path=experiments/artifacts/spec350m \
+    --set checkpoint.interval_steps=2000 \
+    --set training.log_interval=100
+run spec_measure 2400 python experiments/spec_acceptance.py measure \
+    --ckpt experiments/artifacts/spec350m --model gpt-350m
+
+echo "battery11 complete; results in $OUT/"
