@@ -183,7 +183,13 @@ def compute(model, hardware, seq_len, global_batch, long_context,
               show_default=True,
               help="Persist the measured compute efficiency so future "
                    "planner predictions use it.")
-def verify(model, hardware, batch, seq_len, steps, save_calib):
+@click.option("--moment-dtype", default="float32", show_default=True,
+              type=click.Choice(["float32", "bfloat16"]),
+              help="Adam mu/nu dtype for the measured step (bfloat16 is "
+                   "the measured-best config and what lets 7B-shape "
+                   "proxies like gpt-7b-4l fit one chip).")
+def verify(model, hardware, batch, seq_len, steps, save_calib,
+           moment_dtype):
     """Measure a real train step and compare against the planner's
     prediction; persist the measured compute efficiency as calibration.
 
@@ -212,7 +218,8 @@ def verify(model, hardware, batch, seq_len, steps, save_calib):
     par = ParallelConfig(activation_checkpoint="selective",
                          micro_batch_size=batch, global_batch_size=batch)
     step_fn, tx, _ = make_train_step(
-        model_cfg, OptimizerConfig(lr=1e-4), par,
+        model_cfg, OptimizerConfig(lr=1e-4, moment_dtype=moment_dtype,
+                                   nu_dtype=moment_dtype), par,
         attn_impl="flash" if on_tpu else "xla")
     state = TrainState.create(init(model_cfg, jax.random.PRNGKey(0)), tx)
     jstep = jax.jit(step_fn, donate_argnums=(0,))

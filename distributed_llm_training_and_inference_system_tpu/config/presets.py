@@ -98,6 +98,16 @@ MODEL_TEMPLATES: dict[str, ModelConfig] = {
         max_position_embeddings=4096, activation="silu",
         moe=MoEConfig(num_experts=8, experts_per_token=2),
     ),
+    # Depth-truncated gpt-7b: the SAME H=4096/D=128/F=11008 layer at 4
+    # layers, so one 16 GB chip can STEP the north-star model's real
+    # matmul shapes (full gpt-7b training state needs ~27 GB params+Adam
+    # alone). Per-layer time measured on this proxy calibrates `plan
+    # compute` for multi-chip gpt-7b predictions (BASELINE round-4).
+    "gpt-7b-4l": ModelConfig(
+        name="gpt-7b-4l", num_layers=4, hidden_size=4096, ffn_size=11008,
+        num_heads=32, num_kv_heads=32, head_dim=128, vocab_size=50304,
+        max_position_embeddings=4096, activation="silu",
+    ),
     # Chip-sized MoE for single-chip measurement (BASELINE round-4 MoE
     # rows): ~0.94B total params, ~0.33B active/token (8 experts, top-2) —
     # params + AdamW state fit one 16 GB v5e the way gpt-750m does.

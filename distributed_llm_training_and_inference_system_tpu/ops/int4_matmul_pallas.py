@@ -1,0 +1,105 @@
+"""Pallas TPU kernel: W4A16 matmul with IN-KERNEL dequantization.
+
+Round-3 measured int4 decode at 24.8 tok/s vs bf16's 104 (BASELINE.md):
+the XLA dequant chain (nibble unpack -> stack -> reshape -> scale) defeats
+dequant-into-matmul fusion, so the full bf16 weight tensor round-trips
+through HBM every step — 2.5x the traffic bf16 itself pays. The verdict
+(r3 weak #5) noted a dequant-in-kernel matmul had not even been costed.
+This kernel is that costing: packed nibbles stream HBM->VMEM at 4-bit
+width and expand to bf16 in registers, so per-step weight traffic is
+0.25x bf16 / 0.5x int8.
+
+Layout contract (ops.quantization.quantize_int4_groupwise, "kernel"
+orientation): packed uint8 [in/2, out] with input-channel nibble pair
+(2i, 2i+1) at row i; scales fp32 [in/group, out]; chan fp32 [in].
+
+Interleave avoidance: x @ W = x_even @ W_even + x_odd @ W_odd, so the
+kernel never reassembles nibble pairs — the low-nibble plane multiplies
+the even input channels and the high plane the odd ones, two MXU dots per
+(k, out) tile. The AWQ channel statistic folds into the ACTIVATIONS once
+per call (x * 1/chan), not into the weight tiles.
+
+Constraints: in % (2*block_k) == 0, out % block_out == 0, block_k == group
+(one scale row per k tile). CPU fallback/interpret mode for tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _unnib(v):
+    """4-bit two's-complement sign extension on int32 lanes.
+
+    Same encoding as ops.quantization._unnibble (which is pinned to int8
+    lanes — int8 VPU arithmetic is what the XLA dequant paths want, but
+    inside Mosaic the int32 form lowers more robustly).
+    tests/test_int4_matmul_pallas.py asserts the two never diverge."""
+    return jnp.where(v >= 8, v - 16, v)
+
+
+def _kernel(xe_ref, xo_ref, packed_ref, scale_ref, out_ref):
+    k = pl.program_id(1)
+    p = packed_ref[:].astype(jnp.int32)            # [bk/2, bo]
+    s = scale_ref[:].astype(jnp.float32)           # [1, bo]
+    wlo = (_unnib(p & 0xF).astype(jnp.float32) * s).astype(jnp.bfloat16)
+    whi = (_unnib(p >> 4).astype(jnp.float32) * s).astype(jnp.bfloat16)
+    acc = jnp.dot(xe_ref[:], wlo, preferred_element_type=jnp.float32)
+    acc += jnp.dot(xo_ref[:], whi, preferred_element_type=jnp.float32)
+
+    @pl.when(k == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    out_ref[:] += acc
+
+
+@functools.partial(jax.jit, static_argnames=("group", "block_out",
+                                             "interpret"))
+def matmul_w4(x: jax.Array, packed: jax.Array, scale: jax.Array,
+              chan: jax.Array, group: int = 128, block_out: int = 512,
+              interpret: bool = False) -> jax.Array:
+    """y = x @ dequant(packed, scale, chan) with in-kernel dequant.
+
+    x [B, in] (any float dtype; compute is bf16 x bf16 -> f32),
+    packed uint8 [in/2, out], scale [in/group, out], chan [in].
+    Returns [B, out] in x.dtype. B is padded to 8 MXU sublanes.
+    """
+    B, n_in = x.shape
+    n_out = packed.shape[-1]
+    if packed.shape[-2] * 2 != n_in:
+        raise ValueError(f"packed rows {packed.shape[-2]} != in/2")
+    if n_in % group:
+        raise ValueError(f"in={n_in} not divisible by group={group}")
+    bo = min(block_out, n_out)
+    if n_out % bo:
+        raise ValueError(f"out={n_out} not divisible by block_out={bo}")
+
+    xf = (x.astype(jnp.float32) / chan.astype(jnp.float32))
+    xf = xf.astype(jnp.bfloat16)
+    Bp = ((B + 7) // 8) * 8            # every batch to a sublane multiple
+    if Bp != B:
+        xf = jnp.pad(xf, ((0, Bp - B), (0, 0)))
+    xe, xo = xf[:, 0::2], xf[:, 1::2]              # [Bp, in/2]
+
+    kb2 = group // 2                               # packed rows per k tile
+    n_k = n_in // group
+
+    out = pl.pallas_call(
+        _kernel,
+        grid=(n_out // bo, n_k),
+        in_specs=[
+            pl.BlockSpec((Bp, kb2), lambda i, k: (0, k)),
+            pl.BlockSpec((Bp, kb2), lambda i, k: (0, k)),
+            pl.BlockSpec((kb2, bo), lambda i, k: (k, i)),
+            pl.BlockSpec((1, bo), lambda i, k: (k, i)),
+        ],
+        out_specs=pl.BlockSpec((Bp, bo), lambda i, k: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((Bp, n_out), jnp.float32),
+        interpret=interpret,
+    )(xe, xo, packed, scale)
+    return out[:B].astype(x.dtype)

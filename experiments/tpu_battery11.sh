@@ -29,8 +29,14 @@ run moe_serve 1800 python -m distributed_llm_training_and_inference_system_tpu.c
 # (b) speculation: corpus -> train -> measure. ~2k steps of gpt-350m
 # (b8 s1024) on the order-2 Markov corpus; loss falling = the chain is
 # being learned; held-out prompts then measure REAL n-gram acceptance.
-[ -d experiments/artifacts/markov ] || \
-    python experiments/spec_acceptance.py gen-corpus
+# prompts.json is written LAST by gen-corpus, so its presence implies a
+# complete corpus; regenerate (logged + timeboxed) otherwise and abort
+# rather than burn the 5400 s train step on partial shards
+if [ ! -f experiments/artifacts/markov/prompts.json ]; then
+  run spec_corpus 600 python experiments/spec_acceptance.py gen-corpus
+fi
+[ -f experiments/artifacts/markov/prompts.json ] || \
+    { echo "corpus generation failed; skipping spec steps"; exit 1; }
 run spec_train 5400 python -m distributed_llm_training_and_inference_system_tpu.cli.main \
     train launch --model gpt-350m --in-process --max-steps 2000 --no-resume \
     --set data.train=experiments/artifacts/markov \

@@ -1,0 +1,33 @@
+#!/bin/bash
+# Round-4 battery 12: gpt-7b-SHAPE train evidence (verdict r3 next #2).
+# Full gpt-7b training state (~27 GB params+Adam) cannot fit one chip, but
+# gpt-7b-4l — the SAME H=4096/D=128/F=11008 layer, 4 deep — can. Measured
+# MFU at the real north-star matmul shapes replaces round-3's
+# matmul-microprobe extrapolation, and `plan verify` stamps the measured
+# compute efficiency into the planner calibration so the v5e-256 gpt-7b
+# plan prediction cites stepped H=4096 data.
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-experiments/results_r4}
+mkdir -p "$OUT"
+source experiments/battery_lib.sh
+tpu_guard
+
+# (batch, remat, model, mu_dtype, loss_chunk, fused, nu_dtype, accum)
+run mfu7b4l_b4 2400 python experiments/mfu_sweep.py 4 selective gpt-7b-4l \
+    bfloat16 1024 1 bfloat16 1
+run mfu7b4l_b4_accum4 2400 python experiments/mfu_sweep.py 4 selective \
+    gpt-7b-4l bfloat16 1024 1 bfloat16 4
+run mfu7b4l_b2 2400 python experiments/mfu_sweep.py 2 selective gpt-7b-4l \
+    bfloat16 1024 1 bfloat16 1
+
+# measured-vs-predicted + chip-stamped calibration at the 7b layer shapes
+run plan7b_verify 2400 python -m distributed_llm_training_and_inference_system_tpu.cli.main \
+    plan verify --model gpt-7b-4l --batch 4 --seq-len 2048 --moment-dtype bfloat16
+
+# the calibrated 256-chip plan prediction for the full north-star model
+run plan7b_256 600 python -m distributed_llm_training_and_inference_system_tpu.cli.main \
+    plan compute --model gpt-7b --hardware v5e-256 --global-batch 256 \
+    --seq-len 2048
+
+echo "battery12 complete; results in $OUT/"

@@ -1,0 +1,15 @@
+#!/bin/bash
+# Round-4 battery 13: cost the int4 dequant-in-kernel Pallas matmul
+# (verdict r3 weak #5) at decode batch sizes, plus the gpt-7b-shape
+# sweep's follow-ups if battery12 surfaced any.
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-experiments/results_r4}
+mkdir -p "$OUT"
+source experiments/battery_lib.sh
+tpu_guard
+
+run int4_kernel_b8 1800 python experiments/int4_kernel_bench.py 8 50
+run int4_kernel_b16 1800 python experiments/int4_kernel_bench.py 16 50
+
+echo "battery13 complete; results in $OUT/"

@@ -22,27 +22,30 @@ ART=experiments/artifacts/gpt7b-int8.safetensors
 run serve7b_light 3600 python -m distributed_llm_training_and_inference_system_tpu.cli.main \
     bench e2e --model gpt-7b --mode serve-load --artifact "$ART" \
     --requests 16 --prompt-len 512 --gen-len 64 \
-    --rps 0.25 --concurrency 1 --admission ondemand --kv-blocks 120
+    --rps 0.25 --concurrency 1 --admission ondemand --kv-blocks 96
 run serve7b_light_adapt 3600 python -m distributed_llm_training_and_inference_system_tpu.cli.main \
     bench e2e --model gpt-7b --mode serve-load --artifact "$ART" \
     --requests 16 --prompt-len 512 --gen-len 64 \
-    --rps 0.25 --concurrency 1 --admission ondemand --kv-blocks 120 \
+    --rps 0.25 --concurrency 1 --admission ondemand --kv-blocks 96 \
     --latency-dispatch-steps 2
 
 # Saturation: closed-loop c=4,8 — goodput + tails. KV: 640 tok/req =
-# 10 pages; c=8 needs 80 pages live; 120 pages = 4.0 GB bf16 KV on top of
-# 7.3 GB weights.
+# 10 pages; c=8 needs 80 pages live; 96 pages = 3.2 GB bf16 KV on top of
+# 7.3 GB weights (the first attempt at 120 pages OOM'd the decode
+# program by 118 MB — the K-step scan transiently holds ~2x the pool,
+# so 7B KV budgets must leave that headroom; 16-page slack changes the
+# admission regime vs the 1B rows' 96-of-96, noted in BASELINE).
 run serve7b_load 3600 python -m distributed_llm_training_and_inference_system_tpu.cli.main \
     bench e2e --model gpt-7b --mode serve-load --artifact "$ART" \
     --requests 24 --prompt-len 512 --gen-len 128 \
-    --rps "" --concurrency 4,8 --admission ondemand --kv-blocks 120
+    --rps "" --concurrency 4,8 --admission ondemand --kv-blocks 96
 
-# int8 KV pages: 2x KV capacity/byte + half the decode KV streaming —
-# does it pay at 7B the way it didn't at 1B?
+# int8 KV pages (160 = 2.7 GB): 2x KV capacity/byte + half the decode
+# KV streaming — does it pay at 7B the way it didn't at 1B?
 run serve7b_load_kv8 3600 python -m distributed_llm_training_and_inference_system_tpu.cli.main \
     bench e2e --model gpt-7b --mode serve-load --artifact "$ART" \
     --requests 24 --prompt-len 512 --gen-len 128 --kv-quant int8 \
-    --rps "" --concurrency 4,8 --admission ondemand --kv-blocks 120
+    --rps "" --concurrency 4,8 --admission ondemand --kv-blocks 160
 
 # 16 decode slots under int8 KV (capacity headroom): where does goodput
 # knee at 7B?

@@ -136,6 +136,31 @@ class TestTrainCycle:
         # int8 quantization should meaningfully shrink the artifact
         assert out8.stat().st_size < out.stat().st_size
 
+    def test_export_gguf_and_synth(self, runner, trained, tmp_path):
+        gg = tmp_path / "m.gguf"
+        invoke(runner, ["export", "convert", "--ckpt", f"{trained}/ckpt",
+                        "--format", "gguf", "--model", "gpt-test",
+                        "--out", str(gg)])
+        from distributed_llm_training_and_inference_system_tpu.io.gguf import read_gguf
+        meta, infos = read_gguf(gg, load_tensors=False)
+        assert meta["general.architecture"] == "llama"
+        assert any(n.startswith("blk.0.") for n in infos)
+
+        synth = tmp_path / "s8.safetensors"
+        invoke(runner, ["export", "synth", "--model", "gpt-test",
+                        "--quant", "int8", "--out", str(synth)])
+        from distributed_llm_training_and_inference_system_tpu.io.export import load_exported
+        tree, smeta = load_exported(synth)
+        assert smeta["quant"] == "int8"
+        assert tree["blocks"]["q"]["kernel"]["__quant__"] == "int8"
+
+    def test_plan_verify_moment_dtype(self, runner):
+        result = invoke(runner, [
+            "plan", "verify", "--model", "gpt-test", "--batch", "1",
+            "--seq-len", "32", "--steps", "1", "--no-save",
+            "--moment-dtype", "bfloat16"])
+        assert "measured_step_ms" in result.output
+
     def test_admin_inspect_and_gc(self, runner, trained):
         result = invoke(runner, ["admin", "inspect", "--ckpt",
                                  f"{trained}/ckpt", "--limit", "5"])
