@@ -128,10 +128,14 @@ def kernels(op, seq_len, hidden, heads, batch):
 @click.option("--slots", default=0, show_default=True, type=int,
               help="serve-load: decode slot count (max_batch_size); "
                    "0 = auto from --requests (capped at 16).")
+@click.option("--pipelined/--no-pipelined", "pipelined", default=False,
+              show_default=True,
+              help="serve-load: pipelined decode dispatch (one un-fetched "
+                   "dispatch in flight, chained on the device carry).")
 def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
         requests, rps, concurrency, admission, kv_blocks, device_times,
         preemption, latency_dispatch_steps, artifact, quant, kv_quant,
-        slots):
+        slots, pipelined):
     """End-to-end train step throughput / serve TTFT+throughput
     (parity: reference bench.py:35-49). ``serve-load`` runs open-loop
     (Poisson) and closed-loop sweeps with p50/p99 TTFT, per-token latency,
@@ -219,6 +223,7 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
                 kv_num_blocks=kv_blocks,
                 admission=admission, preemption=preemption,
                 latency_dispatch_steps=latency_dispatch_steps,
+                pipelined_decode=pipelined,
                 artifact=artifact, quantization=quant,
                 kv_quantization=kv_quant,
                 dtype="bfloat16" if on_tpu else "float32"))
@@ -242,8 +247,13 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
             eng = fresh_engine()
             eng.generate([list(range(1, prompt_len + 1))],
                          SamplingParams(temperature=0.0, max_tokens=2))
+            # zero EVERY counter stats() derives ratios from — a partial
+            # reset left warmup padded-slot steps in the utilization
+            # denominator's sibling (review r4)
             eng.total_prefill_tokens = 0
             eng.total_decode_steps = 0
+            eng.total_padded_slot_steps = 0
+            eng.total_short_dispatches = 0
             last_engine.append(eng)
             return eng
 
@@ -255,7 +265,13 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
                               num_requests=requests, prompt_len=prompt_len,
                               max_tokens=gen_len, seed=0,
                               device_times=device_times)
-            results["serve_load"]["open_loop"].append(out.summary())
+            s = out.summary()
+            es = last_engine[0].stats() if last_engine else {}
+            s["engine"] = {k: es.get(k) for k in
+                           ("short_dispatches", "decode_steps",
+                            "padded_slot_steps", "prefill_tokens",
+                            "preemptions", "decode_slot_utilization")}
+            results["serve_load"]["open_loop"].append(s)
         for c in [int(x) for x in str(concurrency).split(",") if x]:
             out = run_closed_loop(warmed_engine(), concurrency=c,
                                   num_requests=requests,
@@ -264,6 +280,14 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
                                   device_times=device_times)
             s = out.summary()
             s["concurrency"] = c
+            # engine counters for the sweep point (short dispatches,
+            # decode steps, padded-slot waste, preemptions) — the
+            # adaptive-dispatch A/B was undiagnosable without them
+            es = last_engine[0].stats() if last_engine else {}
+            s["engine"] = {k: es.get(k) for k in
+                           ("short_dispatches", "decode_steps",
+                            "padded_slot_steps", "prefill_tokens",
+                            "preemptions", "decode_slot_utilization")}
             results["serve_load"]["closed_loop"].append(s)
 
     click.echo(json.dumps(results, indent=2))

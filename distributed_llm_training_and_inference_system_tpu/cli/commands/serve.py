@@ -81,6 +81,12 @@ def app(ctx):
               help="Shrink decode dispatches to this many steps while "
                    "requests wait in the queue with a free slot, so "
                    "prefill windows open sooner (0 disables).")
+@click.option("--pipelined-decode/--no-pipelined-decode", default=False,
+              show_default=True,
+              help="Keep one un-fetched decode dispatch in flight and "
+                   "chain the next on its device carry (overlaps the "
+                   "per-dispatch host round trip; engages at >= half-full "
+                   "batches; bitwise-identical output).")
 @click.option("--cors-origins", default="*", show_default=True,
               help="CORS allowed origins for browser clients: '*', a "
                    "comma-separated list, or '' to disable (parity: the "
@@ -89,7 +95,8 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
           kv_block_size, kv_hbm_gb, scheduler, dtype, prometheus_port,
           speculative, spec_tokens, prefix_cache, tensor_parallel,
           quantization, chunked_prefill, kv_quantization, admission,
-          preemption, latency_dispatch_steps, cors_origins):
+          preemption, latency_dispatch_steps, pipelined_decode,
+          cors_origins):
     """Start the OpenAI-compatible inference server."""
     import jax
 
@@ -113,6 +120,7 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
         kv_quantization=kv_quantization, admission=admission,
         preemption=preemption,
         latency_dispatch_steps=latency_dispatch_steps,
+        pipelined_decode=pipelined_decode,
         cors_origins=cors_origins)
     serve_cfg.validate()
 

@@ -540,6 +540,17 @@ class ServeConfig:
     # so the cost is not the shortening itself); opt in for low-occupancy
     # latency-sensitive deployments.
     latency_dispatch_steps: int = 0
+    # pipelined decode: keep ONE un-fetched K-step dispatch in flight and
+    # chain the next dispatch on its device-resident scan carry, so the
+    # per-dispatch host round trip overlaps device execution instead of
+    # serialising with it (measured ~115 ms RTT per dispatch on the
+    # tunneled dev chip; dispatch+sync cost anywhere). Engages only at
+    # >= half-full batches (chained pairs delay an arrival's prefill
+    # window by up to 2K steps — the light-load TTFT regime belongs to
+    # latency_dispatch_steps, the saturation regime to this). Chains
+    # break on any slot (re)arm; output is bitwise identical (same
+    # per-step program, same PRNG fold).
+    pipelined_decode: bool = False
     # tokens per KV-cache page: 64 makes each page a [64, D] DMA tile for
     # the Pallas decode kernel (16-token pages measured 2.4x slower — DMA
     # too small); internal fragmentation is at most page_size-1 tokens/seq

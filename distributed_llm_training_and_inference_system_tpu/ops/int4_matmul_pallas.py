@@ -42,20 +42,26 @@ def _unnib(v):
     return jnp.where(v >= 8, v - 16, v)
 
 
-def _kernel(xe_ref, xo_ref, packed_ref, scale_ref, out_ref):
-    k = pl.program_id(1)
-    p = packed_ref[:].astype(jnp.int32)            # [bk/2, bo]
-    s = scale_ref[:].astype(jnp.float32)           # [1, bo]
-    wlo = (_unnib(p & 0xF).astype(jnp.float32) * s).astype(jnp.bfloat16)
-    whi = (_unnib(p >> 4).astype(jnp.float32) * s).astype(jnp.bfloat16)
-    acc = jnp.dot(xe_ref[:], wlo, preferred_element_type=jnp.float32)
-    acc += jnp.dot(xo_ref[:], whi, preferred_element_type=jnp.float32)
-
-    @pl.when(k == 0)
-    def _init():
-        out_ref[:] = jnp.zeros_like(out_ref)
-
-    out_ref[:] += acc
+def _make_kernel(wdtype):
+    # whole reduction dim resident per out-tile (1-2 MB VMEM at 7B
+    # shapes): one unpack + one dot pair per tile, no k-grid — the first
+    # k-tiled version used (Bp, group/2) x-blocks whose 64-lane trailing
+    # dim Mosaic rejects (blocks must end in a multiple of 128 or the
+    # full array dim). wdtype: bf16 on TPU; f32 under interpret (the
+    # XLA:CPU dot thunk lacks bf16 x bf16 -> f32)
+    def _kernel(xe_ref, xo_ref, packed_ref, scale_ref, out_ref):
+        p = packed_ref[:].astype(jnp.int32)            # [in/2, bo]
+        s = scale_ref[:].astype(jnp.float32)           # [G, bo]
+        half_group = p.shape[0] // s.shape[0]
+        # per-pair-row scale: group g covers packed rows [g*group/2,
+        # (g+1)*group/2) — a broadcast + relabel, no data movement
+        srow = jnp.repeat(s, half_group, axis=0)
+        wlo = (_unnib(p & 0xF).astype(jnp.float32) * srow).astype(wdtype)
+        whi = (_unnib(p >> 4).astype(jnp.float32) * srow).astype(wdtype)
+        out_ref[:] = (
+            jnp.dot(xe_ref[:], wlo, preferred_element_type=jnp.float32)
+            + jnp.dot(xo_ref[:], whi, preferred_element_type=jnp.float32))
+    return _kernel
 
 
 @functools.partial(jax.jit, static_argnames=("group", "block_out",
@@ -79,26 +85,26 @@ def matmul_w4(x: jax.Array, packed: jax.Array, scale: jax.Array,
     if n_out % bo:
         raise ValueError(f"out={n_out} not divisible by block_out={bo}")
 
+    wdtype = jnp.float32 if interpret else jnp.bfloat16
     xf = (x.astype(jnp.float32) / chan.astype(jnp.float32))
-    xf = xf.astype(jnp.bfloat16)
+    # bf16 round-trip either way so interpret numerics track the TPU path
+    xf = xf.astype(jnp.bfloat16).astype(wdtype)
     Bp = ((B + 7) // 8) * 8            # every batch to a sublane multiple
     if Bp != B:
         xf = jnp.pad(xf, ((0, Bp - B), (0, 0)))
     xe, xo = xf[:, 0::2], xf[:, 1::2]              # [Bp, in/2]
 
-    kb2 = group // 2                               # packed rows per k tile
-    n_k = n_in // group
-
+    n_groups = n_in // group
     out = pl.pallas_call(
-        _kernel,
-        grid=(n_out // bo, n_k),
+        _make_kernel(wdtype),
+        grid=(n_out // bo,),
         in_specs=[
-            pl.BlockSpec((Bp, kb2), lambda i, k: (0, k)),
-            pl.BlockSpec((Bp, kb2), lambda i, k: (0, k)),
-            pl.BlockSpec((kb2, bo), lambda i, k: (k, i)),
-            pl.BlockSpec((1, bo), lambda i, k: (k, i)),
+            pl.BlockSpec((Bp, n_in // 2), lambda i: (0, 0)),
+            pl.BlockSpec((Bp, n_in // 2), lambda i: (0, 0)),
+            pl.BlockSpec((n_in // 2, bo), lambda i: (0, i)),
+            pl.BlockSpec((n_groups, bo), lambda i: (0, i)),
         ],
-        out_specs=pl.BlockSpec((Bp, bo), lambda i, k: (0, i)),
+        out_specs=pl.BlockSpec((Bp, bo), lambda i: (0, i)),
         out_shape=jax.ShapeDtypeStruct((Bp, n_out), jnp.float32),
         interpret=interpret,
     )(xe, xo, packed, scale)

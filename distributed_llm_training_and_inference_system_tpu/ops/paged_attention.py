@@ -80,7 +80,12 @@ def paged_attention(
     elsewhere.
     """
     if impl == "auto":
-        impl = "pallas" if jax.default_backend() == "tpu" else "gather"
+        # the Pallas kernels tile head_dim onto the 128-lane axis; D < 128
+        # (e.g. gpt-350m's 64) fails Mosaic layout inference ("unsupported
+        # shape cast", measured round 4) — those shapes take the gather
+        # path instead of crashing the serve engine
+        on_tpu = jax.default_backend() == "tpu"
+        impl = "pallas" if on_tpu and q.shape[-1] % 128 == 0 else "gather"
     if impl == "pallas":
         from .paged_attention_pallas import paged_attention_pallas
         return paged_attention_pallas(
@@ -207,7 +212,10 @@ def paged_attention_multi(
     """
     B, T, Nq, D = q.shape
     if impl == "auto":
-        impl = "pallas" if jax.default_backend() == "tpu" else "gather"
+        # same D % 128 == 0 constraint as paged_attention (Mosaic lane
+        # tiling); small-head models serve via the gather fallback
+        on_tpu = jax.default_backend() == "tpu"
+        impl = "pallas" if on_tpu and D % 128 == 0 else "gather"
     if impl == "pallas":
         from .paged_attention_pallas import paged_attention_pallas_multi
         return paged_attention_pallas_multi(

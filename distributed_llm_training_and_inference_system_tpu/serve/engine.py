@@ -38,7 +38,7 @@ import numpy as np
 
 from ..config.schema import ModelConfig, ServeConfig
 from ..models import gpt
-from .decode import decode_multi_step, extend_step_forward
+from .decode import decode_scan, extend_step_forward
 from .kv_cache import PagedKVCache
 from .sampling import sample_tokens
 from .scheduler import ContinuousBatchingScheduler, Request, SamplingParams
@@ -210,6 +210,9 @@ class InferenceEngine:
                 "(must be paged|scatter) — a typo here would silently "
                 "select the paged path and poison A/B data")
         self._prefill_cache: dict[int, callable] = {}
+        # pipelined decode: the one un-fetched in-flight dispatch record
+        # (None = none in flight); see step()
+        self._pending = None
         # chunked prefill: request_id -> progress state (one chunk advances
         # per engine step, interleaved with decode)
         self._partial_prefills: dict[str, dict] = {}
@@ -378,7 +381,8 @@ class InferenceEngine:
             # prefix once PER SUFFIX TOKEN — there a small hit on a long
             # tail costs more than a cold dense prefill, so it is dropped.
             pallas_suffix = (self._attn_impl == "auto"
-                             and jax.default_backend() == "tpu")
+                             and jax.default_backend() == "tpu"
+                             and self.cfg.head_dim % 128 == 0)
             computed = n - len(pins) * self.kv.page_size
             if pins and not pallas_suffix and computed > max(
                     len(pins) * self.kv.page_size,
@@ -746,10 +750,14 @@ class InferenceEngine:
     def _decode_impl_n(self, num_steps, params, k_pages, v_pages, tokens,
                        positions, tables, stops, slot_keys, temp, top_k,
                        top_p):
-        return decode_multi_step(
+        # the final scan carry (tokens, positions) comes back as DEVICE
+        # arrays so a pipelined follow-up dispatch can chain on them
+        # without a host round trip (step() pipelining below)
+        (toks, pos, k_pages, v_pages), toks_seq = decode_scan(
             params, tokens, positions, k_pages, v_pages, tables, stops,
-            slot_keys, temp, top_k, top_p, self.cfg, num_steps=num_steps,
+            slot_keys, temp, top_k, top_p, self.cfg, num_steps,
             attn_impl=self._attn_impl, write_mode=self._extend_write)
+        return toks_seq, toks, pos, k_pages, v_pages
 
     def _short_dispatch_ok(self) -> bool:
         """Should the next decode dispatch run the SHORT program? (caller
@@ -787,7 +795,7 @@ class InferenceEngine:
         S = self.serve_cfg.max_batch_size
         zeros_i = jnp.zeros(S, jnp.int32)
         scratch_tables = jnp.zeros_like(jnp.asarray(self.kv.block_tables))
-        _, self.kv.k_pages, self.kv.v_pages = self._decode_jit_short(
+        _, _, _, self.kv.k_pages, self.kv.v_pages = self._decode_jit_short(
             self.params, self.kv.k_pages, self.kv.v_pages, zeros_i,
             zeros_i, scratch_tables, zeros_i,
             jnp.asarray(self._slot_keys),
@@ -815,18 +823,63 @@ class InferenceEngine:
         if use_short and self._decode_jit_short is not None:
             jit = self._decode_jit_short
             self.total_short_dispatches += 1
-        sampled_seq, self.kv.k_pages, self.kv.v_pages = jit(
-            self.params, self.kv.k_pages, self.kv.v_pages,
-            jnp.asarray(self.last_tokens), jnp.asarray(self.positions),
-            jnp.asarray(self.kv.block_tables),
-            jnp.asarray(self.stop_positions),
-            jnp.asarray(self._slot_keys), jnp.asarray(self.temperature),
-            jnp.asarray(self.top_k), jnp.asarray(self.top_p))
-        out = np.asarray(sampled_seq)              # [K, B]
+        pend = self._submit_decode(jit)
+        return self._fetch_decode(pend)
+
+    def _submit_decode(self, jit, chain_from=None) -> dict:
+        """Dispatch one K-step decode program WITHOUT fetching results.
+
+        ``chain_from``: a previous dispatch's pending record — its final
+        scan carry (tokens, positions) feeds this dispatch as device
+        arrays, so back-to-back dispatches queue on the device with no
+        host round trip between them (the pipelined path; the ~100 ms
+        tunnel RTT was a serial cost per dispatch otherwise). Everything
+        else (tables, stops, sampling state) is host state, valid because
+        step() only chains when no slot was re-armed in between.
+
+        Returns a pending record carrying the un-fetched device arrays
+        plus the per-slot request-id snapshot apply-time masking needs."""
+        if chain_from is not None:
+            tokens, positions = (chain_from["next_tokens"],
+                                 chain_from["next_positions"])
+        else:
+            tokens = jnp.asarray(self.last_tokens)
+            positions = jnp.asarray(self.positions)
+        sampled_seq, next_toks, next_pos, self.kv.k_pages, self.kv.v_pages \
+            = jit(
+                self.params, self.kv.k_pages, self.kv.v_pages,
+                tokens, positions,
+                jnp.asarray(self.kv.block_tables),
+                jnp.asarray(self.stop_positions),
+                jnp.asarray(self._slot_keys), jnp.asarray(self.temperature),
+                jnp.asarray(self.top_k), jnp.asarray(self.top_p))
+        return {
+            "sampled": sampled_seq, "next_tokens": next_toks,
+            "next_positions": next_pos,
+            "req_ids": [r.request_id if r is not None else None
+                        for r in self.scheduler.slots],
+            "active": self.active.copy(),
+        }
+
+    def _fetch_decode(self, pend: dict) -> np.ndarray:
+        out = np.asarray(pend["sampled"])          # [K, B]
         self.total_decode_steps += out.shape[0]
         self.total_padded_slot_steps += out.shape[0] * int(
-            self.serve_cfg.max_batch_size - self.active.sum())
+            self.serve_cfg.max_batch_size - pend["active"].sum())
         return out
+
+    def _drain_pending(self) -> None:
+        """Fetch + apply the in-flight pipelined dispatch (if any) so the
+        engine's host state catches up with the device before a
+        non-chainable action (prefill of a re-armed slot, short dispatch,
+        speculation, shutdown)."""
+        prev, self._pending = self._pending, None
+        if prev is None:
+            return
+        sampled = self._fetch_decode(prev)
+        with self.lock:
+            self._apply_decode(sampled, snapshot=prev)
+            self.scheduler.step_finished(self.eos_token_id)
 
     # -- speculative decode --------------------------------------------------
 
@@ -934,15 +987,28 @@ class InferenceEngine:
             if accepted and self.on_token is not None:
                 self.on_token(req, accepted)
 
-    def _apply_decode(self, sampled_seq: np.ndarray) -> None:
+    def _apply_decode(self, sampled_seq: np.ndarray,
+                      snapshot: Optional[dict] = None) -> None:
         """Host bookkeeping for K decode steps (called under self.lock).
 
         Continuing slots accept all K tokens (positions advance in lockstep
         with the device scan carry); slots that hit a stop condition
         mid-scan stop accepting — their trailing device iterations wrote
-        reserved pages that are released with the slot."""
+        reserved pages that are released with the slot.
+
+        ``snapshot``: the dispatch's pending record when applying a
+        PIPELINED dispatch one step late — slots whose request changed
+        since submission (finished + released while this dispatch was in
+        flight) are skipped: their rows decoded past the old request's
+        life into freed pages, which is harmless (the device executes any
+        subsequent prefill AFTER this program, so reallocated pages are
+        overwritten in order) but must not be credited to anyone."""
         for slot, req in enumerate(self.scheduler.slots):
             if req is None or not self.active[slot]:
+                continue
+            if snapshot is not None and (
+                    req.request_id != snapshot["req_ids"][slot]
+                    or not snapshot["active"][slot]):
                 continue
             accepted = []
             for k in range(sampled_seq.shape[0]):
@@ -982,6 +1048,7 @@ class InferenceEngine:
         the process would mind losing its compilation cache."""
         self.params = None
         self.kv = None
+        self._pending = None
         self._decode_jit = None
         self._decode_jit_short = None
         self._spec_jit = None
@@ -1086,7 +1153,12 @@ class InferenceEngine:
         Caller holds self.lock."""
         if self.serve_cfg.admission != "ondemand":
             return
-        k = self._decode_lookahead
+        # lag: un-applied pipelined dispatch in flight — the device is
+        # already K tokens past the host's positions, so the NEXT
+        # (chained) dispatch writes up to positions + lag + k
+        lag = (max(self.serve_cfg.decode_steps_per_dispatch, 1)
+               if self._pending is not None else 0)
+        k = self._decode_lookahead + lag
         order = sorted(np.flatnonzero(self.active),
                        key=lambda i: self._slot_seq[i])
         for i in order:
@@ -1197,15 +1269,56 @@ class InferenceEngine:
                 self._spec_jit = None
             if (self._spec_jit is not None
                     and bool((self.temperature[self.active] <= 0).any())):
+                # a pending pipelined dispatch (set while the batch was
+                # all-sampled) leaves host tokens/positions K steps stale —
+                # the spec dispatch builds its drafts and window from host
+                # state, so it must catch up first
+                self._drain_pending()
                 emitted, n_emit, decode_seq = self._spec_device()
                 with self.lock:
                     self._apply_speculative(emitted, n_emit, decode_seq)
                     self.scheduler.step_finished(self.eos_token_id)
+            elif (self.serve_cfg.pipelined_decode and not static
+                  and not use_short and not pending
+                  and not self._partial_prefills
+                  and 2 * int(self.active.sum())
+                  >= self.serve_cfg.max_batch_size):
+                # occupancy gate (>= half the slots resident): at light
+                # load a chained pair queues up to 2K device steps ahead
+                # of any arrival's prefill window — the same TTFT hazard
+                # the latency-adaptive short dispatch exists to shrink —
+                # while the goodput win only materialises when the batch
+                # is busy enough for the RTT to be the bottleneck
+                # PIPELINED decode: keep one un-fetched dispatch in flight.
+                # Submit the next dispatch chained on the previous one's
+                # device-resident scan carry, THEN fetch/apply the previous
+                # one — the per-dispatch host round trip (~100 ms on a
+                # tunneled chip, dispatch+sync anywhere) overlaps device
+                # execution instead of serialising with it. Chains break
+                # whenever a slot is (re)armed — any prefill this step, the
+                # short program, speculation — because the chained inputs
+                # (tokens/positions) would be stale for that slot; mere
+                # FINISHES don't break the chain (the stale row decodes
+                # into its freed pages, which the device overwrites in
+                # program order before any reuse, and apply() masks it out
+                # via the request-id snapshot).
+                prev = self._pending
+                self._pending = self._submit_decode(
+                    self._decode_jit, chain_from=prev)
+                if prev is not None:
+                    sampled = self._fetch_decode(prev)
+                    with self.lock:
+                        self._apply_decode(sampled, snapshot=prev)
+                        self.scheduler.step_finished(self.eos_token_id)
             else:
-                sampled = self._decode_device(use_short)
-                with self.lock:
-                    self._apply_decode(sampled)
-                    self.scheduler.step_finished(self.eos_token_id)
+                self._drain_pending()
+                # the drain may have finished every resident request —
+                # don't burn a dispatch on an all-inactive batch
+                if any(self.active):
+                    sampled = self._decode_device(use_short)
+                    with self.lock:
+                        self._apply_decode(sampled)
+                        self.scheduler.step_finished(self.eos_token_id)
         with self.lock:
             return self.scheduler.active_count
 
@@ -1214,6 +1327,9 @@ class InferenceEngine:
         waiters fire via on_finish instead of hanging to the HTTP timeout."""
         with self.lock:
             failed = self.scheduler.fail_all(error)
+            # in-flight pipelined dispatch references the failed slots'
+            # state; its results must never be applied
+            self._pending = None
             # fail_all released every slot (incl. PREFILLING); advancing a
             # stale chunked prefill would write into freed pages
             self._partial_prefills.clear()
@@ -1305,13 +1421,13 @@ class InferenceEngine:
                  jnp.ones(self.serve_cfg.max_batch_size, jnp.float32),
                  jnp.zeros(self.serve_cfg.max_batch_size, jnp.int32),
                  jnp.ones(self.serve_cfg.max_batch_size, jnp.float32))
-        sampled, kp, vp = self._decode_jit(
+        sampled, _, _, kp, vp = self._decode_jit(
             self.params, kp, vp, zeros_i, zeros_i, *dargs)
         self.kv.k_pages, self.kv.v_pages = kp, vp
         np.asarray(sampled)
         t0 = time.perf_counter()
         for _ in range(iters):
-            sampled, kp, vp = self._decode_jit(
+            sampled, _, _, kp, vp = self._decode_jit(
                 self.params, kp, vp, zeros_i, zeros_i, *dargs)
             self.kv.k_pages, self.kv.v_pages = kp, vp
         np.asarray(sampled)

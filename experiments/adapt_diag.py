@@ -1,0 +1,104 @@
+"""Diagnose the latency-adaptive-dispatch saturation deficit (round 4).
+
+Battery 9 settled THAT it exists (n=3 interleaved: c8 goodput 114.4+/-2
+with latency_dispatch_steps=2 vs 139.3+/-4 off, -18%) but the engine
+counters show ZERO short dispatches in every run — the configured feature
+never fires, so the deficit must come from a side effect of merely
+ENABLING it. The only structural difference is the second compiled decode
+program (the L-step scan) warmed during engine warmup.
+
+This probe runs the same c8 cell with per-request timestamps and
+JAX_LOG_COMPILES, A/B, printing: dispatch-count, wall histogram of
+engine.step() latencies, and any compile events inside the timed window.
+
+Usage: python experiments/adapt_diag.py [L] (0 = off)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    L = int(sys.argv[1]) if len(sys.argv) > 1 else 2
+
+    import jax
+
+    jax.config.update("jax_log_compiles", True)
+
+    import logging
+    compiles: list[str] = []
+
+    class Catch(logging.Handler):
+        def emit(self, record):
+            compiles.append(record.getMessage()[:120])
+
+    logging.getLogger("jax._src.dispatch").addHandler(Catch())
+    logging.getLogger("jax._src.interpreters.pxla").addHandler(Catch())
+
+    from distributed_llm_training_and_inference_system_tpu.config import (
+        get_model_config)
+    from distributed_llm_training_and_inference_system_tpu.config.schema import (
+        ServeConfig)
+    from distributed_llm_training_and_inference_system_tpu.serve import (
+        InferenceEngine, SamplingParams)
+    from distributed_llm_training_and_inference_system_tpu.serve.loadgen import (
+        run_closed_loop)
+
+    cfg = get_model_config("gpt-1b")
+    eng = InferenceEngine(cfg, ServeConfig(
+        model="gpt-1b", max_batch_size=16, max_seq_len=656,
+        kv_block_size=64, kv_num_blocks=96, admission="ondemand",
+        latency_dispatch_steps=L, dtype="bfloat16"), seed=0)
+    eng.generate([list(range(1, 513))],
+                 SamplingParams(temperature=0.0, max_tokens=2))
+    eng.total_prefill_tokens = 0
+    eng.total_decode_steps = 0
+    n_warm_compiles = len(compiles)
+
+    # step-latency instrumentation
+    step_times: list[float] = []
+    orig_step = eng.step
+
+    def timed_step():
+        t0 = time.perf_counter()
+        n = orig_step()
+        step_times.append(time.perf_counter() - t0)
+        return n
+
+    eng.step = timed_step
+
+    out = run_closed_loop(eng, concurrency=8, num_requests=32,
+                          prompt_len=512, max_tokens=128, seed=0,
+                          device_times=False)
+    s = out.summary()
+    st = sorted(step_times)
+    run_compiles = compiles[n_warm_compiles:]
+    print(json.dumps({
+        "L": L,
+        "goodput_tok_s": s["goodput_tok_s"],
+        "duration_s": s["duration_s"],
+        "steps": len(step_times),
+        "decode_steps": eng.total_decode_steps,
+        "short_dispatches": eng.total_short_dispatches,
+        "prefill_tokens": eng.total_prefill_tokens,
+        "step_ms": {
+            "p10": round(st[len(st) // 10] * 1e3, 1),
+            "p50": round(st[len(st) // 2] * 1e3, 1),
+            "p90": round(st[9 * len(st) // 10] * 1e3, 1),
+            "max": round(st[-1] * 1e3, 1),
+            "sum": round(sum(st), 2),
+        },
+        "compiles_in_run": len(run_compiles),
+        "compile_msgs": run_compiles[:6],
+    }), flush=True)
+    eng.release()
+
+
+if __name__ == "__main__":
+    main()

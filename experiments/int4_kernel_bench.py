@@ -42,6 +42,20 @@ def main() -> None:
     shapes = [("gpt-1b.ffn", 2048, 5632), ("gpt-1b.attn", 2048, 2048),
               ("gpt-7b.ffn", 4096, 11008), ("gpt-7b.attn", 4096, 4096)]
 
+    # decode streams weights from HBM every step; a naive scan over ONE
+    # weight tensor lets XLA park it in VMEM (measured "13 TB/s" bf16 —
+    # impossible) and measure pure MXU time. Rotating across enough
+    # copies that the set exceeds VMEM forces the streaming regime the
+    # cost model cares about. The Pallas kernel needs no forcing (its
+    # BlockSpecs DMA operands from HBM per call — measured exactly
+    # packed-bytes/time without it).
+    VMEM_BYTES = 128 * 1024 * 1024
+
+    def rotated(arrs):
+        per = sum(a.size * a.dtype.itemsize for a in arrs)
+        n = max(2, VMEM_BYTES // per + 2)
+        return [jnp.stack([a] * n) for a in arrs], n
+
     for name, n_in, n_out in shapes:
         w = jax.random.normal(jax.random.PRNGKey(0), (n_in, n_out),
                               jnp.float32) * 0.05
@@ -50,21 +64,27 @@ def main() -> None:
         wb = w.astype(jnp.bfloat16)
         q8, s8 = quantize_int8(w)
         p4, s4, c4 = quantize_int4_groupwise(w, group=128)
+        (wb_r,), n_wb = rotated([wb])
+        (q8_r, s8_r), n_q8 = rotated([q8, s8])
+        (p4_r, s4_r), n_p4 = rotated([p4, s4])
 
-        def scan_time(fn, *args):
-            """Per-iteration ms with the per-dispatch constant (tunnel RTT
-            + host overhead) cancelled: time an N-iter and a 2N-iter scan
-            and difference them — a single window would fold ~RTT/N into
-            every sub-ms kernel and compress the variant ratios."""
-            def body(carry, _):
-                y = fn(carry, *args)
-                # feed a scalar back so iterations serialise
-                return carry + (y[0, :1] * 0).astype(carry.dtype), None
+        def scan_time(fn, n_copies):
+            """Per-iteration ms, two-window differenced (N vs 2N iters)
+            so the per-dispatch constant (tunnel RTT + host overhead)
+            cancels. The scan rotates through n_copies weight replicas
+            (xs = copy index) so XLA cannot park the weights in VMEM, and
+            the output feeds back with a tiny real coefficient so
+            iterations serialise and nothing dead-code-eliminates."""
+            def body(carry, i):
+                y = fn(carry, i)
+                return carry + y[:, :1].astype(carry.dtype) * 1e-12, None
 
             def make(n):
+                idx = jnp.arange(n, dtype=jnp.int32) % n_copies
+
                 @jax.jit
                 def run(x0):
-                    out, _ = jax.lax.scan(body, x0, None, length=n)
+                    out, _ = jax.lax.scan(body, x0, idx)
                     return out[0, 0]
                 return run
 
@@ -76,20 +96,24 @@ def main() -> None:
             return ((t2 - t1) - (t1 - t0)) / iters * 1e3
 
         variants = {
-            "bf16": lambda xx: xx @ wb,
-            "int8-xla": lambda xx: xx @ dequantize_int8(q8, s8),
-            "int4-xla": lambda xx: xx @ dequantize_int4_groupwise(
-                p4, s4, c4, group=128),
-            "int4-pallas": lambda xx: matmul_w4(
+            "bf16": (lambda xx, i: xx @ wb_r[i], n_wb),
+            "int8-xla": (lambda xx, i: xx @ dequantize_int8(
+                q8_r[i], s8_r[i]), n_q8),
+            "int4-xla": (lambda xx, i: xx @ dequantize_int4_groupwise(
+                p4_r[i], s4_r[i], c4, group=128), n_p4),
+            # the Pallas kernel's BlockSpecs stream from HBM per call —
+            # no rotation needed (or possible without scalar-prefetch
+            # plumbing); i is unused
+            "int4-pallas": (lambda xx, i: matmul_w4(
                 xx, p4, s4, c4, group=128,
                 block_out=512 if n_out % 512 == 0 else 256,
-                interpret=interpret),
+                interpret=interpret), 1),
         }
         bytes_per = {"bf16": 2 * n_in * n_out, "int8-xla": n_in * n_out,
                      "int4-xla": n_in * n_out // 2,
                      "int4-pallas": n_in * n_out // 2}
-        for vname, fn in variants.items():
-            ms = scan_time(fn)
+        for vname, (fn, n_copies) in variants.items():
+            ms = scan_time(fn, n_copies)
             bw = bytes_per[vname] / (ms / 1e3) / 1e9
             print(json.dumps({"shape": name, "in": n_in, "out": n_out,
                               "B": B, "variant": vname,

@@ -6,12 +6,14 @@ continuations are unlearnable and acceptance is structurally ~0; the
 break-even acceptance (0.229 at the measured verify cost) was analytic
 only. This experiment produces a real operating point:
 
-  gen-corpus: write an order-2 Markov corpus (peaked transitions,
-      determinism ``--peak``) as .bin token shards + a held-out prompt
-      file. A model that LEARNS the chain continues held-out prompts
-      along it, and those continuations contain repeating n-grams — the
-      regime prompt-lookup drafting exists for (the same reason it pays
-      on code/extraction workloads in the literature).
+  gen-corpus: write a PHRASE-INDUCTION corpus (documents assembled from
+      a phrase pool with high reuse) as .bin token shards + a held-out
+      prompt file. A model that learns the copy/induction structure
+      continues held-out prompts along previously-seen phrases, and
+      those continuations contain repeating n-grams — the regime
+      prompt-lookup drafting exists for (extractive / RAG / templated
+      code). v1 was an order-2 Markov chain with hashed contexts — the
+      model learned only the marginal in 1,800 steps (see _phrase_doc).
   measure: load the trained checkpoint, serve held-out prompts greedy
       with speculative=ngram vs off on the SAME engine config, report
       measured acceptance + end-to-end tok/s both ways, and the verdict
@@ -36,25 +38,25 @@ VOCAB = 2048          # ids 2..2049 within every template's vocab
 ORDER = 2
 
 
-def _chain(rng, peak, vocab=VOCAB):
-    """Order-2 transition table: for each (a, b) context a peaked
-    categorical over 8 candidate next tokens."""
+def _phrase_doc(rng, pool, doc_len, reuse):
+    """A document built from a per-doc phrase pool with heavy reuse —
+    the induction/repetition structure transformers learn FAST (copy
+    heads) and exactly the regime prompt-lookup drafting exists for
+    (extractive / RAG / templated-code workloads). A first attempt used
+    an order-2 Markov chain with hashed contexts: the model learned only
+    the token marginal in 1,800 steps (loss flat at 7.44 = ln support),
+    because an arbitrary pair->next lookup has no inductive prior —
+    honest dead end, kept in git history (battery-11 spec_train.log)."""
     import numpy as np
-    cands = rng.integers(2, vocab, size=(vocab, 8))
-    logits = rng.normal(0, 1, size=(vocab, 8))
-    logits[:, 0] += peak          # mode gets +peak nats
-    p = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
-    return cands, p
-
-
-def _sample_doc(rng, cands, p, length, vocab=VOCAB):
-    import numpy as np
-    out = [int(rng.integers(2, vocab)), int(rng.integers(2, vocab))]
-    for _ in range(length - 2):
-        ctx = (out[-2] * 31 + out[-1]) % vocab
-        j = rng.choice(8, p=p[ctx])
-        out.append(int(cands[ctx, j]))
-    return np.asarray(out, np.uint16)
+    out: list[int] = []
+    while len(out) < doc_len:
+        if out and rng.random() < reuse:
+            ph = pool[rng.integers(len(pool))]
+        else:
+            ph = rng.integers(2, VOCAB, size=rng.integers(8, 24)).tolist()
+            pool[rng.integers(len(pool))] = ph
+        out.extend(ph)
+    return np.asarray(out[:doc_len], np.uint16)
 
 
 def gen_corpus(out_dir: str, peak: float, num_docs: int,
@@ -64,23 +66,34 @@ def gen_corpus(out_dir: str, peak: float, num_docs: int,
     from distributed_llm_training_and_inference_system_tpu.io.data import (
         write_token_shard)
 
+    global VOCAB
+    VOCAB = vocab
     rng = np.random.default_rng(0)
-    cands, p = _chain(rng, peak, vocab)
+    # a small GLOBAL phrase inventory shared across documents (so held-out
+    # prompts exercise learned phrases), refreshed per doc for variety
+    global_pool = [rng.integers(2, vocab, size=rng.integers(8, 24)).tolist()
+                   for _ in range(64)]
     os.makedirs(out_dir, exist_ok=True)
+    reuse = min(max(peak / 4.0, 0.3), 0.9)     # peak repurposed: reuse rate
     for s in range(4):
-        docs = [_sample_doc(rng, cands, p, doc_len, vocab)
-                for _ in range(num_docs // 4)]
+        docs = []
+        for _ in range(num_docs // 4):
+            pool = [list(p) for p in
+                    (global_pool[i] for i in rng.choice(64, 16,
+                                                        replace=False))]
+            docs.append(_phrase_doc(rng, pool, doc_len, reuse))
         write_token_shard(os.path.join(out_dir, f"shard{s:02d}.bin"), docs)
-    # held-out prompts from the SAME chain (unseen continuations)
-    prompts = [_sample_doc(rng, cands, p, 256, vocab).tolist()
-               for _ in range(8)]
+    prompts = []
+    for _ in range(8):
+        pool = [list(p) for p in
+                (global_pool[i] for i in rng.choice(64, 16,
+                                                    replace=False))]
+        prompts.append(_phrase_doc(rng, pool, 256, reuse).tolist())
     with open(os.path.join(out_dir, "prompts.json"), "w") as f:
         json.dump(prompts, f)
-    # chain determinism = how often the mode continues the context;
-    # an upper bound on greedy-model n-gram acceptance
     print(json.dumps({"corpus": out_dir, "docs": num_docs,
-                      "doc_len": doc_len, "peak": peak, "vocab": vocab,
-                      "mode_prob": round(float(p.max(-1).mean()), 3)}))
+                      "doc_len": doc_len, "reuse": reuse, "vocab": vocab,
+                      "style": "phrase-induction"}))
 
 
 def measure(ckpt: str, model: str, spec_tokens: int, gen_len: int) -> None:
@@ -138,7 +151,8 @@ def main() -> None:
     sub = ap.add_subparsers(dest="cmd", required=True)
     g = sub.add_parser("gen-corpus")
     g.add_argument("--out", default="experiments/artifacts/markov")
-    g.add_argument("--peak", type=float, default=2.5)
+    g.add_argument("--peak", type=float, default=2.5,
+                   help="reuse-rate dial: reuse = clamp(peak/4, 0.3, 0.9)")
     g.add_argument("--num-docs", type=int, default=2000)
     g.add_argument("--doc-len", type=int, default=1024)
     g.add_argument("--vocab", type=int, default=VOCAB)

@@ -1,0 +1,48 @@
+#!/bin/bash
+# Round-4 battery 15: follow-ups from the main chain.
+# (a) MoE train MFU retry — b8/b16 OOM'd (20.8 GB: the dense-dispatch
+#     all-experts FFN at b8 s2048 overruns); b4/b2 with accumulation.
+# (b) adapt_diag: attribute the measured-but-unexplained 18% c8 goodput
+#     deficit when latency_dispatch_steps is merely ENABLED (battery 9:
+#     zero short dispatches fired, so the configured mechanism is not
+#     the cost).
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-experiments/results_r4}
+mkdir -p "$OUT"
+source experiments/battery_lib.sh
+tpu_guard
+
+run moe_mfu_b4 1800 python experiments/mfu_sweep.py 4 selective gpt-moe-1b \
+    bfloat16 1024 1 bfloat16 4
+run moe_mfu_b2 1800 python experiments/mfu_sweep.py 2 selective gpt-moe-1b \
+    bfloat16 1024 1 bfloat16 8
+
+# speculation take 2: phrase-induction corpus (the Markov v1 never
+# converged — battery-11 spec_train.log, loss flat at the marginal)
+run spec_corpus_v2 600 python experiments/spec_acceptance.py gen-corpus \
+    --out experiments/artifacts/markov2
+# gpt-750m (D=128 -> the Pallas serving path; gpt-350m's D=64 serves
+# via the gather fallback after the round-4 Mosaic fix and would not
+# represent flagship spec economics). bf16 Adam moments to fit.
+run spec_train_v2 5400 python -m distributed_llm_training_and_inference_system_tpu.cli.main \
+    train launch --model gpt-750m --in-process --max-steps 1500 --no-resume \
+    --set data.train=experiments/artifacts/markov2 \
+    --set data.max_length=1024 \
+    --set optimizer.moment_dtype=bfloat16 \
+    --set optimizer.nu_dtype=bfloat16 \
+    --set parallel.micro_batch_size=8 \
+    --set parallel.global_batch_size=8 \
+    --set checkpoint.path=experiments/artifacts/spec750m_v2 \
+    --set checkpoint.interval_steps=1500 \
+    --set training.log_interval=100
+run spec_measure_v2 2400 env SPEC_PROMPTS=experiments/artifacts/markov2/prompts.json \
+    python experiments/spec_acceptance.py measure \
+    --ckpt experiments/artifacts/spec750m_v2 --model gpt-750m
+
+run adapt_diag_on 1200 python experiments/adapt_diag.py 2
+run adapt_diag_off 1200 python experiments/adapt_diag.py 0
+run adapt_diag_on2 1200 python experiments/adapt_diag.py 2
+run adapt_diag_off2 1200 python experiments/adapt_diag.py 0
+
+echo "battery15 complete; results in $OUT/"
