@@ -257,6 +257,13 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
             last_engine.append(eng)
             return eng
 
+        def engine_counters() -> dict:
+            es = last_engine[0].stats() if last_engine else {}
+            return {k: es.get(k) for k in
+                    ("short_dispatches", "decode_steps",
+                     "padded_slot_steps", "prefill_tokens",
+                     "preemptions", "decode_slot_utilization")}
+
         results["serve_load"] = {"admission": admission,
                                  "preemption": preemption,
                                  "open_loop": [], "closed_loop": []}
@@ -266,11 +273,7 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
                               max_tokens=gen_len, seed=0,
                               device_times=device_times)
             s = out.summary()
-            es = last_engine[0].stats() if last_engine else {}
-            s["engine"] = {k: es.get(k) for k in
-                           ("short_dispatches", "decode_steps",
-                            "padded_slot_steps", "prefill_tokens",
-                            "preemptions", "decode_slot_utilization")}
+            s["engine"] = engine_counters()
             results["serve_load"]["open_loop"].append(s)
         for c in [int(x) for x in str(concurrency).split(",") if x]:
             out = run_closed_loop(warmed_engine(), concurrency=c,
@@ -283,11 +286,7 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
             # engine counters for the sweep point (short dispatches,
             # decode steps, padded-slot waste, preemptions) — the
             # adaptive-dispatch A/B was undiagnosable without them
-            es = last_engine[0].stats() if last_engine else {}
-            s["engine"] = {k: es.get(k) for k in
-                           ("short_dispatches", "decode_steps",
-                            "padded_slot_steps", "prefill_tokens",
-                            "preemptions", "decode_slot_utilization")}
+            s["engine"] = engine_counters()
             results["serve_load"]["closed_loop"].append(s)
 
     click.echo(json.dumps(results, indent=2))

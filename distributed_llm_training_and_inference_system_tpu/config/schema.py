@@ -534,11 +534,14 @@ class ServeConfig:
     # prefill floor, BASELINE.md round 3). Splitting a dispatch is
     # bitwise-identical output (the scan is literally the same per-step
     # program). 0 disables; values >= K clamp to K-1 (never a silent
-    # no-op); K = 1 has nothing to shrink. DEFAULT OFF: measured +12-16%
-    # p99 TTFT at c<=2 but -15% goodput at c8 on the r3 chip (mechanism
-    # under investigation — CPU repro shows zero short dispatches at c8,
-    # so the cost is not the shortening itself); opt in for low-occupancy
-    # latency-sensitive deployments.
+    # no-op); K = 1 has nothing to shrink. DEFAULT OFF — round-4 verdict
+    # (BASELINE battery 9, n=3 interleaved): enabling costs 18%
+    # saturation goodput at 1B shapes with ZERO short dispatches firing
+    # (a side effect of the second compiled program, not the mechanism),
+    # and light-load 1B tails showed no replicable gain. The one measured
+    # win is LONG-dispatch-window models (gpt-7b: 326 ms windows —
+    # p50 161-172 ms and closed-loop p99 181 ms vs 182/214 off, battery
+    # 8); enable only there.
     latency_dispatch_steps: int = 0
     # pipelined decode: keep ONE un-fetched K-step dispatch in flight and
     # chain the next dispatch on its device-resident scan carry, so the

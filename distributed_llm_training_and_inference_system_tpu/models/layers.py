@@ -195,12 +195,20 @@ def _activate(x: jax.Array, activation: str) -> jax.Array:
     return jax.nn.relu(x)
 
 
-def mlp_block(x: jax.Array, layer: Params, cfg: ModelConfig) -> jax.Array:
-    """Gated FFN (SwiGLU for silu — reference llama-7b.json activation)."""
-    gate = jnp.einsum("bsh,hf->bsf", x, layer["gate"]["kernel"])
-    up = jnp.einsum("bsh,hf->bsf", x, layer["up"]["kernel"])
+def mlp_block(x: jax.Array, layer: Params, cfg: ModelConfig,
+              matmul=None) -> jax.Array:
+    """Gated FFN (SwiGLU for silu — reference llama-7b.json activation).
+
+    ``matmul(a, w)`` overrides the kernel contraction — the serving decode
+    path injects the in-kernel-dequant W4A16 Pallas matmul for
+    Quant4Tensor weights (serve/decode.py) without forking the FFN
+    semantics."""
+    if matmul is None:
+        matmul = lambda a, w: jnp.einsum("bsh,hf->bsf", a, w)
+    gate = matmul(x, layer["gate"]["kernel"])
+    up = matmul(x, layer["up"]["kernel"])
     h = _activate(gate, cfg.activation) * up
-    return jnp.einsum("bsf,fh->bsh", h, layer["down"]["kernel"]).astype(x.dtype)
+    return matmul(h, layer["down"]["kernel"]).astype(x.dtype)
 
 
 def moe_block(x: jax.Array, layer: Params, cfg: ModelConfig,

@@ -67,7 +67,7 @@ def _make_kernel(wdtype):
 @functools.partial(jax.jit, static_argnames=("group", "block_out",
                                              "interpret"))
 def matmul_w4(x: jax.Array, packed: jax.Array, scale: jax.Array,
-              chan: jax.Array, group: int = 128, block_out: int = 512,
+              chan: jax.Array, group: int = 128, block_out: int = 0,
               interpret: bool = False) -> jax.Array:
     """y = x @ dequant(packed, scale, chan) with in-kernel dequant.
 
@@ -81,6 +81,12 @@ def matmul_w4(x: jax.Array, packed: jax.Array, scale: jax.Array,
         raise ValueError(f"packed rows {packed.shape[-2]} != in/2")
     if n_in % group:
         raise ValueError(f"in={n_in} not divisible by group={group}")
+    if block_out == 0:
+        # largest standard tile dividing n_out (gpt-7b's FFN 11008 =
+        # 86*128 divides 256 but not 512 — a fixed 512 crashed the serve
+        # trace, round-4 review); fall back to the whole dim
+        block_out = next((b for b in (512, 256, 128)
+                          if n_out % b == 0), n_out)
     bo = min(block_out, n_out)
     if n_out % bo:
         raise ValueError(f"out={n_out} not divisible by block_out={bo}")

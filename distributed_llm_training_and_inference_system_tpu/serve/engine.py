@@ -1279,7 +1279,7 @@ class InferenceEngine:
                     self._apply_speculative(emitted, n_emit, decode_seq)
                     self.scheduler.step_finished(self.eos_token_id)
             elif (self.serve_cfg.pipelined_decode and not static
-                  and not use_short and not pending
+                  and not use_short and not admitted and not pending
                   and not self._partial_prefills
                   and 2 * int(self.active.sum())
                   >= self.serve_cfg.max_batch_size):

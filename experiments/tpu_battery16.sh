@@ -1,0 +1,38 @@
+#!/bin/bash
+# Round-4 battery 16: the in-kernel-dequant W4A16 serving path.
+# (a) numerics: matmul_w4 vs the XLA dequant reference ON THE CHIP
+#     (interpret-mode equivalence already holds; Mosaic lowering must
+#     agree too).
+# (b) decode throughput: the r3 battery-4 cell (gpt-1b, 4 slots, 512/128,
+#     K=8) with int4 weights now routed through the Pallas matmul —
+#     baseline on record: int4 24.8 tok/s vs bf16 104.2 / int8 110.7.
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-experiments/results_r4}
+mkdir -p "$OUT"
+source experiments/battery_lib.sh
+tpu_guard
+
+run w4_numerics 900 python - <<'EOF'
+import json
+import jax, jax.numpy as jnp
+from distributed_llm_training_and_inference_system_tpu.ops.int4_matmul_pallas import matmul_w4
+from distributed_llm_training_and_inference_system_tpu.ops.quantization import (
+    quantize_int4_groupwise, dequantize_int4_groupwise)
+for (n_in, n_out) in [(2048, 5632), (4096, 4096)]:
+    w = jax.random.normal(jax.random.PRNGKey(0), (n_in, n_out), jnp.float32) * 0.05
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, n_in), jnp.bfloat16)
+    act = jnp.abs(jax.random.normal(jax.random.PRNGKey(2), (n_in,))) + 0.5
+    p4, s4, c4 = quantize_int4_groupwise(w, group=128, act_scale=act)
+    wd = dequantize_int4_groupwise(p4, s4, c4, group=128)
+    ref = x.astype(jnp.float32) @ wd.astype(jnp.float32)
+    got = matmul_w4(x, p4, s4, c4, group=128)
+    rel = float(jnp.max(jnp.abs(got.astype(jnp.float32) - ref))
+                / (jnp.max(jnp.abs(ref)) + 1e-9))
+    print(json.dumps({"n_in": n_in, "n_out": n_out, "rel_err": round(rel, 5)}))
+    assert rel < 0.02, rel
+print("w4 numerics OK on", jax.default_backend())
+EOF
+
+run int4_serve_w4 1800 python experiments/int4_bench.py
+echo "battery16 complete; results in $OUT/"
