@@ -627,7 +627,9 @@ class ServePlanner:
     - decode is HBM-bandwidth-bound: step time = (weight bytes + KV bytes
       read for the resident batch) / membw / efficiency. Weight-only
       quantization divides the weight term (measured +23% decode at int8,
-      BASELINE.md r2); int8 KV halves the KV term.
+      BASELINE.md r2); int8 KV halves the KV term BUT multiplies the step
+      by a measured scatter/dequant overhead (1.18-1.63x by per-chip kv
+      heads — BASELINE r4 battery 8; see estimate()).
     - prefill is MXU-bound: 2*P*prompt_tokens FLOPs at ``mfu_prefill``
       (default 0.5, the measured train-side MFU — prefill is the same
       matmul mix).
@@ -714,6 +716,26 @@ class ServePlanner:
         kv_read = batch * context_len * (pb / max(page_size, 1))
         bw = hw.hbm_bw_gbps * 1e9 * self.decode_efficiency
         decode_s = (wb + kv_read) / max(bw, 1.0)
+        if kv_quant == "int8":
+            # int8 KV pages switch the page writes to the per-row scatter
+            # path and add in-kernel dequant — a program-structure cost,
+            # not a bytes cost, so the byte model alone predicts int8 KV
+            # always wins while the chip measures a LOSS. Whole-step
+            # multiplier anchored at the two measured single-chip points
+            # (BASELINE r3 battery 4 / r4 battery 8, ctx~640, b4-8):
+            # net ~-5% at Nkv/chip=16, ~-40% at Nkv/chip=32 => raw
+            # ~1.18x / ~1.63x after backing out the byte savings this
+            # model credits. Per-CHIP kv heads (the scatter/dequant work
+            # shards with tp), linear between anchors, floored at 1.0.
+            # Deliberately crude (two data points; extrapolation in
+            # batch/context is unvalidated) — like the rest of this
+            # model, it exists to rank configs, and without it the
+            # ranking steered 7B/MHA users into the measured 40% loss.
+            # At long contexts the halved KV traffic can still net a
+            # win — the capacity regime the feature exists for.
+            nkv_chip = m.num_kv_heads / tp
+            overhead = max(1.0, 1.18 + 0.45 * (nkv_chip - 16) / 16)
+            decode_s *= overhead
         # prefill: FLOPs-bound on this chip's share
         flops = 2.0 * m.param_count * prompt_len / tp
         prefill_s = flops / (hw.peak_bf16_tflops * 1e12 * self.mfu_prefill)

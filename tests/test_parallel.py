@@ -338,6 +338,17 @@ def test_serve_planner_prices_quant_and_capacity(tmp_path, monkeypatch):
     # int8 KV doubles capacity per byte (within scale overhead)
     kv8 = p.estimate(batch=8, kv_quant="int8")
     assert kv8.kv_pages > fp.kv_pages * 1.8
+    # ...but carries a measured step overhead (net -5% at Nkv=16,
+    # -40% at Nkv=32, BASELINE r4 battery 8): at 1b/long-ctx the byte
+    # savings may still win (the capacity regime), but the planner must
+    # NOT steer 7B/MHA users into int8 KV for throughput
+    assert kv8.decode_tok_s < fp.decode_tok_s * 1.1
+    cfg7b = get_model_config("gpt-7b")
+    p7 = ServePlanner(cfg7b, HardwareConfig())
+    f7 = p7.estimate(batch=8, context_len=640, quant="int8")
+    k7 = p7.estimate(batch=8, context_len=640, quant="int8",
+                     kv_quant="int8")
+    assert k7.decode_tok_s < 0.8 * f7.decode_tok_s
     # oversubscription flagged in the sweep
     rows = p.sweep(context_len=8192, batches=(256,))
     assert any(not r["fits"] and "KV pool" in r["reject_reason"]
