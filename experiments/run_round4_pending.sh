@@ -1,0 +1,26 @@
+#!/bin/bash
+# Round-4 pending measurements (written while the chip was wedged at
+# ~01:00 2026-08-01 — same stale-relay-claim symptom as the round-3
+# outage). Retries until the chip answers, then runs, in value order:
+#   battery14  pipelined-decode A/B (expected ~1.5-2x saturation goodput)
+#   battery16  w4 on-chip numerics + int4 serve A/B (vs recorded 24.8)
+#   battery15  MoE MFU b4/b2, spec-v2 train+measure, adapt diag,
+#              plan verify gpt-7b-4l
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-experiments/results_r4}
+mkdir -p "$OUT"
+
+for i in $(seq 1 200); do
+  if timeout 90 python -c "import jax, sys; sys.exit(0 if jax.default_backend()=='tpu' else 1)" 2>/dev/null; then
+    echo "chip answered (attempt $i) — running pending batteries"
+    bash experiments/tpu_battery14.sh "$OUT"
+    bash experiments/tpu_battery16.sh "$OUT"
+    bash experiments/tpu_battery15.sh "$OUT"
+    exit 0
+  fi
+  echo "attempt $i: chip still wedged; sleeping 7 min"
+  sleep 420
+done
+echo "chip never recovered; batteries 14-16 remain pending"
+exit 1
