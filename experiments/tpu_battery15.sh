@@ -40,6 +40,11 @@ run spec_measure_v2 2400 env SPEC_PROMPTS=experiments/artifacts/markov2/prompts.
     python experiments/spec_acceptance.py measure \
     --ckpt experiments/artifacts/spec750m_v2 --model gpt-750m
 
+# battery-12's plan verify OOM'd at the default b4 (fp32 state); b2
+run plan7b_verify_b2 2400 python -m distributed_llm_training_and_inference_system_tpu.cli.main \
+    plan verify --model gpt-7b-4l --batch 2 --seq-len 2048 \
+    --moment-dtype bfloat16
+
 run adapt_diag_on 1200 python experiments/adapt_diag.py 2
 run adapt_diag_off 1200 python experiments/adapt_diag.py 0
 run adapt_diag_on2 1200 python experiments/adapt_diag.py 2
