@@ -161,6 +161,32 @@ class TestPipelinedWithSpeculation:
         assert outs[0] == outs[1]
 
 
+class TestPipelinedComposition:
+    def test_pipelined_int8_artifact_prefix_cache(self, model_cfg, params,
+                                                  tmp_path):
+        """The round-4 stack composed: pre-quantized int8 artifact +
+        prefix caching + pipelined dispatch — tokens identical to the
+        plain unpipelined in-memory engine with in-process quant."""
+        from distributed_llm_training_and_inference_system_tpu.io.export import (
+            export_params)
+        art = export_params(params, tmp_path / "w8.safetensors",
+                            quant="int8")
+        sp = SamplingParams(temperature=0.0, max_tokens=16)
+        shared = [9, 8, 7, 6, 5, 4, 3, 2]
+        prompts = [shared + [i] for i in range(4)]   # shared prefix
+        ref_eng = make_engine(model_cfg, params, False,
+                              quantization="int8")
+        ref = _tokens(ref_eng.generate(prompts, sp))
+        eng = InferenceEngine(model_cfg, ServeConfig(
+            model="gpt-test", max_batch_size=4, max_seq_len=128,
+            prefill_chunk=32, kv_block_size=8, dtype="float32",
+            artifact=str(tmp_path / "w8.safetensors"),
+            prefix_caching=True, pipelined_decode=True), seed=0)
+        got = _tokens(eng.generate(prompts, sp))
+        assert got == ref
+        assert eng.serve_cfg.quantization == "int8"
+
+
 class TestPipelinedMachinery:
     def test_chain_actually_forms(self, model_cfg, params):
         """At full occupancy the engine must hold a pending dispatch."""
