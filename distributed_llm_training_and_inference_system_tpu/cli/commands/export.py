@@ -61,6 +61,14 @@ def convert(ckpt_dir, fmt, quant, model_name, calib_seq, out_path, step):
     meta = {"source_step": str(step or ckpt.latest_step())}
     if isinstance(extra, dict) and "config" in extra:
         meta["model"] = str(extra["config"].get("model", ""))
+        # architecture facts the serve loader must honor (a tied-embedding
+        # artifact served under an untied template would mis-project).
+        # _parse_bool, not bool(): a string-sourced "false" is truthy
+        tied = extra["config"].get("tie_word_embeddings")
+        if tied is not None:
+            from ...config.schema import _parse_bool
+            meta["tie_word_embeddings"] = str(
+                _parse_bool("checkpoint tie_word_embeddings", tied)).lower()
     def resolved_model_cfg(why: str):
         from ...config.presets import get_model_config
         from ...io.checkpoint import apply_ckpt_model_overrides
@@ -221,7 +229,8 @@ def synth(model_name, quant, seed, out_path):
         params["lm_head"] = {"kernel": dense(H, V)}
 
     meta = {"model": model_name, "synthetic": "random-init",
-            "seed": str(seed)}
+            "seed": str(seed),
+            "tie_word_embeddings": str(cfg.tie_word_embeddings).lower()}
     if quant != "none":
         meta["quant"] = quant
     path = export_params(params, out_path, fmt="safetensors", metadata=meta)

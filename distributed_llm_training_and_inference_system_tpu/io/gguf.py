@@ -22,11 +22,15 @@ metadata, tensor bytes.
 
 from __future__ import annotations
 
+import re
 import struct
 from pathlib import Path
 from typing import Any
 
 import numpy as np
+
+# byte-fallback vocab entries (sentencepiece / this framework's fallback)
+_BYTE_TOKEN = re.compile(r"<0x[0-9A-Fa-f]{2}>")
 
 GGUF_MAGIC = 0x46554747          # "GGUF" little-endian
 GGUF_VERSION = 3
@@ -301,24 +305,69 @@ def export_gguf(params: Any, model_cfg, out_path: str | Path,
         "llama.vocab_size": cfg.vocab_size,
     }
 
-    vocab = None
+    vocab = merges = None
+    specials: set[str] = set()
     if tokenizer_dir:
-        vocab = _hf_vocab(tokenizer_dir)
+        vocab, merges, specials = _hf_vocab(tokenizer_dir)
+        if vocab is not None and not merges:
+            # a vocab without BPE merges (WordPiece / Unigram tokenizer)
+            # cannot be represented as gguf's "gpt2" model — tagging it
+            # gpt2 anyway would export a file llama.cpp refuses at load.
+            # Fall back to the self-describing byte tokenizer and say so.
+            import logging
+            logging.getLogger(__name__).warning(
+                "%s/tokenizer.json has a vocab but no BPE merges; gguf "
+                "export falls back to the byte-level tokenizer (the "
+                "gpt2 vocab form requires merges)", tokenizer_dir)
+            vocab = None
     if vocab is None:
         # self-describing fallback: the framework's byte-level tokenizer
-        # (serve/tokenizer.py) — ids 0-255 are raw bytes
+        # (serve/tokenizer.py) — ids 0-255 are raw bytes. NOTE: the model
+        # name "llmctl-bytes" is not a vocab llama.cpp knows how to load;
+        # the container is spec-valid and self-describing, but third-party
+        # loaders need an HF ``tokenizer_dir`` export to run it.
         vocab = [f"<0x{i:02X}>" for i in range(256)]
         vocab += [f"<extra_{i}>" for i in range(256, cfg.vocab_size)]
         meta["tokenizer.ggml.model"] = "llmctl-bytes"
     else:
         meta["tokenizer.ggml.model"] = "gpt2"
-    meta["tokenizer.ggml.tokens"] = vocab[:cfg.vocab_size]
+        if len(vocab) < cfg.vocab_size:   # padded embedding rows
+            pad = [f"<extra_{i}>"
+                   for i in range(len(vocab), cfg.vocab_size)]
+            vocab = vocab + pad
+            specials |= set(pad)   # padding is never real text
+        # llama.cpp's gpt2/BPE loader requires the merge list to
+        # reconstruct the tokenizer; without it the file is refused
+        # (merges-less vocabs fell back to the byte tokenizer above)
+        meta["tokenizer.ggml.merges"] = merges
+    if meta["tokenizer.ggml.model"] == "llmctl-bytes":
+        # fallback vocab is self-generated: ids 0-255 are bytes, the
+        # <extra_i> rows are padding (never produced as text)
+        specials = {t for t in vocab if not _BYTE_TOKEN.fullmatch(t)}
+    vocab = vocab[:cfg.vocab_size]
+    meta["tokenizer.ggml.tokens"] = vocab
+    # token_type per llama.cpp llama_token_type: NORMAL=1, CONTROL=3,
+    # BYTE=6. CONTROL comes from the tokenizer's OWN special list
+    # (added_tokens[].special) — an angle-bracket string heuristic would
+    # silently drop ordinary tokens like '<br>' from detokenized output
+    # (loaders exclude CONTROL tokens). <0xNN> byte-fallback entries are
+    # BYTE, not CONTROL, for the same reason: a CONTROL tag would make
+    # every byte the model emits vanish from the text.
+    meta["tokenizer.ggml.token_type"] = [
+        3 if t in specials else 6 if _BYTE_TOKEN.fullmatch(t) else 1
+        for t in vocab]
 
     return write_gguf(out_path, meta, tensors, dtype=dtype)
 
 
-def _hf_vocab(tokenizer_dir: str) -> list[str] | None:
-    """Best-effort vocab list from a local HF tokenizer dir."""
+def _hf_vocab(tokenizer_dir: str) -> tuple[
+        list[str] | None, list[str] | None, set[str]]:
+    """Best-effort (vocab, merges, special tokens) from a local HF
+    tokenizer dir. Merges come back in gguf's "left right" string form
+    (newer tokenizer.json files store them as [left, right] pairs — both
+    accepted). Specials are the tokenizer's OWN declaration
+    (added_tokens[].special), the authoritative source for gguf's
+    CONTROL token_type."""
     import json
     d = Path(tokenizer_dir)
     for name in ("tokenizer.json",):
@@ -326,10 +375,22 @@ def _hf_vocab(tokenizer_dir: str) -> list[str] | None:
         if p.exists():
             try:
                 tok = json.loads(p.read_text())
-                vocab = tok.get("model", {}).get("vocab")
-                if isinstance(vocab, dict):
-                    inv = sorted(vocab.items(), key=lambda kv: kv[1])
-                    return [k for k, _ in inv]
+                model = tok.get("model", {})
+                vocab = model.get("vocab")
+                if not isinstance(vocab, dict):
+                    return None, None, set()
+                inv = sorted(vocab.items(), key=lambda kv: kv[1])
+                merges = []
+                for m in model.get("merges") or []:
+                    if isinstance(m, str):
+                        merges.append(m)
+                    elif isinstance(m, (list, tuple)) and len(m) == 2:
+                        merges.append(f"{m[0]} {m[1]}")
+                specials = {
+                    t.get("content") for t in tok.get("added_tokens") or []
+                    if isinstance(t, dict) and t.get("special")
+                    and isinstance(t.get("content"), str)}
+                return [k for k, _ in inv], merges or None, specials
             except (json.JSONDecodeError, OSError):
-                return None
-    return None
+                return None, None, set()
+    return None, None, set()

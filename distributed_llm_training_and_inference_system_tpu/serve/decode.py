@@ -42,6 +42,7 @@ def decode_step_forward(
     active: Any = None,       # [B] bool — inactive rows write scratch page
     attn_impl: str = "auto",
     write_mode: str = "paged",
+    w4_kernel_ok: bool = True,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Returns (logits [B, V] fp32, new k_pages, new v_pages).
 
@@ -54,7 +55,8 @@ def decode_step_forward(
     write_ok = None if active is None else active[:, None]
     logits, new_k, new_v = extend_step_forward(
         params, tokens[:, None], positions, k_pages, v_pages, block_tables,
-        cfg, write_ok=write_ok, attn_impl=attn_impl, write_mode=write_mode)
+        cfg, write_ok=write_ok, attn_impl=attn_impl, write_mode=write_mode,
+        w4_kernel_ok=w4_kernel_ok)
     return logits[:, 0], new_k, new_v
 
 
@@ -77,6 +79,11 @@ def extend_step_forward(
                               # engine reads LLMCTL_EXTEND_WRITE once at
                               # construction) — reading env HERE would
                               # bake a stale value into cached programs
+    w4_kernel_ok: bool = True,  # engine passes False under tensor-parallel:
+                              # like the Pallas attention kernel, the W4
+                              # matmul is a custom call GSPMD cannot
+                              # partition — tp>1 must take the dequant path
+                              # (same reason the engine forces attn gather)
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Paged forward over T tokens per slot: the multi-token sibling of
     ``decode_step_forward``. Returns (logits [B, T, V] fp32, k_pages, v_pages).
@@ -121,7 +128,7 @@ def extend_step_forward(
     # HBM (measured 2.5x bf16 traffic — int4 decoded 4x SLOWER than bf16,
     # BASELINE r3/r4), while the kernel streams packed nibbles at 4-bit
     # width (measured FASTER than bf16 at decode shapes, battery 13)
-    use_w4_kernel = jax.default_backend() == "tpu"
+    use_w4_kernel = w4_kernel_ok and jax.default_backend() == "tpu"
 
     def mm(a, w):
         from ..ops.quantization import Quant4Tensor
@@ -220,6 +227,7 @@ def decode_multi_step(
     num_steps: int,
     attn_impl: str = "auto",
     write_mode: str = "paged",
+    w4_kernel_ok: bool = True,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Run ``num_steps`` decode+sample iterations in ONE compiled program.
 
@@ -243,14 +251,14 @@ def decode_multi_step(
     (_, _, k_pages, v_pages), toks_seq = decode_scan(
         params, tokens, positions, k_pages, v_pages, block_tables,
         stop_positions, slot_keys, temperature, top_k, top_p, cfg,
-        num_steps, attn_impl, write_mode)
+        num_steps, attn_impl, write_mode, w4_kernel_ok)
     return toks_seq, k_pages, v_pages
 
 
 def decode_scan(params, tokens, positions, k_pages, v_pages, block_tables,
                 stop_positions, slot_keys, temperature, top_k, top_p,
                 cfg: ModelConfig, num_steps: int, attn_impl: str = "auto",
-                write_mode: str = "paged"):
+                write_mode: str = "paged", w4_kernel_ok: bool = True):
     """The decode+sample scan shared by ``decode_multi_step`` and the fused
     speculative dispatch (speculative.verify_and_decode). Returns
     ((tokens, positions, k_pages, v_pages), toks_seq [K, B])."""
@@ -261,7 +269,8 @@ def decode_scan(params, tokens, positions, k_pages, v_pages, block_tables,
         act = pos < stop_positions
         logits, kp, vp = decode_step_forward(
             params, toks, pos, kp, vp, block_tables, cfg, active=act,
-            attn_impl=attn_impl, write_mode=write_mode)
+            attn_impl=attn_impl, write_mode=write_mode,
+            w4_kernel_ok=w4_kernel_ok)
         keys = jax.vmap(jax.random.fold_in)(
             jax.vmap(jax.random.wrap_key_data)(slot_keys), pos + 1)
         nxt = sample_tokens(logits, keys, temperature, top_k, top_p)

@@ -60,12 +60,17 @@ class InferenceEngine:
         self.eos_token_id = eos_token_id
         dtype = jnp.dtype(serve_cfg.dtype)
 
+        # effective quantization: a pre-quantized artifact can supply the
+        # quant kind without the user asking for one. Tracked HERE (not by
+        # mutating the caller's ServeConfig — the config object belongs to
+        # the caller and may be reused for another engine).
+        self.quantization = serve_cfg.quantization
         if params is None:
             # the artifact may override architecture facts (e.g. an
             # HF-imported tied-embedding checkpoint under an untied
             # template) — the effective config comes back with the params
-            params, model_cfg = self._load_params(model_cfg, serve_cfg,
-                                                  seed, dtype)
+            params, model_cfg, self.quantization = self._load_params(
+                model_cfg, serve_cfg, seed, dtype)
         self.cfg = model_cfg
 
         from ..ops.quantization import _is_runtime_quant
@@ -78,7 +83,7 @@ class InferenceEngine:
             # 7B-class model needs on a 16 GB chip, where bf16 params +
             # a quantized copy cannot coexist during requantization
             logger.info("serving pre-quantized artifact weights (%s)",
-                        serve_cfg.quantization or "int8")
+                        self.quantization or "int8")
         elif serve_cfg.quantization == "int8":
             from ..ops.quantization import (quantize_tree_int8,
                                             to_runtime_quant)
@@ -118,6 +123,9 @@ class InferenceEngine:
         tp = serve_cfg.tensor_parallel
         self.mesh = None
         self._attn_impl = "auto"
+        # the W4 Pallas matmul is a custom call GSPMD cannot partition,
+        # same as the attention kernel — tp>1 takes the dequant path
+        self._w4_kernel_ok = tp <= 1
         page_sharding = None
         if tp > 1:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -250,7 +258,12 @@ class InferenceEngine:
     def _load_params(model_cfg, serve_cfg, seed, dtype):
         """Restore from the artifact checkpoint dir, else random init (the
         reference errors without an artifact; random init keeps bench/smoke
-        paths self-contained)."""
+        paths self-contained).
+
+        Returns (params, effective model_cfg, effective quantization).
+        The caller's ServeConfig is never mutated — a pre-quantized
+        artifact's quant kind is reported through the return value and
+        tracked on the engine."""
         art = serve_cfg.artifact
         if art and Path(art).is_file():
             # `llmctl export` artifact (safetensors/npz), possibly
@@ -272,8 +285,34 @@ class InferenceEngine:
                     "int8-awq exports are an interchange format; the serve "
                     "runtime consumes int8 / int4 / int4-awq artifacts "
                     "(the awq channel scaling is already folded for int4)")
-            if art_quant and not want:
-                serve_cfg.quantization = art_quant
+            # architecture facts recorded at export (or provable from the
+            # tree's structure) override the serving template — an artifact
+            # from a tied-embedding model must not silently serve under an
+            # untied config (and vice versa: the missing/extra lm_head
+            # would corrupt the output projection, not error)
+            import dataclasses
+
+            from ..config.schema import _parse_bool
+            tied_meta = meta.get("tie_word_embeddings")
+            if tied_meta is not None:
+                tied = _parse_bool("artifact tie_word_embeddings", tied_meta)
+                if tied != model_cfg.tie_word_embeddings:
+                    logger.warning(
+                        "artifact records tie_word_embeddings=%s; "
+                        "overriding serving template %r", tied,
+                        model_cfg.name)
+                    model_cfg = dataclasses.replace(
+                        model_cfg, tie_word_embeddings=tied)
+            has_head = isinstance(tree, dict) and "lm_head" in tree
+            if has_head == model_cfg.tie_word_embeddings:
+                # structural proof beats both metadata and template
+                logger.warning(
+                    "artifact %s lm_head — overriding "
+                    "tie_word_embeddings=%s on template %r",
+                    "has an" if has_head else "has no", not has_head,
+                    model_cfg.name)
+                model_cfg = dataclasses.replace(
+                    model_cfg, tie_word_embeddings=not has_head)
             params = to_runtime_quant(tree)
 
             def cast(x):
@@ -302,7 +341,7 @@ class InferenceEngine:
                                "serving as %r", meta["model"], model_cfg.name)
             logger.info("loaded exported artifact %s (quant=%s)", art,
                         art_quant or "none")
-            return params, model_cfg
+            return params, model_cfg, (art_quant or want)
         if art and Path(art).exists():
             from ..io.checkpoint import (CheckpointManager,
                                          apply_ckpt_model_overrides,
@@ -314,12 +353,13 @@ class InferenceEngine:
                 model_cfg = apply_ckpt_model_overrides(model_cfg, extra)
                 logger.info("loaded params from %s step %s", art,
                             ckpt.latest_step())
-                return jax.tree_util.tree_map(
-                    lambda a: jnp.asarray(a, dtype), params), model_cfg
+                return (jax.tree_util.tree_map(
+                    lambda a: jnp.asarray(a, dtype), params), model_cfg,
+                    serve_cfg.quantization)
         logger.warning("no artifact checkpoint found (%r): using random init",
                        art)
-        return gpt.init(model_cfg, jax.random.PRNGKey(seed),
-                        dtype=dtype), model_cfg
+        return (gpt.init(model_cfg, jax.random.PRNGKey(seed),
+                         dtype=dtype), model_cfg, serve_cfg.quantization)
 
     # -- prefill -------------------------------------------------------------
 
@@ -483,7 +523,8 @@ class InferenceEngine:
                 logits, k_pages, v_pages = extend_step_forward(
                     params, tokens, start, k_pages, v_pages, table, cfg,
                     write_ok=write_ok, attn_impl=self._attn_impl,
-                    write_mode=self._extend_write)
+                    write_mode=self._extend_write,
+                    w4_kernel_ok=self._w4_kernel_ok)
                 last = jnp.take_along_axis(
                     logits, (m - 1)[:, None, None], axis=1)[:, 0]   # [1, V]
                 token = sample_tokens(last, key[None], temp[None],
@@ -510,7 +551,8 @@ class InferenceEngine:
                 _, k_pages, v_pages = extend_step_forward(
                     params, tokens, start, k_pages, v_pages, table, cfg,
                     write_ok=write_ok, attn_impl=self._attn_impl,
-                    write_mode=self._extend_write)
+                    write_mode=self._extend_write,
+                    w4_kernel_ok=self._w4_kernel_ok)
                 return k_pages, v_pages
 
             self._prefill_cache[key_] = jax.jit(
@@ -756,7 +798,8 @@ class InferenceEngine:
         (toks, pos, k_pages, v_pages), toks_seq = decode_scan(
             params, tokens, positions, k_pages, v_pages, tables, stops,
             slot_keys, temp, top_k, top_p, self.cfg, num_steps,
-            attn_impl=self._attn_impl, write_mode=self._extend_write)
+            attn_impl=self._attn_impl, write_mode=self._extend_write,
+            w4_kernel_ok=self._w4_kernel_ok)
         return toks_seq, toks, pos, k_pages, v_pages
 
     def _short_dispatch_ok(self) -> bool:
@@ -898,7 +941,8 @@ class InferenceEngine:
             slot_keys, temp, top_k, top_p, self.cfg,
             num_decode_steps=max(
                 self.serve_cfg.decode_steps_per_dispatch - 1, 0),
-            attn_impl=self._attn_impl, write_mode=self._extend_write)
+            attn_impl=self._attn_impl, write_mode=self._extend_write,
+            w4_kernel_ok=self._w4_kernel_ok)
 
     def _spec_device(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         """One fused speculative dispatch: propose drafts on host (prompt-
@@ -1462,7 +1506,7 @@ class InferenceEngine:
         steps = max(self.total_decode_steps, 1)
         return {
             "weight_bytes": tree_weight_bytes(self.params),
-            "quantization": self.serve_cfg.quantization,
+            "quantization": self.quantization,
             **self.scheduler.stats(),
             "kv": self.kv.stats(),
             "admission": self.serve_cfg.admission,

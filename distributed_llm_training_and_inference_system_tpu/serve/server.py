@@ -382,9 +382,17 @@ class InferenceServer:
             return {}
         origin = request.headers.get("Origin", "")
         explicit = origins != "*"
+        # responses differ by Origin (ACAO present/absent/reflected) and,
+        # for preflights, by the reflected Allow-Headers — a shared cache
+        # must key on both or it can serve one origin's CORS grant (or a
+        # denied response's absence of one) to a different origin. The
+        # Vary header therefore goes on EVERY response in explicit mode,
+        # including denials and requests with no Origin at all.
+        # ("*" mode still reflects Allow-Headers, so it varies too)
+        vary = {"Vary": "Origin, Access-Control-Request-Headers"}
         if explicit and origin not in {
                 o.strip() for o in origins.split(",") if o.strip()}:
-            return {}
+            return vary
         headers = {
             "Access-Control-Allow-Origin":
                 (origin if explicit else "*") or "*",
@@ -392,6 +400,7 @@ class InferenceServer:
             "Access-Control-Allow-Headers":
                 request.headers.get(
                     "Access-Control-Request-Headers", "*") or "*",
+            **vary,
         }
         if explicit:
             headers["Access-Control-Allow-Credentials"] = "true"

@@ -48,7 +48,7 @@ def main() -> None:
         kv_hbm_budget_gb=4.0, admission="ondemand",
         dtype="bfloat16"), seed=0)
     print(json.dumps({"build_s": round(time.time() - t0, 1),
-                      "quant": eng.serve_cfg.quantization,
+                      "quant": eng.quantization,
                       "kv_pages": eng.kv.num_pages}), flush=True)
 
     wb = tree_weight_bytes(eng.params)

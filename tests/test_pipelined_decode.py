@@ -184,7 +184,7 @@ class TestPipelinedComposition:
             prefix_caching=True, pipelined_decode=True), seed=0)
         got = _tokens(eng.generate(prompts, sp))
         assert got == ref
-        assert eng.serve_cfg.quantization == "int8"
+        assert eng.quantization == "int8"
 
 
 class TestPipelinedMachinery:

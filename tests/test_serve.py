@@ -242,8 +242,14 @@ class TestHTTPServer:
         h = InferenceServer._cors_headers(fake, req("http://a.com"))
         assert h["Access-Control-Allow-Origin"] == "http://a.com"
         assert h["Access-Control-Allow-Credentials"] == "true"
-        assert InferenceServer._cors_headers(
-            fake, req("http://evil.com")) == {}
+        # responses vary by Origin — without this a shared cache could
+        # serve one origin's grant (or a denial) to a different origin,
+        # so even DENIED origins must carry Vary (and nothing else)
+        assert "Origin" in h["Vary"]
+        denied = InferenceServer._cors_headers(fake, req("http://evil.com"))
+        assert "Access-Control-Allow-Origin" not in denied
+        assert "Access-Control-Allow-Credentials" not in denied
+        assert "Origin" in denied["Vary"]
         fake.serve_cfg.cors_origins = ""
         assert InferenceServer._cors_headers(fake, req("http://a.com")) == {}
 
