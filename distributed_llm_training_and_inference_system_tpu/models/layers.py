@@ -215,10 +215,22 @@ def moe_block(x: jax.Array, layer: Params, cfg: ModelConfig,
               router_key: Optional[jax.Array] = None) -> tuple[jax.Array, jax.Array]:
     """Token-choice top-k MoE with GShard-style capacity dispatch.
 
-    Static shapes throughout (XLA requirement): tokens are dispatched into a
-    fixed per-expert capacity C via one-hot einsums; overflow tokens fall
-    back to the residual stream. Experts carry a leading E axis that the
-    mesh shards on 'ep' (SURVEY §2.2: EP absent from the reference).
+    Static shapes throughout (XLA requirement): tokens are dispatched into
+    a fixed per-expert capacity C; overflow tokens fall back to the
+    residual stream. Experts carry a leading E axis that the mesh shards
+    on 'ep' (SURVEY §2.2: EP absent from the reference).
+
+    Dispatch is SORT-based, not one-hot: the classic GShard one-hot
+    einsum builds [N, E, C] dispatch/combine tensors whose memory grows
+    ~quadratically in tokens (C itself is O(N/E)); at b8 x S4096 on
+    gpt-moe-test scales that tensor alone was ~5 GB *per layer* — the
+    measured 20.8 GB OOM of round 4 (battery 11, VERDICT r4 item 7).
+    Here choices are stably sorted by expert id, each expert gathers its
+    first C tokens from the sorted order, and outputs scatter-add back —
+    peak extra memory is the [E, C, H] expert buffers plus O(N*K) index
+    vectors, linear in tokens. The stable sort preserves the flattened
+    (token-major) choice order, so the set of dropped overflow tokens is
+    IDENTICAL to the one-hot formulation (asserted in tests).
 
     Returns (output, aux_loss).
     """
@@ -237,34 +249,49 @@ def moe_block(x: jax.Array, layer: Params, cfg: ModelConfig,
     top_p, top_e = jax.lax.top_k(probs, K)                       # [N,K]
     top_p = top_p / jnp.maximum(jnp.sum(top_p, axis=-1, keepdims=True), 1e-9)
 
-    # position of each (token, choice) in its expert's buffer
-    onehot = jax.nn.one_hot(top_e, E, dtype=jnp.int32)           # [N,K,E]
-    pos_in_expert = jnp.cumsum(onehot.reshape(N * K, E), axis=0) - onehot.reshape(N * K, E)
-    pos_in_expert = jnp.sum(pos_in_expert.reshape(N, K, E) * onehot, axis=-1)  # [N,K]
-    fits = pos_in_expert < C
+    flat_e = top_e.reshape(N * K)
+    flat_w = top_p.reshape(N * K)
+    flat_tok = jnp.repeat(jnp.arange(N, dtype=jnp.int32), K)     # [NK]
 
-    # dispatch tensor [N, E, C]
-    disp = (jax.nn.one_hot(top_e, E, dtype=x.dtype)[..., None]
-            * jax.nn.one_hot(jnp.where(fits, pos_in_expert, C), C + 1,
-                             dtype=x.dtype)[..., None, :-1])     # [N,K,E,C]
-    combine = disp * top_p[..., None, None].astype(x.dtype)      # weightings
-    disp = jnp.sum(disp, axis=1)                                  # [N,E,C]
-    combine = jnp.sum(combine, axis=1)                            # [N,E,C]
+    order = jnp.argsort(flat_e, stable=True)                     # [NK]
+    counts = jnp.bincount(flat_e, length=E)                      # [E]
+    starts = jnp.concatenate([jnp.zeros((1,), counts.dtype),
+                              jnp.cumsum(counts)[:-1]])          # [E]
+    # expert e's buffer slot c holds sorted choice starts[e] + c,
+    # valid while c < counts[e] (the rest of the buffer is padding)
+    c_idx = jnp.arange(C, dtype=counts.dtype)
+    gather_pos = jnp.minimum(starts[:, None] + c_idx[None, :],
+                             N * K - 1)                          # [E,C]
+    valid = c_idx[None, :] < counts[:, None]                     # [E,C]
+    choice = order[gather_pos]                                   # [E,C]
+    tok = flat_tok[choice]                                       # [E,C]
+    w = jnp.where(valid, flat_w[choice], 0.0).astype(x.dtype)    # [E,C]
 
-    xe = jnp.einsum("nec,nh->ech", disp, xt)                      # [E,C,H]
+    # gather each expert's tokens; padding rows are zeroed so invalid
+    # slots contribute nothing even before the w=0 combine
+    xe = xt[tok] * valid[..., None].astype(x.dtype)              # [E,C,H]
 
-    def expert_ffn(w, xe_):
-        g = jnp.einsum("ch,hf->cf", xe_, w["gate"])
-        u = jnp.einsum("ch,hf->cf", xe_, w["up"])
-        return jnp.einsum("cf,fh->ch", _activate(g, cfg.activation) * u, w["down"])
+    def expert_ffn(we, xe_):
+        g = jnp.einsum("ch,hf->cf", xe_, we["gate"])
+        u = jnp.einsum("ch,hf->cf", xe_, we["up"])
+        return jnp.einsum("cf,fh->ch", _activate(g, cfg.activation) * u,
+                          we["down"])
 
     he = jax.vmap(expert_ffn)(
         {"gate": layer["gate"]["kernel"], "up": layer["up"]["kernel"],
          "down": layer["down"]["kernel"]}, xe)                    # [E,C,H]
-    out = jnp.einsum("nec,ech->nh", combine, he).reshape(B, S, H)
 
-    # load-balancing aux loss (Switch-style): E * mean(f_e * p_e)
-    f = jnp.mean(jnp.sum(jax.nn.one_hot(top_e, E, dtype=jnp.float32), axis=1), axis=0)
+    # combine: scatter-add the weighted expert outputs back per token
+    # (a token's K choices land in different experts and accumulate)
+    out = jnp.zeros((N, H), x.dtype).at[tok.reshape(-1)].add(
+        (he * w[..., None]).reshape(E * C, H),
+        mode="drop", indices_are_sorted=False, unique_indices=False)
+    out = out.reshape(B, S, H)
+
+    # load-balancing aux loss (Switch-style): E * mean(f_e * p_e).
+    # f_e = fraction of choices routed to e — exactly counts/N, already
+    # computed for the dispatch (no [N, K, E] one-hot needed)
+    f = counts.astype(jnp.float32) / N
     p = jnp.mean(probs, axis=0)
     aux = E * jnp.sum(f * p) * cfg.moe.router_aux_loss_weight
     return out.astype(x.dtype), aux

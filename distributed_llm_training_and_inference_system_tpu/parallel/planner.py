@@ -200,6 +200,27 @@ class MeshPlanner:
             "full": 2 * b * s_local * h,
         }[par.activation_checkpoint]
         per_layer /= par.tensor_parallel
+        if m.is_moe:
+            # sort-based capacity dispatch (models/layers.py moe_block):
+            # the per-layer extras are the [E, C, H] expert input+output
+            # buffers (E*C = capacity_factor * K * tokens, independent of
+            # how E shards over ep) plus the [E*C, F] expert hidden.
+            # Residency follows the SAME remat semantics as the dense
+            # entries above: "none" saves everything, selective keeps the
+            # H-wide buffers but discards the FFN-width hidden, "full"
+            # recomputes it all (single-layer transient peak is not
+            # modeled, matching the dense policy). The pre-r5 one-hot
+            # [N, E, C] dispatch tensors — the measured 20.8 GB b8 OOM
+            # of battery 11 — no longer exist.
+            tokens = b * s_local
+            ec = m.moe.capacity_factor * m.moe.experts_per_token * tokens
+            moe_extra = {
+                "none": 2 * ec * h + ec * m.ffn_size / par.tensor_parallel,
+                "selective": 2 * ec * h,
+                "selective_attn": 2 * ec * h,
+                "full": 0.0,
+            }[par.activation_checkpoint]
+            per_layer += moe_extra
         boundary = 2 * b * s_local * h  # residual stream at block boundaries
         return (per_layer * layers_resident + boundary) * BYTES_BF16
 

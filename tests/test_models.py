@@ -129,6 +129,74 @@ def test_moe_forward_and_grads():
     assert float(jnp.sum(jnp.abs(r))) > 0
 
 
+def _moe_block_onehot_reference(x, layer, cfg):
+    """GShard one-hot einsum dispatch — the round-1..4 formulation, kept
+    as the numerical reference for the sort-based dispatch that replaced
+    it (the [N, E, C] one-hot tensors were the measured 20.8 GB MoE
+    training OOM; see models/layers.py moe_block docstring)."""
+    from distributed_llm_training_and_inference_system_tpu.models.layers import (
+        _activate)
+    B, S, H = x.shape
+    E = cfg.moe.num_experts
+    K = cfg.moe.experts_per_token
+    N = B * S
+    C = max(int(cfg.moe.capacity_factor * K * N / E), 1)
+
+    xt = x.reshape(N, H)
+    logits = jnp.einsum("nh,he->ne", xt.astype(jnp.float32),
+                        layer["router"]["kernel"].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_p, top_e = jax.lax.top_k(probs, K)
+    top_p = top_p / jnp.maximum(jnp.sum(top_p, axis=-1, keepdims=True), 1e-9)
+    onehot = jax.nn.one_hot(top_e, E, dtype=jnp.int32)
+    pos = jnp.cumsum(onehot.reshape(N * K, E), axis=0) - onehot.reshape(N * K, E)
+    pos = jnp.sum(pos.reshape(N, K, E) * onehot, axis=-1)
+    fits = pos < C
+    disp = (jax.nn.one_hot(top_e, E, dtype=x.dtype)[..., None]
+            * jax.nn.one_hot(jnp.where(fits, pos, C), C + 1,
+                             dtype=x.dtype)[..., None, :-1])
+    combine = disp * top_p[..., None, None].astype(x.dtype)
+    disp = jnp.sum(disp, axis=1)
+    combine = jnp.sum(combine, axis=1)
+    xe = jnp.einsum("nec,nh->ech", disp, xt)
+
+    def expert_ffn(w, xe_):
+        g = jnp.einsum("ch,hf->cf", xe_, w["gate"])
+        u = jnp.einsum("ch,hf->cf", xe_, w["up"])
+        return jnp.einsum("cf,fh->ch", _activate(g, cfg.activation) * u,
+                          w["down"])
+
+    he = jax.vmap(expert_ffn)(
+        {"gate": layer["gate"]["kernel"], "up": layer["up"]["kernel"],
+         "down": layer["down"]["kernel"]}, xe)
+    return jnp.einsum("nec,ech->nh", combine, he).reshape(B, S, H)
+
+
+@pytest.mark.parametrize("capacity_factor", [1.25, 0.35])
+def test_moe_sort_dispatch_matches_onehot(capacity_factor):
+    """The sort-based dispatch must be numerically identical to the
+    one-hot einsum formulation — INCLUDING which overflow tokens drop at
+    tight capacity (stable sort preserves the token-major choice order
+    the cumsum-based position assignment used)."""
+    import dataclasses
+
+    from distributed_llm_training_and_inference_system_tpu.models.layers import (
+        moe_block)
+    cfg = get_model_config("gpt-test-moe")
+    cfg = dataclasses.replace(
+        cfg, moe=dataclasses.replace(cfg.moe,
+                                     capacity_factor=capacity_factor))
+    params = init(cfg, jax.random.PRNGKey(0))
+    layer = jax.tree_util.tree_map(lambda p: p[0],
+                                   params["blocks"]["moe"])
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 16, cfg.hidden_size),
+                          jnp.float32)
+    got, _ = moe_block(x, layer, cfg)
+    want = _moe_block_onehot_reference(x, layer, cfg)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
 def test_remat_matches_baseline(cfg, params):
     tokens = jax.random.randint(jax.random.PRNGKey(9), (2, 16), 0, cfg.vocab_size)
     base = forward(params, tokens, cfg, remat="none")
