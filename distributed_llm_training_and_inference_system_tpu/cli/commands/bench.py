@@ -368,3 +368,161 @@ def dataloader(path, batch, seq_len, batches, prefetch, workers, step_ms):
     if step_ms > 0:
         out["step_ms_simulated"] = step_ms
     click.echo(json.dumps(out, indent=2))
+
+
+@app.command()
+@click.option("--spec", required=True, type=click.Path(exists=True),
+              help="Battery spec: TOML/JSON listing [[item]] entries with "
+                   "name, cmd, timeout (see docs/USER_GUIDE.md).")
+@click.option("--out", "out_dir", default="battery_results",
+              show_default=True, help="Per-item logs + manifest dir.")
+@click.option("--resume/--no-resume", default=True, show_default=True,
+              help="Skip items whose log already records rc=0.")
+@click.option("--wait-for-chip/--no-wait-for-chip", default=True,
+              show_default=True,
+              help="Probe until the TPU backend answers before each item "
+                   "(and re-probe after a failure — a wedged tunnel parks "
+                   "the battery instead of burning the remaining items).")
+@click.option("--probe-interval", default=420, show_default=True,
+              help="Seconds between chip probes while waiting.")
+@click.option("--max-probes", default=200, show_default=True,
+              help="Give up after this many failed probes.")
+@click.option("--guard/--no-guard", "tpu_guard", default=True,
+              show_default=True,
+              help="--no-guard runs items without requiring a TPU backend "
+                   "(CPU smoke tests of the battery machinery).")
+def battery(spec, out_dir, resume, wait_for_chip, probe_interval,
+            max_probes, tpu_guard):
+    """Run a config-listed measurement battery with per-item timeouts,
+    resume-from-partial, and chip-outage parking.
+
+    Promotes the round-4 pending-runner pattern (probe every few minutes
+    through a tunnel wedge, then run batteries in value order) from a
+    hand-written recovery script into the CLI: the next outage costs
+    waiting hours, not a rewrite. The reference has no bench runner at
+    all (its bench command is a stub, reference cli/commands/bench.py:
+    35-49); per-item timeouts follow this repo's bench.py watchdog — a
+    hung dispatch records a self-describing failure instead of hanging
+    the battery.
+    """
+    import shlex
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    spec_path = Path(spec)
+    if spec_path.suffix == ".json":
+        items_spec = json.loads(spec_path.read_text())
+    else:
+        import tomllib
+        items_spec = tomllib.loads(spec_path.read_text())
+    items = items_spec.get("item") or items_spec.get("items") or []
+    if not items:
+        raise click.ClickException(f"{spec}: no [[item]] entries")
+    for i, it in enumerate(items):
+        if not it.get("name") or not it.get("cmd"):
+            raise click.ClickException(
+                f"{spec}: item {i} needs 'name' and 'cmd'")
+
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    manifest_path = out / "battery_manifest.json"
+    manifest = {"spec": str(spec_path), "items": {}}
+    if resume and manifest_path.exists():
+        try:
+            manifest = json.loads(manifest_path.read_text())
+        except json.JSONDecodeError:
+            pass
+        if not isinstance(manifest, dict):
+            manifest = {"spec": str(spec_path)}
+        manifest.setdefault("items", {})
+
+    def probe_chip() -> bool:
+        """True when the ACTIVE backend is TPU. A wedged tunnel hangs
+        jax.devices() forever — the probe subprocess carries its own
+        timeout so the battery never inherits the hang."""
+        code = ("import sys, jax; "
+                "sys.exit(0 if jax.default_backend() == 'tpu' else 1)")
+        try:
+            return subprocess.run(
+                [sys.executable, "-c", code], timeout=90,
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL).returncode == 0
+        except subprocess.TimeoutExpired:
+            return False
+
+    def wait_chip() -> bool:
+        if not tpu_guard:
+            return True
+        for attempt in range(1, max_probes + 1):
+            if probe_chip():
+                return True
+            if not wait_for_chip or attempt == max_probes:
+                return False
+            click.echo(f"chip probe {attempt}/{max_probes} failed; "
+                       f"sleeping {probe_interval}s", err=True)
+            time.sleep(probe_interval)
+        return False
+
+    ran = skipped = failed = 0
+    parked = False
+    for it in items:
+        name = it["name"]
+        cmd = it["cmd"]
+        argv = shlex.split(cmd) if isinstance(cmd, str) else list(cmd)
+        prior = manifest["items"].get(name, {})
+        # resume keys on (name, cmd): an edited item is a DIFFERENT
+        # measurement — its stale rc=0 must not stand in for the new one
+        if resume and prior.get("rc") == 0 and prior.get("cmd") == argv:
+            click.echo(f"=== {name}: already done (rc=0), skipping ===")
+            skipped += 1
+            continue
+        if not wait_chip():
+            parked = True
+            click.echo(f"=== {name}: chip unavailable — battery parked "
+                       "(resume with the same command) ===", err=True)
+            break
+        timeout_s = float(it.get("timeout", 900))
+        log_path = out / f"{name}.log"
+        click.echo(f"=== {name} (timeout {timeout_s:.0f}s) ===")
+        t0 = time.time()
+        with open(log_path, "w") as log:
+            try:
+                rc = subprocess.run(argv, stdout=log,
+                                    stderr=subprocess.STDOUT,
+                                    timeout=timeout_s).returncode
+            except subprocess.TimeoutExpired:
+                rc = -9
+                log.write(f"\nbattery watchdog: item exceeded "
+                          f"{timeout_s:.0f}s and was killed\n")
+            except FileNotFoundError as e:
+                rc = 127
+                log.write(f"\n{e}\n")
+        dt = time.time() - t0
+        with open(log_path, "a") as log:
+            log.write(f"rc={rc}\n")
+        # bounded tail: a verbose 40-min item can write a huge log —
+        # don't load it all just to echo three lines
+        with open(log_path, "rb") as log:
+            log.seek(0, 2)
+            log.seek(max(log.tell() - 4096, 0))
+            tail = log.read().decode(errors="replace").splitlines()[-4:-1]
+        for line in tail:
+            click.echo(f"  {line}")
+        manifest["items"][name] = {"rc": rc, "seconds": round(dt, 1),
+                                   "cmd": argv, "log": str(log_path)}
+        manifest_path.write_text(json.dumps(manifest, indent=2))
+        if rc == 0:
+            ran += 1
+        else:
+            failed += 1
+            click.echo(f"  item {name} rc={rc}", err=True)
+    click.echo(json.dumps({"ran": ran, "skipped": skipped,
+                           "failed": failed, "parked": parked,
+                           "manifest": str(manifest_path)}))
+    if parked:
+        # distinct from item failure: nothing is wrong with the battery,
+        # the chip never answered — wrappers should retry, not give up
+        raise SystemExit(2)
+    if failed:
+        raise SystemExit(1)

@@ -223,3 +223,111 @@ class TestBenchAndHealth:
             "--output-dir", str(tmp_path / "tr")])
         assert "matmul: best=" in result.output
         assert (tmp_path / "tr" / "tuning_cache.json").exists()
+
+
+class TestBenchBattery:
+    """The config-listed battery runner (round-4 verdict #9): per-item
+    timeouts, resume-from-partial, outage parking — the pending-runner
+    pattern promoted from a hand-written recovery script into the CLI."""
+
+    def _spec(self, tmp_path, items):
+        lines = []
+        for it in items:
+            lines.append("[[item]]")
+            for k, v in it.items():
+                lines.append(f'{k} = {json.dumps(v)}')
+        p = tmp_path / "battery.toml"
+        p.write_text("\n".join(lines))
+        return str(p)
+
+    def test_runs_items_and_writes_manifest(self, runner, tmp_path):
+        spec = self._spec(tmp_path, [
+            {"name": "a", "cmd": "python -c \"print('hello-a')\""},
+            {"name": "b", "cmd": "python -c \"print('hello-b')\"",
+             "timeout": 60},
+        ])
+        out = tmp_path / "res"
+        result = invoke(runner, ["bench", "battery", "--spec", spec,
+                                 "--out", str(out), "--no-guard"])
+        man = json.loads((out / "battery_manifest.json").read_text())
+        assert man["items"]["a"]["rc"] == 0
+        assert man["items"]["b"]["rc"] == 0
+        assert "hello-a" in (out / "a.log").read_text()
+        assert '"ran": 2' in result.output
+
+    def test_resume_skips_done_and_reruns_failed(self, runner, tmp_path):
+        spec = self._spec(tmp_path, [
+            {"name": "ok", "cmd": "python -c \"print('fine')\""},
+            {"name": "bad", "cmd": "python -c \"import sys; sys.exit(3)\""},
+        ])
+        out = tmp_path / "res"
+        r1 = runner.invoke(cli, ["bench", "battery", "--spec", spec,
+                                 "--out", str(out), "--no-guard"],
+                           catch_exceptions=False)
+        assert r1.exit_code == 1      # failed item propagates
+        man = json.loads((out / "battery_manifest.json").read_text())
+        assert man["items"]["bad"]["rc"] == 3
+        # second run: 'ok' skipped, 'bad' retried
+        r2 = runner.invoke(cli, ["bench", "battery", "--spec", spec,
+                                 "--out", str(out), "--no-guard"],
+                           catch_exceptions=False)
+        assert "already done" in r2.output
+        assert '"skipped": 1' in r2.output
+
+    def test_watchdog_kills_hung_item(self, runner, tmp_path):
+        spec = self._spec(tmp_path, [
+            {"name": "hang", "cmd": "python -c \"import time; time.sleep(60)\"",
+             "timeout": 2},
+        ])
+        out = tmp_path / "res"
+        r = runner.invoke(cli, ["bench", "battery", "--spec", spec,
+                                "--out", str(out), "--no-guard"],
+                          catch_exceptions=False)
+        assert r.exit_code == 1
+        log = (out / "hang.log").read_text()
+        assert "battery watchdog" in log and "rc=-9" in log
+
+    def test_no_wait_parks_without_chip(self, runner, tmp_path, monkeypatch):
+        """With the guard on and no TPU, --no-wait-for-chip parks the
+        battery immediately instead of sleeping through probes."""
+        spec = self._spec(tmp_path, [
+            {"name": "never", "cmd": "python -c \"print('unreached')\""},
+        ])
+        out = tmp_path / "res"
+        import subprocess as sp
+        real_run = sp.run
+
+        def fake_run(argv, **kw):
+            if isinstance(argv, list) and "-c" in argv and \
+                    "default_backend" in argv[-1]:
+                class R:   # probe says: not a TPU
+                    returncode = 1
+                return R()
+            return real_run(argv, **kw)
+
+        monkeypatch.setattr(sp, "run", fake_run)
+        r = runner.invoke(cli, ["bench", "battery", "--spec", spec,
+                                "--out", str(out), "--no-wait-for-chip",
+                                "--max-probes", "1"],
+                          catch_exceptions=False)
+        assert "parked" in r.output
+        assert '"parked": true' in r.output
+        assert r.exit_code == 2       # distinct from item failure (1)
+        assert not (out / "never.log").exists()
+
+    def test_resume_reruns_edited_cmd(self, runner, tmp_path):
+        """Editing an item's cmd makes it a different measurement — the
+        stale rc=0 must not stand in for it."""
+        spec = self._spec(tmp_path, [
+            {"name": "m", "cmd": "python -c \"print('v1')\""},
+        ])
+        out = tmp_path / "res"
+        invoke(runner, ["bench", "battery", "--spec", spec,
+                        "--out", str(out), "--no-guard"])
+        spec = self._spec(tmp_path, [
+            {"name": "m", "cmd": "python -c \"print('v2')\""},
+        ])
+        r = invoke(runner, ["bench", "battery", "--spec", spec,
+                            "--out", str(out), "--no-guard"])
+        assert "already done" not in r.output
+        assert "v2" in (out / "m.log").read_text()
