@@ -1,0 +1,30 @@
+#!/bin/bash
+# Round-5 measurement chain. Waits for the chip (wedged since ~01:00
+# 2026-08-01, same stale-relay symptom as rounds 3/4 — both recovered),
+# then runs, in value order:
+#   battery14        pipelined-decode A/B + open-loop p99 re-measure
+#   battery16        w4 numerics + int4 serve A/B
+#   battery15        MoE MFU (pre-fix rows), spec v2, adapt diag, plan verify
+#   battery_r5.toml  7B-shape MFU accumulation rows + sort-dispatch MoE MFU
+#                    (via llmctl bench battery — resumable, watchdogged)
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-experiments/results_r5}
+mkdir -p "$OUT"
+
+for i in $(seq 1 200); do
+  if timeout 90 python -c "import jax, sys; sys.exit(0 if jax.default_backend()=='tpu' else 1)" 2>/dev/null; then
+    echo "chip answered (attempt $i) — running pending batteries"
+    bash experiments/tpu_battery14.sh "$OUT"
+    bash experiments/tpu_battery16.sh "$OUT"
+    bash experiments/tpu_battery15.sh "$OUT"
+    python -m distributed_llm_training_and_inference_system_tpu.cli.main \
+      bench battery --spec experiments/battery_r5.toml --out "$OUT"
+    echo "round-5 chain complete"
+    exit 0
+  fi
+  echo "attempt $i: chip still wedged; sleeping 7 min"
+  sleep 420
+done
+echo "chip never recovered; round-5 measurements remain pending"
+exit 1

@@ -120,6 +120,27 @@ def test_moe_ep_sharding(devices8):
     assert np.isfinite(float(m["loss"]))
 
 
+def test_moe_ep_loss_matches_single_device(devices8):
+    """The sort-based capacity dispatch under an ep-sharded mesh must
+    produce the SAME loss as the unsharded computation — the gather/
+    scatter dispatch compiles through GSPMD, and a partitioning bug
+    there would silently reroute tokens rather than error."""
+    model_cfg = get_model_config("gpt-test-moe")
+    tokens = jax.random.randint(jax.random.PRNGKey(7), (4, 16), 1,
+                                model_cfg.vocab_size)
+
+    def one_step_loss(par, devs):
+        tr = ShardedTrainer(model_cfg, OptimizerConfig(lr=1e-2), par,
+                            devices=devs)
+        tr.init_state(seed=0)
+        return float(tr.step({"tokens": tokens})["loss"])
+
+    ref = one_step_loss(ParallelConfig(), devices8[:1])
+    ep = one_step_loss(ParallelConfig(data_parallel=2, expert_parallel=4),
+                       devices8)
+    assert abs(ep - ref) < 5e-4, (ep, ref)
+
+
 def test_no_involuntary_remat(devices8):
     """The fsdp x sp x ep regime must compile without GSPMD's "Involuntary
     full rematerialization" warning on the token-embedding gather (round-1
