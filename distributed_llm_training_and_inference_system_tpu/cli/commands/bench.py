@@ -419,6 +419,16 @@ def battery(spec, out_dir, resume, wait_for_chip, probe_interval,
     items = items_spec.get("item") or items_spec.get("items") or []
     if not items:
         raise click.ClickException(f"{spec}: no [[item]] entries")
+    # spec-level [env] table: exported to every item's subprocess. The
+    # shell batteries source battery_lib.sh for JAX_COMPILATION_CACHE_DIR
+    # (7B programs compile ~6 min over the tunnel; cached rebuilds are
+    # seconds) — TOML batteries declare the same thing here.
+    import os as _os
+    spec_env = {str(k): str(v)
+                for k, v in (items_spec.get("env") or {}).items()}
+    item_env = None
+    if spec_env:
+        item_env = {**_os.environ, **spec_env}
     for i, it in enumerate(items):
         if not it.get("name") or not it.get("cmd"):
             raise click.ClickException(
@@ -490,6 +500,7 @@ def battery(spec, out_dir, resume, wait_for_chip, probe_interval,
             try:
                 rc = subprocess.run(argv, stdout=log,
                                     stderr=subprocess.STDOUT,
+                                    env=item_env,
                                     timeout=timeout_s).returncode
             except subprocess.TimeoutExpired:
                 rc = -9
@@ -499,8 +510,15 @@ def battery(spec, out_dir, resume, wait_for_chip, probe_interval,
                 rc = 127
                 log.write(f"\n{e}\n")
         dt = time.time() - t0
-        with open(log_path, "a") as log:
-            log.write(f"rc={rc}\n")
+        with open(log_path, "r+b") as log:
+            # a killed item's stdout can end mid-line — keep the rc
+            # marker on its own line so log parsers see it
+            log.seek(0, 2)
+            if log.tell() > 0:
+                log.seek(-1, 2)
+                if log.read(1) != b"\n":
+                    log.write(b"\n")
+            log.write(f"rc={rc}\n".encode())
         # bounded tail: a verbose 40-min item can write a huge log —
         # don't load it all just to echo three lines
         with open(log_path, "rb") as log:

@@ -1,0 +1,62 @@
+"""Tabulate battery logs: one row per <name>.log in a results dir.
+
+Each battery item's log ends with `rc=N`; the measurement itself is the
+LAST JSON object line the tool printed (bench e2e / mfu_sweep / bench.py
+all follow the one-JSON-line convention). Prints a compact table plus
+the raw JSON per row, ready to paste into BASELINE.md.
+
+Usage: python experiments/summarize_results.py [results_dir] [key ...]
+  key ... = JSON fields to show as columns (default: a serve/train mix)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+
+def last_json(text: str) -> dict | None:
+    obj = None
+    for line in text.splitlines():
+        line = line.strip()
+        if line.startswith("{") and line.endswith("}"):
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return obj
+
+
+def main() -> None:
+    out = Path(sys.argv[1] if len(sys.argv) > 1 else "experiments/results_r5")
+    keys = sys.argv[2:] or ["goodput_tok_s", "ttft_p50_ms", "ttft_p99_ms",
+                            "mfu", "tok_s", "step_ms"]
+    rows = []
+    for log in sorted(out.glob("*.log")):
+        text = log.read_text(errors="replace")
+        rc = None
+        for line in reversed(text.splitlines()):
+            if line.startswith("rc="):
+                rc = line[3:]
+                break
+        obj = last_json(text)
+        rows.append((log.stem, rc, obj))
+
+    namew = max((len(r[0]) for r in rows), default=4)
+    print(f"{'item'.ljust(namew)}  rc  " + "  ".join(keys))
+    for name, rc, obj in rows:
+        cells = []
+        for k in keys:
+            v = (obj or {}).get(k, "")
+            cells.append(f"{v:.4g}" if isinstance(v, float) else str(v))
+        print(f"{name.ljust(namew)}  {str(rc):>2}  " + "  ".join(cells))
+    print()
+    for name, rc, obj in rows:
+        if obj is not None:
+            print(f"--- {name} (rc={rc})")
+            print(json.dumps(obj))
+
+
+if __name__ == "__main__":
+    main()

@@ -230,8 +230,11 @@ class TestBenchBattery:
     timeouts, resume-from-partial, outage parking — the pending-runner
     pattern promoted from a hand-written recovery script into the CLI."""
 
-    def _spec(self, tmp_path, items):
+    def _spec(self, tmp_path, items, env=None):
         lines = []
+        if env:
+            lines.append("[env]")
+            lines += [f'{k} = {json.dumps(v)}' for k, v in env.items()]
         for it in items:
             lines.append("[[item]]")
             for k, v in it.items():
@@ -331,3 +334,14 @@ class TestBenchBattery:
                             "--out", str(out), "--no-guard"])
         assert "already done" not in r.output
         assert "v2" in (out / "m.log").read_text()
+
+    def test_spec_env_exported_to_items(self, runner, tmp_path):
+        spec = self._spec(tmp_path, [
+            {"name": "envcheck",
+             "cmd": "python -c "
+                    "\"import os; print(os.environ['BATTERY_TEST_ENV'])\""},
+        ], env={"BATTERY_TEST_ENV": "from-spec"})
+        out = tmp_path / "res"
+        invoke(runner, ["bench", "battery", "--spec", spec,
+                        "--out", str(out), "--no-guard"])
+        assert "from-spec" in (out / "envcheck.log").read_text()
