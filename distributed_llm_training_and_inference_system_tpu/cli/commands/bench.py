@@ -391,8 +391,11 @@ def dataloader(path, batch, seq_len, batches, prefetch, workers, step_ms):
               show_default=True,
               help="--no-guard runs items without requiring a TPU backend "
                    "(CPU smoke tests of the battery machinery).")
+@click.option("--dry-run", is_flag=True,
+              help="Parse and validate the spec, list the items and which "
+                   "would be skipped by --resume, run nothing.")
 def battery(spec, out_dir, resume, wait_for_chip, probe_interval,
-            max_probes, tpu_guard):
+            max_probes, tpu_guard, dry_run):
     """Run a config-listed measurement battery with per-item timeouts,
     resume-from-partial, and chip-outage parking.
 
@@ -429,13 +432,29 @@ def battery(spec, out_dir, resume, wait_for_chip, probe_interval,
     item_env = None
     if spec_env:
         item_env = {**_os.environ, **spec_env}
-    for i, it in enumerate(items):
+    def plan_item(i, it):
+        """Validated (argv, timeout_s, done-under-resume) for one item —
+        the ONE place the resume predicate lives, so --dry-run's preview
+        cannot drift from what the run loop actually skips."""
         if not it.get("name") or not it.get("cmd"):
             raise click.ClickException(
                 f"{spec}: item {i} needs 'name' and 'cmd'")
+        cmd = it["cmd"]
+        try:
+            argv = shlex.split(cmd) if isinstance(cmd, str) else \
+                [str(a) for a in cmd]
+            timeout_s = float(it.get("timeout", 900))
+        except ValueError as e:
+            raise click.ClickException(
+                f"{spec}: item {i} ({it['name']!r}): {e}")
+        prior = manifest["items"].get(it["name"], {})
+        # resume keys on (name, cmd): an edited item is a DIFFERENT
+        # measurement — its stale rc=0 must not stand in for the new one
+        done = (resume and prior.get("rc") == 0
+                and prior.get("cmd") == argv)
+        return argv, timeout_s, done
 
     out = Path(out_dir)
-    out.mkdir(parents=True, exist_ok=True)
     manifest_path = out / "battery_manifest.json"
     manifest = {"spec": str(spec_path), "items": {}}
     if resume and manifest_path.exists():
@@ -446,6 +465,19 @@ def battery(spec, out_dir, resume, wait_for_chip, probe_interval,
         if not isinstance(manifest, dict):
             manifest = {"spec": str(spec_path)}
         manifest.setdefault("items", {})
+
+    if dry_run:
+        # validate + preview only: no output dir, no subprocesses
+        for i, it in enumerate(items):
+            argv, timeout_s, done = plan_item(i, it)
+            click.echo(f"{'skip' if done else 'run '}  {it['name']}  "
+                       f"(timeout {timeout_s:.0f}s)  "
+                       f"{' '.join(argv[:6])}{' ...' if len(argv) > 6 else ''}")
+        if spec_env:
+            click.echo("env: " + ", ".join(f"{k}={v}"
+                                           for k, v in spec_env.items()))
+        return
+    out.mkdir(parents=True, exist_ok=True)
 
     def probe_chip() -> bool:
         """True when the ACTIVE backend is TPU. A wedged tunnel hangs
@@ -476,14 +508,12 @@ def battery(spec, out_dir, resume, wait_for_chip, probe_interval,
 
     ran = skipped = failed = 0
     parked = False
-    for it in items:
+    # validate the WHOLE spec before any item runs — a malformed item at
+    # position 9 must not surface after 8 items of chip time
+    plans = [plan_item(i, it) for i, it in enumerate(items)]
+    for it, (argv, timeout_s, done) in zip(items, plans):
         name = it["name"]
-        cmd = it["cmd"]
-        argv = shlex.split(cmd) if isinstance(cmd, str) else list(cmd)
-        prior = manifest["items"].get(name, {})
-        # resume keys on (name, cmd): an edited item is a DIFFERENT
-        # measurement — its stale rc=0 must not stand in for the new one
-        if resume and prior.get("rc") == 0 and prior.get("cmd") == argv:
+        if done:
             click.echo(f"=== {name}: already done (rc=0), skipping ===")
             skipped += 1
             continue
@@ -492,7 +522,6 @@ def battery(spec, out_dir, resume, wait_for_chip, probe_interval,
             click.echo(f"=== {name}: chip unavailable — battery parked "
                        "(resume with the same command) ===", err=True)
             break
-        timeout_s = float(it.get("timeout", 900))
         log_path = out / f"{name}.log"
         click.echo(f"=== {name} (timeout {timeout_s:.0f}s) ===")
         t0 = time.time()

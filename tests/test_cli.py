@@ -345,3 +345,13 @@ class TestBenchBattery:
         invoke(runner, ["bench", "battery", "--spec", spec,
                         "--out", str(out), "--no-guard"])
         assert "from-spec" in (out / "envcheck.log").read_text()
+
+    def test_dry_run_lists_without_running(self, runner, tmp_path):
+        spec = self._spec(tmp_path, [
+            {"name": "x", "cmd": "python -c \"print('nope')\""},
+        ])
+        out = tmp_path / "res"
+        r = invoke(runner, ["bench", "battery", "--spec", spec,
+                            "--out", str(out), "--no-guard", "--dry-run"])
+        assert "run " in r.output and "x" in r.output
+        assert not (out / "x.log").exists()

@@ -197,6 +197,37 @@ def test_moe_sort_dispatch_matches_onehot(capacity_factor):
                                atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
+def test_moe_learns_under_tight_capacity():
+    """Token dropping at capacity_factor=1.0 must not break learning —
+    the dropped-token residual fallback is the GShard/Switch semantics,
+    and a dispatch bug that misroutes (rather than drops) tokens shows
+    up here as a flat loss."""
+    import dataclasses
+
+    cfg = get_model_config("gpt-test-moe")
+    cfg = dataclasses.replace(
+        cfg, moe=dataclasses.replace(cfg.moe, capacity_factor=1.0))
+    params = init(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (8, 32), 0,
+                                cfg.vocab_size)
+
+    @jax.jit
+    def step(p):
+        def loss_fn(p):
+            lg, aux = forward(p, tokens, cfg, return_aux=True)
+            return next_token_loss(lg, tokens)[0] + aux
+        l, g = jax.value_and_grad(loss_fn)(p)
+        return l, jax.tree_util.tree_map(lambda w, gr: w - 0.05 * gr, p, g)
+
+    l0, params = step(params)
+    for _ in range(60):
+        loss, params = step(params)
+    # measured: 5.60 -> 3.94 over 60 steps on CPU; a misrouting bug
+    # leaves the loss near the 5.5 unigram floor
+    assert float(loss) < 0.8 * float(l0), (float(l0), float(loss))
+
+
 def test_remat_matches_baseline(cfg, params):
     tokens = jax.random.randint(jax.random.PRNGKey(9), (2, 16), 0, cfg.vocab_size)
     base = forward(params, tokens, cfg, remat="none")
