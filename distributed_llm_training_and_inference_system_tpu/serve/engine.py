@@ -1527,4 +1527,37 @@ class InferenceEngine:
             "spec_accepted": self.total_spec_accepted,
             "spec_acceptance": round(
                 self.total_spec_accepted / max(self.total_spec_drafts, 1), 4),
+            "compiled_programs": self.compiled_programs(),
+        }
+
+    def compiled_programs(self) -> dict:
+        """Resident compiled-program inventory by kind. Battery 9 measured
+        an 18% saturation-goodput loss from merely ENABLING the short-
+        dispatch program (zero short dispatches fired — the cost is a side
+        effect of the second resident decode executable, mechanism under
+        diagnosis in experiments/adapt_diag.py). Prefill buckets,
+        pipelining, and speculation all multiply resident executables the
+        same way, so the count is first-class observable state: a user
+        seeing an unexplained throughput delta can check whether the
+        program population changed before suspecting the schedule."""
+        # snapshot: the engine thread inserts new buckets lock-free while
+        # a stats request iterates — list() prevents "dict changed size"
+        keys = list(self._prefill_cache)
+        prefill_dense = sum(1 for k in keys if isinstance(k, int))
+        prefill_extend = sum(1 for k in keys
+                             if isinstance(k, tuple) and k[0] == "extend")
+        prefill_chunk = sum(1 for k in keys
+                            if isinstance(k, tuple) and k[0] == "chunk")
+        decode = int(self._decode_jit is not None)   # 0 after release()
+        decode_short = int(self._decode_jit_short is not None)
+        spec = int(self._spec_jit is not None)
+        return {
+            "prefill_dense_buckets": prefill_dense,
+            "prefill_extend_buckets": prefill_extend,
+            "prefill_chunk_buckets": prefill_chunk,
+            "decode": decode,
+            "decode_short": decode_short,
+            "speculative": spec,
+            "total": (prefill_dense + prefill_extend + prefill_chunk
+                      + decode + decode_short + spec),
         }

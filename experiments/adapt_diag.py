@@ -96,6 +96,7 @@ def main() -> None:
         },
         "compiles_in_run": len(run_compiles),
         "compile_msgs": run_compiles[:6],
+        "compiled_programs": eng.compiled_programs(),
     }), flush=True)
     eng.release()
 

@@ -673,3 +673,18 @@ def test_latency_adaptive_dispatch_identical_and_engaged(model_cfg):
     with eng5.lock:
         assert eng5.scheduler.active_count == 1
         assert not eng5._short_dispatch_ok()
+
+
+def test_compiled_program_inventory(model_cfg):
+    """stats()['compiled_programs'] tracks the resident executables per
+    kind — the observable the battery-9 second-executable deficit
+    investigation keys on."""
+    eng = make_engine(model_cfg, latency_dispatch_steps=2)
+    progs = eng.stats()["compiled_programs"]
+    assert progs["decode"] == 1 and progs["decode_short"] == 1
+    before = progs["total"]
+    eng.generate([[1, 2, 3]], SamplingParams(max_tokens=2, temperature=0.0))
+    progs2 = eng.stats()["compiled_programs"]
+    assert progs2["prefill_dense_buckets"] >= 1     # prefill compiled
+    assert progs2["total"] > before
+    eng.release()
