@@ -11,6 +11,15 @@ This probe runs the same c8 cell with per-request timestamps and
 JAX_LOG_COMPILES, A/B, printing: dispatch-count, wall histogram of
 engine.step() latencies, and any compile events inside the timed window.
 
+ROUND-5 NOTE: the engine's short-program warmup changed from "execute
+one scratch dispatch" (which donated + returned the live KV pages
+through the second executable) to a zero-dispatch AOT lower().compile().
+That scratch dispatch was a candidate mechanism for the battery-9
+deficit, so this A/B now discriminates: deficit GONE on the rerun =>
+the warmup execution was the cost (donation/layout churn on the page
+buffers); deficit PERSISTS => mere executable residency, and the next
+suspect is the axon runtime's per-program state.
+
 Usage: python experiments/adapt_diag.py [L] (0 = off)
 """
 

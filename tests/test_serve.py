@@ -688,3 +688,29 @@ def test_compiled_program_inventory(model_cfg):
     assert progs2["prefill_dense_buckets"] >= 1     # prefill compiled
     assert progs2["total"] > before
     eng.release()
+
+
+def test_short_dispatch_fires_and_matches_plain(model_cfg):
+    """Short dispatches through the AOT-compiled program (round-5 warmup
+    is lower().compile(), never a scratch dispatch) must produce greedy
+    output bitwise-identical to the adaptive-off engine.
+
+    The organic trigger is an arrival landing between a step's admission
+    phase and its dispatch — a thread race generate() cannot reproduce
+    deterministically — so the decision hook is forced: EVERY dispatch
+    runs the short program, the strictest version of the splitting-
+    preserves-output property."""
+    prompts = [[5, 17, 99, 3], [1, 2, 3, 4, 5], [200, 100, 7],
+               [42, 43, 44, 45, 46, 47]]
+    sp = SamplingParams(temperature=0.0, max_tokens=10)
+
+    ref_eng = make_engine(model_cfg, max_batch_size=2)
+    ref = [r.generated_tokens for r in ref_eng.generate(prompts, sp)]
+
+    eng = make_engine(model_cfg, max_batch_size=2,
+                      latency_dispatch_steps=2)
+    eng._short_dispatch_ok = lambda: True
+    got = [r.generated_tokens for r in eng.generate(prompts, sp)]
+    assert got == ref
+    assert eng.total_short_dispatches > 0
+    assert eng.stats()["compiled_programs"]["decode_short"] == 1
