@@ -17,15 +17,39 @@ from pathlib import Path
 
 
 def last_json(text: str) -> dict | None:
+    """Last JSON object in the log — single-line (bench.py/mfu_sweep
+    convention) or pretty-printed (bench e2e serve-load)."""
+    dec = json.JSONDecoder()
     obj = None
-    for line in text.splitlines():
-        line = line.strip()
-        if line.startswith("{") and line.endswith("}"):
-            try:
-                obj = json.loads(line)
-            except json.JSONDecodeError:
-                continue
+    i = text.find("{")
+    while i != -1:
+        try:
+            parsed, end = dec.raw_decode(text, i)
+            if isinstance(parsed, dict):
+                obj = parsed
+            i = text.find("{", max(end, i + 1))
+        except json.JSONDecodeError:
+            i = text.find("{", i + 1)
     return obj
+
+
+def find_key(obj, key):
+    """Depth-first lookup so nested serve-load keys (serve_load →
+    closed_loop[n] → goodput_tok_s) surface as table cells; lists are
+    searched back-to-front so the last (highest-load) row wins."""
+    if isinstance(obj, dict):
+        if key in obj:
+            return obj[key]
+        for v in obj.values():
+            r = find_key(v, key)
+            if r is not None:
+                return r
+    elif isinstance(obj, list):
+        for v in reversed(obj):
+            r = find_key(v, key)
+            if r is not None:
+                return r
+    return None
 
 
 def main() -> None:
@@ -48,7 +72,8 @@ def main() -> None:
     for name, rc, obj in rows:
         cells = []
         for k in keys:
-            v = (obj or {}).get(k, "")
+            v = find_key(obj or {}, k)
+            v = "" if v is None else v
             cells.append(f"{v:.4g}" if isinstance(v, float) else str(v))
         print(f"{name.ljust(namew)}  {str(rc):>2}  " + "  ".join(cells))
     print()

@@ -1,0 +1,57 @@
+#!/bin/bash
+# Round-5 battery 14b: re-run the 7B pipelined cells with an explicit
+# unpipelined CONTROL first.
+#
+# Why: battery 14's three 7B rows all RESOURCE_EXHAUSTED at the warmup
+# prefill — *before any decode dispatch*, so before pipelining can hold
+# anything extra — minutes after the chip recovered from its 12 h wedge.
+# The same cell (gpt-7b int8 artifact, 96 pages, c8) ran clean in
+# battery 8. Discriminator:
+#   control OOM too  => chip-side residual claim / regression since
+#                       battery 8 unrelated to --pipelined
+#   control passes,
+#   pipelined OOMs   => pipelining genuinely adds resident HBM at 7B;
+#                       fall through the page ladder (96 -> 72 -> 56)
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-experiments/results_r5}
+mkdir -p "$OUT"
+source experiments/battery_lib.sh
+
+ART=experiments/artifacts/gpt7b-int8.safetensors
+[ -f "$ART" ] || { echo "missing $ART"; exit 1; }
+
+# control: battery-8 cell verbatim (no --pipelined). Expected ~95.8 tok/s.
+run pipe7b_control_c8 3600 python -m distributed_llm_training_and_inference_system_tpu.cli.main \
+    bench e2e --model gpt-7b --mode serve-load --artifact "$ART" \
+    --requests 24 --prompt-len 512 --gen-len 128 \
+    --rps "" --concurrency 8 --admission ondemand --kv-blocks 96
+
+# pipelined at the same cell, then down the page ladder only on OOM.
+run pipe7b_on_c8 3600 python -m distributed_llm_training_and_inference_system_tpu.cli.main \
+    bench e2e --model gpt-7b --mode serve-load --artifact "$ART" \
+    --requests 24 --prompt-len 512 --gen-len 128 \
+    --rps "" --concurrency 8 --admission ondemand --kv-blocks 96 --pipelined
+if grep -q "RESOURCE_EXHAUSTED\|Ran out of memory" "$OUT/pipe7b_on_c8.log"; then
+  run pipe7b_on_c8_72p 3600 python -m distributed_llm_training_and_inference_system_tpu.cli.main \
+      bench e2e --model gpt-7b --mode serve-load --artifact "$ART" \
+      --requests 24 --prompt-len 512 --gen-len 128 \
+      --rps "" --concurrency 8 --admission ondemand --kv-blocks 72 --pipelined
+fi
+if [ -f "$OUT/pipe7b_on_c8_72p.log" ] && \
+   grep -q "RESOURCE_EXHAUSTED\|Ran out of memory" "$OUT/pipe7b_on_c8_72p.log"; then
+  run pipe7b_on_c8_56p 3600 python -m distributed_llm_training_and_inference_system_tpu.cli.main \
+      bench e2e --model gpt-7b --mode serve-load --artifact "$ART" \
+      --requests 24 --prompt-len 512 --gen-len 128 \
+      --rps "" --concurrency 8 --admission ondemand --kv-blocks 56 --pipelined
+fi
+
+# light-load gate sanity (battery-14 row), only if the saturation cell ran
+if ! grep -q "RESOURCE_EXHAUSTED\|Ran out of memory" "$OUT/pipe7b_on_c8.log"; then
+  run pipe7b_gate 3600 python -m distributed_llm_training_and_inference_system_tpu.cli.main \
+      bench e2e --model gpt-7b --mode serve-load --artifact "$ART" \
+      --requests 16 --prompt-len 512 --gen-len 64 \
+      --rps 0.25 --concurrency 1 --admission ondemand --kv-blocks 96 --pipelined
+fi
+
+echo "battery14b complete; results in $OUT/"
