@@ -132,10 +132,15 @@ def kernels(op, seq_len, hidden, heads, batch):
               show_default=True,
               help="serve-load: pipelined decode dispatch (one un-fetched "
                    "dispatch in flight, chained on the device carry).")
+@click.option("--int8-pallas/--no-int8-pallas", "int8_pallas",
+              default=False, show_default=True,
+              help="serve-load: route int8 decode matmuls through the "
+                   "in-kernel-dequant Pallas kernel (A/B vs XLA's fused "
+                   "dequant; see ServeConfig.int8_pallas_matmul).")
 def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
         requests, rps, concurrency, admission, kv_blocks, device_times,
         preemption, latency_dispatch_steps, artifact, quant, kv_quant,
-        slots, pipelined):
+        slots, pipelined, int8_pallas):
     """End-to-end train step throughput / serve TTFT+throughput
     (parity: reference bench.py:35-49). ``serve-load`` runs open-loop
     (Poisson) and closed-loop sweeps with p50/p99 TTFT, per-token latency,
@@ -224,6 +229,7 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
                 admission=admission, preemption=preemption,
                 latency_dispatch_steps=latency_dispatch_steps,
                 pipelined_decode=pipelined,
+                int8_pallas_matmul=int8_pallas,
                 artifact=artifact, quantization=quant,
                 kv_quantization=kv_quant,
                 dtype="bfloat16" if on_tpu else "float32"))

@@ -87,6 +87,11 @@ def app(ctx):
                    "chain the next on its device carry (overlaps the "
                    "per-dispatch host round trip; engages at >= half-full "
                    "batches; bitwise-identical output).")
+@click.option("--int8-pallas/--no-int8-pallas", "int8_pallas",
+              default=False, show_default=True,
+              help="Route int8 decode matmuls through the in-kernel-"
+                   "dequant Pallas kernel instead of XLA's fused dequant "
+                   "(enable only where measured faster on your chip).")
 @click.option("--cors-origins", default="*", show_default=True,
               help="CORS allowed origins for browser clients: '*', a "
                    "comma-separated list, or '' to disable (parity: the "
@@ -96,7 +101,7 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
           speculative, spec_tokens, prefix_cache, tensor_parallel,
           quantization, chunked_prefill, kv_quantization, admission,
           preemption, latency_dispatch_steps, pipelined_decode,
-          cors_origins):
+          int8_pallas, cors_origins):
     """Start the OpenAI-compatible inference server."""
     import jax
 
@@ -121,6 +126,7 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
         preemption=preemption,
         latency_dispatch_steps=latency_dispatch_steps,
         pipelined_decode=pipelined_decode,
+        int8_pallas_matmul=int8_pallas,
         cors_origins=cors_origins)
     serve_cfg.validate()
 

@@ -603,6 +603,15 @@ class ServeConfig:
     # least memory). Composes with tensor_parallel (param_specs shards
     # the quantized leaves like the kernels they replace).
     quantization: str = "none"      # none | int8 | int4 | int4-awq
+    # route int8 decode matmuls through the in-kernel-dequant Pallas
+    # kernel (ops.int8_matmul_pallas) instead of XLA's fused dequant.
+    # DEFAULT OFF: unlike int4 (whose unpack chain defeats XLA fusion —
+    # the Pallas kernel is a measured 12x win, battery 13), int8 dequant
+    # DOES fuse (int8-xla streamed 384 GB/s vs bf16's 555 in the same
+    # battery), so the kernel must beat fused-XLA on chip before it can
+    # default on. Single-device only (Pallas is opaque to GSPMD — the
+    # tp>1 engine forces the dequant path like it does for attention).
+    int8_pallas_matmul: bool = False
     # int8 KV cache: pages stored int8 with per-token absmax scales (~3%
     # overhead at D=128) — 2x KV capacity per HBM byte and half the
     # decode-attention KV streaming. Dequant happens in VMEM inside the

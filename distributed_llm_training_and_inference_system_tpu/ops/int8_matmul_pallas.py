@@ -1,0 +1,102 @@
+"""Pallas TPU kernel: W8A16 matmul with IN-KERNEL dequantization.
+
+Status: OPT-IN A/B candidate (ServeConfig.int8_pallas_matmul), not the
+default int8 route. Unlike int4 — whose XLA unpack chain defeats
+dequant-into-matmul fusion and made the Pallas kernel a measured 12x
+win (battery 13) — the plain int8 dequant DOES fuse at the isolated
+matmul level: int8-xla streamed 384 GB/s effective vs bf16's 555 in
+the same battery, and int8 serving beat bf16 by 6-23% at gpt-1b
+(BASELINE.md). This kernel exists because the fused rate is still 30%
+below the bf16 stream rate and the gpt-7b decode step (40.8 ms vs an
+8.9 ms int8 floor, battery 8) leaves room that per-shape measurement
+must attribute: if the kernel beats int8-xla at decode shapes on a
+given chip (experiments/int4_kernel_bench.py, variant "int8-pallas"),
+flip the config flag; if not, the default already does the right
+thing. It streams int8 HBM->VMEM at 1-byte width and converts to bf16
+in registers, so weight traffic is the int8 bytes alone.
+
+Layout contract (ops.quantization.quantize_int8 with the default
+axis=-1 over a [in, out] kernel): values int8 [in, out], scale fp32
+[in, 1] — one scale per INPUT row. Because the scale multiplies rows
+of W, it folds into the ACTIVATIONS once per call (x * scale), exactly
+like the W4 kernel's AWQ channel statistic: the kernel itself is a
+pure convert-and-dot, no per-tile scale arithmetic.
+
+Constraints: out % block_out == 0 (block_out auto-picks a standard
+tile). The whole reduction dim is resident per out-tile; the auto
+block_out caps the int8 tile at ~2 MB so the converted bf16 tile plus
+Mosaic's double buffering stay inside VMEM at gpt-7b shapes
+(in=11008 -> block_out 128). CPU fallback/interpret mode for tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _make_kernel(wdtype):
+    # wdtype: bf16 on TPU; f32 under interpret (the XLA:CPU dot thunk
+    # lacks bf16 x bf16 -> f32, same workaround as the W4 kernel)
+    def _kernel(x_ref, w_ref, out_ref):
+        w = w_ref[:].astype(wdtype)                    # int8 -> compute
+        out_ref[:] = jnp.dot(x_ref[:], w,
+                             preferred_element_type=jnp.float32)
+    return _kernel
+
+
+@functools.partial(jax.jit, static_argnames=("block_out", "interpret"))
+def matmul_w8(x: jax.Array, values: jax.Array, scale: jax.Array,
+              block_out: int = 0, interpret: bool = False) -> jax.Array:
+    """y = x @ (values * scale) with in-kernel int8->bf16 dequant.
+
+    x [B, in] (any float dtype; compute is bf16 x bf16 -> f32),
+    values int8 [in, out], scale fp32 [in, 1] (quantize_int8 axis=-1
+    layout; [in] also accepted). Returns [B, out] in x.dtype. B is
+    padded to 8 MXU sublanes.
+    """
+    B, n_in = x.shape
+    if values.shape[-2] != n_in:
+        raise ValueError(f"values rows {values.shape[-2]} != in={n_in}")
+    n_out = values.shape[-1]
+    if block_out == 0:
+        # largest standard tile whose int8 block stays <= ~2 MB: the
+        # converted bf16 tile is 2x the int8 bytes and Mosaic double-
+        # buffers the streamed input, so bigger tiles blow VMEM at the
+        # gpt-7b FFN shapes (in=11008). When even 128 exceeds the budget
+        # (n_in > 16K) 128 is still the least-bad dividing tile — the
+        # whole-dim fallback would be the LARGEST tile exactly when VMEM
+        # is tightest; it stays reserved for tiny no-128-divisor outputs
+        budget = 2 * 2**20
+        block_out = next((b for b in (512, 256, 128)
+                          if n_out % b == 0 and n_in * b <= budget),
+                         128 if n_out % 128 == 0 else n_out)
+    bo = min(block_out, n_out)
+    if n_out % bo:
+        raise ValueError(f"out={n_out} not divisible by block_out={bo}")
+
+    wdtype = jnp.float32 if interpret else jnp.bfloat16
+    # per-input-row scale folds into the activations (see module doc);
+    # bf16 round-trip either way so interpret numerics track the TPU path
+    s = scale.reshape(-1) if scale.ndim > 1 else scale
+    xf = (x.astype(jnp.float32) * s.astype(jnp.float32))
+    xf = xf.astype(jnp.bfloat16).astype(wdtype)
+    Bp = ((B + 7) // 8) * 8            # every batch to a sublane multiple
+    if Bp != B:
+        xf = jnp.pad(xf, ((0, Bp - B), (0, 0)))
+
+    out = pl.pallas_call(
+        _make_kernel(wdtype),
+        grid=(n_out // bo,),
+        in_specs=[
+            pl.BlockSpec((Bp, n_in), lambda i: (0, 0)),
+            pl.BlockSpec((n_in, bo), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((Bp, bo), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((Bp, n_out), jnp.float32),
+        interpret=interpret,
+    )(xf, values)
+    return out[:B].astype(x.dtype)

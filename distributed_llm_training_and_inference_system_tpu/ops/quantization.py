@@ -229,7 +229,8 @@ def _is_runtime_quant(x: Any) -> bool:
     return isinstance(x, (QuantTensor, Quant4Tensor))
 
 
-def cast_params(tree: Any, dtype, keep_w4: bool = False) -> Any:
+def cast_params(tree: Any, dtype, keep_w4: bool = False,
+                keep_w8: bool = False) -> Any:
     """Cast a (possibly mixed plain/Quant[4]Tensor) param tree for compute:
     plain leaves are cast; quantized leaves are DEQUANTIZED. Call this
     per layer inside the scan body so only one layer's bf16 weights are
@@ -238,9 +239,14 @@ def cast_params(tree: Any, dtype, keep_w4: bool = False) -> Any:
     ``keep_w4=True`` passes Quant4Tensor leaves through UN-dequantized —
     for consumers routing them into the in-kernel-dequant Pallas matmul
     (ops.int4_matmul_pallas), where the XLA dequant chain's 2.5x-bf16 HBM
-    round trip (the round-3/4 measured int4 slowdown) never happens."""
+    round trip (the round-3/4 measured int4 slowdown) never happens.
+    ``keep_w8=True`` is the int8 counterpart (ops.int8_matmul_pallas,
+    the same ~5x-int8-bytes dequant round trip measured as gpt-7b's
+    40.8 ms decode step, battery 8)."""
     def one(x):
         if isinstance(x, Quant4Tensor) and keep_w4:
+            return x
+        if isinstance(x, QuantTensor) and keep_w8:
             return x
         if _is_runtime_quant(x):
             return x.dequant(dtype)

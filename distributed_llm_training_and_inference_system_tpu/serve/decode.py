@@ -43,6 +43,7 @@ def decode_step_forward(
     attn_impl: str = "auto",
     write_mode: str = "paged",
     w4_kernel_ok: bool = True,
+    w8_kernel_ok: bool = False,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Returns (logits [B, V] fp32, new k_pages, new v_pages).
 
@@ -56,7 +57,7 @@ def decode_step_forward(
     logits, new_k, new_v = extend_step_forward(
         params, tokens[:, None], positions, k_pages, v_pages, block_tables,
         cfg, write_ok=write_ok, attn_impl=attn_impl, write_mode=write_mode,
-        w4_kernel_ok=w4_kernel_ok)
+        w4_kernel_ok=w4_kernel_ok, w8_kernel_ok=w8_kernel_ok)
     return logits[:, 0], new_k, new_v
 
 
@@ -84,6 +85,9 @@ def extend_step_forward(
                               # matmul is a custom call GSPMD cannot
                               # partition — tp>1 must take the dequant path
                               # (same reason the engine forces attn gather)
+    w8_kernel_ok: bool = False,  # OPT-IN (ServeConfig.int8_pallas_matmul):
+                              # int8 dequant fuses in XLA, so the Pallas
+                              # route needs a measured per-chip win first
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Paged forward over T tokens per slot: the multi-token sibling of
     ``decode_step_forward``. Returns (logits [B, T, V] fp32, k_pages, v_pages).
@@ -127,11 +131,30 @@ def extend_step_forward(
     # TPU: the XLA dequant chain round-trips the full bf16 tensor through
     # HBM (measured 2.5x bf16 traffic — int4 decoded 4x SLOWER than bf16,
     # BASELINE r3/r4), while the kernel streams packed nibbles at 4-bit
-    # width (measured FASTER than bf16 at decode shapes, battery 13)
+    # width (measured FASTER than bf16 at decode shapes, battery 13).
+    # W8A16 can take the int8 sibling kernel (ops.int8_matmul_pallas),
+    # but OPT-IN (ServeConfig.int8_pallas_matmul -> w8_kernel_ok): XLA
+    # fuses the plain int8 dequant (battery 13: 384 GB/s vs bf16's 555),
+    # so unlike int4 the Pallas route needs a measured win first.
     use_w4_kernel = w4_kernel_ok and jax.default_backend() == "tpu"
+    use_w8_kernel = w8_kernel_ok and jax.default_backend() == "tpu"
 
     def mm(a, w):
-        from ..ops.quantization import Quant4Tensor
+        from ..ops.quantization import Quant4Tensor, QuantTensor
+        if isinstance(w, QuantTensor):
+            rows = 1
+            for d in a.shape[:-1]:
+                rows *= d
+            # same routing regime as W4: short-row decode/verify shapes
+            # only; long-T prefill amortises the dequant round trip and
+            # its whole-K activation blocks would blow the kernel's VMEM
+            if (use_w8_kernel and rows <= 64
+                    and w.shape[-1] % 128 == 0):
+                from ..ops.int8_matmul_pallas import matmul_w8
+                y = matmul_w8(a.reshape(rows, a.shape[-1]),
+                              w.values, w.scale)
+                return y.reshape(*a.shape[:-1], y.shape[-1])
+            w = w.dequant(compute_dtype)
         if isinstance(w, Quant4Tensor):
             n_in, n_out = w.shape[-2], w.shape[-1]
             rows = 1
@@ -153,10 +176,17 @@ def extend_step_forward(
 
     def body(x, layer_and_pages):
         layer, kp, vp = layer_and_pages
-        # per-layer cast/dequant: int8-quantized serving weights
-        # materialise one layer of bf16 at a time (ops.quantization);
-        # int4 kernels stay packed for the Pallas matmul above
-        layer = cast_params(layer, compute_dtype, keep_w4=use_w4_kernel)
+        # per-layer cast/dequant: quantized serving weights either stay
+        # packed for the Pallas matmuls above (TPU) or materialise one
+        # layer of bf16 at a time (ops.quantization)
+        layer = cast_params(layer, compute_dtype, keep_w4=use_w4_kernel,
+                            keep_w8=use_w8_kernel)
+        if cfg.is_moe and "moe" in layer:
+            # moe_block contracts expert weights directly (no matmul
+            # injection) — a passed-through Quant[4]Tensor would hit
+            # `a @ w` untyped; experts take the dequant path
+            layer = dict(layer, moe=cast_params(layer["moe"],
+                                                compute_dtype))
         h = rms_norm(x, layer["attn_norm"]["scale"], cfg.norm_eps)
         q = mm(h, layer["q"]["kernel"]).reshape(B, T, Nq, D)
         k = mm(h, layer["k"]["kernel"]).reshape(B, T, Nkv, D)
@@ -228,6 +258,7 @@ def decode_multi_step(
     attn_impl: str = "auto",
     write_mode: str = "paged",
     w4_kernel_ok: bool = True,
+    w8_kernel_ok: bool = False,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Run ``num_steps`` decode+sample iterations in ONE compiled program.
 
@@ -251,14 +282,15 @@ def decode_multi_step(
     (_, _, k_pages, v_pages), toks_seq = decode_scan(
         params, tokens, positions, k_pages, v_pages, block_tables,
         stop_positions, slot_keys, temperature, top_k, top_p, cfg,
-        num_steps, attn_impl, write_mode, w4_kernel_ok)
+        num_steps, attn_impl, write_mode, w4_kernel_ok, w8_kernel_ok)
     return toks_seq, k_pages, v_pages
 
 
 def decode_scan(params, tokens, positions, k_pages, v_pages, block_tables,
                 stop_positions, slot_keys, temperature, top_k, top_p,
                 cfg: ModelConfig, num_steps: int, attn_impl: str = "auto",
-                write_mode: str = "paged", w4_kernel_ok: bool = True):
+                write_mode: str = "paged", w4_kernel_ok: bool = True,
+                w8_kernel_ok: bool = False):
     """The decode+sample scan shared by ``decode_multi_step`` and the fused
     speculative dispatch (speculative.verify_and_decode). Returns
     ((tokens, positions, k_pages, v_pages), toks_seq [K, B])."""
@@ -270,7 +302,7 @@ def decode_scan(params, tokens, positions, k_pages, v_pages, block_tables,
         logits, kp, vp = decode_step_forward(
             params, toks, pos, kp, vp, block_tables, cfg, active=act,
             attn_impl=attn_impl, write_mode=write_mode,
-            w4_kernel_ok=w4_kernel_ok)
+            w4_kernel_ok=w4_kernel_ok, w8_kernel_ok=w8_kernel_ok)
         keys = jax.vmap(jax.random.fold_in)(
             jax.vmap(jax.random.wrap_key_data)(slot_keys), pos + 1)
         nxt = sample_tokens(logits, keys, temperature, top_k, top_p)

@@ -126,6 +126,9 @@ class InferenceEngine:
         # the W4 Pallas matmul is a custom call GSPMD cannot partition,
         # same as the attention kernel — tp>1 takes the dequant path
         self._w4_kernel_ok = tp <= 1
+        # int8 Pallas matmul is OPT-IN (int8 dequant fuses in XLA; the
+        # kernel must beat fused-XLA on chip first — schema docstring)
+        self._w8_kernel_ok = tp <= 1 and serve_cfg.int8_pallas_matmul
         page_sharding = None
         if tp > 1:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -524,7 +527,8 @@ class InferenceEngine:
                     params, tokens, start, k_pages, v_pages, table, cfg,
                     write_ok=write_ok, attn_impl=self._attn_impl,
                     write_mode=self._extend_write,
-                    w4_kernel_ok=self._w4_kernel_ok)
+                    w4_kernel_ok=self._w4_kernel_ok,
+                    w8_kernel_ok=self._w8_kernel_ok)
                 last = jnp.take_along_axis(
                     logits, (m - 1)[:, None, None], axis=1)[:, 0]   # [1, V]
                 token = sample_tokens(last, key[None], temp[None],
@@ -552,7 +556,8 @@ class InferenceEngine:
                     params, tokens, start, k_pages, v_pages, table, cfg,
                     write_ok=write_ok, attn_impl=self._attn_impl,
                     write_mode=self._extend_write,
-                    w4_kernel_ok=self._w4_kernel_ok)
+                    w4_kernel_ok=self._w4_kernel_ok,
+                    w8_kernel_ok=self._w8_kernel_ok)
                 return k_pages, v_pages
 
             self._prefill_cache[key_] = jax.jit(
@@ -799,7 +804,8 @@ class InferenceEngine:
             params, tokens, positions, k_pages, v_pages, tables, stops,
             slot_keys, temp, top_k, top_p, self.cfg, num_steps,
             attn_impl=self._attn_impl, write_mode=self._extend_write,
-            w4_kernel_ok=self._w4_kernel_ok)
+            w4_kernel_ok=self._w4_kernel_ok,
+            w8_kernel_ok=self._w8_kernel_ok)
         return toks_seq, toks, pos, k_pages, v_pages
 
     def _short_dispatch_ok(self) -> bool:
@@ -961,7 +967,8 @@ class InferenceEngine:
             num_decode_steps=max(
                 self.serve_cfg.decode_steps_per_dispatch - 1, 0),
             attn_impl=self._attn_impl, write_mode=self._extend_write,
-            w4_kernel_ok=self._w4_kernel_ok)
+            w4_kernel_ok=self._w4_kernel_ok,
+            w8_kernel_ok=self._w8_kernel_ok)
 
     def _spec_device(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         """One fused speculative dispatch: propose drafts on host (prompt-

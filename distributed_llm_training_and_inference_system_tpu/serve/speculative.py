@@ -88,6 +88,7 @@ def speculative_verify(
     attn_impl: str = "auto",
     write_mode: str = "paged",
     w4_kernel_ok: bool = True,
+    w8_kernel_ok: bool = False,
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """One verification pass. Returns (emitted [B, T], n_emit [B], kp, vp).
 
@@ -106,7 +107,7 @@ def speculative_verify(
     logits, k_pages, v_pages = extend_step_forward(
         params, tokens, positions, k_pages, v_pages, block_tables, cfg,
         write_ok=write_ok, attn_impl=attn_impl, write_mode=write_mode,
-        w4_kernel_ok=w4_kernel_ok)
+        w4_kernel_ok=w4_kernel_ok, w8_kernel_ok=w8_kernel_ok)
 
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)     # [B, T]
     is_greedy = temperature <= 0.0
@@ -141,6 +142,7 @@ def verify_and_decode(
     attn_impl: str = "auto",
     write_mode: str = "paged",
     w4_kernel_ok: bool = True,
+    w8_kernel_ok: bool = False,
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """Fused dispatch: one verification window + ``num_decode_steps`` plain
     decode iterations, all on device.
@@ -163,7 +165,7 @@ def verify_and_decode(
         params, tokens, positions, k_pages, v_pages, block_tables,
         stop_positions, slot_keys, temperature, top_k, top_p, cfg,
         attn_impl=attn_impl, write_mode=write_mode,
-        w4_kernel_ok=w4_kernel_ok)
+        w4_kernel_ok=w4_kernel_ok, w8_kernel_ok=w8_kernel_ok)
     if num_decode_steps < 1:
         B = tokens.shape[0]
         return (emitted, n_emit,
@@ -175,5 +177,6 @@ def verify_and_decode(
     (_, _, k_pages, v_pages), decode_seq = decode_scan(
         params, last, positions + n_emit, k_pages, v_pages, block_tables,
         stop_positions, slot_keys, temperature, top_k, top_p, cfg,
-        num_decode_steps, attn_impl, write_mode, w4_kernel_ok)
+        num_decode_steps, attn_impl, write_mode, w4_kernel_ok,
+        w8_kernel_ok)
     return emitted, n_emit, decode_seq, k_pages, v_pages

@@ -34,6 +34,8 @@ def main() -> None:
 
     from distributed_llm_training_and_inference_system_tpu.ops.int4_matmul_pallas import (
         matmul_w4)
+    from distributed_llm_training_and_inference_system_tpu.ops.int8_matmul_pallas import (
+        matmul_w8)
     from distributed_llm_training_and_inference_system_tpu.ops.quantization import (
         dequantize_int4_groupwise, dequantize_int8,
         quantize_int4_groupwise, quantize_int8)
@@ -108,10 +110,15 @@ def main() -> None:
                 xx, p4, s4, c4, group=128,
                 block_out=512 if n_out % 512 == 0 else 256,
                 interpret=interpret), 1),
+            # round-5: W8A16 in-kernel dequant — must BEAT int8-xla
+            # (whose dequant fuses) before serve routing defaults on
+            "int8-pallas": (lambda xx, i: matmul_w8(
+                xx, q8, s8, interpret=interpret), 1),
         }
         bytes_per = {"bf16": 2 * n_in * n_out, "int8-xla": n_in * n_out,
                      "int4-xla": n_in * n_out // 2,
-                     "int4-pallas": n_in * n_out // 2}
+                     "int4-pallas": n_in * n_out // 2,
+                     "int8-pallas": n_in * n_out}
         for vname, (fn, n_copies) in variants.items():
             ms = scan_time(fn, n_copies)
             bw = bytes_per[vname] / (ms / 1e3) / 1e9

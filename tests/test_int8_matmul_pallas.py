@@ -1,0 +1,144 @@
+"""In-kernel-dequant W8A16 matmul (ops/int8_matmul_pallas.py), interpret
+mode on CPU.
+
+The XLA int8 path dequantizes layer-by-layer inside the decode scan,
+streaming ~5x the int8 bytes through HBM (gpt-7b: 40.8 ms measured
+decode step vs its 8.9 ms int8 weight floor, battery 8); this kernel
+streams int8 and converts in registers. Bars: numerics match the XLA
+dequant reference to bf16 accumulation error across shapes and batch
+paddings, the per-input-row scale folds into activations exactly, and
+the decode routing keeps QuantTensor weights packed end-to-end.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_llm_training_and_inference_system_tpu.ops.int8_matmul_pallas import (
+    matmul_w8,
+)
+from distributed_llm_training_and_inference_system_tpu.ops.quantization import (
+    dequantize_int8,
+    quantize_int8,
+)
+
+
+def _case(In, Out, B, block_out=0, seed=0):
+    w = jax.random.normal(jax.random.PRNGKey(seed), (In, Out),
+                          jnp.float32) * 0.05
+    x = jax.random.normal(jax.random.PRNGKey(seed + 1), (B, In),
+                          jnp.bfloat16)
+    values, scale = quantize_int8(w)               # axis=-1: scale [In, 1]
+    wd = dequantize_int8(values, scale)
+    # reference applies the scale weight-side; the kernel folds it
+    # activation-side — agreement IS the fold's correctness proof
+    ref = x.astype(jnp.float32) @ wd.astype(jnp.float32)
+    got = matmul_w8(x, values, scale, block_out=block_out, interpret=True)
+    rel = float(jnp.max(jnp.abs(got.astype(jnp.float32) - ref))
+                / (jnp.max(jnp.abs(ref)) + 1e-9))
+    return rel
+
+
+@pytest.mark.parametrize("In,Out,B", [
+    (256, 256, 4),
+    (512, 1024, 8),
+    (256, 512, 1),     # B=1 pads to 8 sublanes
+    (384, 256, 3),     # In not a power of two
+    (256, 256, 12),    # B>8, non-multiple: pads to 16
+    (256, 384, 2),     # Out with no 128-tile: whole-dim fallback
+])
+def test_matches_xla_dequant_reference(In, Out, B):
+    assert _case(In, Out, B) < 0.01
+
+
+def test_flat_scale_accepted():
+    """quantize_int8 keeps dims ([in, 1]); a squeezed [in] scale must
+    behave identically (artifact loaders may strip the keepdim)."""
+    w = jax.random.normal(jax.random.PRNGKey(3), (256, 256)) * 0.05
+    x = jax.random.normal(jax.random.PRNGKey(4), (4, 256), jnp.bfloat16)
+    values, scale = quantize_int8(w)
+    a = matmul_w8(x, values, scale, interpret=True)
+    b = matmul_w8(x, values, scale.reshape(-1), interpret=True)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_block_out_auto_handles_budget_and_fallback_shapes():
+    """The auto-tile picker must produce a WORKING kernel at the shapes
+    that exercise its branches: over-budget reduction widths (in large
+    enough that no standard tile fits the 2 MB budget — must fall to
+    128, not the whole dim) and no-128-divisor outputs (whole-dim
+    fallback). Exercised through matmul_w8 itself so a picker
+    regression fails here, not in a 30B serve trace."""
+    for In, Out in [
+        (2048, 1024),    # in-budget: a standard tile
+        (4096, 640),     # 128 divides, 512/256 don't
+        (18 * 1024, 256),  # every standard tile over budget -> 128
+        (256, 192),      # no 128 divisor: whole-dim fallback
+    ]:
+        assert _case(In, Out, 4, seed=In + Out) < 0.01, (In, Out)
+
+
+def test_rejects_bad_shapes():
+    values = jnp.zeros((256, 256), jnp.int8)
+    scale = jnp.ones((256, 1), jnp.float32)
+    x = jnp.ones((2, 300), jnp.bfloat16)           # in mismatch
+    with pytest.raises(ValueError, match="values rows"):
+        matmul_w8(x, values, scale, interpret=True)
+    x = jnp.ones((2, 256), jnp.bfloat16)
+    with pytest.raises(ValueError, match="divisible by block_out"):
+        matmul_w8(x, values, scale, block_out=96, interpret=True)
+
+
+def test_engine_flag_plumbing_tokens_unchanged():
+    """int8_pallas_matmul=True must thread through the engine and decode
+    trace without changing CPU output (the backend gate falls back to
+    the dequant route off-TPU, so tokens are bitwise-identical)."""
+    from distributed_llm_training_and_inference_system_tpu.config import (
+        get_model_config,
+    )
+    from distributed_llm_training_and_inference_system_tpu.config.schema import (
+        ServeConfig,
+    )
+    from distributed_llm_training_and_inference_system_tpu.serve import (
+        InferenceEngine,
+        SamplingParams,
+    )
+    cfg = get_model_config("gpt-test")
+    outs = {}
+    for flag in (False, True):
+        sc = ServeConfig(max_batch_size=2, max_seq_len=128,
+                         kv_num_blocks=16, quantization="int8",
+                         int8_pallas_matmul=flag)
+        eng = InferenceEngine(cfg, sc, seed=0)
+        r = eng.generate([[5, 6, 7, 8]],
+                         SamplingParams(temperature=0.0, max_tokens=8))
+        outs[flag] = r[0].generated_tokens
+        eng.release()
+    assert outs[False] == outs[True]
+    assert len(outs[False]) == 8
+
+
+def test_decode_routes_int8_through_kernel_same_tokens():
+    """An int8-quantized model served through the decode path must emit
+    logits matching the dequant route to bf16 error — the routing gate
+    (rows<=64, out%128, keep_w8 pass-through incl. the MoE guard) is
+    what's under test; on CPU the kernel route is skipped by the backend
+    gate, so drive mm directly via extend_step_forward's contract is
+    covered by the serve equivalence suite; here we assert the
+    cast_params pass-through plumbing."""
+    from distributed_llm_training_and_inference_system_tpu.ops.quantization import (
+        QuantTensor,
+        cast_params,
+        quantize_tree_int8,
+        to_runtime_quant,
+    )
+    tree = {"q": {"kernel": jnp.ones((128, 128), jnp.float32)},
+            "norm": {"scale": jnp.ones((8,), jnp.float32)}}
+    rt = to_runtime_quant(quantize_tree_int8(tree, min_size=128))
+    kept = cast_params(rt, jnp.bfloat16, keep_w8=True)
+    assert isinstance(kept["q"]["kernel"], QuantTensor)
+    assert kept["norm"]["scale"].dtype == jnp.bfloat16
+    # without the flag the leaf dequantizes (the pre-round-5 behavior)
+    plain = cast_params(rt, jnp.bfloat16)
+    assert plain["q"]["kernel"].dtype == jnp.bfloat16
