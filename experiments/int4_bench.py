@@ -30,7 +30,13 @@ def main() -> None:
 
     model = sys.argv[1] if len(sys.argv) > 1 else "gpt-1b"
     cfg = get_model_config(model)
-    for q in ("int4", "int4-awq"):
+    order = ("int4", "int4-awq")
+    if os.environ.get("LLMCTL_INT4_ORDER") == "reversed":
+        # order-control rerun (battery 17): battery 16 measured the
+        # FIRST engine 3.3x slower through an identical route — flip
+        # the order to separate order effects from quant kind
+        order = order[::-1]
+    for q in order:
         eng = InferenceEngine(cfg, ServeConfig(
             model=model, max_batch_size=4, max_seq_len=704,
             kv_block_size=64, dtype="bfloat16", quantization=q,

@@ -1,0 +1,45 @@
+#!/bin/bash
+# Round-5 battery 17: follow-ups surfaced by the first round-5 results.
+#
+# 1. int4 order-control A/B. Battery 16 measured int4 27.8 vs int4-awq
+#    92.8 tok/s THROUGH THE SAME Quant4Tensor route — the only
+#    structural difference is chan != ones, which costs the kernel
+#    nothing. Prime suspect: order effects (first engine in the process
+#    pays something the second doesn't). int4_bench.py runs int4 first;
+#    this row re-runs with LLMCTL_INT4_ORDER=reversed so awq goes
+#    first. If the SECOND variant wins again, it's order, not quant
+#    kind; if int4 stays slow either way, the kernel route has an
+#    int4-specific hole to find.
+# 2. W8A16 Pallas kernel costing (new this round): int8-pallas variant
+#    vs the fused int8-xla route at decode shapes. Flip
+#    ServeConfig.int8_pallas_matmul default only if this wins.
+# 3. int8-pallas serve-level A/B at gpt-1b (the 110.7 tok/s row).
+# 4. MoE b4 retry with loss_chunk 512: b4 OOM'd by 428 MB at compile
+#    (16.17 vs 15.75 GB); halving the [chunk, V] CE workspace buys
+#    ~0.4 GB at V=50304 — the same trick as the 7B b4 row.
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-experiments/results_r5}
+mkdir -p "$OUT"
+source experiments/battery_lib.sh
+tpu_guard || exit 1
+
+run int4_order_reversed 1800 env LLMCTL_INT4_ORDER=reversed \
+    python experiments/int4_bench.py
+
+run w8_kernel_cost 1800 python experiments/int4_kernel_bench.py 8 50
+
+run int8_pallas_serve 1800 python -m distributed_llm_training_and_inference_system_tpu.cli.main \
+    bench e2e --model gpt-1b --mode serve-load \
+    --requests 16 --prompt-len 512 --gen-len 128 --quant int8 \
+    --rps "" --concurrency 4 --admission ondemand --kv-blocks 96 \
+    --int8-pallas
+run int8_xla_serve 1800 python -m distributed_llm_training_and_inference_system_tpu.cli.main \
+    bench e2e --model gpt-1b --mode serve-load \
+    --requests 16 --prompt-len 512 --gen-len 128 --quant int8 \
+    --rps "" --concurrency 4 --admission ondemand --kv-blocks 96
+
+run moe_mfu_b4_c512 1800 python experiments/mfu_sweep.py 4 selective gpt-moe-1b \
+    bfloat16 512 1 bfloat16 8
+
+echo "battery17 complete; results in $OUT/"
