@@ -6,7 +6,26 @@ Here the whole batch is sampled in one traced function on device: every
 request carries its own (temperature, top_k, top_p, key) and the math is
 vectorised — no data-dependent Python control flow (XLA requirement).
 
-temperature == 0 means greedy (argmax), selected via jnp.where, not cond.
+temperature == 0 means greedy (argmax). Per-ROW selection stays
+jnp.where (rows can't branch); whole-BATCH tier selection is lax.cond.
+
+Cost structure (round 5): the top-k and top-p filters each need the
+row's sort order, and a [B, V] sort at V=50304 is VPU-heavy — it runs
+INSIDE every iteration of the K-step decode scan. Three tiers keep the
+common cases off that path, chosen by ``lax.cond`` on whole-batch
+predicates (loop-invariant in the decode scan; XLA conditionals execute
+ONE branch at runtime, and the predicates are known at dispatch time):
+
+  all rows greedy          -> argmax only (zero sampling machinery)
+  no row filters           -> Gumbel categorical, no sort
+  any row filters          -> ONE shared argsort feeds both filters
+                              (previously jnp.sort + jnp.argsort = two)
+
+The filtered path is equivalent to filtering per-filter: top-k keeps
+``logits >= kth`` (ties included) exactly as before, and top-p's
+cumulative cut sees the same kept-entry order — masked entries land in
+the tail with ~0 probability either way, so the kept sets, and
+therefore the sampled tokens, are unchanged.
 """
 
 from __future__ import annotations
@@ -46,6 +65,36 @@ def _apply_top_p(logits: jax.Array, top_p: jax.Array) -> jax.Array:
     return jnp.where(keep, logits, NEG_INF)
 
 
+def _filtered_single_sort(scaled: jax.Array, top_k: jax.Array,
+                          top_p: jax.Array) -> jax.Array:
+    """top-k then top-p filtering from ONE argsort of the scaled logits.
+
+    Equivalent to ``_apply_top_p(_apply_top_k(scaled, top_k), top_p)``:
+    top-k's mask only moves non-kept entries to NEG_INF, which preserves
+    the descending order of kept entries, so top-p's cumulative scan
+    sees the same prefix; masked entries carry ~0 probability wherever
+    they sort. One sort instead of two — this path only runs when some
+    row actually has a filter (see sample_tokens).
+    """
+    B, V = scaled.shape
+    rows = jnp.arange(B)[:, None]
+    sort_idx = jnp.argsort(scaled, axis=-1)[:, ::-1]
+    sorted_desc = jnp.take_along_axis(scaled, sort_idx, axis=-1)
+
+    k = jnp.clip(top_k, 1, V)
+    kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=1)
+    keep_k = (sorted_desc >= kth) | (top_k[:, None] <= 0)   # ties included
+
+    masked_sorted = jnp.where(keep_k, sorted_desc, NEG_INF)
+    probs = jax.nn.softmax(masked_sorted, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_p = ((cum - probs) < top_p[:, None]).at[:, 0].set(True)
+    keep_p = keep_p | (top_p[:, None] >= 1.0)
+
+    keep = jnp.zeros((B, V), bool).at[rows, sort_idx].set(keep_k & keep_p)
+    return jnp.where(keep, scaled, NEG_INF)
+
+
 def sample_tokens(
     logits: jax.Array,       # [B, V] fp32
     keys: jax.Array,         # [B] PRNG keys (uint32[2] each)
@@ -55,8 +104,28 @@ def sample_tokens(
 ) -> jax.Array:
     """Return sampled token ids [B] int32."""
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    temp = jnp.maximum(temperature, 1e-6)[:, None]
-    filtered = _apply_top_p(_apply_top_k(logits / temp, top_k), top_p)
-    sampled = jax.vmap(
-        lambda key, row: jax.random.categorical(key, row))(keys, filtered)
-    return jnp.where(temperature <= 0.0, greedy, sampled.astype(jnp.int32))
+    is_sampled = temperature > 0.0
+
+    def greedy_only(_):
+        return greedy
+
+    def sampled(_):
+        temp = jnp.maximum(temperature, 1e-6)[:, None]
+        scaled = logits / temp
+
+        def unfiltered(_):
+            return scaled
+
+        def filtered(_):
+            return _filtered_single_sort(scaled, top_k, top_p)
+
+        # the filter sort only runs when a SAMPLED row asks for it —
+        # greedy rows' filter knobs are irrelevant to their argmax
+        any_filter = jnp.any(is_sampled
+                             & ((top_k > 0) | (top_p < 1.0)))
+        row = jax.lax.cond(any_filter, filtered, unfiltered, None)
+        toks = jax.vmap(
+            lambda key, r: jax.random.categorical(key, r))(keys, row)
+        return jnp.where(is_sampled, toks.astype(jnp.int32), greedy)
+
+    return jax.lax.cond(jnp.any(is_sampled), sampled, greedy_only, None)
