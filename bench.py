@@ -63,9 +63,12 @@ def main() -> None:
     import os as _os
     recipes = {
         "gpt-750m": dict(batch=4, accum=16, chunk=1024),
-        # b2: b4 OOMs by 1.34 GB at chunk 1024 (battery 12); accum 16
-        # mirrors the gpt-750m tail-amortisation recipe at the 7B shape
-        "gpt-7b-4l": dict(batch=2, accum=16, chunk=1024),
+        # b2: b4 OOMs by 1.34 GB at chunk 1024 (battery 12). The fp32
+        # accumulation carry OOM'd every b2 x accum row by 3.85 GB
+        # (results_r5) — the bf16 carry (OptimizerConfig.accum_dtype)
+        # halves it and chunk 512 trims the CE workspace
+        "gpt-7b-4l": dict(batch=2, accum=8, chunk=512,
+                          accum_dtype="bfloat16"),
         "gpt-test": dict(batch=4, accum=2, chunk=1024),
     }
     model_name = _os.environ.get("LLMCTL_BENCH_MODEL") or (
@@ -84,8 +87,9 @@ def main() -> None:
                          gradient_accumulation_steps=accum)
     step_fn, tx, _ = make_train_step(
         cfg, OptimizerConfig(lr=1e-4, moment_dtype="bfloat16",
-                             nu_dtype="bfloat16"), par,
-        attn_impl="flash" if on_tpu else "xla", loss_chunk=r["chunk"])
+                             nu_dtype="bfloat16",
+                             accum_dtype=r.get("accum_dtype", "float32")),
+        par, attn_impl="flash" if on_tpu else "xla", loss_chunk=r["chunk"])
     params = init(cfg, jax.random.PRNGKey(0))
     state = TrainState.create(params, tx)
     jstep = jax.jit(step_fn, donate_argnums=(0,))

@@ -243,9 +243,20 @@ class OptimizerConfig:
     # Numerically identical to the optax chain (tests/test_exec.py);
     # applies to adamw/adam only, other types fall back to optax.
     fused: bool = True
+    # dtype of the gradient-accumulation carry (train_step's scanned
+    # grads_acc — a full params-sized tree resident for the whole step
+    # whenever gradient_accumulation_steps > 1). bfloat16 halves it
+    # (~2.45 GB at the gpt-7b-4l shape, where the fp32 carry OOM'd the
+    # b2 x accum rows by 3.85 GB). Cost: summing N microbatch grads in
+    # bf16 loses ~log2(N)/256 relative precision on the mean — the same
+    # concession as moment_dtype, applied one stage earlier; clip and
+    # the optimizer update still COMPUTE in fp32.
+    accum_dtype: str = "float32"
     scheduler: SchedulerConfig = field(default_factory=SchedulerConfig)
 
     def validate(self) -> None:
+        if self.accum_dtype not in ("float32", "bfloat16"):
+            raise ConfigError("accum_dtype must be float32|bfloat16")
         if self.type not in ("adamw", "adam", "sgd", "adafactor", "lion"):
             raise ConfigError(f"unknown optimizer {self.type!r}")
         if not (0 < self.lr < 1):
@@ -275,6 +286,7 @@ class OptimizerConfig:
             moment_dtype=str(_take(d, "moment_dtype", default="float32")),
             nu_dtype=str(_take(d, "nu_dtype", default="float32")),
             fused=_parse_bool("fused", _take(d, "fused", default=True)),
+            accum_dtype=str(_take(d, "accum_dtype", default="float32")),
             scheduler=SchedulerConfig.from_dict(d.get("scheduler")),
         )
         cfg.validate()
@@ -693,6 +705,21 @@ class ServeConfig:
         return cfg
 
 
+# alias -> canonical field name for ModelConfig dict keys (the _take
+# alias groups in ModelConfig.from_dict, inverted). Used when overlaying
+# user keys onto a template's canonical dict — see RunConfig.from_dict.
+_MODEL_KEY_ALIASES: dict[str, str] = {
+    "layers": "num_layers", "num_hidden_layers": "num_layers",
+    "hidden": "hidden_size", "d_model": "hidden_size",
+    "ffn": "ffn_size", "intermediate_size": "ffn_size",
+    "heads": "num_heads", "num_attention_heads": "num_heads",
+    "kv_heads": "num_kv_heads", "num_key_value_heads": "num_kv_heads",
+    "max_seq_len": "max_position_embeddings",
+    "hidden_act": "activation",
+    "layer_norm_eps": "norm_eps", "rms_norm_eps": "norm_eps",
+}
+
+
 @dataclass
 class RunConfig:
     """The full training-run preset: everything in one file.
@@ -735,6 +762,29 @@ class RunConfig:
             loaded = load_config_file(found)
             loaded.update({k: v for k, v in model_d.items() if k != "config_file"})
             model_d = loaded
+        # A known template NAME seeds the architecture, explicit keys
+        # override it. Without this, `[model] name = "gpt-7b"` in a run
+        # config silently trained the 125m DEFAULT dims under a 7b label
+        # (the CLI --model flag resolved templates; config files did not).
+        name = model_d.get("name")
+        if name:
+            from .presets import MODEL_TEMPLATES, TEST_TEMPLATES
+            tmpl = MODEL_TEMPLATES.get(name) or TEST_TEMPLATES.get(name)
+            if tmpl is not None:
+                import dataclasses as _dc
+                base = _dc.asdict(tmpl)
+                for k, v in model_d.items():
+                    # user keys overlay under their CANONICAL names —
+                    # otherwise the template's canonical key shadows a
+                    # user value written under an HF-style alias (e.g.
+                    # num_hidden_layers) and _take silently prefers the
+                    # template's dims
+                    k = _MODEL_KEY_ALIASES.get(k, k)
+                    if isinstance(v, dict) and isinstance(base.get(k), dict):
+                        base[k] = {**base[k], **v}
+                    else:
+                        base[k] = v
+                model_d = base
         return cls(
             model=ModelConfig.from_dict(model_d) if model_d else ModelConfig(),
             optimizer=OptimizerConfig.from_dict(d.get("optimizer")),

@@ -111,11 +111,16 @@ def make_train_step(
         if accum == 1:
             (total, (loss, count)), grads = grad_fn(state.params, batch)
         else:
+            # the carry is a params-sized tree resident across the whole
+            # scan; accum_dtype=bfloat16 halves it (OptimizerConfig
+            # docstring — the fp32 carry OOM'd gpt-7b-4l accumulation)
+            acc_dtype = jnp.dtype(opt_cfg.accum_dtype)
+
             def micro(carry, mb):
                 grads_acc, loss_acc, count_acc = carry
                 (_, (loss, count)), grads = grad_fn(state.params, mb)
                 grads_acc = jax.tree_util.tree_map(
-                    lambda a, g: a + g.astype(jnp.float32), grads_acc, grads)
+                    lambda a, g: a + g.astype(acc_dtype), grads_acc, grads)
                 return (grads_acc, loss_acc + loss * count, count_acc + count), None
 
             def split(x):
@@ -123,10 +128,12 @@ def make_train_step(
 
             micro_batches = jax.tree_util.tree_map(split, batch)
             zeros = jax.tree_util.tree_map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+                lambda p: jnp.zeros(p.shape, acc_dtype), state.params)
             (grads, loss_sum, count), _ = jax.lax.scan(
                 micro, (zeros, jnp.float32(0.0), jnp.float32(0.0)), micro_batches)
-            grads = jax.tree_util.tree_map(lambda g: g / accum, grads)
+            # mean in fp32: clip/update math is fp32 regardless of carry
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32) / accum, grads)
             loss = loss_sum / jnp.maximum(count, 1.0)
 
         gnorm = global_norm(grads)

@@ -3,6 +3,7 @@
 Run as a subprocess per config so an OOM kills only the probe:
     python experiments/mfu_sweep.py <batch> <remat> [model] [mu_dtype]
                                     [loss_chunk] [fused] [nu_dtype] [accum]
+                                    [accum_dtype]
 
 ``accum`` > 1 scans <accum> microbatches of size <batch> per optimizer
 step (exec/train_step.py lax.scan) — amortises the optimizer + collective
@@ -31,6 +32,7 @@ def main() -> None:
              if len(sys.argv) > 6 else True)
     nu_dtype = sys.argv[7] if len(sys.argv) > 7 else "float32"
     accum = int(sys.argv[8]) if len(sys.argv) > 8 else 1
+    accum_dtype = sys.argv[9] if len(sys.argv) > 9 else "float32"
 
     import jax
 
@@ -51,7 +53,8 @@ def main() -> None:
                          gradient_accumulation_steps=accum)
     step_fn, tx, _ = make_train_step(
         cfg, OptimizerConfig(lr=1e-4, moment_dtype=moment_dtype,
-                             nu_dtype=nu_dtype, fused=fused), par,
+                             nu_dtype=nu_dtype, fused=fused,
+                             accum_dtype=accum_dtype), par,
         attn_impl="flash", loss_chunk=loss_chunk)
     params = init(cfg, jax.random.PRNGKey(0))
     state = TrainState.create(params, tx)
@@ -78,6 +81,7 @@ def main() -> None:
     print(json.dumps({"model": model_name, "batch": batch, "remat": remat,
                       "moment_dtype": moment_dtype, "loss_chunk": loss_chunk,
                       "fused": fused, "nu_dtype": nu_dtype, "accum": accum,
+                      "accum_dtype": accum_dtype,
                       "step_ms": round(dt * 1e3, 2),
                       "tok_s": round(tokens_per_sec, 1),
                       "mfu": round(mfu, 4)}))

@@ -1,0 +1,31 @@
+#!/bin/bash
+# Round-5 second measurement wave. Waits for the first wave
+# (run_round5_pending.sh) to release the chip, then runs:
+#   battery14b       7B pipelined-decode OOM discriminator (unpipelined
+#                    control first) + the saturation/gate A/B if it fits
+#   battery_r5b      7B MFU retry rows (bf16 accum carry), flagship v2,
+#                    clean adapt_diag, tiered-sampling serve re-baselines
+#   battery17        int4 order-control, W8 kernel cost, int8-pallas
+#                    serve A/B, MoE b4 chunk-512 retry
+# NOTHING else may touch the chip while this runs — the first wave's
+# adapt_diag rows were contaminated by a concurrent probe (27 s max
+# step times) and had to be re-queued.
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-experiments/results_r5}
+mkdir -p "$OUT"
+
+# wait for the first wave (match the script name, not this script)
+for i in $(seq 1 400); do
+  if ! pgrep -f "run_round5_pending.sh" > /dev/null 2>&1; then
+    break
+  fi
+  sleep 120
+done
+
+bash experiments/tpu_battery14b.sh "$OUT"
+python -m distributed_llm_training_and_inference_system_tpu.cli.main \
+    bench battery --spec experiments/battery_r5b.toml --out "$OUT" \
+    --resume
+bash experiments/tpu_battery17.sh "$OUT"
+echo "round-5 second wave complete"

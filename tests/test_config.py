@@ -121,3 +121,47 @@ def test_model_family_templates_validate_and_run():
             params, jnp.asarray([[5, 9, 2, 7]], jnp.int32), tiny)
         assert logits.shape == (1, 4, 256)
         assert bool(jnp.isfinite(logits).all()), name
+
+
+def test_run_config_resolves_template_by_name():
+    """`[model] name = "gpt-7b"` in a run config must seed the TEMPLATE
+    architecture (round 5: it silently trained 125m default dims under a
+    7b label; the CLI --model flag resolved templates, config files did
+    not)."""
+    from distributed_llm_training_and_inference_system_tpu.config.schema import (
+        RunConfig,
+    )
+    rc = RunConfig.from_dict({"model": {"name": "gpt-7b"}})
+    assert rc.model.num_layers == 32
+    assert rc.model.hidden_size == 4096
+    # unknown names keep the plain-dict path
+    rc2 = RunConfig.from_dict({"model": {"name": "my-custom", "layers": 5}})
+    assert rc2.model.num_layers == 5
+
+
+def test_template_overlay_honors_alias_keys():
+    """HF-style alias keys must OVERRIDE the template's canonical dims
+    (review r5: the template's canonical key shadowed the user's alias,
+    reproducing the silent-wrong-dims bug for alias-keyed configs)."""
+    from distributed_llm_training_and_inference_system_tpu.config.schema import (
+        RunConfig,
+    )
+    rc = RunConfig.from_dict({"model": {
+        "name": "gpt-7b", "num_hidden_layers": 2,
+        "num_attention_heads": 4, "num_key_value_heads": 4,
+        "intermediate_size": 256}})
+    assert rc.model.num_layers == 2
+    assert rc.model.num_heads == 4
+    assert rc.model.ffn_size == 256
+
+
+def test_optimizer_accum_dtype_from_config():
+    """accum_dtype must survive the config file path (review r5: the
+    dataclass field existed but from_dict dropped it, so TOML users got
+    the fp32 carry and the documented 3.85 GB OOM)."""
+    from distributed_llm_training_and_inference_system_tpu.config.schema import (
+        OptimizerConfig,
+    )
+    assert OptimizerConfig.from_dict(
+        {"accum_dtype": "bfloat16"}).accum_dtype == "bfloat16"
+    assert OptimizerConfig.from_dict({"lr": 1e-4}).accum_dtype == "float32"

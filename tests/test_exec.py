@@ -159,6 +159,43 @@ def test_bf16_nu_loss_trajectory_close_to_fp32():
     assert abs(finals["bfloat16"] - finals["float32"]) < 0.05 * finals["float32"], finals
 
 
+def test_bf16_accum_carry_loss_trajectory_close_to_fp32():
+    """accum_dtype=bfloat16 halves the accumulation carry (the fix for
+    the gpt-7b-4l accum OOM, round 5); the quality bound: same data, 30
+    accumulated steps, final losses within 5% of the fp32 carry, and
+    the update direction still matches the full-batch step loosely."""
+    cfg = get_model_config("gpt-test")
+    params = init(cfg, jax.random.PRNGKey(0))
+    data = [_batch(cfg, jax.random.PRNGKey(200 + i), batch=8, seq=32)
+            for i in range(4)]
+    finals = {}
+    for accum_dtype in ("float32", "bfloat16"):
+        opt = OptimizerConfig(lr=3e-3, moment_dtype="bfloat16",
+                              nu_dtype="bfloat16", fused=True,
+                              accum_dtype=accum_dtype)
+        step, tx, _ = make_train_step(cfg, opt, ParallelConfig(
+            gradient_accumulation_steps=4))
+        s = TrainState.create(params, tx)
+        jstep = jax.jit(step)
+        losses = []
+        for i in range(30):
+            s, m = jstep(s, data[i % len(data)])
+            losses.append(float(m["loss"]))
+        finals[accum_dtype] = losses[-1]
+        assert losses[-1] < losses[0], (accum_dtype, losses[:3], losses[-3:])
+    assert abs(finals["bfloat16"] - finals["float32"]) \
+        < 0.05 * finals["float32"], finals
+
+
+def test_accum_dtype_validated():
+    import pytest
+
+    from distributed_llm_training_and_inference_system_tpu.config.schema import (  # noqa: E501
+        ConfigError)
+    with pytest.raises(ConfigError, match="accum_dtype"):
+        OptimizerConfig(accum_dtype="float16").validate()
+
+
 def test_nu_bf16_requires_fused():
     import pytest
 
