@@ -70,57 +70,62 @@ def main() -> None:
         (q8_r, s8_r), n_q8 = rotated([q8, s8])
         (p4_r, s4_r), n_p4 = rotated([p4, s4])
 
-        def scan_time(fn, n_copies):
+        def scan_time(fn, ws, n_copies):
             """Per-iteration ms, two-window differenced (N vs 2N iters)
             so the per-dispatch constant (tunnel RTT + host overhead)
             cancels. The scan rotates through n_copies weight replicas
             (xs = copy index) so XLA cannot park the weights in VMEM, and
             the output feeds back with a tiny real coefficient so
-            iterations serialise and nothing dead-code-eliminates."""
-            def body(carry, i):
-                y = fn(carry, i)
-                return carry + y[:, :1].astype(carry.dtype) * 1e-12, None
+            iterations serialise and nothing dead-code-eliminates.
 
+            ``ws`` (the weight arrays) are EXPLICIT jit arguments — as
+            closure captures they were serialised into the remote-compile
+            payload, which the 7B shapes overflowed (HTTP 413; the r4
+            battery-13 run silently lost every shape after gpt-1b.ffn
+            to the same limit)."""
             def make(n):
                 idx = jnp.arange(n, dtype=jnp.int32) % n_copies
 
                 @jax.jit
-                def run(x0):
+                def run(x0, *ws):
+                    def body(carry, i):
+                        y = fn(carry, i, *ws)
+                        return carry + y[:, :1].astype(carry.dtype) * 1e-12, None
                     out, _ = jax.lax.scan(body, x0, idx)
                     return out[0, 0]
                 return run
 
             run1, run2 = make(iters), make(2 * iters)
-            float(run1(x)); float(run2(x))      # compile + warm
-            t0 = time.perf_counter(); float(run1(x))
-            t1 = time.perf_counter(); float(run2(x))
+            float(run1(x, *ws)); float(run2(x, *ws))      # compile + warm
+            t0 = time.perf_counter(); float(run1(x, *ws))
+            t1 = time.perf_counter(); float(run2(x, *ws))
             t2 = time.perf_counter()
             return ((t2 - t1) - (t1 - t0)) / iters * 1e3
 
         variants = {
-            "bf16": (lambda xx, i: xx @ wb_r[i], n_wb),
-            "int8-xla": (lambda xx, i: xx @ dequantize_int8(
-                q8_r[i], s8_r[i]), n_q8),
-            "int4-xla": (lambda xx, i: xx @ dequantize_int4_groupwise(
-                p4_r[i], s4_r[i], c4, group=128), n_p4),
+            "bf16": (lambda xx, i, w: xx @ w[i], (wb_r,), n_wb),
+            "int8-xla": (lambda xx, i, q, sc: xx @ dequantize_int8(
+                q[i], sc[i]), (q8_r, s8_r), n_q8),
+            "int4-xla": (lambda xx, i, pk, sc: xx @ dequantize_int4_groupwise(
+                pk[i], sc[i], c4, group=128), (p4_r, s4_r), n_p4),
             # the Pallas kernel's BlockSpecs stream from HBM per call —
             # no rotation needed (or possible without scalar-prefetch
             # plumbing); i is unused
-            "int4-pallas": (lambda xx, i: matmul_w4(
-                xx, p4, s4, c4, group=128,
+            "int4-pallas": (lambda xx, i, pk, sc, ch: matmul_w4(
+                xx, pk, sc, ch, group=128,
                 block_out=512 if n_out % 512 == 0 else 256,
-                interpret=interpret), 1),
+                interpret=interpret), (p4, s4, c4), 1),
             # round-5: W8A16 in-kernel dequant — must BEAT int8-xla
             # (whose dequant fuses) before serve routing defaults on
-            "int8-pallas": (lambda xx, i: matmul_w8(
-                xx, q8, s8, interpret=interpret), 1),
+            "int8-pallas": (lambda xx, i, q, sc: matmul_w8(
+                xx, q, sc, interpret=interpret), (q8, s8), 1),
         }
         bytes_per = {"bf16": 2 * n_in * n_out, "int8-xla": n_in * n_out,
                      "int4-xla": n_in * n_out // 2,
                      "int4-pallas": n_in * n_out // 2,
                      "int8-pallas": n_in * n_out}
-        for vname, (fn, n_copies) in variants.items():
-            ms = scan_time(fn, n_copies)
+        for vname, (fn, ws, n_copies) in variants.items():
+            ms = scan_time(fn, ws, n_copies)
             bw = bytes_per[vname] / (ms / 1e3) / 1e9
             print(json.dumps({"shape": name, "in": n_in, "out": n_out,
                               "B": B, "variant": vname,

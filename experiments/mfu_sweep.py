@@ -33,6 +33,10 @@ def main() -> None:
     nu_dtype = sys.argv[7] if len(sys.argv) > 7 else "float32"
     accum = int(sys.argv[8]) if len(sys.argv) > 8 else 1
     accum_dtype = sys.argv[9] if len(sys.argv) > 9 else "float32"
+    # LLMCTL_OPT_TYPE=adafactor: AdamW's resident state (fp32 master +
+    # two moments + accum carry) cannot fit accumulation at the 7B shape
+    # on 16 GB; adafactor factors the second moment and drops the first
+    opt_type = os.environ.get("LLMCTL_OPT_TYPE", "adamw")
 
     import jax
 
@@ -52,7 +56,8 @@ def main() -> None:
                          global_batch_size=batch * accum,
                          gradient_accumulation_steps=accum)
     step_fn, tx, _ = make_train_step(
-        cfg, OptimizerConfig(lr=1e-4, moment_dtype=moment_dtype,
+        cfg, OptimizerConfig(type=opt_type, lr=1e-4,
+                             moment_dtype=moment_dtype,
                              nu_dtype=nu_dtype, fused=fused,
                              accum_dtype=accum_dtype), par,
         attn_impl="flash", loss_chunk=loss_chunk)
@@ -81,7 +86,7 @@ def main() -> None:
     print(json.dumps({"model": model_name, "batch": batch, "remat": remat,
                       "moment_dtype": moment_dtype, "loss_chunk": loss_chunk,
                       "fused": fused, "nu_dtype": nu_dtype, "accum": accum,
-                      "accum_dtype": accum_dtype,
+                      "accum_dtype": accum_dtype, "opt": opt_type,
                       "step_ms": round(dt * 1e3, 2),
                       "tok_s": round(tokens_per_sec, 1),
                       "mfu": round(mfu, 4)}))

@@ -1,0 +1,30 @@
+#!/bin/bash
+# Round-5 wave 3. Waits for wave 2 (run_round5b.sh), then:
+#   battery14b      7B pipelined A/B — SKIPPED in wave 2 (the r4 int8
+#                   artifact had been cleaned; regenerated 11:32)
+#   battery_r5c     7B MFU via adafactor (AdamW state can't fit accum
+#                   at this shape on 16 GB — wave-2 ledger)
+#   w8_kernel_cost  re-run: wave-2's run was host-starved by the
+#                   concurrent artifact synthesis (negative timings)
+#                   and the closure-payload 413 is fixed
+# Keep the HOST quiet too: wall-clock differencing is what the kernel
+# costing uses, and a concurrent 13 GB numpy job corrupted it once.
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-experiments/results_r5}
+mkdir -p "$OUT"
+
+for i in $(seq 1 400); do
+  if ! pgrep -f "run_round5b.sh" > /dev/null 2>&1; then
+    break
+  fi
+  sleep 120
+done
+
+bash experiments/tpu_battery14b.sh "$OUT"
+python -m distributed_llm_training_and_inference_system_tpu.cli.main \
+    bench battery --spec experiments/battery_r5c.toml --out "$OUT" \
+    --resume
+source experiments/battery_lib.sh
+run w8_kernel_cost_v2 1800 python experiments/int4_kernel_bench.py 8 50
+echo "round-5 wave 3 complete"
