@@ -227,19 +227,38 @@ class InferenceEngine:
         # chunked prefill: request_id -> progress state (one chunk advances
         # per engine step, interleaved with decode)
         self._partial_prefills: dict[str, dict] = {}
-        self._decode_jit = jax.jit(self._decode_impl, donate_argnums=(1, 2))
-        # latency-adaptive dispatch: a second compiled decode program with
-        # a short scan, used while requests wait in the queue so a prefill
-        # slot opens after ~L steps instead of K (splitting a dispatch is
-        # bitwise-identical output — the scan is the same per-step program)
+        # decode: ONE compiled executable for every dispatch length.
+        # With latency-adaptive dispatch (L > 0) the unit is L steps and
+        # a full dispatch chains floor(K/L) units on the device-resident
+        # scan carry — no host round trip between units, ONE batched
+        # fetch per group — while under queue pressure a dispatch is a
+        # single unit, so a prefill window opens after L steps.
+        # This REPLACES the round-4 two-program design (a second L-step
+        # executable): merely enabling that program cost 18-25%
+        # saturation goodput with zero short dispatches firing
+        # (battery 9, re-confirmed clean in round 5), and the round-5
+        # diagnostic caught 274 XLA compile/retrace events mid-run once
+        # short dispatches DID fire — switching executables over the
+        # donated page buffers churns layouts/caches. One executable
+        # makes the mechanism structurally impossible; splitting a
+        # dispatch into units is bitwise-identical output (same per-step
+        # program, PRNG folded by position).
         K = max(serve_cfg.decode_steps_per_dispatch, 1)
         # L is a CAP: clamp to K-1 so a misconfigured L >= K still helps
         # instead of silently disabling; K == 1 has nothing to shrink
         L = min(serve_cfg.latency_dispatch_steps, K - 1)
-        self._decode_jit_short = (
-            jax.jit(functools.partial(self._decode_impl_n, L),
-                    donate_argnums=(1, 2)) if L > 0 else None)
-        self._short_warmed = self._decode_jit_short is None
+        self._decode_unit_len = L if L > 0 else K
+        # ceil division: a full group covers AT LEAST the configured K
+        # steps (up to L-1 extra — the same wasted-trailing-iteration
+        # trade K itself makes), so round-trip amortisation never
+        # silently shrinks and every 0 < L < K keeps a real short path
+        # (floor made any L > K/2 one unit == no adaptivity at all).
+        # The admission lookahead derives from units * unit_len, so page
+        # reservation tracks the actual group length.
+        self._decode_units = -(-K // L) if L > 0 else 1
+        self._decode_jit = jax.jit(
+            functools.partial(self._decode_impl_n, self._decode_unit_len),
+            donate_argnums=(1, 2))
         self.total_short_dispatches = 0
         self._spec_jit = (jax.jit(self._spec_impl, donate_argnums=(1, 2))
                           if serve_cfg.speculative == "ngram" else None)
@@ -370,7 +389,7 @@ class InferenceEngine:
     def _decode_lookahead(self) -> int:
         """Tokens one device dispatch may write per slot: the page-growth
         horizon for on-demand admission."""
-        k = max(self.serve_cfg.decode_steps_per_dispatch, 1)
+        k = self._decode_units * self._decode_unit_len
         if self.serve_cfg.speculative == "ngram":
             k = max(k, self.serve_cfg.speculative_tokens)
         return k
@@ -787,13 +806,6 @@ class InferenceEngine:
 
     # -- decode --------------------------------------------------------------
 
-    def _decode_impl(self, params, k_pages, v_pages, tokens, positions,
-                     tables, stops, slot_keys, temp, top_k, top_p):
-        return self._decode_impl_n(
-            max(self.serve_cfg.decode_steps_per_dispatch, 1),
-            params, k_pages, v_pages, tokens, positions, tables, stops,
-            slot_keys, temp, top_k, top_p)
-
     def _decode_impl_n(self, num_steps, params, k_pages, v_pages, tokens,
                        positions, tables, stops, slot_keys, temp, top_k,
                        top_p):
@@ -817,7 +829,7 @@ class InferenceEngine:
         K/L x the host round trips would buy nothing). The page probe
         ignores prefix-cache pins — pessimistic, so the failure mode is
         keeping the long program, never wasted RTT."""
-        if self._decode_jit_short is None:
+        if self._decode_units <= 1:
             return False
         # occupancy gate: only at a mostly-empty batch. Near saturation a
         # queued admissible head exists almost every boundary, and paying
@@ -837,73 +849,41 @@ class InferenceEngine:
             len(head.context_tokens) + self._admission_tail(head))
         return need <= self.kv.free_pages - self._reserved_pages
 
-    def _warm_short_program(self) -> None:
-        """AOT-compile the short program off the latency path WITHOUT
-        executing it.
-
-        The round-4 warmup ran one scratch dispatch THROUGH the short
-        executable — which donated and returned the live KV pages, so the
-        pages' producing executable changed once even when the feature
-        never fired afterwards. That dispatch is a candidate mechanism
-        for the battery-9 deficit (enabling adaptive dispatch cost 18%
-        saturation goodput with ZERO short dispatches firing).
-        ``lower().compile()`` builds the executable with zero dispatches
-        and zero page traffic; the compiled object replaces the jit
-        wrapper (same signature, donation preserved), so its first real
-        use still pays no XLA compile on the latency path."""
-        S = self.serve_cfg.max_batch_size
-
-        def aval(x):
-            # shape/dtype(/sharding) placeholder — lower() needs avals,
-            # not data; concrete arrays here would be pure device traffic
-            return jax.ShapeDtypeStruct(
-                jnp.shape(x), jnp.asarray(x).dtype if not hasattr(
-                    x, "dtype") else x.dtype,
-                sharding=getattr(x, "sharding", None))
-
-        i32 = jax.ShapeDtypeStruct((S,), jnp.int32)
-        f32 = jax.ShapeDtypeStruct((S,), jnp.float32)
-        params_avals = jax.tree_util.tree_map(aval, self.params)
-        self._decode_jit_short = self._decode_jit_short.lower(
-            params_avals, aval(self.kv.k_pages), aval(self.kv.v_pages),
-            i32, i32, aval(np.asarray(self.kv.block_tables)), i32,
-            jax.ShapeDtypeStruct((S, 2), jnp.uint32), f32, i32,
-            f32).compile()
-        self._short_warmed = True
-
     def _decode_device(self, use_short: bool = False) -> np.ndarray:
-        """Dispatch K decode steps for every slot; lock-free device work.
+        """Dispatch one decode GROUP and fetch its tokens.
 
-        One dispatch + one device->host fetch per K tokens: the
-        host-round-trip cost (the decode bottleneck on remote devices) is
-        amortised K-fold (see decode.decode_multi_step). While requests
-        WAIT in the queue the short program runs instead, so the next
-        admit/prefill window opens after latency_dispatch_steps instead
-        of K — the measured open-loop p99 device TTFT was dominated by
-        arrivals waiting out a full in-flight dispatch (BASELINE.md r3)."""
-        if not self._short_warmed and self._decode_jit_short is not None:
-            # compile the short program OFF the latency path (piggybacked
-            # on the warmup phase): its first queue-pressure use would
-            # otherwise pay a multi-second XLA compile exactly when a
-            # request is waiting — the opposite of the feature's goal
-            self._warm_short_program()
-        jit = self._decode_jit
-        if use_short and self._decode_jit_short is not None:
-            jit = self._decode_jit_short
+        A group is ``self._decode_units`` chained unit dispatches (ONE
+        when ``use_short`` — the latency-adaptive path: the device
+        finishes after unit_len steps, so the next admit/prefill window
+        opens that much sooner). Units chain on the device-resident scan
+        carry, so the group costs one device->host fetch regardless of
+        unit count — the host-round-trip amortisation of the old K-step
+        program is preserved (see decode.decode_multi_step)."""
+        if use_short:
             self.total_short_dispatches += 1
-        pend = self._submit_decode(jit)
-        return self._fetch_decode(pend)
+        group = self._submit_group(1 if use_short else self._decode_units)
+        return self._fetch_group(group)
 
-    def _submit_decode(self, jit, chain_from=None) -> dict:
-        """Dispatch one K-step decode program WITHOUT fetching results.
+    def _shared_decode_args(self) -> tuple:
+        """Device-convert the dispatch args that are invariant across a
+        group's units (tables, stops, sampling state) ONCE per group —
+        per-unit jnp.asarray would re-upload [B, maxP] block tables
+        units-fold on exactly the remote-link path this design exists
+        to amortise."""
+        return (jnp.asarray(self.kv.block_tables),
+                jnp.asarray(self.stop_positions),
+                jnp.asarray(self._slot_keys), jnp.asarray(self.temperature),
+                jnp.asarray(self.top_k), jnp.asarray(self.top_p))
 
-        ``chain_from``: a previous dispatch's pending record — its final
-        scan carry (tokens, positions) feeds this dispatch as device
-        arrays, so back-to-back dispatches queue on the device with no
-        host round trip between them (the pipelined path; the ~100 ms
-        tunnel RTT was a serial cost per dispatch otherwise). Everything
-        else (tables, stops, sampling state) is host state, valid because
-        step() only chains when no slot was re-armed in between.
+    def _submit_decode(self, chain_from=None, shared=None) -> dict:
+        """Dispatch ONE decode unit WITHOUT fetching results.
+
+        ``chain_from``: a previous dispatch record (unit or group) — its
+        final scan carry (tokens, positions) feeds this dispatch as
+        device arrays, so back-to-back dispatches queue on the device
+        with no host round trip between them. Everything else (tables,
+        stops, sampling state) is host state, valid because step() only
+        chains when no slot was re-armed in between.
 
         Returns a pending record carrying the un-fetched device arrays
         plus the per-slot request-id snapshot apply-time masking needs."""
@@ -913,14 +893,12 @@ class InferenceEngine:
         else:
             tokens = jnp.asarray(self.last_tokens)
             positions = jnp.asarray(self.positions)
+        if shared is None:
+            shared = self._shared_decode_args()
         sampled_seq, next_toks, next_pos, self.kv.k_pages, self.kv.v_pages \
-            = jit(
+            = self._decode_jit(
                 self.params, self.kv.k_pages, self.kv.v_pages,
-                tokens, positions,
-                jnp.asarray(self.kv.block_tables),
-                jnp.asarray(self.stop_positions),
-                jnp.asarray(self._slot_keys), jnp.asarray(self.temperature),
-                jnp.asarray(self.top_k), jnp.asarray(self.top_p))
+                tokens, positions, *shared)
         return {
             "sampled": sampled_seq, "next_tokens": next_toks,
             "next_positions": next_pos,
@@ -929,22 +907,49 @@ class InferenceEngine:
             "active": self.active.copy(),
         }
 
-    def _fetch_decode(self, pend: dict) -> np.ndarray:
-        out = np.asarray(pend["sampled"])          # [K, B]
+    def _submit_group(self, n_units: int, chain_from=None) -> dict:
+        """Chain ``n_units`` unit dispatches; return a group record.
+
+        The group exposes the same keys a unit does (last unit's carry,
+        first unit's slot snapshot — identical across units, nothing
+        re-arms between submissions), so groups chain onto groups in the
+        pipelined path exactly like units chain onto units."""
+        units = []
+        pend = chain_from
+        shared = self._shared_decode_args()
+        for _ in range(n_units):
+            pend = self._submit_decode(chain_from=pend, shared=shared)
+            units.append(pend)
+        return {
+            "units": units,
+            "sampled": None,                 # fetch via _fetch_group
+            "next_tokens": units[-1]["next_tokens"],
+            "next_positions": units[-1]["next_positions"],
+            "req_ids": units[0]["req_ids"],
+            "active": units[0]["active"],
+        }
+
+    def _fetch_group(self, group: dict) -> np.ndarray:
+        """One batched device->host fetch of a group's sampled tokens:
+        [n_units * unit_len, B]. jax.device_get issues the per-unit
+        transfers together, so the link round trip is paid once per
+        group, not per unit."""
+        arrs = jax.device_get([u["sampled"] for u in group["units"]])
+        out = np.concatenate([np.asarray(a) for a in arrs], axis=0)
         self.total_decode_steps += out.shape[0]
         self.total_padded_slot_steps += out.shape[0] * int(
-            self.serve_cfg.max_batch_size - pend["active"].sum())
+            self.serve_cfg.max_batch_size - group["active"].sum())
         return out
 
     def _drain_pending(self) -> None:
-        """Fetch + apply the in-flight pipelined dispatch (if any) so the
-        engine's host state catches up with the device before a
+        """Fetch + apply the in-flight pipelined dispatch group (if any)
+        so the engine's host state catches up with the device before a
         non-chainable action (prefill of a re-armed slot, short dispatch,
         speculation, shutdown)."""
         prev, self._pending = self._pending, None
         if prev is None:
             return
-        sampled = self._fetch_decode(prev)
+        sampled = self._fetch_group(prev)
         with self.lock:
             self._apply_decode(sampled, snapshot=prev)
             self.scheduler.step_finished(self.eos_token_id)
@@ -1120,7 +1125,6 @@ class InferenceEngine:
         self.kv = None
         self._pending = None
         self._decode_jit = None
-        self._decode_jit_short = None
         self._spec_jit = None
         self._prefill_cache.clear()
         self._partial_prefills.clear()
@@ -1373,10 +1377,10 @@ class InferenceEngine:
                 # program order before any reuse, and apply() masks it out
                 # via the request-id snapshot).
                 prev = self._pending
-                self._pending = self._submit_decode(
-                    self._decode_jit, chain_from=prev)
+                self._pending = self._submit_group(
+                    self._decode_units, chain_from=prev)
                 if prev is not None:
-                    sampled = self._fetch_decode(prev)
+                    sampled = self._fetch_group(prev)
                     with self.lock:
                         self._apply_decode(sampled, snapshot=prev)
                         self.scheduler.step_finished(self.eos_token_id)
@@ -1480,7 +1484,7 @@ class InferenceEngine:
             out["prefill_ms"][bucket] = (time.perf_counter() - t0) \
                 / iters * 1e3
         # decode: K steps per dispatch, all slots
-        K = max(self.serve_cfg.decode_steps_per_dispatch, 1)
+        K = self._decode_unit_len      # steps per compiled decode dispatch
         zeros_i = jnp.zeros(self.serve_cfg.max_batch_size, jnp.int32)
         # an all-zero block table sends every probe write to the reserved
         # scratch page — the LIVE tables would route position-0 writes
@@ -1575,15 +1579,17 @@ class InferenceEngine:
         prefill_chunk = sum(1 for k in keys
                             if isinstance(k, tuple) and k[0] == "chunk")
         decode = int(self._decode_jit is not None)   # 0 after release()
-        decode_short = int(self._decode_jit_short is not None)
         spec = int(self._spec_jit is not None)
         return {
             "prefill_dense_buckets": prefill_dense,
             "prefill_extend_buckets": prefill_extend,
             "prefill_chunk_buckets": prefill_chunk,
             "decode": decode,
-            "decode_short": decode_short,
+            # the second (short) decode executable was REMOVED in round
+            # 5 — adaptive dispatch chains units of ONE program; the key
+            # stays for dashboard compatibility and is always 0
+            "decode_short": 0,
             "speculative": spec,
             "total": (prefill_dense + prefill_extend + prefill_chunk
-                      + decode + decode_short + spec),
+                      + decode + spec),
         }

@@ -11,14 +11,17 @@ This probe runs the same c8 cell with per-request timestamps and
 JAX_LOG_COMPILES, A/B, printing: dispatch-count, wall histogram of
 engine.step() latencies, and any compile events inside the timed window.
 
-ROUND-5 NOTE: the engine's short-program warmup changed from "execute
-one scratch dispatch" (which donated + returned the live KV pages
-through the second executable) to a zero-dispatch AOT lower().compile().
-That scratch dispatch was a candidate mechanism for the battery-9
-deficit, so this A/B now discriminates: deficit GONE on the rerun =>
-the warmup execution was the cost (donation/layout churn on the page
-buffers); deficit PERSISTS => mere executable residency, and the next
-suspect is the axon runtime's per-program state.
+ROUND-5 FINDINGS (in order): (1) the AOT lower().compile() warmup did
+NOT remove the deficit — clean A/B measured OFF 193.1 vs ON 144.2
+tok/s with 4 short dispatches and 274 XLA compile/retrace events
+inside the ON run's timed window (the first retained message:
+"Compiling jit(prefill)") — switching executables over the donated
+page buffers churns layouts/caches. (2) The engine was therefore
+REBUILT: adaptive dispatch now chains units of ONE compiled program
+(engine._submit_group); there is no second executable to switch to.
+This A/B now measures the unit-chaining overhead itself — expect the
+ON deficit to collapse to the per-unit dispatch cost, and
+compiles_in_run == 0.
 
 Usage: python experiments/adapt_diag.py [L] (0 = off)
 """

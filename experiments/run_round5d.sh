@@ -1,0 +1,14 @@
+#!/bin/bash
+# Round-5 wave 4: unit-chained adaptive decode A/B. Waits for wave 3.
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-experiments/results_r5}
+for i in $(seq 1 400); do
+  if ! pgrep -f "run_round5c.sh" > /dev/null 2>&1; then
+    break
+  fi
+  sleep 120
+done
+python -m distributed_llm_training_and_inference_system_tpu.cli.main \
+    bench battery --spec experiments/battery_r5d.toml --out "$OUT" --resume
+echo "round-5 wave 4 complete"

@@ -678,10 +678,13 @@ def test_latency_adaptive_dispatch_identical_and_engaged(model_cfg):
 def test_compiled_program_inventory(model_cfg):
     """stats()['compiled_programs'] tracks the resident executables per
     kind — the observable the battery-9 second-executable deficit
-    investigation keys on."""
+    investigation keyed on. Round 5 REMOVED the second decode
+    executable (adaptive dispatch now chains units of one program), so
+    decode_short must report 0 even with adaptivity configured."""
     eng = make_engine(model_cfg, latency_dispatch_steps=2)
     progs = eng.stats()["compiled_programs"]
-    assert progs["decode"] == 1 and progs["decode_short"] == 1
+    assert progs["decode"] == 1 and progs["decode_short"] == 0
+    assert eng._decode_units == 4 and eng._decode_unit_len == 2
     before = progs["total"]
     eng.generate([[1, 2, 3]], SamplingParams(max_tokens=2, temperature=0.0))
     progs2 = eng.stats()["compiled_programs"]
@@ -691,14 +694,14 @@ def test_compiled_program_inventory(model_cfg):
 
 
 def test_short_dispatch_fires_and_matches_plain(model_cfg):
-    """Short dispatches through the AOT-compiled program (round-5 warmup
-    is lower().compile(), never a scratch dispatch) must produce greedy
-    output bitwise-identical to the adaptive-off engine.
+    """Unit-chained adaptive decode (round 5: ONE compiled program;
+    short dispatch = 1 unit, full dispatch = K//L chained units) must
+    produce greedy output bitwise-identical to the adaptive-off engine.
 
     The organic trigger is an arrival landing between a step's admission
     phase and its dispatch — a thread race generate() cannot reproduce
     deterministically — so the decision hook is forced: EVERY dispatch
-    runs the short program, the strictest version of the splitting-
+    is a single unit, the strictest version of the splitting-
     preserves-output property."""
     prompts = [[5, 17, 99, 3], [1, 2, 3, 4, 5], [200, 100, 7],
                [42, 43, 44, 45, 46, 47]]
@@ -713,4 +716,43 @@ def test_short_dispatch_fires_and_matches_plain(model_cfg):
     got = [r.generated_tokens for r in eng.generate(prompts, sp)]
     assert got == ref
     assert eng.total_short_dispatches > 0
-    assert eng.stats()["compiled_programs"]["decode_short"] == 1
+    assert eng.stats()["compiled_programs"]["decode_short"] == 0
+
+
+def test_unit_chained_full_dispatch_matches_plain(model_cfg):
+    """A FULL adaptive dispatch is floor(K/L) chained units of the one
+    compiled program (round 5); its output — greedy AND sampled rows —
+    must be bitwise-identical to the plain K-step engine. L=3 with K=8
+    exercises the ceil split (3 units x 3 steps per group — at least
+    the configured K, never silently fewer)."""
+    prompts = [[5, 17, 99, 3], [1, 2, 3, 4, 5]]
+    sp = SamplingParams(temperature=0.7, top_k=5, max_tokens=9, seed=11)
+
+    ref_eng = make_engine(model_cfg, max_batch_size=2)
+    ref = [r.generated_tokens for r in ref_eng.generate(prompts, sp)]
+
+    eng = make_engine(model_cfg, max_batch_size=2,
+                      latency_dispatch_steps=3)
+    assert eng._decode_units == 3 and eng._decode_unit_len == 3
+    got = [r.generated_tokens for r in eng.generate(prompts, sp)]
+    # PRNG folds by position, so the dispatch split is invisible to
+    # sampling — byte-equal even for the temperature/top-k rows
+    assert got == ref
+    assert eng.total_short_dispatches == 0     # gate never fired here
+
+
+def test_pipelined_and_adaptive_compose(model_cfg):
+    """pipelined_decode=True + latency_dispatch_steps>0: pipelined
+    groups chain onto groups (the group record exposes a unit's carry
+    keys); tokens must match the plain engine bitwise."""
+    prompts = [[5, 17, 99, 3], [1, 2, 3, 4, 5], [200, 100, 7],
+               [42, 43, 44, 45, 46, 47]]
+    sp = SamplingParams(temperature=0.0, max_tokens=12)
+
+    ref_eng = make_engine(model_cfg, max_batch_size=4)
+    ref = [r.generated_tokens for r in ref_eng.generate(prompts, sp)]
+
+    eng = make_engine(model_cfg, max_batch_size=4,
+                      latency_dispatch_steps=2, pipelined_decode=True)
+    got = [r.generated_tokens for r in eng.generate(prompts, sp)]
+    assert got == ref
