@@ -63,16 +63,22 @@ def main() -> None:
     import os as _os
     recipes = {
         "gpt-750m": dict(batch=4, accum=16, chunk=1024),
-        # b2: b4 OOMs by 1.34 GB at chunk 1024 (battery 12). The fp32
-        # accumulation carry OOM'd every b2 x accum row by 3.85 GB
-        # (results_r5) — the bf16 carry (OptimizerConfig.accum_dtype)
-        # halves it and chunk 512 trims the CE workspace
+        # THE NORTH-STAR SHAPE (H=4096, ffn 11008, V=50304 — gpt-7b's
+        # per-layer geometry). AdamW cannot fit accumulation here on a
+        # 16 GB chip (fp32 master 4.9 + moments 4.9 + carry + ~6 GB
+        # transient — every row OOM'd, results_r5); the measured fit is
+        # adafactor (factored second moment, no mu) + bf16 accumulation
+        # carry + chunk-512 CE: MFU 0.5817 at b2 x accum8
+        # (mfu7b4l_b2_a8_adafactor, results_r5) — above the >=0.50 bar.
         "gpt-7b-4l": dict(batch=2, accum=8, chunk=512,
-                          accum_dtype="bfloat16"),
+                          accum_dtype="bfloat16", opt="adafactor"),
         "gpt-test": dict(batch=4, accum=2, chunk=1024),
     }
+    # flagship: the north-star shape now that its recipe measures >=0.50
+    # (round-4 verdict item 2); LLMCTL_BENCH_MODEL=gpt-750m recovers the
+    # round-3/4 comparison statistic
     model_name = _os.environ.get("LLMCTL_BENCH_MODEL") or (
-        "gpt-750m" if on_tpu else "gpt-test")
+        "gpt-7b-4l" if on_tpu else "gpt-test")
     r = recipes.get(model_name, recipes["gpt-test" if not on_tpu
                                         else "gpt-750m"])
     seq_len = 2048 if on_tpu else 128
@@ -85,10 +91,16 @@ def main() -> None:
                          micro_batch_size=batch,
                          global_batch_size=batch * accum,
                          gradient_accumulation_steps=accum)
+    opt_type = r.get("opt", "adamw")
     step_fn, tx, _ = make_train_step(
-        cfg, OptimizerConfig(lr=1e-4, moment_dtype="bfloat16",
-                             nu_dtype="bfloat16",
-                             accum_dtype=r.get("accum_dtype", "float32")),
+        cfg, OptimizerConfig(
+            type=opt_type, lr=1e-4,
+            # moment dtypes and the fused kernel are adam-family knobs;
+            # adafactor goes through the optax path
+            moment_dtype="bfloat16" if opt_type == "adamw" else "float32",
+            nu_dtype="bfloat16" if opt_type == "adamw" else "float32",
+            fused=opt_type == "adamw",
+            accum_dtype=r.get("accum_dtype", "float32")),
         par, attn_impl="flash" if on_tpu else "xla", loss_chunk=r["chunk"])
     params = init(cfg, jax.random.PRNGKey(0))
     state = TrainState.create(params, tx)

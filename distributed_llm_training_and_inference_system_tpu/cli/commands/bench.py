@@ -128,10 +128,12 @@ def kernels(op, seq_len, hidden, heads, batch):
 @click.option("--slots", default=0, show_default=True, type=int,
               help="serve-load: decode slot count (max_batch_size); "
                    "0 = auto from --requests (capped at 16).")
-@click.option("--pipelined/--no-pipelined", "pipelined", default=False,
+@click.option("--pipelined/--no-pipelined", "pipelined", default=True,
               show_default=True,
               help="serve-load: pipelined decode dispatch (one un-fetched "
-                   "dispatch in flight, chained on the device carry).")
+                   "dispatch in flight, chained on the device carry). "
+                   "Default matches production serving (ON since round "
+                   "5); pass --no-pipelined for the unpipelined control.")
 @click.option("--int8-pallas/--no-int8-pallas", "int8_pallas",
               default=False, show_default=True,
               help="serve-load: route int8 decode matmuls through the "

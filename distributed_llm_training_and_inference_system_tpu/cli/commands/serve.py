@@ -81,12 +81,13 @@ def app(ctx):
               help="Shrink decode dispatches to this many steps while "
                    "requests wait in the queue with a free slot, so "
                    "prefill windows open sooner (0 disables).")
-@click.option("--pipelined-decode/--no-pipelined-decode", default=False,
+@click.option("--pipelined-decode/--no-pipelined-decode", default=True,
               show_default=True,
               help="Keep one un-fetched decode dispatch in flight and "
                    "chain the next on its device carry (overlaps the "
                    "per-dispatch host round trip; engages at >= half-full "
-                   "batches; bitwise-identical output).")
+                   "batches; bitwise-identical output; measured +20-25% "
+                   "saturation goodput at 1B/7B — round 5).")
 @click.option("--int8-pallas/--no-int8-pallas", "int8_pallas",
               default=False, show_default=True,
               help="Route int8 decode matmuls through the in-kernel-"

@@ -229,7 +229,7 @@ class InferenceEngine:
         self._partial_prefills: dict[str, dict] = {}
         # decode: ONE compiled executable for every dispatch length.
         # With latency-adaptive dispatch (L > 0) the unit is L steps and
-        # a full dispatch chains floor(K/L) units on the device-resident
+        # a full dispatch chains ceil(K/L) units on the device-resident
         # scan carry — no host round trip between units, ONE batched
         # fetch per group — while under queue pressure a dispatch is a
         # single unit, so a prefill window opens after L steps.
@@ -1227,10 +1227,11 @@ class InferenceEngine:
         Caller holds self.lock."""
         if self.serve_cfg.admission != "ondemand":
             return
-        # lag: un-applied pipelined dispatch in flight — the device is
-        # already K tokens past the host's positions, so the NEXT
-        # (chained) dispatch writes up to positions + lag + k
-        lag = (max(self.serve_cfg.decode_steps_per_dispatch, 1)
+        # lag: un-applied pipelined dispatch GROUP in flight — the
+        # device is already a full group (units * unit_len >= K; the
+        # ceil split can exceed K) past the host's positions, so the
+        # NEXT (chained) dispatch writes up to positions + lag + k
+        lag = (self._decode_units * self._decode_unit_len
                if self._pending is not None else 0)
         k = self._decode_lookahead + lag
         order = sorted(np.flatnonzero(self.active),

@@ -1,0 +1,14 @@
+#!/bin/bash
+# Round-5 wave 5. Waits for wave 4.
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-experiments/results_r5}
+for i in $(seq 1 400); do
+  if ! pgrep -f "run_round5d.sh" > /dev/null 2>&1; then
+    break
+  fi
+  sleep 120
+done
+python -m distributed_llm_training_and_inference_system_tpu.cli.main \
+    bench battery --spec experiments/battery_r5e.toml --out "$OUT" --resume
+echo "round-5 wave 5 complete"

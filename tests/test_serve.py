@@ -720,7 +720,7 @@ def test_short_dispatch_fires_and_matches_plain(model_cfg):
 
 
 def test_unit_chained_full_dispatch_matches_plain(model_cfg):
-    """A FULL adaptive dispatch is floor(K/L) chained units of the one
+    """A FULL adaptive dispatch is ceil(K/L) chained units of the one
     compiled program (round 5); its output — greedy AND sampled rows —
     must be bitwise-identical to the plain K-step engine. L=3 with K=8
     exercises the ceil split (3 units x 3 steps per group — at least
@@ -754,5 +754,27 @@ def test_pipelined_and_adaptive_compose(model_cfg):
 
     eng = make_engine(model_cfg, max_batch_size=4,
                       latency_dispatch_steps=2, pipelined_decode=True)
+    got = [r.generated_tokens for r in eng.generate(prompts, sp)]
+    assert got == ref
+
+
+def test_pipelined_adaptive_tight_pool_reserves_group_length(model_cfg):
+    """The in-flight pipelined GROUP can be ceil(K/L)*L > K steps ahead
+    of host positions; page reservation must use the group length, not
+    K (review r5: lag=K under-reserved by up to unit_len*units-K and
+    the decode scan would write through an unassigned block-table
+    entry). Tight pool + non-divisor L + long generations force page
+    growth while a chained group is in flight; tokens must match the
+    plain engine bitwise."""
+    prompts = [[5, 17, 99, 3], [1, 2, 3, 4]]
+    sp = SamplingParams(temperature=0.0, max_tokens=40)
+
+    ref_eng = make_engine(model_cfg, max_batch_size=2, kv_num_blocks=20)
+    ref = [r.generated_tokens for r in ref_eng.generate(prompts, sp)]
+
+    eng = make_engine(model_cfg, max_batch_size=2, kv_num_blocks=20,
+                      latency_dispatch_steps=3, pipelined_decode=True,
+                      admission="ondemand")
+    assert eng._decode_units * eng._decode_unit_len == 9   # > K=8
     got = [r.generated_tokens for r in eng.generate(prompts, sp)]
     assert got == ref
