@@ -1,0 +1,14 @@
+#!/bin/bash
+# Round-5 final measurement runner: wave 4 (unit-chain A/B) then wave 5
+# (flagship validation, 7B adaptive light-load, MoE carry rows, spec
+# re-measure), sequentially. The per-wave pgrep chaining deadlocked
+# (the launching shell's cmdline contained the watched string), so this
+# runner just runs both batteries in order.
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-experiments/results_r5}
+python -m distributed_llm_training_and_inference_system_tpu.cli.main \
+    bench battery --spec experiments/battery_r5d.toml --out "$OUT" --resume
+python -m distributed_llm_training_and_inference_system_tpu.cli.main \
+    bench battery --spec experiments/battery_r5e.toml --out "$OUT" --resume
+echo "round-5 final waves complete"
