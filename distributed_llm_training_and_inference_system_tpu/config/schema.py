@@ -539,21 +539,29 @@ class ServeConfig:
     # dispatches, so K also bounds admission latency in decode steps.
     decode_steps_per_dispatch: int = 8
     # latency-adaptive dispatch: while an ADMISSIBLE request waits in the
-    # queue, decode dispatches shrink to min(this, K-1) steps so a
-    # prefill slot opens sooner — an arrival landing just after a K=8
-    # dispatch began otherwise waits out the whole ~K*step_time window
-    # (the measured open-loop p99 device TTFT was 249 ms vs a 26 ms
-    # prefill floor, BASELINE.md round 3). Splitting a dispatch is
-    # bitwise-identical output (the scan is literally the same per-step
-    # program). 0 disables; values >= K clamp to K-1 (never a silent
-    # no-op); K = 1 has nothing to shrink. DEFAULT OFF — round-4 verdict
-    # (BASELINE battery 9, n=3 interleaved): enabling costs 18%
-    # saturation goodput at 1B shapes with ZERO short dispatches firing
-    # (a side effect of the second compiled program, not the mechanism),
-    # and light-load 1B tails showed no replicable gain. The one measured
-    # win is LONG-dispatch-window models (gpt-7b: 326 ms windows —
-    # p50 161-172 ms and closed-loop p99 181 ms vs 182/214 off, battery
-    # 8); enable only there.
+    # queue, the next decode dispatch is ONE unit of min(this, K-1)
+    # steps so a prefill slot opens sooner — an arrival landing just
+    # after a K=8 dispatch began otherwise waits out the whole
+    # ~K*step_time window. Splitting a dispatch is bitwise-identical
+    # output (same per-step program, PRNG folded by position). 0
+    # disables; values >= K clamp to K-1 (never a silent no-op); K = 1
+    # has nothing to shrink.
+    #
+    # ROUND-5 REDESIGN: there is no second compiled program. The decode
+    # executable is one L-step unit; a full dispatch chains ceil(K/L)
+    # units on the device-resident carry with a single batched fetch.
+    # The round-4 "-18% goodput with zero short dispatches firing" tax
+    # was executable switching (274 XLA recompile events caught in one
+    # diagnosed run) and is structurally gone (re-measured: ON runs
+    # show compiles_in_run == 0). The REMAINING cost of enabling is
+    # real per-unit launch overhead at saturation: ceil(K/L) device
+    # program launches per group instead of one (measured ~20% at the
+    # 1B c8 cell with L=2 -> 4 units). Pick L >= K/2 (2 units) to bound
+    # it; the feature's regime is LIGHT-load TTFT on long-dispatch-
+    # window models (7B: K=8 windows are ~300 ms device), where the
+    # occupancy gate fires shortening and per-unit overhead is noise.
+    # DEFAULT OFF: saturation-focused deployments lose, light-load
+    # 7B-class deployments should enable with L = K/2.
     latency_dispatch_steps: int = 0
     # pipelined decode: keep ONE un-fetched dispatch group in flight and
     # chain the next dispatch on its device-resident scan carry, so the
