@@ -402,8 +402,14 @@ def dataloader(path, batch, seq_len, batches, prefetch, workers, step_ms):
 @click.option("--dry-run", is_flag=True,
               help="Parse and validate the spec, list the items and which "
                    "would be skipped by --resume, run nothing.")
+@click.option("--chip-lock", default="/tmp/llmctl_chip.lock",
+              show_default=True,
+              help="flock() this path for the duration of the battery so "
+                   "concurrent batteries serialize instead of sharing the "
+                   "chip mid-measurement (a concurrent probe contaminated "
+                   "one round-5 A/B with 27 s step outliers). '' disables.")
 def battery(spec, out_dir, resume, wait_for_chip, probe_interval,
-            max_probes, tpu_guard, dry_run):
+            max_probes, tpu_guard, dry_run, chip_lock):
     """Run a config-listed measurement battery with per-item timeouts,
     resume-from-partial, and chip-outage parking.
 
@@ -514,70 +520,100 @@ def battery(spec, out_dir, resume, wait_for_chip, probe_interval,
             time.sleep(probe_interval)
         return False
 
-    ran = skipped = failed = 0
-    parked = False
-    # validate the WHOLE spec before any item runs — a malformed item at
-    # position 9 must not surface after 8 items of chip time
+    # validate the WHOLE spec before any item runs (and before the lock
+    # wait, which can be hours) — a malformed item at position 9 must
+    # not surface after 8 items of chip time
     plans = [plan_item(i, it) for i, it in enumerate(items)]
-    for it, (argv, timeout_s, done) in zip(items, plans):
-        name = it["name"]
-        if done:
-            click.echo(f"=== {name}: already done (rc=0), skipping ===")
-            skipped += 1
-            continue
-        if not wait_chip():
-            parked = True
-            click.echo(f"=== {name}: chip unavailable — battery parked "
-                       "(resume with the same command) ===", err=True)
-            break
-        log_path = out / f"{name}.log"
-        click.echo(f"=== {name} (timeout {timeout_s:.0f}s) ===")
-        t0 = time.time()
-        with open(log_path, "w") as log:
-            try:
-                rc = subprocess.run(argv, stdout=log,
-                                    stderr=subprocess.STDOUT,
-                                    env=item_env,
-                                    timeout=timeout_s).returncode
-            except subprocess.TimeoutExpired:
-                rc = -9
-                log.write(f"\nbattery watchdog: item exceeded "
-                          f"{timeout_s:.0f}s and was killed\n")
-            except FileNotFoundError as e:
-                rc = 127
-                log.write(f"\n{e}\n")
-        dt = time.time() - t0
-        with open(log_path, "r+b") as log:
-            # a killed item's stdout can end mid-line — keep the rc
-            # marker on its own line so log parsers see it
-            log.seek(0, 2)
-            if log.tell() > 0:
-                log.seek(-1, 2)
-                if log.read(1) != b"\n":
-                    log.write(b"\n")
-            log.write(f"rc={rc}\n".encode())
-        # bounded tail: a verbose 40-min item can write a huge log —
-        # don't load it all just to echo three lines
-        with open(log_path, "rb") as log:
-            log.seek(0, 2)
-            log.seek(max(log.tell() - 4096, 0))
-            tail = log.read().decode(errors="replace").splitlines()[-4:-1]
-        for line in tail:
-            click.echo(f"  {line}")
-        manifest["items"][name] = {"rc": rc, "seconds": round(dt, 1),
-                                   "cmd": argv, "log": str(log_path)}
-        manifest_path.write_text(json.dumps(manifest, indent=2))
-        if rc == 0:
-            ran += 1
-        else:
-            failed += 1
-            click.echo(f"  item {name} rc={rc}", err=True)
-    click.echo(json.dumps({"ran": ran, "skipped": skipped,
-                           "failed": failed, "parked": parked,
-                           "manifest": str(manifest_path)}))
-    if parked:
-        # distinct from item failure: nothing is wrong with the battery,
-        # the chip never answered — wrappers should retry, not give up
-        raise SystemExit(2)
-    if failed:
-        raise SystemExit(1)
+
+    lock_fh = None
+    if chip_lock:
+        # machine-global measurement mutex: the chip (and the host's
+        # wall clock, which the kernel costings difference) must be
+        # quiet during a battery — waiting here is always cheaper than
+        # re-running a contaminated A/B. O_CREAT + world-writable mode
+        # so a lock file created by another user on a shared host still
+        # opens (a plain open('w') raised PermissionError and killed
+        # the battery the mutex exists to protect)
+        import fcntl
+        fd = _os.open(chip_lock, _os.O_RDWR | _os.O_CREAT, 0o666)
+        lock_fh = _os.fdopen(fd, "w")
+        try:
+            fcntl.flock(lock_fh, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            click.echo(f"waiting for chip lock {chip_lock} "
+                       "(another battery is running)...", err=True)
+            fcntl.flock(lock_fh, fcntl.LOCK_EX)
+
+    try:
+        ran = skipped = failed = 0
+        parked = False
+        for it, (argv, timeout_s, done) in zip(items, plans):
+            name = it["name"]
+            if done:
+                click.echo(f"=== {name}: already done (rc=0), skipping ===")
+                skipped += 1
+                continue
+            if not wait_chip():
+                parked = True
+                click.echo(f"=== {name}: chip unavailable — battery parked "
+                           "(resume with the same command) ===", err=True)
+                break
+            log_path = out / f"{name}.log"
+            click.echo(f"=== {name} (timeout {timeout_s:.0f}s) ===")
+            t0 = time.time()
+            with open(log_path, "w") as log:
+                try:
+                    rc = subprocess.run(argv, stdout=log,
+                                        stderr=subprocess.STDOUT,
+                                        env=item_env,
+                                        timeout=timeout_s).returncode
+                except subprocess.TimeoutExpired:
+                    rc = -9
+                    log.write(f"\nbattery watchdog: item exceeded "
+                              f"{timeout_s:.0f}s and was killed\n")
+                except FileNotFoundError as e:
+                    rc = 127
+                    log.write(f"\n{e}\n")
+            dt = time.time() - t0
+            with open(log_path, "r+b") as log:
+                # a killed item's stdout can end mid-line — keep the rc
+                # marker on its own line so log parsers see it
+                log.seek(0, 2)
+                if log.tell() > 0:
+                    log.seek(-1, 2)
+                    if log.read(1) != b"\n":
+                        log.write(b"\n")
+                log.write(f"rc={rc}\n".encode())
+            # bounded tail: a verbose 40-min item can write a huge log —
+            # don't load it all just to echo three lines
+            with open(log_path, "rb") as log:
+                log.seek(0, 2)
+                log.seek(max(log.tell() - 4096, 0))
+                tail = log.read().decode(errors="replace").splitlines()[-4:-1]
+            for line in tail:
+                click.echo(f"  {line}")
+            manifest["items"][name] = {"rc": rc, "seconds": round(dt, 1),
+                                       "cmd": argv, "log": str(log_path)}
+            manifest_path.write_text(json.dumps(manifest, indent=2))
+            if rc == 0:
+                ran += 1
+            else:
+                failed += 1
+                click.echo(f"  item {name} rc={rc}", err=True)
+        click.echo(json.dumps({"ran": ran, "skipped": skipped,
+                               "failed": failed, "parked": parked,
+                               "manifest": str(manifest_path)}))
+        if parked:
+            # distinct from item failure: nothing is wrong with the battery,
+            # the chip never answered — wrappers should retry, not give up
+            raise SystemExit(2)
+        if failed:
+            raise SystemExit(1)
+    finally:
+        if lock_fh is not None:
+            # explicit release: a SystemExit traceback held by the
+            # caller (test runners, wrappers) keeps this frame —
+            # and with it the flock'd fd — alive, deadlocking the
+            # next battery in the same process
+            lock_fh.close()
+

@@ -250,7 +250,9 @@ class TestBenchBattery:
              "timeout": 60},
         ])
         out = tmp_path / "res"
-        result = invoke(runner, ["bench", "battery", "--spec", spec,
+        result = invoke(runner, ["bench", "battery", "--chip-lock",
+                                 str(tmp_path / "lk"),
+                                 "--spec", spec,
                                  "--out", str(out), "--no-guard"])
         man = json.loads((out / "battery_manifest.json").read_text())
         assert man["items"]["a"]["rc"] == 0
@@ -264,14 +266,18 @@ class TestBenchBattery:
             {"name": "bad", "cmd": "python -c \"import sys; sys.exit(3)\""},
         ])
         out = tmp_path / "res"
-        r1 = runner.invoke(cli, ["bench", "battery", "--spec", spec,
+        r1 = runner.invoke(cli, ["bench", "battery", "--chip-lock",
+                                 str(tmp_path / "lk"),
+                                 "--spec", spec,
                                  "--out", str(out), "--no-guard"],
                            catch_exceptions=False)
         assert r1.exit_code == 1      # failed item propagates
         man = json.loads((out / "battery_manifest.json").read_text())
         assert man["items"]["bad"]["rc"] == 3
         # second run: 'ok' skipped, 'bad' retried
-        r2 = runner.invoke(cli, ["bench", "battery", "--spec", spec,
+        r2 = runner.invoke(cli, ["bench", "battery", "--chip-lock",
+                                 str(tmp_path / "lk"),
+                                 "--spec", spec,
                                  "--out", str(out), "--no-guard"],
                            catch_exceptions=False)
         assert "already done" in r2.output
@@ -283,7 +289,9 @@ class TestBenchBattery:
              "timeout": 2},
         ])
         out = tmp_path / "res"
-        r = runner.invoke(cli, ["bench", "battery", "--spec", spec,
+        r = runner.invoke(cli, ["bench", "battery", "--chip-lock",
+                                 str(tmp_path / "lk"),
+                                 "--spec", spec,
                                 "--out", str(out), "--no-guard"],
                           catch_exceptions=False)
         assert r.exit_code == 1
@@ -309,7 +317,9 @@ class TestBenchBattery:
             return real_run(argv, **kw)
 
         monkeypatch.setattr(sp, "run", fake_run)
-        r = runner.invoke(cli, ["bench", "battery", "--spec", spec,
+        r = runner.invoke(cli, ["bench", "battery", "--chip-lock",
+                                 str(tmp_path / "lk"),
+                                 "--spec", spec,
                                 "--out", str(out), "--no-wait-for-chip",
                                 "--max-probes", "1"],
                           catch_exceptions=False)
@@ -325,12 +335,16 @@ class TestBenchBattery:
             {"name": "m", "cmd": "python -c \"print('v1')\""},
         ])
         out = tmp_path / "res"
-        invoke(runner, ["bench", "battery", "--spec", spec,
+        invoke(runner, ["bench", "battery", "--chip-lock",
+                                 str(tmp_path / "lk"),
+                                 "--spec", spec,
                         "--out", str(out), "--no-guard"])
         spec = self._spec(tmp_path, [
             {"name": "m", "cmd": "python -c \"print('v2')\""},
         ])
-        r = invoke(runner, ["bench", "battery", "--spec", spec,
+        r = invoke(runner, ["bench", "battery", "--chip-lock",
+                                 str(tmp_path / "lk"),
+                                 "--spec", spec,
                             "--out", str(out), "--no-guard"])
         assert "already done" not in r.output
         assert "v2" in (out / "m.log").read_text()
@@ -342,7 +356,9 @@ class TestBenchBattery:
                     "\"import os; print(os.environ['BATTERY_TEST_ENV'])\""},
         ], env={"BATTERY_TEST_ENV": "from-spec"})
         out = tmp_path / "res"
-        invoke(runner, ["bench", "battery", "--spec", spec,
+        invoke(runner, ["bench", "battery", "--chip-lock",
+                                 str(tmp_path / "lk"),
+                                 "--spec", spec,
                         "--out", str(out), "--no-guard"])
         assert "from-spec" in (out / "envcheck.log").read_text()
 
@@ -351,7 +367,28 @@ class TestBenchBattery:
             {"name": "x", "cmd": "python -c \"print('nope')\""},
         ])
         out = tmp_path / "res"
-        r = invoke(runner, ["bench", "battery", "--spec", spec,
+        r = invoke(runner, ["bench", "battery", "--chip-lock",
+                                 str(tmp_path / "lk"),
+                                 "--spec", spec,
                             "--out", str(out), "--no-guard", "--dry-run"])
         assert "run " in r.output and "x" in r.output
         assert not (out / "x.log").exists()
+
+
+class TestChipLock:
+    def test_lock_released_after_failed_battery(self, runner, tmp_path):
+        """A battery exiting via SystemExit (failed item) must RELEASE
+        the chip lock before the exception propagates: the caller's
+        traceback keeps the frame (and a GC-released fd) alive, which
+        deadlocked the next in-process battery (round-5 regression)."""
+        spec = tmp_path / "battery.toml"
+        spec.write_text('[[item]]\nname = "bad"\n'
+                        'cmd = "python -c \\"import sys; sys.exit(3)\\""\n')
+        lock = str(tmp_path / "lk")
+        args = ["bench", "battery", "--chip-lock", lock, "--spec",
+                str(spec), "--out", str(tmp_path / "res"), "--no-guard"]
+        r1 = runner.invoke(cli, args, catch_exceptions=False)
+        assert r1.exit_code == 1
+        # would hang forever before the fix
+        r2 = runner.invoke(cli, args, catch_exceptions=False)
+        assert r2.exit_code == 1
