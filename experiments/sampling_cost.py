@@ -1,0 +1,74 @@
+"""Clean per-tier sampling cost at serve shapes ([B, V] = [8, 50304]).
+
+The round-5 tier restructure (serve/sampling.py) was first timed during
+chip contention (spec training shared the device), which inverted the
+filtered-path comparison. This probe runs each tier's sample_tokens in
+a fenced scan (runtime args — closure consts would let XLA fold the
+tier predicates) and prints one JSON line per tier.
+
+Usage: python experiments/sampling_cost.py [B] [V] [iters]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    B = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    V = int(sys.argv[2]) if len(sys.argv) > 2 else 50304
+    iters = int(sys.argv[3]) if len(sys.argv) > 3 else 100
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_llm_training_and_inference_system_tpu.serve.sampling import (
+        sample_tokens)
+
+    logits = jax.random.normal(jax.random.PRNGKey(0), (B, V), jnp.float32)
+    keys = jax.vmap(jax.random.fold_in)(
+        jnp.stack([jax.random.PRNGKey(1)] * B),
+        jnp.arange(B, dtype=jnp.int32))
+
+    tiers = {
+        "greedy": (jnp.zeros(B), jnp.zeros(B, jnp.int32), jnp.ones(B)),
+        "unfiltered": (jnp.ones(B), jnp.zeros(B, jnp.int32), jnp.ones(B)),
+        "topk40": (jnp.ones(B), jnp.full((B,), 40, jnp.int32), jnp.ones(B)),
+        "topk40_topp09": (jnp.ones(B), jnp.full((B,), 40, jnp.int32),
+                          jnp.full((B,), 0.9)),
+        "mixed": (jnp.where(jnp.arange(B) % 2 == 0, 0.0, 1.0),
+                  jnp.where(jnp.arange(B) % 2 == 0, 0, 40).astype(jnp.int32),
+                  jnp.ones(B)),
+    }
+
+    def scan_time(t, k, p):
+        @jax.jit
+        def run(logits, keys, t, k, p):
+            def body(c, i):
+                tok = sample_tokens(c, keys, t, k, p)
+                # data dependency so iterations serialise
+                return jnp.where(jnp.arange(V)[None, :] == tok[:, None],
+                                 c * 1.0000001, c), None
+            out, _ = jax.lax.scan(body, logits, jnp.arange(iters))
+            return out[0, 0]
+        float(run(logits, keys, t, k, p))   # compile + warm
+        best = 1e9
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(run(logits, keys, t, k, p))
+            best = min(best, (time.perf_counter() - t0) / iters)
+        return best * 1e3
+
+    for name, (t, k, p) in tiers.items():
+        ms = scan_time(t, k, p)
+        print(json.dumps({"tier": name, "B": B, "V": V,
+                          "ms_per_step": round(ms, 4)}))
+
+
+if __name__ == "__main__":
+    main()
