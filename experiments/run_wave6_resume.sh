@@ -1,0 +1,9 @@
+#!/bin/bash
+# picks up the rows appended to battery_r5f.toml after the wave-6
+# battery had loaded its spec (the chip flock serializes us behind it)
+set -u
+cd "$(dirname "$0")/.."
+python -m distributed_llm_training_and_inference_system_tpu.cli.main \
+    bench battery --spec experiments/battery_r5f.toml \
+    --out experiments/results_r5 --resume
+echo "wave-6 resume complete"
