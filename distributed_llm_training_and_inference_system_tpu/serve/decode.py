@@ -140,14 +140,16 @@ def extend_step_forward(
     use_w8_kernel = w8_kernel_ok and jax.default_backend() == "tpu"
 
     def mm(a, w):
+        import math
+
         from ..ops.quantization import Quant4Tensor, QuantTensor
+        # rows <= 64 keeps the Pallas kernels' whole-K activation blocks
+        # in the 1-2 MB VMEM regime they were designed for (decode T=1,
+        # verify windows T<=8); long-T chunked/suffix prefill through
+        # those tiles would blow VMEM — it takes the dequant path, where
+        # T amortises the bf16 round trip anyway
+        rows = math.prod(a.shape[:-1])
         if isinstance(w, QuantTensor):
-            rows = 1
-            for d in a.shape[:-1]:
-                rows *= d
-            # same routing regime as W4: short-row decode/verify shapes
-            # only; long-T prefill amortises the dequant round trip and
-            # its whole-K activation blocks would blow the kernel's VMEM
             if (use_w8_kernel and rows <= 64
                     and w.shape[-1] % 128 == 0):
                 from ..ops.int8_matmul_pallas import matmul_w8
@@ -157,14 +159,6 @@ def extend_step_forward(
             w = w.dequant(compute_dtype)
         if isinstance(w, Quant4Tensor):
             n_in, n_out = w.shape[-2], w.shape[-1]
-            rows = 1
-            for d in a.shape[:-1]:
-                rows *= d
-            # rows <= 64 keeps the kernel's whole-K activation blocks in
-            # the 1-2 MB VMEM regime it was designed for (decode T=1,
-            # verify windows T<=8); long-T chunked/suffix prefill through
-            # these tiles would blow VMEM — it takes the dequant path,
-            # where T amortises the bf16 round trip anyway
             if (use_w4_kernel and rows <= 64 and n_out % 128 == 0
                     and n_in % w.group == 0):
                 from ..ops.int4_matmul_pallas import matmul_w4

@@ -922,7 +922,6 @@ class InferenceEngine:
             units.append(pend)
         return {
             "units": units,
-            "sampled": None,                 # fetch via _fetch_group
             "next_tokens": units[-1]["next_tokens"],
             "next_positions": units[-1]["next_positions"],
             "req_ids": units[0]["req_ids"],
