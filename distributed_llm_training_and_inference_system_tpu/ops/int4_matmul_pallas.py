@@ -84,9 +84,16 @@ def matmul_w4(x: jax.Array, packed: jax.Array, scale: jax.Array,
     if block_out == 0:
         # largest standard tile dividing n_out (gpt-7b's FFN 11008 =
         # 86*128 divides 256 but not 512 — a fixed 512 crashed the serve
-        # trace, round-4 review); fall back to the whole dim
+        # trace, round-4 review) whose VMEM residents fit: the packed
+        # tile [in/2, bo] expands to TWO bf16 planes in-kernel (~5x the
+        # packed bytes live at once), and in=11008 with bo=512 failed
+        # Mosaic compilation outright (round-5 kernel bench — the same
+        # shape gpt-7b serving routes through for the FFN down-proj).
+        # Fall back to the whole dim only for tiny no-128-divisor outs.
+        budget = 2**20
         block_out = next((b for b in (512, 256, 128)
-                          if n_out % b == 0), n_out)
+                          if n_out % b == 0 and (n_in // 2) * b <= budget),
+                         128 if n_out % 128 == 0 else n_out)
     bo = min(block_out, n_out)
     if n_out % bo:
         raise ValueError(f"out={n_out} not divisible by block_out={bo}")

@@ -42,7 +42,11 @@ def main() -> None:
 
     interpret = jax.default_backend() != "tpu"
     shapes = [("gpt-1b.ffn", 2048, 5632), ("gpt-1b.attn", 2048, 2048),
-              ("gpt-7b.ffn", 4096, 11008), ("gpt-7b.attn", 4096, 4096)]
+              ("gpt-7b.ffn", 4096, 11008), ("gpt-7b.attn", 4096, 4096),
+              # down-proj: the wide-REDUCTION case (in=11008) that
+              # forced the whole-K W8 kernel to a 128-wide tile — the
+              # round-5 k-split path exists for exactly this shape
+              ("gpt-7b.ffn_dn", 11008, 4096)]
 
     # decode streams weights from HBM every step; a naive scan over ONE
     # weight tensor lets XLA park it in VMEM (measured "13 TB/s" bf16 —
@@ -97,10 +101,20 @@ def main() -> None:
 
             run1, run2 = make(iters), make(2 * iters)
             float(run1(x, *ws)); float(run2(x, *ws))      # compile + warm
-            t0 = time.perf_counter(); float(run1(x, *ws))
-            t1 = time.perf_counter(); float(run2(x, *ws))
-            t2 = time.perf_counter()
-            return ((t2 - t1) - (t1 - t0)) / iters * 1e3
+
+            def best(run, reps=5):
+                # min over repetitions: the tunnel's per-dispatch
+                # constant VARIES (single-sample differencing measured
+                # negative times); the minimum of each window is the
+                # quiet-link value, and differencing the minima cancels
+                # the constant that remains
+                b = 1e9
+                for _ in range(reps):
+                    t0 = time.perf_counter()
+                    float(run(x, *ws))
+                    b = min(b, time.perf_counter() - t0)
+                return b
+            return (best(run2) - best(run1)) / iters * 1e3
 
         variants = {
             "bf16": (lambda xx, i, w: xx @ w[i], (wb_r,), n_wb),
@@ -113,7 +127,6 @@ def main() -> None:
             # plumbing); i is unused
             "int4-pallas": (lambda xx, i, pk, sc, ch: matmul_w4(
                 xx, pk, sc, ch, group=128,
-                block_out=512 if n_out % 512 == 0 else 256,
                 interpret=interpret), (p4, s4, c4), 1),
             # round-5: W8A16 in-kernel dequant — must BEAT int8-xla
             # (whose dequant fuses) before serve routing defaults on

@@ -73,10 +73,22 @@ def test_block_out_auto_handles_budget_and_fallback_shapes():
     for In, Out in [
         (2048, 1024),    # in-budget: a standard tile
         (4096, 640),     # 128 divides, 512/256 don't
-        (18 * 1024, 256),  # every standard tile over budget -> 128
+        # wide reduction with NO clean k tile (18560 % 256 != 0): the
+        # k-split cannot fire, so this is the whole-K 128-fallback path
+        (18560, 256),
         (256, 192),      # no 128 divisor: whole-dim fallback
     ]:
         assert _case(In, Out, 4, seed=In + Out) < 0.01, (In, Out)
+
+
+def test_ksplit_path_matches_reference():
+    """Wide reductions (whole-K tile over the VMEM budget) take the
+    k-split accumulating kernel; numerics must match the dequant
+    reference — including the EXACT gpt-7b FFN down-proj geometry
+    (in=11008, out=4096: bk=256, bo=512), the shape the k-split was
+    built for, plus odd-batch and 256-wide-out variants."""
+    for In, Out, B in [(11008, 4096, 8), (11008, 512, 3), (8192, 1024, 1)]:
+        assert _case(In, Out, B) < 0.01, (In, Out)
 
 
 def test_rejects_bad_shapes():
