@@ -136,7 +136,7 @@ def import_hf(src, model_name, out_dir):
 @click.option("--model", "model_name", required=True,
               help="Model template to synthesize (e.g. gpt-7b).")
 @click.option("--quant", default="int8", show_default=True,
-              type=click.Choice(["none", "int8"]),
+              type=click.Choice(["none", "int8", "int4"]),
               help="Quantize block kernels at synthesis (int8 = the "
                    "serve engine's W8A16 policy, bit-identical to "
                    "quantizing a real checkpoint of the same values).")
@@ -186,11 +186,16 @@ def synth(model_name, quant, seed, out_path):
         return x.astype(dtype) if dtype is not np.float32 else x
 
     def q8(*shape, scale=std):
-        """Generate layer-by-layer and int8-quantize (absmax over the
-        output axis, exactly quantize_int8's axis=-1 keepdims semantics);
-        peak host memory is one layer's fp32, not the stacked tensor."""
+        """Generate layer-by-layer and quantize (int8: absmax over the
+        output axis, exactly quantize_int8's axis=-1 keepdims semantics;
+        int4: group-wise over the INPUT axis, bit-exact with
+        quantize_int4_groupwise's kernel-oriented packing — parity
+        asserted in tests/test_export_serve.py); peak host memory is
+        one layer's fp32, not the stacked tensor."""
         if quant == "none":
             return {"kernel": dense(*shape, scale=scale)}
+        if quant == "int4":
+            return {"kernel": _q4_numpy(shape, scale)}
         vals = np.empty(shape, np.int8)
         scales = np.empty((shape[0], shape[1], 1), np.float32)
         for layer in range(shape[0]):
@@ -202,6 +207,31 @@ def synth(model_name, quant, seed, out_path):
             scales[layer] = s
         return {"kernel": {"__quant__": "int8", "values": vals,
                            "scale": scales}}
+
+    def _q4_numpy(shape, scale, group=128):
+        """Numpy mirror of ops.quantization.quantize_int4_groupwise
+        (chan=ones): pack over the INPUT axis in kernel orientation —
+        packed uint8 [L, in/2, out], scales fp32 [L, in/group, out]."""
+        L_, n_in, n_out = shape
+        if n_in % group:
+            raise click.ClickException(
+                f"int4 synth needs in % {group} == 0 (got {n_in})")
+        vals = np.empty((L_, n_in // 2, n_out), np.uint8)
+        scales = np.empty((L_, n_in // group, n_out), np.float32)
+        for layer in range(L_):
+            w = dense(n_in, n_out, scale=scale, dtype=np.float32)
+            wt = np.ascontiguousarray(w.T)                 # [out, in]
+            xb = wt.reshape(n_out, n_in // group, group)
+            absmax = np.abs(xb).max(axis=-1, keepdims=True)
+            sc = np.maximum(absmax / 7.0, 1e-12)
+            q = np.clip(np.round(xb / sc), -7, 7).astype(
+                np.int8).reshape(n_out, n_in)
+            lo = (q[:, 0::2] & 0xF).astype(np.uint8)
+            hi = (q[:, 1::2] & 0xF).astype(np.uint8)
+            vals[layer] = (lo | (hi << 4)).T               # [in/2, out]
+            scales[layer] = sc[..., 0].astype(np.float32).T
+        return {"__quant__": "int4", "values": vals, "scale": scales,
+                "chan": np.ones((L_, n_in), np.float32), "group": 128}
 
     blocks = {
         "attn_norm": {"scale": np.zeros((L, H), bf16)},
@@ -233,6 +263,10 @@ def synth(model_name, quant, seed, out_path):
             "tie_word_embeddings": str(cfg.tie_word_embeddings).lower()}
     if quant != "none":
         meta["quant"] = quant
+    if quant == "int4":
+        # loaders refuse int4 artifacts without an explicit layout tag
+        # (the pre-round-3 [out, in/2] orientation is ambiguous)
+        meta["int4_layout"] = "kernel"
     path = export_params(params, out_path, fmt="safetensors", metadata=meta)
     size_gb = Path(path).stat().st_size / 1e9
     click.echo(f"synthesized {model_name} artifact "

@@ -140,3 +140,67 @@ class TestServeFromArtifact:
                             quant="int8")
         with pytest.raises(ValueError, match="re-export"):
             _engine(model_cfg, artifact=str(art), quantization="int4")
+
+
+def test_synth_int4_matches_jax_quantizer_and_serves(tmp_path):
+    """`export synth --quant int4` (round 5): the numpy group-wise
+    packing must be BIT-exact with ops.quantization.quantize_int4_
+    groupwise's kernel-oriented layout, and the artifact must serve."""
+    import numpy as np
+    from click.testing import CliRunner
+
+    from distributed_llm_training_and_inference_system_tpu.cli.main import (
+        main as cli,
+    )
+    from distributed_llm_training_and_inference_system_tpu.config import (
+        get_model_config,
+    )
+    from distributed_llm_training_and_inference_system_tpu.config.schema import (
+        ServeConfig,
+    )
+    from distributed_llm_training_and_inference_system_tpu.io.export import (
+        load_exported,
+    )
+    from distributed_llm_training_and_inference_system_tpu.ops.quantization import (
+        quantize_int4_groupwise,
+    )
+    from distributed_llm_training_and_inference_system_tpu.serve import (
+        InferenceEngine,
+        SamplingParams,
+    )
+
+    # parity: numpy mirror vs the jax quantizer on one random tensor
+    rng = np.random.Generator(np.random.PCG64(0))
+    w = rng.standard_normal((256, 128), dtype=np.float32) * 0.02
+    jp, js, jc = quantize_int4_groupwise(jnp.asarray(w), group=128)
+    wt = np.ascontiguousarray(w.T)
+    xb = wt.reshape(128, 256 // 128, 128)
+    absmax = np.abs(xb).max(axis=-1, keepdims=True)
+    sc = np.maximum(absmax / 7.0, 1e-12)
+    q = np.clip(np.round(xb / sc), -7, 7).astype(np.int8).reshape(128, 256)
+    packed = (((q[:, 0::2] & 0xF) | ((q[:, 1::2] & 0xF) << 4))
+              .astype(np.uint8).T)
+    np.testing.assert_array_equal(packed, np.asarray(jp))
+    np.testing.assert_allclose(sc[..., 0].astype(np.float32).T,
+                               np.asarray(js), rtol=1e-6)
+
+    # gpt-test's head_dim gives in-dims % 128 == 0? hidden=64 — too
+    # small for group 128, so synth a custom-sized template via the
+    # CLI on the smallest 128-aligned model available
+    runner = CliRunner()
+    art = tmp_path / "t.safetensors"
+    r = runner.invoke(cli, ["export", "synth", "--model", "gpt-125m",
+                            "--quant", "int4", "--out", str(art)],
+                      catch_exceptions=False)
+    assert r.exit_code == 0, r.output
+    tree, meta = load_exported(str(art))
+    assert meta["quant"] == "int4"
+
+    cfg = get_model_config("gpt-125m")
+    eng = InferenceEngine(cfg, ServeConfig(
+        model="gpt-125m", max_batch_size=2, max_seq_len=128,
+        kv_num_blocks=16, artifact=str(art)), seed=0)
+    out = eng.generate([[5, 6, 7, 8]],
+                       SamplingParams(temperature=0.0, max_tokens=4))
+    assert len(out[0].generated_tokens) == 4
+    eng.release()
