@@ -231,7 +231,7 @@ def synth(model_name, quant, seed, out_path):
             vals[layer] = (lo | (hi << 4)).T               # [in/2, out]
             scales[layer] = sc[..., 0].astype(np.float32).T
         return {"__quant__": "int4", "values": vals, "scale": scales,
-                "chan": np.ones((L_, n_in), np.float32), "group": 128}
+                "chan": np.ones((L_, n_in), np.float32), "group": group}
 
     blocks = {
         "attn_norm": {"scale": np.zeros((L, H), bf16)},
@@ -263,10 +263,6 @@ def synth(model_name, quant, seed, out_path):
             "tie_word_embeddings": str(cfg.tie_word_embeddings).lower()}
     if quant != "none":
         meta["quant"] = quant
-    if quant == "int4":
-        # loaders refuse int4 artifacts without an explicit layout tag
-        # (the pre-round-3 [out, in/2] orientation is ambiguous)
-        meta["int4_layout"] = "kernel"
     path = export_params(params, out_path, fmt="safetensors", metadata=meta)
     size_gb = Path(path).stat().st_size / 1e9
     click.echo(f"synthesized {model_name} artifact "

@@ -137,6 +137,22 @@ def export_params(params: Any, out_path: str | Path, fmt: str = "safetensors",
             raise ValueError(
                 f"unsupported quant {quant!r} "
                 "(int8 | int8-awq | int4 | int4-awq)")
+    def _tree_has_int4(node):
+        if isinstance(node, dict):
+            if node.get("__quant__") == "int4":
+                return True
+            return any(_tree_has_int4(v) for v in node.values()
+                       if isinstance(v, dict))
+        return False
+
+    # PRE-quantized trees (export synth, requantization-free flows)
+    # carry int4 markers without the quant= argument — the layout tag
+    # must follow the markers, not the call site, or every such caller
+    # has to remember it (load_exported refuses untagged int4)
+    if _tree_has_int4(params):
+        meta.setdefault("quant", "int4")
+        meta["int4_layout"] = "kernel"
+
     flat = dict(flatten_with_paths(params))
     # quantized leaves carry a "__quant__" string marker; markers are
     # metadata, not tensors (the ".values"/".scale" suffix pair identifies

@@ -146,17 +146,10 @@ def test_synth_int4_matches_jax_quantizer_and_serves(tmp_path):
     """`export synth --quant int4` (round 5): the numpy group-wise
     packing must be BIT-exact with ops.quantization.quantize_int4_
     groupwise's kernel-oriented layout, and the artifact must serve."""
-    import numpy as np
     from click.testing import CliRunner
 
     from distributed_llm_training_and_inference_system_tpu.cli.main import (
         main as cli,
-    )
-    from distributed_llm_training_and_inference_system_tpu.config import (
-        get_model_config,
-    )
-    from distributed_llm_training_and_inference_system_tpu.config.schema import (
-        ServeConfig,
     )
     from distributed_llm_training_and_inference_system_tpu.io.export import (
         load_exported,
@@ -164,15 +157,11 @@ def test_synth_int4_matches_jax_quantizer_and_serves(tmp_path):
     from distributed_llm_training_and_inference_system_tpu.ops.quantization import (
         quantize_int4_groupwise,
     )
-    from distributed_llm_training_and_inference_system_tpu.serve import (
-        InferenceEngine,
-        SamplingParams,
-    )
 
     # parity: numpy mirror vs the jax quantizer on one random tensor
     rng = np.random.Generator(np.random.PCG64(0))
     w = rng.standard_normal((256, 128), dtype=np.float32) * 0.02
-    jp, js, jc = quantize_int4_groupwise(jnp.asarray(w), group=128)
+    jp, js, _ = quantize_int4_groupwise(jnp.asarray(w), group=128)
     wt = np.ascontiguousarray(w.T)
     xb = wt.reshape(128, 256 // 128, 128)
     absmax = np.abs(xb).max(axis=-1, keepdims=True)
