@@ -17,6 +17,24 @@ import click
 from ...utils.timing import time_fn as _timed
 
 
+def _open_chip_lock(path: str):
+    """Open (creating if needed) the world-writable chip-lock file.
+
+    ``os.open(..., 0o666)`` alone is not enough: the process umask
+    (typically 022) strips the group/other WRITE bits at creation, so the
+    next user on a shared host hits EACCES opening the lock O_RDWR — the
+    exact failure the world-writable mode exists to prevent. chmod AFTER
+    creation bypasses the umask; failure is ignored when the file already
+    exists under another owner (they already widened it)."""
+    import os
+    fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o666)
+    try:
+        os.chmod(path, 0o666)
+    except OSError:
+        pass
+    return os.fdopen(fd, "w")
+
+
 @click.group(name="bench", invoke_without_command=True)
 @click.pass_context
 def app(ctx):
@@ -300,6 +318,104 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
     click.echo(json.dumps(results, indent=2))
 
 
+@app.command(name="kv-decode")
+@click.option("--slots", default=16, show_default=True,
+              help="Decode slots (batch rows).")
+@click.option("--kv-heads", default=32, show_default=True)
+@click.option("--head-dim", default=128, show_default=True)
+@click.option("--q-heads", default=0, show_default=True,
+              help="Query heads (0 = same as --kv-heads).")
+@click.option("--page-size", default=64, show_default=True)
+@click.option("--context", default=512, show_default=True,
+              help="Live tokens per slot at measurement.")
+@click.option("--layers", default=32, show_default=True,
+              help="Layer count for the per-model traffic ledger "
+                   "(the timed kernel runs ONE layer; ms/step scales).")
+@click.option("--steps", default=50, show_default=True)
+@click.option("--write-mode", default="paged", show_default=True,
+              type=click.Choice(["paged", "scatter"]),
+              help="KV append path: whole-page merge (fused "
+                   "quantize-on-write for int8) vs per-row scatter.")
+def kv_decode(slots, kv_heads, head_dim, q_heads, page_size, context,
+              layers, steps, write_mode):
+    """int8-KV decode A/B: one layer's paged attention + KV append per
+    step, bf16 pages vs int8 QuantPages, same shapes — the
+    round-5-named 7B 16-slot wall (BASELINE.md:205-218). Reports
+    ms/step for each mode plus an HBM-traffic ledger (bytes the decode
+    step must stream per token) so a chip run can certify whether a
+    remaining gap is physical or software."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...ops.paged_attention import (
+        QuantPages, paged_attention, quantize_kv_token,
+        write_token_to_pages, write_window_to_pages)
+
+    q_heads = q_heads or kv_heads
+    B, Nkv, Nq, D, PS = slots, kv_heads, q_heads, head_dim, page_size
+    maxP = (context + PS - 1) // PS
+    NP = B * maxP + 1
+    on_tpu = jax.default_backend() == "tpu"
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    key = jax.random.PRNGKey(0)
+    kf = jax.random.normal(key, (NP, Nkv, PS, D), dtype)
+    tables = jnp.arange(1, NP, dtype=jnp.int32).reshape(B, maxP)
+    lengths = jnp.full((B,), context, jnp.int32)
+    q = jax.random.normal(key, (B, Nq, D), dtype)
+    new_kv = jax.random.normal(key, (B, 1, Nkv, D), dtype)
+
+    def build(quant):
+        if quant:
+            qv, sc = quantize_kv_token(kf)
+            return QuantPages(qv, sc)
+        return jnp.array(kf)     # copy: the step donates its page buffer
+
+    def step(pages, q, new_kv):
+        if write_mode == "paged":
+            pages = write_window_to_pages(pages, new_kv, tables,
+                                          lengths - 1)
+        else:
+            pages = write_token_to_pages(pages, new_kv[:, 0], tables,
+                                         lengths - 1)
+        out = paged_attention(q, pages, pages, tables, lengths)
+        return pages, out
+
+    results = {}
+    for name, quant in (("bf16", False), ("int8", True)):
+        pages = build(quant)
+        fn = jax.jit(step, donate_argnums=(0,))
+        pages, out = jax.block_until_ready(fn(pages, q, new_kv))  # compile
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            pages, out = fn(pages, q, new_kv)
+        jax.block_until_ready(out)
+        sec = (time.perf_counter() - t0) / steps
+        # per-token HBM ledger at this shape, whole model (layers x):
+        # attention must stream every live K/V row once; the append
+        # writes (and, page-granular, re-reads) whole pages
+        kv_bytes = (1 if quant else jnp.dtype(dtype).itemsize)
+        row = Nkv * D * kv_bytes + (Nkv * 4 if quant else 0)  # + scales
+        read_attn = 2 * B * context * row
+        if write_mode == "paged":
+            write_rw = 2 * B * 2 * PS * row        # K+V staging gather+scatter
+        else:
+            write_rw = 2 * B * row                 # K+V row scatter (ideal)
+        results[name] = {
+            "ms_per_layer_step": round(sec * 1e3, 3),
+            "est_model_decode_ms": round(sec * 1e3 * layers, 1),
+            "hbm_ledger_per_step_mb": {
+                "attn_kv_read": round(layers * read_attn / 1e6, 4),
+                "kv_append_rw": round(layers * write_rw / 1e6, 4),
+            },
+        }
+    b, i8 = (results["bf16"]["ms_per_layer_step"],
+             results["int8"]["ms_per_layer_step"])
+    results["int8_vs_bf16_speedup"] = round(b / i8, 3) if i8 else None
+    results["write_mode"] = write_mode
+    results["backend"] = jax.default_backend()
+    click.echo(json.dumps(results, indent=2))
+
+
 @app.command()
 @click.option("--pattern", default="all", show_default=True,
               type=click.Choice(["allreduce", "all_gather", "reduce_scatter",
@@ -431,8 +547,8 @@ def battery(spec, out_dir, resume, wait_for_chip, probe_interval,
     if spec_path.suffix == ".json":
         items_spec = json.loads(spec_path.read_text())
     else:
-        import tomllib
-        items_spec = tomllib.loads(spec_path.read_text())
+        from ...utils.tomlio import loads_toml
+        items_spec = loads_toml(spec_path.read_text())
     items = items_spec.get("item") or items_spec.get("items") or []
     if not items:
         raise click.ClickException(f"{spec}: no [[item]] entries")
@@ -535,8 +651,7 @@ def battery(spec, out_dir, resume, wait_for_chip, probe_interval,
         # opens (a plain open('w') raised PermissionError and killed
         # the battery the mutex exists to protect)
         import fcntl
-        fd = _os.open(chip_lock, _os.O_RDWR | _os.O_CREAT, 0o666)
-        lock_fh = _os.fdopen(fd, "w")
+        lock_fh = _open_chip_lock(chip_lock)
         try:
             fcntl.flock(lock_fh, fcntl.LOCK_EX | fcntl.LOCK_NB)
         except OSError:

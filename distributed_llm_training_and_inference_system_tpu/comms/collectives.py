@@ -45,7 +45,7 @@ def reduce_scatter(x: jax.Array, axis: str, *, scatter_dim: int = 0) -> jax.Arra
 
 def ring_shift(x: jax.Array, axis: str, *, shift: int = 1) -> jax.Array:
     """Send to (i+shift) mod n — the pipeline/ring-attention hop."""
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis_name=axis, perm=perm)
 
@@ -61,7 +61,8 @@ def axis_index(axis: str) -> jax.Array:
 
 
 def axis_size(axis: str) -> int:
-    return lax.axis_size(axis)
+    from ..utils.compat import axis_size as _axis_size
+    return _axis_size(axis)
 
 
 def barrier(axis: str) -> None:

@@ -137,21 +137,51 @@ def export_params(params: Any, out_path: str | Path, fmt: str = "safetensors",
             raise ValueError(
                 f"unsupported quant {quant!r} "
                 "(int8 | int8-awq | int4 | int4-awq)")
-    def _tree_has_int4(node):
+    def _int4_leaves(node, out):
         if isinstance(node, dict):
             if node.get("__quant__") == "int4":
-                return True
-            return any(_tree_has_int4(v) for v in node.values()
-                       if isinstance(v, dict))
-        return False
+                out.append(node)
+            else:
+                for v in node.values():
+                    if isinstance(v, dict):
+                        _int4_leaves(v, out)
+        return out
+
+    def _kernel_oriented(leaf) -> bool:
+        """True iff the leaf's packed/scale shapes are consistent with the
+        round-3 kernel orientation (packed [..., in/2, out], scale
+        [..., in/group, out]). The pre-round-3 [..., out, in/2] layout
+        puts the group axis LAST (scale [..., out, in/group]) — plausible
+        shapes either way, so validate instead of assuming."""
+        packed, scale = leaf["values"], leaf["scale"]
+        group = int(np.asarray(leaf.get("group", 128)))
+        if packed.ndim < 2 or scale.ndim != packed.ndim:
+            return False
+        n_in, n_out = packed.shape[-2] * 2, packed.shape[-1]
+        return scale.shape[-1] == n_out and scale.shape[-2] * group == n_in
 
     # PRE-quantized trees (export synth, requantization-free flows)
     # carry int4 markers without the quant= argument — the layout tag
     # must follow the markers, not the call site, or every such caller
-    # has to remember it (load_exported refuses untagged int4)
-    if _tree_has_int4(params):
+    # has to remember it (load_exported refuses untagged int4). Both tags
+    # are setdefault: a caller-provided quant kind / layout marker (e.g.
+    # a legacy [L, out, in/2] tree being re-exported) must survive, and
+    # the kernel tag is only stamped when every int4 leaf's shapes
+    # actually validate against the kernel orientation — mislabeling a
+    # legacy tree would produce the silent-garbage dequant the marker
+    # exists to prevent (ADVICE r5 #1).
+    int4_leaves = _int4_leaves(params, [])
+    if int4_leaves:
         meta.setdefault("quant", "int4")
-        meta["int4_layout"] = "kernel"
+        if all(_kernel_oriented(l) for l in int4_leaves):
+            meta.setdefault("int4_layout", "kernel")
+        elif "int4_layout" not in meta:
+            raise ValueError(
+                "int4 leaves do not match the kernel orientation "
+                "([..., in/2, out] packed with [..., in/group, out] "
+                "scales) and no int4_layout metadata was provided — "
+                "refusing to tag; pass metadata={'int4_layout': ...} "
+                "describing the actual layout")
 
     flat = dict(flatten_with_paths(params))
     # quantized leaves carry a "__quant__" string marker; markers are

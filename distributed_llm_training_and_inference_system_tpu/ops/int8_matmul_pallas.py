@@ -117,6 +117,22 @@ def matmul_w8(x: jax.Array, values: jax.Array, scale: jax.Array,
     # k-split whenever the VMEM budget forced the whole-K auto pick
     # below a 512-wide tile (i.e. the reduction is too wide to afford
     # the tile width the MXU wants) and the dims tile cleanly
+    # no clean k tile AND the whole-K block blows the budget (n_in > 16K
+    # at bo=128): a real-TPU launch would fail at Mosaic compile time (or
+    # worse, thrash VMEM) where interpret-mode tests can't see it — take
+    # the XLA dequant route loudly instead (ADVICE r5 #2). The scale is
+    # already folded into the activations, so the fallback is a plain
+    # bf16 dot over converted weights — same math as the kernel.
+    if auto_tile and n_in * bo > budget and not (bk and bo_k > bo):
+        import warnings
+        warnings.warn(
+            f"matmul_w8: reduction dim {n_in} has no clean k tile and a "
+            f"whole-K [{n_in}, {bo}] block exceeds the ~2 MB VMEM budget "
+            "— falling back to the XLA dequant route for this shape",
+            RuntimeWarning, stacklevel=2)
+        out = jnp.dot(xf, values.astype(wdtype),
+                      preferred_element_type=jnp.float32)
+        return out[:B].astype(x.dtype)
     if (auto_tile and bo < 512 and n_in * 512 > budget and bk
             and bo_k > bo):
         bo = bo_k

@@ -32,9 +32,18 @@ from .quantization import QuantTensor
 @jax.tree_util.register_pytree_node_class
 class QuantPages(QuantTensor):
     """int8 KV pages + per-token absmax scales: values [..., NP, Nkv, PS, D]
-    int8, scale [..., NP, Nkv, PS, 1] fp32 (~3% overhead at D=128, vs 50%
+    int8, scale [..., NP, Nkv, PS] fp32 (~3% overhead at D=128, vs 50%
     saved on the page data — 2x KV capacity per HBM byte and half the
     decode-attention KV streaming).
+
+    Scale layout (round 6): one dense PER-PAGE tensor of row scales with
+    NO trailing singleton. The pre-round-6 [..., PS, 1] layout made the
+    Pallas scale block a [Nkv, PS, 1] ref — a degenerate 1-wide lane tile
+    Mosaic pads to a full [8, 128] vector register per scale — and every
+    whole-page merge had to carry the dangling axis. [..., Nkv, PS] makes
+    the per-page scale block a clean [Nkv, PS] tile that rides the SAME
+    block-table index map as its page, so the fused decode kernel DMAs
+    (page, scales) together and dequantizes in VMEM.
 
     The (values, scale) pytree mechanics come from QuantTensor; the
     distinct TYPE keeps page buffers out of ``cast_params``' weight-dequant
@@ -51,14 +60,20 @@ class QuantPages(QuantTensor):
         # appease generic tree-casts (ops never cast pages; keep quantized)
         return self
 
+    def dequant(self, dtype=jnp.float32):
+        # scale has no keepdim axis (unlike QuantTensor weights) — the
+        # row scale broadcasts over D explicitly
+        from .quantization import dequantize_int8_rows
+        return dequantize_int8_rows(self.values, self.scale, dtype)
+
 
 def quantize_kv_token(new_kv: jax.Array) -> tuple[jax.Array, jax.Array]:
     """Per-(row, head) absmax int8 of a token's K or V [..., Nkv, D] ->
     (int8 values, fp32 scale [..., Nkv]). One implementation of the
-    absmax math lives in ops.quantization; this only drops keepdims."""
-    from .quantization import quantize_int8
-    q, scale = quantize_int8(new_kv, axis=-1)
-    return q, scale[..., 0]
+    absmax math lives in ops.quantization (quantize_int8_rows — also the
+    helper the fused quantize-on-write path uses)."""
+    from .quantization import quantize_int8_rows
+    return quantize_int8_rows(new_kv)
 
 
 def paged_attention(
@@ -101,7 +116,7 @@ def paged_attention(
         # right after the gather (the matmuls below run fp32 anyway)
         if isinstance(pages, QuantPages):
             g = (pages.values[block_tables].astype(jnp.float32)
-                 * pages.scale[block_tables]).astype(q.dtype)
+                 * pages.scale[block_tables][..., None]).astype(q.dtype)
         else:
             g = pages[block_tables]
         return g.transpose(0, 2, 1, 3, 4).reshape(B, Nkv, maxP * PS, D)
@@ -144,12 +159,26 @@ def write_window_to_pages(
     numerics asserted equal to the scatter path in
     tests/test_ops.py::test_window_write_matches_row_scatter.
 
+    ``QuantPages`` take the SAME whole-page route with a fused
+    quantize-on-write: the window's rows are absmax-quantized once
+    ([B, T, Nkv] int8 rows + scales), then values AND scales merge
+    through one shared one-hot select and scatter back as whole
+    (page, scale-tile) pairs. No per-row scatter, and no full-precision
+    copy of any cache page is ever materialised — the round-5-measured
+    QuantPages decode wall (BASELINE.md:205-218) was exactly this path
+    falling back to B*T row scatters on values and scales separately.
+    Bit-identical to the scatter path (same quantize_int8_rows math,
+    untouched rows copied int8/fp32-exact), asserted in
+    tests/test_kv_quant.py.
+
     Masked tokens (write_ok False) and slots whose table entry is scratch
     keep their staging content / write scratch page 0, matching the
     scatter path's semantics.
     """
+    quant = isinstance(pages, QuantPages)
+    values = pages.values if quant else pages
     B, T, Nkv, D = new_kv.shape
-    NP, _, PS, _ = pages.shape
+    NP, _, PS, _ = values.shape
     maxP = block_tables.shape[1]
     if T > PS:
         raise ValueError(f"window {T} exceeds page size {PS}")
@@ -171,7 +200,6 @@ def write_window_to_pages(
         # content — redirect it to scratch instead
         phys = phys.at[:, 1].set(jnp.where(lp[:, 1] == lp[:, 0], 0,
                                            phys[:, 1]))
-    staging = pages[phys]                              # [B,n,Nkv,PS,D]
 
     off = pos - p0[:, None] * PS                       # [B,T] in [0,n*PS)
     ok = jnp.ones((B, T), bool) if write_ok is None else write_ok
@@ -181,14 +209,35 @@ def write_window_to_pages(
     onehot = (off[:, :, None] == jnp.arange(n_stage * PS)[None, None]) \
         & ok[:, :, None]                                      # [B,T,nPS]
     hit = onehot.any(axis=1)                                  # [B, nPS]
-    upd = jnp.einsum("bts,btnd->bsnd", onehot.astype(new_kv.dtype),
-                     new_kv)                                  # [B,nPS,Nkv,D]
-    stag = staging.transpose(0, 1, 3, 2, 4).reshape(B, n_stage * PS, Nkv, D)
-    merged = jnp.where(hit[:, :, None, None], upd.astype(pages.dtype),
-                       stag)
-    merged = merged.reshape(B, n_stage, PS, Nkv, D).transpose(0, 1, 3, 2, 4)
-    return pages.at[phys.reshape(-1)].set(
-        merged.reshape(B * n_stage, Nkv, PS, D))
+    flat_phys = phys.reshape(-1)
+
+    def merge_rows(staging, rows, dtype):
+        """Select window rows into their staging positions: staging
+        [B, n, Nkv, PS, D'] updated from rows [B, T, Nkv, D'] via the
+        shared one-hot (exact: each staging position receives at most one
+        window row; fp32 select round-trips int8/fp32 payloads bit-exact).
+        """
+        upd = jnp.einsum("bts,btnd->bsnd", onehot.astype(jnp.float32),
+                         rows.astype(jnp.float32))            # [B,nPS,Nkv,D']
+        stag = staging.transpose(0, 1, 3, 2, 4).reshape(
+            B, n_stage * PS, Nkv, -1)
+        merged = jnp.where(hit[:, :, None, None], upd.astype(dtype),
+                           stag.astype(dtype))
+        merged = merged.reshape(B, n_stage, PS, Nkv, -1).transpose(
+            0, 1, 3, 2, 4)
+        return merged.reshape(B * n_stage, Nkv, PS, -1)
+
+    if quant:
+        # fused quantize-on-write: one absmax pass over the window's rows,
+        # then values and scales ride the same whole-page merge
+        qv, qs = quantize_kv_token(new_kv)     # [B,T,Nkv,D] i8, [B,T,Nkv]
+        merged_v = merge_rows(pages.values[phys], qv, jnp.int8)
+        merged_s = merge_rows(pages.scale[phys][..., None], qs[..., None],
+                              jnp.float32)[..., 0]        # [B*n,Nkv,PS]
+        return QuantPages(pages.values.at[flat_phys].set(merged_v),
+                          pages.scale.at[flat_phys].set(merged_s))
+    merged = merge_rows(pages[phys], new_kv.astype(pages.dtype), pages.dtype)
+    return pages.at[flat_phys].set(merged)
 
 
 def paged_attention_multi(
@@ -253,5 +302,5 @@ def write_token_to_pages(
         qv, scale = quantize_kv_token(new_kv)
         return QuantPages(
             pages.values.at[phys, :, offset].set(qv),
-            pages.scale.at[phys, :, offset, 0].set(scale))
+            pages.scale.at[phys, :, offset].set(scale))
     return pages.at[phys, :, offset].set(new_kv.astype(pages.dtype))

@@ -68,9 +68,10 @@ def _extend_kernel(tables_ref, starts_ref,        # scalar prefetch
     trivia next to per-grid-step overhead (16 GFLOPs/step at gpt-1b B=8
     vs a ~100 us MXU budget).
 
-    ``kv_quant``: pages are int8 with per-token scales [PS, 1] — dequant
-    happens in VMEM right before the fp32 dot, so HBM page traffic is
-    halved (the whole point of the int8 KV cache)."""
+    ``kv_quant``: pages are int8 with a per-page [Nkv, PS] scale tile
+    (one row scale per token — QuantPages layout) — dequant happens in
+    VMEM right before the fp32 dot, so HBM page traffic is halved (the
+    whole point of the int8 KV cache)."""
     if kv_quant:
         (q_ref, k_ref, ks_ref, v_ref, vs_ref,
          o_ref, acc_ref, m_ref, l_ref) = refs
@@ -93,11 +94,15 @@ def _extend_kernel(tables_ref, starts_ref,        # scalar prefetch
     @pl.when(p * page_size < max_len)
     def _body():
         q = q_ref[...].astype(jnp.float32).reshape(num_kv * tg, d)
-        k = k_ref[...].astype(jnp.float32)            # [Nkv, PS, D]
-        v = v_ref[...].astype(jnp.float32)
         if kv_quant:
-            k = k * ks_ref[...]                       # [Nkv, PS, 1]
-            v = v * vs_ref[...]
+            # shared absmax math (ops.quantization): pure jnp, safe in a
+            # Pallas body — page scales are the [Nkv, PS] per-page tile
+            from .quantization import dequantize_int8_rows
+            k = dequantize_int8_rows(k_ref[...], ks_ref[...])
+            v = dequantize_int8_rows(v_ref[...], vs_ref[...])
+        else:
+            k = k_ref[...].astype(jnp.float32)        # [Nkv, PS, D]
+            v = v_ref[...].astype(jnp.float32)
         k = k.reshape(num_kv * page_size, d)
         v = v.reshape(num_kv * page_size, d)
         s = jax.lax.dot_general(
@@ -157,11 +162,14 @@ def paged_attention_pallas_multi(
     tables_clamped = jnp.take_along_axis(
         block_tables.astype(jnp.int32), clamped_p, axis=1)
 
-    # head-folded grid (B, maxP): one whole page (all kv heads) per step
+    # head-folded grid (B, maxP): one whole page (all kv heads) per step.
+    # The scale tile [Nkv, PS] rides the SAME clamped block-table index
+    # map as its page, so Pallas elides its re-fetch together with the
+    # page's on consecutive identical indices.
     page_spec = pl.BlockSpec((None, Nkv, PS, D),
                              lambda b, p, t, u: (t[b, p], 0, 0, 0))
-    scale_spec = pl.BlockSpec((None, Nkv, PS, 1),
-                              lambda b, p, t, u: (t[b, p], 0, 0, 0))
+    scale_spec = pl.BlockSpec((None, Nkv, PS),
+                              lambda b, p, t, u: (t[b, p], 0, 0))
     in_specs = [pl.BlockSpec((None, Nkv, T * groups, D),
                              lambda b, p, t, u: (b, 0, 0, 0))]      # q
     inputs = [qg]

@@ -15,11 +15,35 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def quantize_int8_rows(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Symmetric absmax int8 over the LAST axis, scale WITHOUT keepdims:
+    (values int8 [..., D], scale fp32 [...]).
+
+    Pure jnp elementwise/reduce — safe to call both from traced XLA code
+    and from inside Pallas kernel bodies (the KV quantize-on-write and
+    the in-kernel dequant must share one definition of the absmax math,
+    or the fused write path and the reference path drift)."""
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(absmax / 127.0, 1e-12)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def dequantize_int8_rows(q: jax.Array, scale: jax.Array,
+                         dtype=jnp.float32) -> jax.Array:
+    """Inverse of quantize_int8_rows: values [..., D] * scale [...]."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
 def quantize_int8(x: jax.Array, axis: int = -1) -> tuple[jax.Array, jax.Array]:
     """Symmetric absmax int8 quantization along *axis*.
 
     Returns (values int8, scales float32) with x ≈ values * scales.
     """
+    if axis in (-1, x.ndim - 1):
+        q, scale = quantize_int8_rows(x)
+        return q, scale[..., None]
     absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis, keepdims=True)
     scale = jnp.maximum(absmax / 127.0, 1e-12)
     q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127).astype(jnp.int8)

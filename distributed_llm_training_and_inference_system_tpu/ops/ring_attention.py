@@ -63,7 +63,8 @@ def _merge(acc, w, m_run, r, lse):
 
 
 def _ring_perm(axis_name):
-    sp = lax.axis_size(axis_name)
+    from ..utils.compat import axis_size
+    sp = axis_size(axis_name)
     return sp, [(i, (i + 1) % sp) for i in range(sp)]
 
 
@@ -192,7 +193,8 @@ def ring_attention(
                     scale, bq, block_k)
         return unfold(out).astype(q_.dtype)
 
-    fn = jax.shard_map(
+    from ..utils.compat import shard_map
+    fn = shard_map(
         body, mesh=mesh,
         in_specs=(qspec, qspec, qspec, sspec, sspec),
         out_specs=qspec, check_vma=False)

@@ -114,14 +114,14 @@ def extend_step_forward(
     flat_pos = positions.reshape(B * T)
     flat_tables = jnp.repeat(block_tables, T, axis=0)        # [B*T, maxP]
     flat_ok = None if write_ok is None else write_ok.reshape(B * T)
-    from ..ops.paged_attention import QuantPages
     # T == 1 (plain decode) included: the whole-page merge beat the B-row
     # scatter by ~1 ms/step in the round-3 decode ablation once the
-    # folded attention kernel removed the larger overheads
-    use_window_write = (
-        T <= k_pages.shape[-2]
-        and not isinstance(k_pages, QuantPages)
-        and write_mode != "scatter")
+    # folded attention kernel removed the larger overheads. QuantPages
+    # take the same route (round 6): quantize-on-write is fused into the
+    # whole-page merge, so int8/int4-KV decode no longer detours through
+    # the B*T-row scatter that dominated the 7B 16-slot wall
+    # (BASELINE.md:205-218).
+    use_window_write = (T <= k_pages.shape[-2] and write_mode != "scatter")
 
     x = params["embed"]["embedding"][tokens].astype(compute_dtype)  # [B,T,H]
     inv_freq = rope_frequencies(cfg.head_dim, cfg.rope.base,
@@ -195,9 +195,8 @@ def extend_step_forward(
         if use_window_write:
             # page-granular write (2B whole-page DMAs) instead of a
             # B*T-row scatter — the r2-measured verify-window suspect;
-            # A/B via LLMCTL_EXTEND_WRITE=paged|scatter (default paged on
-            # plain pages; QuantPages always scatter — per-token quant
-            # rides the row path)
+            # A/B via LLMCTL_EXTEND_WRITE=paged|scatter (default paged;
+            # QuantPages quantize-on-write inside the same merge)
             kp = write_window_to_pages(kp, k, block_tables,
                                        start_positions, write_ok)
             vp = write_window_to_pages(vp, v, block_tables,

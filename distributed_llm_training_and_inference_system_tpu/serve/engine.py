@@ -512,11 +512,12 @@ class InferenceEngine:
                                                        quantize_kv_token)
                     if isinstance(pages, QuantPages):
                         # dense [L, nP, Nkv, PS, D]: absmax over D gives
-                        # the per-token scale [L, nP, Nkv, PS]
+                        # the per-token scale [L, nP, Nkv, PS] — exactly
+                        # the per-page scale-tile layout, no reshape
                         qv, sc = quantize_kv_token(dense)
                         return QuantPages(
                             pages.values.at[:, entries].set(qv),
-                            pages.scale.at[:, entries].set(sc[..., None]))
+                            pages.scale.at[:, entries].set(sc))
                     return pages.at[:, entries].set(dense)
 
                 k_pages = scatter(k_pages, kd)

@@ -112,10 +112,22 @@ class PagedKVCache:
         import jax
         if self.quantized:
             from ..ops.paged_attention import QuantPages
+            # scale layout is the kernel-friendly per-page tensor
+            # [L, NP, Nkv, PS] (no trailing singleton — QuantPages doc)
             buf = QuantPages(jnp.zeros(shape, jnp.int8),
-                             jnp.zeros((*shape[:-1], 1), jnp.float32))
-        else:
-            buf = jnp.zeros(shape, dtype)
+                             jnp.zeros(shape[:-1], jnp.float32))
+            if self.page_sharding is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+                # the scale leaf is one rank lower than the values leaf:
+                # trim the head-dim entry off the values spec
+                ps = self.page_sharding
+                scale_sharding = NamedSharding(
+                    ps.mesh, PartitionSpec(*tuple(ps.spec)[:len(shape) - 1]))
+                return QuantPages(
+                    jax.device_put(buf.values, ps),
+                    jax.device_put(buf.scale, scale_sharding))
+            return buf
+        buf = jnp.zeros(shape, dtype)
         if self.page_sharding is not None:
             return jax.device_put(buf, self.page_sharding)
         return buf

@@ -18,8 +18,12 @@ ONE branch at runtime, and the predicates are known at dispatch time):
 
   all rows greedy          -> argmax only (zero sampling machinery)
   no row filters           -> Gumbel categorical, no sort
-  any row filters          -> ONE shared argsort feeds both filters
-                              (previously jnp.sort + jnp.argsort = two)
+  any row filters          -> lax.top_k over FILTER_FAST_CAP candidates
+                              (round 6 — the full-vocab argsort measured
+                              7.0 ms/step at [8, 50304]); the shared
+                              argsort remains as the lax.cond'd exact
+                              fallback when the kept set could reach
+                              past the candidates
 
 The filtered path is equivalent to filtering per-filter: top-k keeps
 ``logits >= kth`` (ties included) exactly as before, and top-p's
@@ -95,6 +99,91 @@ def _filtered_single_sort(scaled: jax.Array, top_k: jax.Array,
     return jnp.where(keep, scaled, NEG_INF)
 
 
+FILTER_FAST_CAP = 256
+"""Candidate width of the ``lax.top_k`` fast filter tier.
+
+The filtered tier's full-vocab ``jnp.argsort`` measured 7.0 ms/step at
+[8, 50304] (round-5 verdict #4) — inside every iteration of the K-step
+decode scan. Real requests ask top_k <= 64 and top-p mass concentrates
+in a few hundred tokens, so a 256-candidate ``lax.top_k`` (O(V) scan vs
+O(V log V) sort) covers the kept set; the argsort path stays as the
+exact fallback, selected per batch by ``lax.cond`` whenever the kept
+set could extend beyond the candidates (large top_k, boundary value
+ties, or a top-p whose mass is not reached within the candidates)."""
+
+
+def _filtered_fast_or_exact(scaled: jax.Array, top_k: jax.Array,
+                            top_p: jax.Array) -> jax.Array:
+    """Filtered logits via top-CAP candidates, with the single-sort path
+    as a ``lax.cond`` fallback. Produces the SAME kept set as
+    ``_filtered_single_sort`` (asserted bitwise on the tie tests): the
+    candidate list is re-ordered to the argsort path's exact tie order
+    (descending value, ties descending token index) before the top-k /
+    top-p cuts, and any batch whose cuts could reach beyond — or tie
+    with — the candidate boundary takes the exact path instead.
+    """
+    B, V = scaled.shape
+    cap = FILTER_FAST_CAP
+    if V <= cap + 1:             # static: small vocabs just sort
+        return _filtered_single_sort(scaled, top_k, top_p)
+    rows = jnp.arange(B)[:, None]
+    vals, idx = jax.lax.top_k(scaled, cap + 1)
+    sentinel = vals[:, cap]                     # largest EXCLUDED value
+    cvals, cidx = vals[:, :cap], idx[:, :cap]
+
+    # reconstruct the argsort tie order within the candidates: arrange by
+    # token index ascending, stable-sort ascending by value (ties keep
+    # ascending index), reverse -> descending value, ties descending index
+    perm = jnp.argsort(cidx, axis=-1)
+    v1 = jnp.take_along_axis(cvals, perm, axis=-1)
+    i1 = jnp.take_along_axis(cidx, perm, axis=-1)
+    order = jnp.argsort(v1, axis=-1)[:, ::-1]
+    svals = jnp.take_along_axis(v1, order, axis=-1)     # [B, cap]
+    sidx = jnp.take_along_axis(i1, order, axis=-1)
+
+    k_active = top_k > 0
+    p_active = top_p < 1.0
+    k = jnp.clip(top_k, 1, V)
+    kth = jnp.take_along_axis(
+        svals, jnp.minimum(k - 1, cap - 1)[:, None], axis=1)    # [B, 1]
+    keep_k = (svals >= kth) | ~k_active[:, None]
+
+    # probabilities under the SAME masked softmax as the exact path:
+    # denominator over the kept candidates when top-k masks the tail,
+    # over the full row when top-k is disabled (the tail carries mass)
+    m = svals[:, :1]                                    # row max
+    exps = jnp.where(keep_k, jnp.exp(svals - m), 0.0)
+    z_kept = jnp.sum(exps, axis=1, keepdims=True)
+    z_full = jnp.sum(jnp.exp(scaled - m), axis=1, keepdims=True)
+    z = jnp.where(k_active[:, None], z_kept, z_full)
+    probs = exps / z
+    cum = jnp.cumsum(probs, axis=1)
+    keep_p = ((cum - probs) < top_p[:, None]).at[:, 0].set(True)
+    keep_p = keep_p | (top_p[:, None] >= 1.0)
+    keep_c = keep_k & keep_p
+
+    filtered_row = k_active | p_active
+    dirty = (
+        # top-k cut beyond (or tied with) the candidate boundary: the
+        # full-vocab tie set at kth is not visible here
+        (k_active & ((k > cap) | (kth[:, 0] <= sentinel)))
+        # top-p mass not reached within the candidates
+        | (~k_active & p_active
+           & ((cum[:, -1] - probs[:, -1]) < top_p))
+        # kept set touches a value the excluded tail ties with
+        | (filtered_row & jnp.any(keep_c & (svals <= sentinel[:, None]),
+                                  axis=1)))
+    need_exact = jnp.any(dirty & filtered_row)
+
+    keep = jnp.zeros((B, V), bool).at[rows, sidx].set(keep_c)
+    fast = jnp.where(keep | ~filtered_row[:, None], scaled, NEG_INF)
+    return jax.lax.cond(
+        need_exact,
+        lambda _: _filtered_single_sort(scaled, top_k, top_p),
+        lambda _: fast,
+        None)
+
+
 def sample_tokens(
     logits: jax.Array,       # [B, V] fp32
     keys: jax.Array,         # [B] PRNG keys (uint32[2] each)
@@ -117,7 +206,7 @@ def sample_tokens(
             return scaled
 
         def filtered(_):
-            return _filtered_single_sort(scaled, top_k, top_p)
+            return _filtered_fast_or_exact(scaled, top_k, top_p)
 
         # the filter sort only runs when a SAMPLED row asks for it —
         # greedy rows' filter knobs are irrelevant to their argmax

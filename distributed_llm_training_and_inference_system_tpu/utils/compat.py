@@ -1,0 +1,34 @@
+"""Version-compat shims for the JAX API surface this repo targets.
+
+The codebase is written against the current jax API (``jax.shard_map``
+with ``check_vma``); older runtimes (<= 0.4.x) ship the same
+functionality as ``jax.experimental.shard_map.shard_map`` with the
+``check_rep`` keyword. One resolver here instead of try/except at every
+call site — kernels and collectives must not fork on jax version.
+"""
+
+from __future__ import annotations
+
+try:                                   # jax >= 0.6: public API
+    from jax import shard_map as _shard_map
+    _CHECK_KW = "check_vma"
+except ImportError:                    # jax <= 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` with the replication-check flag normalised to
+    the modern ``check_vma`` name."""
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **{_CHECK_KW: check_vma})
+
+
+def axis_size(axis) -> int:
+    """Static size of a named mesh axis inside a shard_map/pmap body
+    (``jax.lax.axis_size`` on current jax; the axis-env frame on 0.4.x)."""
+    import jax
+    from jax import lax
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis)
+    return jax.core.axis_frame(axis)

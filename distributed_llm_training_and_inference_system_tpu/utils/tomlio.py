@@ -10,7 +10,11 @@ this environment).
 from __future__ import annotations
 
 import json
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:          # Python < 3.11: tomllib is stdlib-3.11+
+    import tomli as tomllib          # API-identical backport
 from datetime import date, datetime
 from pathlib import Path
 from typing import Any

@@ -3,7 +3,8 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from distributed_llm_training_and_inference_system_tpu.utils.compat import (
+    shard_map)
 from jax.sharding import Mesh, PartitionSpec as P
 
 from distributed_llm_training_and_inference_system_tpu.comms import (

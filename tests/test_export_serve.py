@@ -193,3 +193,58 @@ def test_synth_int4_matches_jax_quantizer_and_serves(tmp_path):
                        SamplingParams(temperature=0.0, max_tokens=4))
     assert len(out[0].generated_tokens) == 4
     eng.release()
+
+
+class TestInt4LayoutTagGuard:
+    """ADVICE r5 #1: re-exporting a pre-quantized int4 tree must not
+    blindly stamp int4_layout='kernel' — the tag follows validated
+    shapes (or caller metadata), never assumption."""
+
+    def _kernel_tree(self):
+        w = jax.random.normal(jax.random.PRNGKey(0), (2, 256, 128))
+        from distributed_llm_training_and_inference_system_tpu.ops.quantization import (  # noqa: E501
+            quantize_tree_int4)
+        return {"blocks": {"q": {"kernel": quantize_tree_int4(
+            {"k": w}, group=128)["k"]}}}
+
+    def test_kernel_layout_tree_gets_tagged(self, tmp_path):
+        p = export_params(self._kernel_tree(), tmp_path / "k.safetensors")
+        _, meta = load_exported(p)
+        assert meta["int4_layout"] == "kernel"
+        assert meta["quant"] == "int4"
+
+    def test_legacy_layout_tree_refused_without_metadata(self, tmp_path):
+        """The pre-round-3 [L, out, in/2] orientation: packed/scale shapes
+        do NOT validate against the kernel orientation — export must
+        refuse to guess, not silently mislabel."""
+        tree = self._kernel_tree()
+        leaf = tree["blocks"]["q"]["kernel"]
+        # transpose to the legacy orientation: packed [L, out, in/2],
+        # scale [L, out, in/group]
+        leaf["values"] = jnp.swapaxes(leaf["values"], -1, -2)
+        leaf["scale"] = jnp.swapaxes(leaf["scale"], -1, -2)
+        with pytest.raises(ValueError, match="kernel orientation"):
+            export_params(tree, tmp_path / "legacy.safetensors")
+
+    def test_legacy_layout_caller_metadata_survives(self, tmp_path):
+        """A caller who KNOWS the layout can tag it; export keeps the
+        provided tag instead of overwriting with 'kernel'."""
+        tree = self._kernel_tree()
+        leaf = tree["blocks"]["q"]["kernel"]
+        leaf["values"] = jnp.swapaxes(leaf["values"], -1, -2)
+        leaf["scale"] = jnp.swapaxes(leaf["scale"], -1, -2)
+        p = export_params(tree, tmp_path / "legacy.safetensors",
+                          metadata={"int4_layout": "transposed-legacy"})
+        from distributed_llm_training_and_inference_system_tpu.io.export import (  # noqa: E501
+            load_safetensors)
+        _, meta = load_safetensors(p)
+        assert meta["int4_layout"] == "transposed-legacy"
+
+    def test_mixed_tree_quant_tag_not_overwritten(self, tmp_path):
+        """Caller-provided quant metadata survives setdefault."""
+        p = export_params(self._kernel_tree(), tmp_path / "m.safetensors",
+                          metadata={"quant": "int4-awq"})
+        from distributed_llm_training_and_inference_system_tpu.io.export import (  # noqa: E501
+            load_safetensors)
+        _, meta = load_safetensors(p)
+        assert meta["quant"] == "int4-awq"

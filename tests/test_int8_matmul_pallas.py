@@ -154,3 +154,38 @@ def test_decode_routes_int8_through_kernel_same_tokens():
     # without the flag the leaf dequantizes (the pre-round-5 behavior)
     plain = cast_params(rt, jnp.bfloat16)
     assert plain["q"]["kernel"].dtype == jnp.bfloat16
+
+
+def test_over_budget_whole_k_falls_back_loudly():
+    """ADVICE r5 #2: n_in=18560 has no clean k tile and a whole-K
+    [18560, 128] int8 block exceeds the ~2 MB VMEM budget — the auto
+    picker must NOT launch the whole-K kernel (a real-TPU Mosaic/VMEM
+    failure interpret mode can't see); it takes the dequant route with
+    a RuntimeWarning, numerically identical."""
+    import warnings
+    # B=3 (pads to 8): a shape no other test traces — the warning fires
+    # at TRACE time, so a jit-cache hit from an earlier test would
+    # silently skip it
+    In, Out, B = 18560, 256, 3
+    w = jax.random.normal(jax.random.PRNGKey(0), (In, Out),
+                          jnp.float32) * 0.05
+    x = jax.random.normal(jax.random.PRNGKey(1), (B, In), jnp.bfloat16)
+    values, scale = quantize_int8(w)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        got = matmul_w8(x, values, scale, interpret=True)
+    assert any("VMEM budget" in str(c.message) for c in caught), \
+        "over-budget whole-K shape did not take the loud fallback"
+    ref = x.astype(jnp.float32) @ dequantize_int8(values, scale).astype(
+        jnp.float32)
+    rel = float(jnp.max(jnp.abs(got.astype(jnp.float32) - ref))
+                / (jnp.max(jnp.abs(ref)) + 1e-9))
+    assert rel < 0.01
+    # an in-budget shape must NOT warn (the kernel path stays default)
+    w2 = jax.random.normal(jax.random.PRNGKey(2), (512, 256)) * 0.05
+    x2 = jnp.ones((4, 512), jnp.bfloat16)
+    v2, s2 = quantize_int8(w2)
+    with warnings.catch_warnings(record=True) as caught2:
+        warnings.simplefilter("always")
+        matmul_w8(x2, v2, s2, interpret=True)
+    assert not any("VMEM budget" in str(c.message) for c in caught2)

@@ -93,3 +93,87 @@ def test_scan_context_all_greedy():
     _, (toks, argmaxes) = jax.lax.scan(
         step, 0, jnp.arange(K, dtype=jnp.int32))
     np.testing.assert_array_equal(np.asarray(toks), np.asarray(argmaxes))
+
+
+class TestFastFilterTier:
+    """Round-6 lax.top_k fast tier (_filtered_fast_or_exact): bitwise
+    equal to the argsort path wherever the kept set resolves inside the
+    candidate window, exact fallback via lax.cond everywhere else."""
+
+    def _both(self, logits, tk, tp):
+        from distributed_llm_training_and_inference_system_tpu.serve.sampling import (  # noqa: E501
+            _filtered_fast_or_exact, _filtered_single_sort)
+        fast = np.asarray(jax.jit(_filtered_fast_or_exact)(logits, tk, tp))
+        ref = np.asarray(jax.jit(_filtered_single_sort)(logits, tk, tp))
+        return fast, ref
+
+    @pytest.mark.parametrize("tk,tp", [
+        (50, 1.0),          # top-k only
+        (0, 0.9),           # top-p only
+        (64, 0.8),          # both
+        (0, 0.01),          # razor top-p (keeps ~1 token)
+        (500, 0.9),         # top_k > cap: must take the exact fallback
+        (-1, 0.95),         # negative k = disabled
+    ])
+    def test_bitwise_matches_argsort_large_vocab(self, tk, tp):
+        B, V = 4, 2048      # > FILTER_FAST_CAP + 1: fast tier engaged
+        logits = jax.random.normal(jax.random.PRNGKey(5), (B, V),
+                                   jnp.float32) * 4.0
+        fast, ref = self._both(
+            logits, jnp.full((B,), tk, jnp.int32),
+            jnp.full((B,), tp, jnp.float32))
+        np.testing.assert_array_equal(fast, ref)
+
+    def test_bitwise_with_massive_ties(self):
+        """Ties spanning the candidate boundary force the exact path —
+        output must still be bitwise identical."""
+        B, V = 2, 1024
+        logits = jnp.zeros((B, V), jnp.float32)   # ALL values tied
+        logits = logits.at[:, :300].set(1.0)      # 300-way tie > cap
+        for tk, tp in [(8, 0.8), (0, 0.5), (290, 0.99)]:
+            fast, ref = self._both(
+                logits, jnp.full((B,), tk, jnp.int32),
+                jnp.full((B,), tp, jnp.float32))
+            np.testing.assert_array_equal(fast, ref, err_msg=f"{tk},{tp}")
+
+    def test_sample_tokens_end_to_end_matches_reference(self):
+        """Through sample_tokens at a vocab wide enough to engage the
+        fast tier: tokens bitwise equal to the pre-tier composition."""
+        B, V = 4, 4096
+        logits = jax.random.normal(jax.random.PRNGKey(9), (B, V)) * 3.0
+        keys = _keys(B, 13)
+        args = (logits, keys, jnp.asarray([1.0, 0.8, 0.0, 1.2]),
+                jnp.asarray([40, 0, 10, 300], jnp.int32),
+                jnp.asarray([0.9, 0.7, 1.0, 1.0], jnp.float32))
+        np.testing.assert_array_equal(
+            np.asarray(jax.jit(sample_tokens)(*args)),
+            np.asarray(_reference(*args)))
+
+    def test_fast_tier_beats_argsort_at_serve_shape(self):
+        """[8, 50304] (the VERDICT r5 #4 shape): the top_k tier must not
+        be slower than the argsort tier anywhere, and on TPU it must meet
+        the <= 2 ms bar (CPU absolute times are not meaningful — the
+        7.0 ms / 2 ms numbers are chip measurements)."""
+        import time
+        from distributed_llm_training_and_inference_system_tpu.serve.sampling import (  # noqa: E501
+            _filtered_fast_or_exact, _filtered_single_sort)
+        B, V = 8, 50304
+        logits = jax.random.normal(jax.random.PRNGKey(0), (B, V)) * 3.0
+        tk = jnp.full((B,), 50, jnp.int32)
+        tp = jnp.full((B,), 0.9, jnp.float32)
+
+        def best_ms(fn):
+            j = jax.jit(fn)
+            j(logits, tk, tp).block_until_ready()       # compile
+            best = float("inf")
+            for _ in range(5):
+                t0 = time.perf_counter()
+                j(logits, tk, tp).block_until_ready()
+                best = min(best, time.perf_counter() - t0)
+            return best * 1e3
+
+        fast_ms = best_ms(_filtered_fast_or_exact)
+        sort_ms = best_ms(_filtered_single_sort)
+        assert fast_ms <= sort_ms * 1.25, (fast_ms, sort_ms)
+        if jax.default_backend() == "tpu":
+            assert fast_ms <= 2.0, fast_ms
