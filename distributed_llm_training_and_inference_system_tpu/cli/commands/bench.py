@@ -157,10 +157,15 @@ def kernels(op, seq_len, hidden, heads, batch):
               help="serve-load: route int8 decode matmuls through the "
                    "in-kernel-dequant Pallas kernel (A/B vs XLA's fused "
                    "dequant; see ServeConfig.int8_pallas_matmul).")
+@click.option("--serve-replicas", default=1, show_default=True, type=int,
+              help="serve-load: drive a fleet of this many threaded "
+                   "engine replicas through the serve/fleet router "
+                   "instead of one engine; results gain the per-replica "
+                   "requests/p99-TTFT/requeue breakdown.")
 def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
         requests, rps, concurrency, admission, kv_blocks, device_times,
         preemption, latency_dispatch_steps, artifact, quant, kv_quant,
-        slots, pipelined, int8_pallas):
+        slots, pipelined, int8_pallas, serve_replicas):
     """End-to-end train step throughput / serve TTFT+throughput
     (parity: reference bench.py:35-49). ``serve-load`` runs open-loop
     (Poisson) and closed-loop sweeps with p50/p99 TTFT, per-token latency,
@@ -238,8 +243,8 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
         from ...serve import InferenceEngine, SamplingParams
         from ...serve.loadgen import run_closed_loop, run_poisson
 
-        def fresh_engine():
-            return InferenceEngine(cfg, ServeConfig(
+        def point_serve_cfg():
+            return ServeConfig(
                 model=model_name,
                 max_batch_size=slots or min(max(requests, 8), 16),
                 max_seq_len=min(prompt_len + gen_len + 16,
@@ -252,11 +257,48 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
                 int8_pallas_matmul=int8_pallas,
                 artifact=artifact, quantization=quant,
                 kv_quantization=kv_quant,
-                dtype="bfloat16" if on_tpu else "float32"))
+                dtype="bfloat16" if on_tpu else "float32")
+
+        def fresh_engine():
+            return InferenceEngine(cfg, point_serve_cfg())
+
+        def _reset_counters(eng):
+            # zero EVERY counter stats() derives ratios from — a partial
+            # reset left warmup padded-slot steps in the utilization
+            # denominator's sibling (review r4)
+            eng.total_prefill_tokens = 0
+            eng.total_decode_steps = 0
+            eng.total_padded_slot_steps = 0
+            eng.total_short_dispatches = 0
 
         last_engine: list = []
 
+        def warmed_fleet():
+            """Fleet sweep point: each replica's programs are compiled
+            BEFORE its threads start (stepping an engine from two threads
+            is undefined), then counters reset and the fleet goes live."""
+            import gc
+
+            from ...config.schema import FleetConfig
+            from ...serve.fleet import ServeFleet
+            if last_engine:
+                last_engine.pop().shutdown()
+                gc.collect()
+                jax.clear_caches()
+            fleet = ServeFleet(cfg, point_serve_cfg(),
+                               FleetConfig(replicas=serve_replicas))
+            for r in fleet.replicas:
+                r.engine.generate([list(range(1, prompt_len + 1))],
+                                  SamplingParams(temperature=0.0,
+                                                 max_tokens=2))
+                _reset_counters(r.engine)
+            fleet.start()
+            last_engine.append(fleet)
+            return fleet
+
         def warmed_engine():
+            if serve_replicas > 1:
+                return warmed_fleet()
             # jitted prefill/decode closures are PER-ENGINE (bound methods
             # key jax's trace cache), so every sweep point's engine must
             # compile its own programs BEFORE its timed window — a shared
@@ -273,22 +315,25 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
             eng = fresh_engine()
             eng.generate([list(range(1, prompt_len + 1))],
                          SamplingParams(temperature=0.0, max_tokens=2))
-            # zero EVERY counter stats() derives ratios from — a partial
-            # reset left warmup padded-slot steps in the utilization
-            # denominator's sibling (review r4)
-            eng.total_prefill_tokens = 0
-            eng.total_decode_steps = 0
-            eng.total_padded_slot_steps = 0
-            eng.total_short_dispatches = 0
+            _reset_counters(eng)
             last_engine.append(eng)
             return eng
 
         def engine_counters() -> dict:
-            es = last_engine[0].stats() if last_engine else {}
-            return {k: es.get(k) for k in
-                    ("short_dispatches", "decode_steps",
-                     "padded_slot_steps", "prefill_tokens",
-                     "preemptions", "decode_slot_utilization")}
+            if not last_engine:
+                return {}
+            target = last_engine[0]
+            engines = ([r.engine for r in target.replicas]
+                       if hasattr(target, "router") else [target])
+            keys = ("short_dispatches", "decode_steps",
+                    "padded_slot_steps", "prefill_tokens", "preemptions")
+            agg = {k: sum(e.stats().get(k) or 0 for e in engines)
+                   for k in keys}
+            B = engines[0].serve_cfg.max_batch_size
+            agg["decode_slot_utilization"] = round(
+                1.0 - agg["padded_slot_steps"]
+                / max(agg["decode_steps"] * B, 1), 4)
+            return agg
 
         results["serve_load"] = {"admission": admission,
                                  "preemption": preemption,

@@ -1,0 +1,106 @@
+"""`llmctl fleet` — operate a running serve fleet over its HTTP surface.
+
+Companion to ``llmctl serve start --replicas N`` (serve/fleet/http.py):
+``status`` reads ``GET /fleet/status``; ``drain``/``undrain`` post to
+``/fleet/drain`` / ``/fleet/undrain``. Stdlib urllib only — the operator
+box running this may not have the serving deps installed.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import click
+
+
+def _get(url: str) -> dict:
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _post(url: str, body: dict) -> dict:
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _die(e: Exception) -> None:
+    if isinstance(e, urllib.error.HTTPError):
+        try:
+            detail = json.loads(e.read().decode()).get("error", "")
+        except Exception:
+            detail = ""
+        raise click.ClickException(f"HTTP {e.code}: {detail or e.reason}")
+    raise click.ClickException(str(e))
+
+
+@click.group(name="fleet")
+def app():
+    """Serve-fleet operations (router + replica supervisor)."""
+
+
+@app.command()
+@click.option("--url", default="http://127.0.0.1:8080", show_default=True,
+              help="Fleet server base URL.")
+@click.option("--json", "as_json", is_flag=True,
+              help="Raw JSON instead of the table.")
+def status(url, as_json):
+    """Per-replica health, queue depths, and the router ledger."""
+    try:
+        snap = _get(f"{url.rstrip('/')}/fleet/status")
+    except Exception as e:
+        _die(e)
+    if as_json:
+        click.echo(json.dumps(snap, indent=2))
+        return
+    from rich.console import Console
+    from rich.table import Table
+    table = Table(title="Fleet replicas")
+    for col in ("replica", "state", "queue", "active", "outstanding tok",
+                "restarts", "last error"):
+        table.add_column(col)
+    for r in snap["replicas"]:
+        color = {"healthy": "green", "draining": "yellow",
+                 "drained": "yellow"}.get(r["state"], "red")
+        table.add_row(str(r["replica"]),
+                      f"[{color}]{r['state']}[/{color}]",
+                      str(r["queue_depth"]), str(r["active"]),
+                      str(r["outstanding_tokens"]), str(r["restarts"]),
+                      (r.get("last_error") or "")[:48])
+    console = Console()
+    console.print(table)
+    rt = snap["router"]
+    console.print(
+        f"router: {rt['completed']}/{rt['submitted']} completed, "
+        f"{rt['rejected']} rejected (429), {rt['requeues']} requeues, "
+        f"{rt['in_flight']} in flight, {rt['parked']} parked")
+
+
+@app.command()
+@click.argument("replica", type=int)
+@click.option("--url", default="http://127.0.0.1:8080", show_default=True)
+def drain(replica, url):
+    """Gracefully drain REPLICA: its in-flight requests requeue to the
+    surviving replicas (token-identical resume), then it leaves rotation."""
+    try:
+        out = _post(f"{url.rstrip('/')}/fleet/drain", {"replica": replica})
+    except Exception as e:
+        _die(e)
+    click.echo(f"replica {out['replica']}: drain requested")
+
+
+@app.command()
+@click.argument("replica", type=int)
+@click.option("--url", default="http://127.0.0.1:8080", show_default=True)
+def undrain(replica, url):
+    """Return a drained REPLICA to rotation."""
+    try:
+        out = _post(f"{url.rstrip('/')}/fleet/undrain",
+                    {"replica": replica})
+    except Exception as e:
+        _die(e)
+    click.echo(f"replica {out['replica']}: back in rotation")

@@ -97,19 +97,42 @@ def app(ctx):
               help="CORS allowed origins for browser clients: '*', a "
                    "comma-separated list, or '' to disable (parity: the "
                    "reference installs allow-all CORSMiddleware).")
+@click.option("--replicas", default=1, show_default=True, type=int,
+              help="Engine replicas behind the fleet router (>1 starts "
+                   "the serve/fleet control plane: prefix-affinity "
+                   "routing, health-driven drain/restart, 429 "
+                   "backpressure; `llmctl fleet status/drain` manages "
+                   "it).")
+@click.option("--fleet-max-pending", default=512, show_default=True,
+              type=int,
+              help="Fleet-wide queued-request bound; beyond it new "
+                   "requests get 429 + Retry-After.")
+@click.option("--fleet-probe-interval", default=0.5, show_default=True,
+              type=float, help="Supervisor health-probe cadence (s).")
+@click.option("--fleet-restart-backoff", default=0.5, show_default=True,
+              type=float,
+              help="First replica-restart delay; doubles per consecutive "
+                   "restart.")
+@click.option("--fleet-affinity-tokens", default=64, show_default=True,
+              type=int,
+              help="Prompt-prefix length hashed for replica affinity "
+                   "(keeps per-replica prefix caches hot; 0 = pure "
+                   "least-outstanding-tokens routing).")
 def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
           kv_block_size, kv_hbm_gb, scheduler, dtype, prometheus_port,
           speculative, spec_tokens, prefix_cache, tensor_parallel,
           quantization, chunked_prefill, kv_quantization, admission,
           preemption, latency_dispatch_steps, pipelined_decode,
-          int8_pallas, cors_origins):
+          int8_pallas, cors_origins, replicas, fleet_max_pending,
+          fleet_probe_interval, fleet_restart_backoff,
+          fleet_affinity_tokens):
     """Start the OpenAI-compatible inference server."""
     import jax
 
     from ...config.presets import get_model_config
-    from ...config.schema import ServeConfig
+    from ...config.schema import FleetConfig, ServeConfig
     from ...metrics.observability import setup_observability
-    from ...serve.server import create_inference_server
+    from ...serve.server import create_server
 
     if dtype is None:
         dtype = "bfloat16" if jax.default_backend() == "tpu" else "float32"
@@ -130,14 +153,31 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
         int8_pallas_matmul=int8_pallas,
         cors_origins=cors_origins)
     serve_cfg.validate()
+    fleet_cfg = None
+    if replicas > 1:
+        fleet_cfg = FleetConfig(
+            replicas=replicas, max_pending=fleet_max_pending,
+            probe_interval_s=fleet_probe_interval,
+            restart_backoff_s=fleet_restart_backoff,
+            affinity_prefix_tokens=fleet_affinity_tokens)
+        fleet_cfg.validate()
 
     observer = None
     if prometheus_port:
         obs = setup_observability(prometheus_port=prometheus_port)
-        observer = lambda event, payload: obs.record_inference(payload)
 
-    server = create_inference_server(model_cfg, serve_cfg, observer=observer)
+        def observer(event, payload):
+            # supervisor snapshots carry per-replica gauges; everything
+            # else is per-request inference telemetry
+            if event == "fleet":
+                obs.record_fleet(payload)
+            else:
+                obs.record_inference(payload)
+
+    server = create_server(model_cfg, serve_cfg, fleet_cfg=fleet_cfg,
+                           observer=observer)
     click.echo(f"serving {model_name} on {host}:{port} "
                f"(backend={jax.default_backend()}, dtype={dtype}, "
-               f"scheduler={scheduler})")
+               f"scheduler={scheduler}"
+               + (f", replicas={replicas}" if replicas > 1 else "") + ")")
     server.run_forever()

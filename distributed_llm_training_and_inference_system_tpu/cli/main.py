@@ -35,6 +35,7 @@ _COMMANDS = {
     "eval": "eval_cmd",
     "export": "export",
     "serve": "serve",
+    "fleet": "fleet",
     "bench": "bench",
     "trace": "trace",
     "replay": "replay",

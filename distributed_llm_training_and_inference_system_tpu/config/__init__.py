@@ -8,6 +8,7 @@ from .schema import (  # noqa: F401
     CheckpointConfig,
     ConfigError,
     DataConfig,
+    FleetConfig,
     HardwareConfig,
     ModelConfig,
     MoEConfig,

@@ -717,6 +717,75 @@ class ServeConfig:
         return cfg
 
 
+@dataclass
+class FleetConfig:
+    """Serve-fleet control plane (serve/fleet/): N engine replicas behind a
+    router + supervisor. The per-replica engine is configured by ServeConfig;
+    this layer only decides WHERE a request runs and what happens when a
+    replica dies (Llumnix-style request-level rerouting above Orca-style
+    iteration-level scheduling — PAPERS.md)."""
+    replicas: int = 1
+    # -- supervisor ----------------------------------------------------------
+    probe_interval_s: float = 0.5   # health-probe cadence
+    probe_failures: int = 3         # consecutive probe misses before the
+    #                                 replica is declared dead and drained
+    restart_backoff_s: float = 0.5  # first restart delay; doubles per
+    #                                 consecutive restart of the same replica
+    restart_backoff_max_s: float = 30.0
+    max_restarts: int = 0           # 0 = unlimited
+    # -- router --------------------------------------------------------------
+    # prefix-affinity: requests whose first `affinity_prefix_tokens` tokens
+    # hash to the same digest route to the same replica (consistent hashing
+    # over `affinity_vnodes` ring points per replica), so each replica's
+    # prefix cache stays hot for its share of the prompt population. 0
+    # disables affinity (pure least-outstanding-tokens).
+    affinity_prefix_tokens: int = 64
+    affinity_vnodes: int = 32
+    # affinity yields to load balance once the ring owner's queue is this
+    # many requests deeper than the least-loaded replica's (a hot prefix
+    # must not melt one replica while others idle)
+    affinity_max_imbalance: int = 4
+    # -- admission / backpressure -------------------------------------------
+    # fleet-wide bound on queued-but-not-resident requests (sum over
+    # replica queues + parked requeues). Above it, submissions are
+    # rejected with 429 + Retry-After instead of growing tail latency.
+    max_pending: int = 512
+    retry_after_s: float = 1.0      # Retry-After hint on 429
+    # per-request requeue budget (crash/drain rerouting); above it the
+    # request fails loudly instead of ping-ponging between dying replicas
+    max_requeues: int = 3
+
+    def validate(self) -> None:
+        if self.replicas < 1:
+            raise ConfigError("fleet replicas must be >= 1")
+        if self.probe_interval_s <= 0:
+            raise ConfigError("probe_interval_s must be > 0")
+        if self.probe_failures < 1:
+            raise ConfigError("probe_failures must be >= 1")
+        if self.restart_backoff_s < 0 or self.restart_backoff_max_s < 0:
+            raise ConfigError("restart backoff values must be >= 0")
+        if self.affinity_prefix_tokens < 0:
+            raise ConfigError("affinity_prefix_tokens must be >= 0")
+        if self.affinity_vnodes < 1:
+            raise ConfigError("affinity_vnodes must be >= 1")
+        if self.max_pending < 1:
+            raise ConfigError("max_pending must be >= 1")
+        if self.max_requeues < 0:
+            raise ConfigError("max_requeues must be >= 0")
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any] | None) -> "FleetConfig":
+        if not d:
+            return cls()
+        kw = {}
+        for f_ in dataclasses.fields(cls):
+            if f_.name in d:
+                kw[f_.name] = type(f_.default)(d[f_.name])
+        cfg = cls(**kw)
+        cfg.validate()
+        return cfg
+
+
 # alias -> canonical field name for ModelConfig dict keys (the _take
 # alias groups in ModelConfig.from_dict, inverted). Used when overlaying
 # user keys onto a template's canonical dict — see RunConfig.from_dict.

@@ -188,6 +188,31 @@ class PrometheusExporter:
                                 "Swap-in restores")
         self.infer_swapped_bytes = g("llmctl_inference_swapped_host_bytes",
                                      "Host bytes held by swapped-out KV")
+        # serve-fleet control plane (serve/fleet/): per-replica health the
+        # operator alarms on. Queue depth + outstanding tokens are the
+        # routing signals themselves; restarts/requeues/rejections are the
+        # failure-path counters the fault-injection tests exercise.
+        self.fleet_queue_depth = g("llmctl_fleet_replica_queue_depth",
+                                   "Queued requests per replica",
+                                   ["replica"])
+        self.fleet_outstanding = g(
+            "llmctl_fleet_replica_outstanding_tokens",
+            "Tokens of work owed per replica (routing load signal)",
+            ["replica"])
+        self.fleet_active = g("llmctl_fleet_replica_active",
+                              "Resident (decoding) requests per replica",
+                              ["replica"])
+        self.fleet_healthy = g("llmctl_fleet_replica_healthy",
+                               "1 while the replica accepts traffic",
+                               ["replica"])
+        self.fleet_restarts = c("llmctl_fleet_replica_restarts",
+                                "Supervisor restarts per replica",
+                                ["replica"])
+        self.fleet_requeues = c("llmctl_fleet_requeues",
+                                "Requests rerouted off a crashed or "
+                                "drained replica")
+        self.fleet_rejected = c("llmctl_fleet_rejected",
+                                "Requests refused with 429 + Retry-After")
         self._last_totals: dict[str, float] = {}
         self._server_started = False
 
@@ -237,6 +262,34 @@ class PrometheusExporter:
                 self._last_totals[key] = m[key]
         if "swapped_host_bytes" in m:
             self.infer_swapped_bytes.set(m["swapped_host_bytes"])
+
+    def export_fleet(self, snap: dict) -> None:
+        """Export a supervisor snapshot (serve/fleet/supervisor.py
+        ``snapshot()``): per-replica gauges + fleet counters. Counters
+        arrive as running totals, so the delta since the last snapshot is
+        inc'ed (same convention as preemptions/swap_ins above)."""
+        for rep in snap.get("replicas", []):
+            rid = str(rep["replica"])
+            self.fleet_queue_depth.labels(replica=rid).set(
+                rep.get("queue_depth", 0))
+            self.fleet_outstanding.labels(replica=rid).set(
+                rep.get("outstanding_tokens", 0))
+            self.fleet_active.labels(replica=rid).set(rep.get("active", 0))
+            self.fleet_healthy.labels(replica=rid).set(
+                1.0 if rep.get("state") == "healthy" else 0.0)
+            key = f"fleet_restarts_{rid}"
+            delta = rep.get("restarts", 0) - self._last_totals.get(key, 0)
+            if delta > 0:
+                self.fleet_restarts.labels(replica=rid).inc(delta)
+            self._last_totals[key] = rep.get("restarts", 0)
+        router = snap.get("router", {})
+        for key, counter in (("requeues", self.fleet_requeues),
+                             ("rejected", self.fleet_rejected)):
+            total = router.get(key, 0)
+            delta = total - self._last_totals.get(f"fleet_{key}", 0)
+            if delta > 0:
+                counter.inc(delta)
+            self._last_totals[f"fleet_{key}"] = total
 
 
 class OTLPExporter:
@@ -326,6 +379,11 @@ class ObservabilityManager:
             self.prometheus.export_inference(m)
         if self.otlp:
             self.otlp.record_inference_request(m)
+
+    def record_fleet(self, snap: dict) -> None:
+        """Per-replica fleet snapshot (supervisor poll cadence)."""
+        if self.prometheus:
+            self.prometheus.export_fleet(snap)
 
 
 # -- global singleton (reference setup_observability observability.py:417) ----

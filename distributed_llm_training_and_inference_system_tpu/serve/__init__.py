@@ -6,6 +6,14 @@ prefill/decode split.
 """
 
 from .engine import InferenceEngine
+from .fleet import (
+    FaultInjector,
+    FaultPlan,
+    FleetRouter,
+    FleetSaturated,
+    ReplicaSupervisor,
+    ServeFleet,
+)
 from .kv_cache import PagedKVCache
 from .scheduler import (
     ContinuousBatchingScheduler,
@@ -13,15 +21,22 @@ from .scheduler import (
     RequestState,
     SamplingParams,
 )
-from .server import InferenceServer, create_inference_server
+from .server import InferenceServer, create_inference_server, create_server
 
 __all__ = [
     "ContinuousBatchingScheduler",
+    "FaultInjector",
+    "FaultPlan",
+    "FleetRouter",
+    "FleetSaturated",
     "InferenceEngine",
     "InferenceServer",
     "PagedKVCache",
+    "ReplicaSupervisor",
     "Request",
     "RequestState",
     "SamplingParams",
+    "ServeFleet",
     "create_inference_server",
+    "create_server",
 ]

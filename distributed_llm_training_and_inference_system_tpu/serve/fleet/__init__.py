@@ -1,0 +1,163 @@
+"""Serve fleet control plane: N engine replicas behind a router.
+
+Everything below one replica — iteration-level continuous batching, paged
+KV, chunked prefill, speculation — is `serve/engine.py`, untouched (the
+Orca split, PAPERS.md). This package adds the first layer where a request
+can outlive a single engine process:
+
+- :class:`~.router.FleetRouter` — prefix-affinity consistent hashing +
+  least-outstanding-tokens placement, fleet admission (429 + Retry-After)
+- :class:`~.replica.EngineReplica` — a threaded engine whose crash and
+  drain paths extract in-flight requests instead of failing them
+- :class:`~.supervisor.ReplicaSupervisor` — health probes, requeue,
+  restart with exponential backoff
+- :class:`~.faults.FaultInjector` — deterministic crash / probe-timeout /
+  straggler injection so every path above is testable on CPU
+- :class:`ServeFleet` — the facade wiring them together
+
+Replicas here are threads over engines on the local (possibly virtual)
+mesh — the same in-process simulation strategy the repo uses for
+multi-chip training (tests/conftest.py): every routing, drain, and
+requeue decision executes the real code path, deterministically, on CPU.
+On real hardware each replica maps to its own chip slice; the control
+plane is transport-agnostic by construction (it only ever calls
+``submit``/``probe``/``take_orphans``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional, Sequence
+
+from ...config.schema import FleetConfig, ModelConfig, ServeConfig
+from ..scheduler import Request, SamplingParams
+from .faults import FaultInjector, FaultPlan, InjectedCrash, ProbeTimeout
+from .replica import EngineReplica, reset_for_requeue
+from .router import FleetRouter, FleetSaturated, prefix_digest
+from .supervisor import ReplicaSupervisor
+
+__all__ = [
+    "EngineReplica",
+    "FaultInjector",
+    "FaultPlan",
+    "FleetRouter",
+    "FleetSaturated",
+    "InjectedCrash",
+    "ProbeTimeout",
+    "ReplicaSupervisor",
+    "ServeFleet",
+    "prefix_digest",
+    "reset_for_requeue",
+]
+
+
+class ServeFleet:
+    """N replicas + router + supervisor, ready to serve.
+
+    Weights are loaded/initialised ONCE (by replica 0) and shared read-only
+    across replicas — on the test CPU that is N KV pools over one param
+    tree, and on real hardware it mirrors replicas serving one artifact.
+
+    ``supervise=True`` runs the supervisor on its own thread (production);
+    ``supervise=False`` leaves probing/requeue/restart to explicit
+    ``supervisor.poll_once()`` calls (deterministic tests, dryrun)."""
+
+    def __init__(self, model_cfg: ModelConfig, serve_cfg: ServeConfig,
+                 fleet_cfg: Optional[FleetConfig] = None, params=None,
+                 fault_plan: Optional[FaultPlan] = None,
+                 observer: Optional[Callable[[str, dict], None]] = None,
+                 eos_token_id: Optional[int] = None, seed: int = 0,
+                 supervise: bool = True):
+        self.fleet_cfg = fleet_cfg or FleetConfig()
+        self.fleet_cfg.validate()
+        self.serve_cfg = serve_cfg
+        self.injector = FaultInjector(fault_plan) if fault_plan else None
+        self.replicas: list[EngineReplica] = []
+        for i in range(self.fleet_cfg.replicas):
+            r = EngineReplica(
+                i, model_cfg, serve_cfg, params=params,
+                # distinct base seeds so unseeded sampled requests don't
+                # mirror each other across replicas (greedy / explicit
+                # seeds are unaffected)
+                seed=seed + 1000 * i, injector=self.injector,
+                on_finish=self._on_request_exit, eos_token_id=eos_token_id)
+            if params is None:          # replica 0 owns the load; share it
+                params = r.engine.params
+                model_cfg = r.model_cfg
+            self.replicas.append(r)
+        self.model_cfg = model_cfg
+        self._params = params
+        self.router = FleetRouter(self.replicas, self.fleet_cfg,
+                                  observer=observer)
+        self.supervisor = ReplicaSupervisor(
+            self.replicas, self.router, self.fleet_cfg,
+            injector=self.injector, params=params, observer=observer)
+        self._supervise = supervise
+
+    def _on_request_exit(self, replica_id: int, req: Request) -> None:
+        self.router.on_request_exit(replica_id, req)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        for r in self.replicas:
+            r.start()
+        if self._supervise:
+            self.supervisor.start()
+
+    def shutdown(self) -> None:
+        self.supervisor.stop()
+        for r in self.replicas:
+            r.stop()
+            try:
+                r.engine.release()
+            except Exception:
+                pass
+
+    # -- serving -------------------------------------------------------------
+
+    def submit(self, prompt_tokens: Sequence[int],
+               sampling: Optional[SamplingParams] = None,
+               request_id: Optional[str] = None,
+               on_complete: Optional[Callable[[Request], None]] = None,
+               ) -> Request:
+        return self.router.submit(prompt_tokens, sampling,
+                                  request_id=request_id,
+                                  on_complete=on_complete)
+
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 sampling: Optional[SamplingParams] = None,
+                 timeout_s: float = 300.0) -> list[Request]:
+        """Synchronous batch convenience (tests + dryrun): submit every
+        prompt, wait for terminal states. Without a supervisor thread the
+        wait loop polls the supervisor, so crash/drain recovery still
+        happens — deterministically on THIS thread."""
+        events: list[threading.Event] = []
+        reqs: list[Request] = []
+        for p in prompts:
+            ev = threading.Event()
+            reqs.append(self.submit(p, sampling,
+                                    on_complete=lambda _r, ev=ev: ev.set()))
+            events.append(ev)
+        deadline = time.monotonic() + timeout_s
+        for ev in events:
+            while not ev.wait(timeout=0.02):
+                if not self._supervise:
+                    self.supervisor.poll_once()
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"fleet generate: {sum(not e.is_set() for e in events)}"
+                        f" of {len(events)} requests still pending")
+        return reqs
+
+    # -- operator surface ----------------------------------------------------
+
+    def drain(self, replica_id: int) -> bool:
+        return self.supervisor.drain(replica_id)
+
+    def undrain(self, replica_id: int) -> bool:
+        return self.supervisor.undrain(replica_id)
+
+    def status(self) -> dict:
+        return self.supervisor.snapshot()

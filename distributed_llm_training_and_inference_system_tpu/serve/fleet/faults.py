@@ -1,0 +1,112 @@
+"""Deterministic fault injection for the serve fleet.
+
+The control plane's hard paths — crash-requeue, probe-driven drain,
+straggler rebalancing — only execute when a replica misbehaves, which on
+healthy hardware is never. This module makes those paths testable on CPU:
+a ``FaultPlan`` declares WHAT goes wrong (one replica crashes, probes time
+out, decode drags) and the ``FaultInjector`` fires it at a deterministic
+point (an exact per-replica step count, or one drawn from a seeded RNG),
+so a fleet test replays bit-identically run over run.
+
+Faults are injected at the same seams real failures enter:
+- crash      — raised from the replica's engine loop between steps, so the
+               replica thread dies exactly like an uncaught device error
+- probe loss — raised from the supervisor's health probe, modelling a hung
+               or partitioned replica whose engine thread still runs
+- straggler  — a fixed per-step delay, modelling a thermally throttled or
+               noisy-neighbour chip that is slow but not dead
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+
+class InjectedCrash(RuntimeError):
+    """Raised inside a replica's engine loop to simulate a process crash."""
+
+
+class ProbeTimeout(RuntimeError):
+    """Raised from a health probe to simulate a hung/partitioned replica."""
+
+
+@dataclass
+class FaultPlan:
+    """Declarative fault schedule. All fields optional; the default plan
+    injects nothing. ``seed`` only matters when ``crash_after_steps`` is 0:
+    the crash step is then drawn once from ``default_rng(seed)`` in
+    [crash_step_lo, crash_step_hi), keeping "crash at a random-but-
+    reproducible point" a one-liner for soak tests."""
+    seed: int = 0
+    # crash: replica `crash_replica` raises InjectedCrash before its
+    # `crash_after_steps`-th engine step (fires once, ever — the restarted
+    # replica is healthy)
+    crash_replica: Optional[int] = None
+    crash_after_steps: int = 0
+    crash_step_lo: int = 1
+    crash_step_hi: int = 8
+    # probe timeouts: the next `probe_timeout_count` health probes of
+    # `probe_timeout_replica` raise ProbeTimeout
+    probe_timeout_replica: Optional[int] = None
+    probe_timeout_count: int = 0
+    # straggler: every engine step of `slow_replica` is delayed `slow_ms`
+    slow_replica: Optional[int] = None
+    slow_ms: float = 0.0
+
+
+class FaultInjector:
+    """Runtime counterpart of a FaultPlan. Thread-safe: replica engine
+    threads call ``before_step``/``step_delay_s``; the supervisor thread
+    calls ``on_probe``."""
+
+    def __init__(self, plan: Optional[FaultPlan] = None):
+        self.plan = plan or FaultPlan()
+        self._lock = threading.Lock()
+        self._steps: dict[int, int] = {}
+        self._crash_fired = False
+        self._probe_timeouts_left = self.plan.probe_timeout_count
+        p = self.plan
+        self._crash_step = p.crash_after_steps
+        if p.crash_replica is not None and p.crash_after_steps <= 0:
+            self._crash_step = int(np.random.default_rng(p.seed).integers(
+                p.crash_step_lo, max(p.crash_step_hi, p.crash_step_lo + 1)))
+
+    def before_step(self, replica_id: int) -> None:
+        """Called by the replica loop before each engine step; raises
+        InjectedCrash exactly once at the planned (replica, step)."""
+        with self._lock:
+            step = self._steps.get(replica_id, 0)
+            self._steps[replica_id] = step + 1
+            fire = (not self._crash_fired
+                    and self.plan.crash_replica == replica_id
+                    and step >= self._crash_step)
+            if fire:
+                self._crash_fired = True
+        if fire:
+            raise InjectedCrash(
+                f"injected crash: replica {replica_id} at step {step}")
+
+    def step_delay_s(self, replica_id: int) -> float:
+        if self.plan.slow_replica == replica_id and self.plan.slow_ms > 0:
+            return self.plan.slow_ms / 1e3
+        return 0.0
+
+    def on_probe(self, replica_id: int) -> None:
+        """Called by the supervisor before each health probe; raises
+        ProbeTimeout for the planned number of probes."""
+        with self._lock:
+            fire = (self.plan.probe_timeout_replica == replica_id
+                    and self._probe_timeouts_left > 0)
+            if fire:
+                self._probe_timeouts_left -= 1
+        if fire:
+            raise ProbeTimeout(
+                f"injected probe timeout: replica {replica_id}")
+
+    def steps_taken(self, replica_id: int) -> int:
+        with self._lock:
+            return self._steps.get(replica_id, 0)

@@ -1,0 +1,224 @@
+"""HTTP front for the serve fleet.
+
+Same OpenAI surface as the single-replica server (POST /v1/completions,
+GET /v1/models, /health, /metrics, /v1/stats — serve/server.py), sharing
+its body validator so the two fronts cannot drift, plus the fleet
+operator endpoints:
+
+- ``GET  /fleet/status``  — per-replica health + router ledger
+- ``POST /fleet/drain``   — ``{"replica": N}``: graceful drain (in-flight
+  requests requeue to surviving replicas, nothing is dropped)
+- ``POST /fleet/undrain`` — return a drained replica to rotation
+
+Backpressure contract: when every replica saturates, completions answer
+**429 with a Retry-After header** (seconds) instead of queueing without
+bound — the client-visible half of the router's ``max_pending`` admission
+bound. SSE streaming is not offered on the fleet front yet (a stream
+would pin a request to one replica and break crash-requeue transparency);
+``stream: true`` is rejected with 400 rather than silently degraded.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from typing import Optional
+
+from aiohttp import web
+
+from ...config.schema import FleetConfig, ModelConfig, ServeConfig
+from ..scheduler import RequestState
+from ..server import BadRequest, parse_completion_body
+from ..tokenizer import load_tokenizer
+from . import ServeFleet
+from .faults import FaultPlan
+from .router import FleetSaturated
+
+logger = logging.getLogger("llmctl.serve.fleet.http")
+
+
+class FleetServer:
+    def __init__(self, model_cfg: ModelConfig, serve_cfg: ServeConfig,
+                 fleet_cfg: FleetConfig, params=None, observer=None,
+                 fault_plan: Optional[FaultPlan] = None):
+        self.serve_cfg = serve_cfg
+        self.observer = observer or (lambda event, payload: None)
+        self.tokenizer = load_tokenizer(serve_cfg.artifact or None,
+                                        model_cfg.vocab_size)
+        self.fleet = ServeFleet(
+            model_cfg, serve_cfg, fleet_cfg, params=params,
+            observer=self.observer, fault_plan=fault_plan,
+            eos_token_id=getattr(self.tokenizer, "eos_token_id", None))
+        self.model_cfg = self.fleet.model_cfg    # artifact-effective config
+        self.app = self._build_app()
+
+    # -- handlers ------------------------------------------------------------
+
+    async def handle_completions(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        try:
+            prompt_tokens, sampling, stream = parse_completion_body(
+                body, self.tokenizer, self.model_cfg.vocab_size)
+        except BadRequest as e:
+            return web.json_response({"error": str(e)}, status=400)
+        if stream:
+            return web.json_response(
+                {"error": "stream=true is not supported on the fleet "
+                          "endpoint (a stream would pin the request to one "
+                          "replica and break crash-requeue)"}, status=400)
+
+        loop = asyncio.get_running_loop()
+        event = asyncio.Event()
+        try:
+            req = self.fleet.submit(
+                prompt_tokens, sampling,
+                on_complete=lambda _r: loop.call_soon_threadsafe(event.set))
+        except FleetSaturated as e:
+            return web.json_response(
+                {"error": str(e)},
+                status=429,
+                headers={"Retry-After":
+                         str(max(int(e.retry_after_s + 0.5), 1))})
+        except ValueError as e:      # per-replica validation (too long)
+            return web.json_response({"error": str(e)}, status=400)
+
+        try:
+            await asyncio.wait_for(event.wait(), timeout=600.0)
+        except asyncio.TimeoutError:
+            self.fleet.router.cancel(req.request_id)
+            return web.json_response({"error": "timeout"}, status=504)
+
+        if req.state is RequestState.FAILED:
+            return web.json_response({"error": req.error or "failed"},
+                                     status=500)
+        latency_ms = (req.finish_time - req.arrival_time) * 1000.0
+        n_gen = len(req.generated_tokens)
+        meta = getattr(req, "fleet_meta", {}) or {}
+        self.observer("inference_request", {
+            "latency_ms": latency_ms, "ttft_ms": req.ttft_ms,
+            "prompt_tokens": req.num_prompt_tokens, "tokens": n_gen,
+            "replica": meta.get("replica"),
+            "requeues": meta.get("requeues", 0),
+        })
+        return web.json_response({
+            "id": req.request_id,
+            "object": "text_completion",
+            "created": int(time.time()),
+            "model": self.model_cfg.name,
+            "choices": [{
+                "index": 0,
+                "text": self.tokenizer.decode(req.generated_tokens),
+                "token_ids": req.generated_tokens,
+                "finish_reason": req.finish_reason,
+            }],
+            "usage": {
+                "prompt_tokens": req.num_prompt_tokens,
+                "completion_tokens": n_gen,
+                "total_tokens": req.num_prompt_tokens + n_gen,
+            },
+            "metrics": {"ttft_ms": req.ttft_ms, "latency_ms": latency_ms,
+                        "replica": meta.get("replica"),
+                        "requeues": meta.get("requeues", 0)},
+        })
+
+    async def handle_models(self, request: web.Request) -> web.Response:
+        return web.json_response({
+            "object": "list",
+            "data": [{"id": self.model_cfg.name, "object": "model",
+                      "owned_by": "llmctl",
+                      "max_model_len": self.serve_cfg.max_seq_len}],
+        })
+
+    async def handle_health(self, request: web.Request) -> web.Response:
+        snap = self.fleet.status()
+        healthy = [r for r in snap["replicas"] if r["state"] == "healthy"]
+        # the fleet is up while ANY replica can take traffic; a load
+        # balancer gating on this must not pull the whole fleet because
+        # one replica is mid-restart
+        status = "healthy" if healthy else "degraded"
+        return web.json_response(
+            {"status": status,
+             "model": self.model_cfg.name,
+             "replicas_healthy": len(healthy),
+             "replicas_total": len(snap["replicas"]),
+             "router": snap["router"]},
+            status=200 if healthy else 503)
+
+    async def handle_stats(self, request: web.Request) -> web.Response:
+        return web.json_response(self.fleet.status())
+
+    async def handle_fleet_status(self, request: web.Request) -> web.Response:
+        return web.json_response(self.fleet.status())
+
+    async def handle_fleet_drain(self, request: web.Request) -> web.Response:
+        return await self._drain_action(request, drain=True)
+
+    async def handle_fleet_undrain(self, request: web.Request
+                                   ) -> web.Response:
+        return await self._drain_action(request, drain=False)
+
+    async def _drain_action(self, request: web.Request,
+                            drain: bool) -> web.Response:
+        try:
+            body = await request.json()
+            replica = int(body["replica"])
+        except (json.JSONDecodeError, KeyError, TypeError, ValueError):
+            return web.json_response(
+                {"error": "body must be {\"replica\": <id>}"}, status=400)
+        ok = (self.fleet.drain(replica) if drain
+              else self.fleet.undrain(replica))
+        if not ok:
+            return web.json_response(
+                {"error": f"no replica {replica}"}, status=404)
+        return web.json_response({"ok": True, "replica": replica,
+                                  "action": "drain" if drain
+                                  else "undrain"})
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        try:
+            from prometheus_client import generate_latest
+            payload = generate_latest()
+        except Exception:
+            payload = b""
+        return web.Response(body=payload, content_type="text/plain")
+
+    def _build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_post("/v1/completions", self.handle_completions)
+        app.router.add_get("/v1/models", self.handle_models)
+        app.router.add_get("/v1/stats", self.handle_stats)
+        app.router.add_get("/health", self.handle_health)
+        app.router.add_get("/metrics", self.handle_metrics)
+        app.router.add_get("/fleet/status", self.handle_fleet_status)
+        app.router.add_post("/fleet/drain", self.handle_fleet_drain)
+        app.router.add_post("/fleet/undrain", self.handle_fleet_undrain)
+        return app
+
+    # -- lifecycle -----------------------------------------------------------
+
+    async def start_async(self) -> web.AppRunner:
+        self.fleet.start()
+        runner = web.AppRunner(self.app)
+        await runner.setup()
+        site = web.TCPSite(runner, self.serve_cfg.host, self.serve_cfg.port)
+        await site.start()
+        logger.info("fleet serving %s on %s:%d (%d replicas)",
+                    self.model_cfg.name, self.serve_cfg.host,
+                    self.serve_cfg.port, len(self.fleet.replicas))
+        return runner
+
+    def run_forever(self) -> None:
+        async def _main():
+            runner = await self.start_async()
+            try:
+                while True:
+                    await asyncio.sleep(3600)
+            finally:
+                await runner.cleanup()
+                self.fleet.shutdown()
+        asyncio.run(_main())

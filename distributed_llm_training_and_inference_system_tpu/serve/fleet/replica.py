@@ -1,0 +1,320 @@
+"""One fleet replica: an InferenceEngine on its own engine thread.
+
+Mirrors the single-server engine loop (serve/server.py ``_engine_loop``)
+with two fleet-specific differences:
+
+- **Crash = requeue, not fail.** The single server answers an engine-thread
+  exception with ``fail_all`` (waiters get HTTP 500). In a fleet the whole
+  point is that another replica can finish the work: the dying thread rips
+  every queued + resident request out of the scheduler (no page bookkeeping
+  — the engine is discarded and rebuilt on restart), resets them for
+  re-prefill, and stashes them as *orphans* for the supervisor to reroute.
+
+- **Drain runs ON the engine thread.** Engine device state (KV page arrays,
+  pipelined dispatch records) is touched outside ``engine.lock`` by the
+  stepping thread, so a foreign thread can never safely evict slots. A
+  drain request just sets a flag; the engine thread performs the eviction
+  itself at the next step boundary — after catching up the pipelined
+  dispatch — using the engine's own preemption path, so KV pages are
+  released (not leaked) and resident requests resume elsewhere from
+  prompt+generated exactly like a preemption resume (token-identical:
+  same assigned_seed, PRNG folded by position).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from ...config.schema import ModelConfig, ServeConfig
+from ..engine import InferenceEngine
+from ..scheduler import Request, RequestState
+from .faults import FaultInjector
+
+logger = logging.getLogger("llmctl.serve.fleet.replica")
+
+# replica lifecycle states
+STARTING = "starting"
+HEALTHY = "healthy"
+DRAINING = "draining"     # drain requested; engine thread not yet at boundary
+DRAINED = "drained"       # out of rotation, engine alive and empty
+CRASHED = "crashed"       # engine thread died; orphans await requeue
+STOPPED = "stopped"
+
+
+def reset_for_requeue(req: Request) -> None:
+    """Make a request admissible on another replica. Generated tokens and
+    ``assigned_seed`` are KEPT: the new replica re-prefills prompt+generated
+    (the engine's preemption-resume path) and continues the same per-position
+    PRNG stream, so greedy and seeded-sampled output is token-identical to
+    an undisturbed run. Replica-local state (slot, prefix hashes, swapped
+    pages — all tied to the old replica's KV pool) is dropped."""
+    req.state = RequestState.QUEUED
+    req.slot = None
+    req.error = None
+    req.finish_time = None
+    req.finish_reason = None
+    req.cancel_requested = False
+    req.prefix_hashes = None
+    req.swapped_kv = None
+
+
+class EngineReplica:
+    """An engine + its stepping thread + fleet bookkeeping."""
+
+    def __init__(self, replica_id: int, model_cfg: ModelConfig,
+                 serve_cfg: ServeConfig, params=None, seed: int = 0,
+                 injector: Optional[FaultInjector] = None,
+                 on_finish: Optional[Callable[[int, Request], None]] = None,
+                 eos_token_id: Optional[int] = None):
+        self.replica_id = replica_id
+        self.serve_cfg = serve_cfg
+        self.seed = seed
+        self.injector = injector
+        self.eos_token_id = eos_token_id
+        # fired with (replica_id, request) whenever a request leaves its
+        # slot terminally on this replica (finished/cancelled) — the
+        # router's completion hook. NOT fired on crash/drain extraction.
+        self.on_finish = on_finish
+        self._state_lock = threading.Lock()
+        self.state = STARTING
+        self.last_error: Optional[str] = None
+        self.restarts = 0          # maintained by the supervisor
+        self._drain_requested = threading.Event()
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._orphans: list[Request] = []
+        self.engine = InferenceEngine(model_cfg, serve_cfg, params=params,
+                                      seed=seed, eos_token_id=eos_token_id)
+        # the engine may refine model_cfg from an artifact; later restarts
+        # and sibling replicas must build from the EFFECTIVE config
+        self.model_cfg = self.engine.cfg
+        self.engine.on_finish = self._engine_finished
+        self.state = HEALTHY
+
+    # -- engine thread -------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name=f"llmctl-fleet-replica-{self.replica_id}")
+            self._thread.start()
+
+    def _loop(self) -> None:
+        logger.info("replica %d engine thread started", self.replica_id)
+        eng = self.engine
+        while not self._stop.is_set():
+            if self._drain_requested.is_set():
+                self._drain_on_thread()
+                self._drain_requested.clear()
+                continue
+            with eng.lock:
+                busy = (eng.scheduler.queue_depth > 0
+                        or eng.scheduler.active_count > 0)
+            if not busy:
+                self._wake.wait(timeout=0.02)
+                self._wake.clear()
+                continue
+            try:
+                if self.injector is not None:
+                    self.injector.before_step(self.replica_id)
+                    d = self.injector.step_delay_s(self.replica_id)
+                    if d > 0:
+                        time.sleep(d)
+                eng.step()
+            except Exception as e:
+                self._crash(e)
+                return                      # thread dies, like a process
+        logger.info("replica %d engine thread stopped", self.replica_id)
+
+    def _crash(self, exc: Exception) -> None:
+        """Engine-thread death: stash every in-flight request as an orphan
+        for the supervisor to reroute. No KV bookkeeping — this engine is
+        discarded; restart() builds a fresh one."""
+        logger.warning("replica %d crashed: %s", self.replica_id, exc)
+        with self._state_lock:
+            self.state = CRASHED
+            self.last_error = f"{type(exc).__name__}: {exc}"
+        self._orphans.extend(self._rip_out())
+
+    def _rip_out(self) -> list[Request]:
+        """Remove every queued + resident request from a dead (or stopping)
+        engine without touching its KV pool, reset each for requeue."""
+        eng = self.engine
+        with eng.lock:
+            victims = list(eng.scheduler.waiting)
+            eng.scheduler.waiting.clear()
+            for i, r in enumerate(eng.scheduler.slots):
+                if r is not None:
+                    victims.append(r)
+                    eng.scheduler.slots[i] = None
+            eng._partial_prefills.clear()
+            eng._pending = None
+        for r in victims:
+            reset_for_requeue(r)
+        return victims
+
+    def _drain_on_thread(self) -> None:
+        """Graceful eviction, executed BY the engine thread between steps:
+        catch up the pipelined dispatch, preempt every resident request
+        through the engine's own path (KV pages released, prefix pages
+        published), then empty the queue. Orphans resume on other replicas
+        from prompt+generated."""
+        eng = self.engine
+        try:
+            eng._drain_pending()
+            victims: list[Request] = []
+            with eng.lock:
+                # chunked prefills: drop progress, release the slot's pages
+                # manually (there is no preemption path for PREFILLING)
+                for rid in list(eng._partial_prefills):
+                    del eng._partial_prefills[rid]
+                for slot, r in enumerate(eng.scheduler.slots):
+                    if r is None:
+                        continue
+                    if r.state is RequestState.RUNNING:
+                        eng._preempt(slot)   # -> waiting head, pages freed
+                    else:                    # PREFILLING (chunked)
+                        eng._reserved_pages -= eng._reserved_by.pop(
+                            r.request_id, 0)
+                        pins = eng._prefix_pins.pop(r.request_id, None)
+                        if r.request_id in eng._req_slot:
+                            eng._req_slot.pop(r.request_id)
+                            eng.kv.release(slot)
+                        if pins:
+                            eng.kv.unpin_pages(pins)
+                        eng.active[slot] = False
+                        eng.positions[slot] = 0
+                        eng.stop_positions[slot] = 0
+                        eng.scheduler.slots[slot] = None
+                        r.slot = None
+                        eng.scheduler.waiting.appendleft(r)
+                victims = list(eng.scheduler.waiting)
+                eng.scheduler.waiting.clear()
+            for r in victims:
+                reset_for_requeue(r)
+            self._orphans.extend(victims)
+            with self._state_lock:
+                self.state = DRAINED
+            logger.info("replica %d drained (%d requests requeued)",
+                        self.replica_id, len(victims))
+        except Exception as e:           # drain hit a broken engine
+            self._crash(e)
+
+    def _engine_finished(self, req: Request) -> None:
+        if self.on_finish is not None:
+            self.on_finish(self.replica_id, req)
+
+    # -- fleet-facing API ----------------------------------------------------
+
+    def accepting(self) -> bool:
+        with self._state_lock:
+            return self.state == HEALTHY
+
+    def submit(self, req: Request) -> bool:
+        if not self.accepting():
+            return False
+        with self.engine.lock:
+            ok = self.engine.scheduler.add_request(req)
+        if ok:
+            self._wake.set()
+        return ok
+
+    def cancel(self, request_id: str) -> bool:
+        with self.engine.lock:
+            return self.engine.scheduler.cancel(request_id)
+
+    def queue_depth(self) -> int:
+        return self.engine.scheduler.queue_depth
+
+    def active_count(self) -> int:
+        return self.engine.scheduler.active_count
+
+    def outstanding_tokens(self) -> int:
+        """Routing load signal: tokens of work still owed — un-prefilled
+        context plus undecoded budget for queued requests, remaining decode
+        budget for resident ones. Read lock-free (a stale-by-one-step value
+        routes marginally unevenly, never incorrectly)."""
+        total = 0
+        for r in list(self.engine.scheduler.waiting):
+            total += len(r.context_tokens) + r.remaining_tokens
+        for r in list(self.engine.scheduler.slots):
+            if r is not None:
+                total += max(r.remaining_tokens, 0)
+        return total
+
+    def probe(self) -> dict:
+        """Health snapshot for the supervisor. Raises if the engine thread
+        is dead — a crashed replica must not look merely idle."""
+        with self._state_lock:
+            state = self.state
+        if state == CRASHED:
+            raise RuntimeError(self.last_error or "replica crashed")
+        return {
+            "replica": self.replica_id,
+            "state": state,
+            "queue_depth": self.queue_depth(),
+            "active": self.active_count(),
+            "outstanding_tokens": self.outstanding_tokens(),
+            "restarts": self.restarts,
+        }
+
+    def request_drain(self) -> None:
+        with self._state_lock:
+            if self.state not in (HEALTHY, DRAINING):
+                return
+            self.state = DRAINING
+        self._drain_requested.set()
+        self._wake.set()
+
+    def undrain(self) -> None:
+        with self._state_lock:
+            if self.state == DRAINED:
+                self.state = HEALTHY
+
+    def take_orphans(self) -> list[Request]:
+        """Hand the stashed crash/drain victims to the caller (supervisor)."""
+        out, self._orphans = self._orphans, []
+        return out
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=timeout)
+        self._thread = None
+        with self._state_lock:
+            if self.state != CRASHED:
+                self.state = STOPPED
+
+    def teardown(self) -> list[Request]:
+        """Stop the thread and extract whatever was still in flight (used
+        when a replica is declared dead by probes: the engine may be fine,
+        but the fleet has already decided to rebuild it)."""
+        self.stop()
+        orphans = self.take_orphans() + self._rip_out()
+        try:
+            self.engine.release()
+        except Exception:
+            logger.exception("replica %d engine release failed",
+                             self.replica_id)
+        return orphans
+
+    def restart(self, params=None) -> None:
+        """Build a fresh engine (fresh KV pool, fresh compiled programs) and
+        resume stepping. Caller (supervisor) owns backoff/limits."""
+        self.engine = InferenceEngine(
+            self.model_cfg, self.serve_cfg, params=params, seed=self.seed,
+            eos_token_id=self.eos_token_id)
+        self.engine.on_finish = self._engine_finished
+        with self._state_lock:
+            self.state = HEALTHY
+            self.last_error = None
+        self.restarts += 1
+        self._drain_requested.clear()
+        self.start()

@@ -1,0 +1,342 @@
+"""Fleet router: request-level placement across engine replicas.
+
+Two-signal routing, Llumnix-style (PAPERS.md):
+
+- **Prefix affinity.** The first ``affinity_prefix_tokens`` of the prompt
+  are digested (sha1 — Python's ``hash`` is per-process salted and would
+  break cross-run determinism) and looked up on a consistent-hash ring
+  with ``affinity_vnodes`` points per replica. Prompts sharing a prefix
+  land on the same replica, so its prefix cache (serve/kv_cache.py) serves
+  the shared pages instead of every replica re-prefilling them. Consistent
+  hashing keeps the mapping stable when a replica leaves: only its own
+  arc reassigns, the other replicas' hot prefixes stay put.
+
+- **Least outstanding tokens.** When affinity is off, the owner is down or
+  draining, or the owner's queue runs ``affinity_max_imbalance`` deeper
+  than the least-loaded replica's (a hot prefix must not melt one replica
+  while others idle), the request goes to the replica owing the fewest
+  tokens of work (queued context + undecoded budget) — a closer proxy for
+  time-to-service than request counts, since requests differ by orders of
+  magnitude in prompt and generation length.
+
+Admission is fleet-scoped: beyond ``max_pending`` queued-but-not-resident
+requests the router rejects with :class:`FleetSaturated` (HTTP 429 +
+Retry-After upstream) instead of growing unbounded tail latency.
+
+Every accepted request is accounted terminally: completed, failed (requeue
+budget exhausted / parked overflow), or still in flight — ``stats()``
+exposes the ledger and tests assert nothing is silently dropped.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import threading
+import time
+import uuid
+from bisect import bisect_right
+from typing import Callable, Iterable, Optional, Sequence
+
+from ...config.schema import FleetConfig
+from ..scheduler import Request, RequestState, SamplingParams
+from .replica import reset_for_requeue
+
+logger = logging.getLogger("llmctl.serve.fleet.router")
+
+
+class FleetSaturated(RuntimeError):
+    """Every replica is saturated (or none is healthy): the client should
+    back off ``retry_after_s`` seconds (HTTP 429 + Retry-After)."""
+
+    def __init__(self, message: str, retry_after_s: float):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+def _hash_point(data: bytes) -> int:
+    return int.from_bytes(hashlib.sha1(data).digest()[:8], "big")
+
+
+def prefix_digest(prompt_tokens: Sequence[int], k: int) -> int:
+    """Stable digest of the first ``k`` prompt tokens — the affinity key."""
+    head = ",".join(str(int(t)) for t in prompt_tokens[:k])
+    return _hash_point(head.encode())
+
+
+class FleetRouter:
+    def __init__(self, replicas: Iterable, cfg: Optional[FleetConfig] = None,
+                 observer: Optional[Callable[[str, dict], None]] = None):
+        self.cfg = cfg or FleetConfig()
+        self.replicas = list(replicas)
+        self.by_id = {r.replica_id: r for r in self.replicas}
+        self.observer = observer or (lambda event, payload: None)
+        # _lock guards router bookkeeping ONLY. It is never held across a
+        # replica.submit() call: submit takes the engine lock, and the
+        # engine thread calls back into on_request_exit under that same
+        # lock — holding _lock across both directions would be an ABBA
+        # deadlock between the HTTP thread and the engine thread.
+        self._lock = threading.Lock()
+        self._ring: list[tuple[int, int]] = []      # (point, replica_id)
+        for r in self.replicas:
+            for v in range(self.cfg.affinity_vnodes):
+                self._ring.append((
+                    _hash_point(f"replica-{r.replica_id}:{v}".encode()),
+                    r.replica_id))
+        self._ring.sort()
+        self._waiters: dict[str, Callable[[Request], None]] = {}
+        self._meta: dict[str, dict] = {}            # rid -> ledger entry
+        self._parked: list[Request] = []            # requeues awaiting a
+        #                                             healthy replica
+        self.total_submitted = 0
+        self.total_completed = 0
+        self.total_failed = 0
+        self.total_rejected = 0
+        self.total_requeues = 0
+        self.total_affinity_hits = 0
+        self.completed_per_replica: dict[int, int] = {
+            r.replica_id: 0 for r in self.replicas}
+        self.routed_per_replica: dict[int, int] = {
+            r.replica_id: 0 for r in self.replicas}
+        self.requeues_per_replica: dict[int, int] = {
+            r.replica_id: 0 for r in self.replicas}
+
+    # -- placement -----------------------------------------------------------
+
+    def _ring_owner(self, digest: int,
+                    accepting_ids: set) -> Optional[int]:
+        """First accepting replica at/after the digest's ring point
+        (wrapping) — consistent hashing's 'walk to the next node'."""
+        if not self._ring or not accepting_ids:
+            return None
+        i = bisect_right(self._ring, (digest, -1))
+        for k in range(len(self._ring)):
+            point, rid = self._ring[(i + k) % len(self._ring)]
+            if rid in accepting_ids:
+                return rid
+        return None
+
+    def _candidates(self, prompt_tokens: Sequence[int],
+                    exclude: frozenset = frozenset()
+                    ) -> tuple[list, bool]:
+        """(replicas to try in order, affinity_applied): affinity owner
+        first when within the imbalance bound, then by least outstanding
+        tokens. ``affinity_applied`` is True only when the ring owner was
+        actually promoted — the affinity-hit stat must not count plain
+        least-loaded placements that happened to coincide."""
+        accepting = [r for r in self.replicas
+                     if r.replica_id not in exclude and r.accepting()]
+        if not accepting:
+            return [], False
+        load = {r.replica_id: r.outstanding_tokens() for r in accepting}
+        depth = {r.replica_id: r.queue_depth() for r in accepting}
+        ordered = sorted(accepting,
+                         key=lambda r: (load[r.replica_id], r.replica_id))
+        if self.cfg.affinity_prefix_tokens > 0 and len(accepting) > 1:
+            owner = self._ring_owner(
+                prefix_digest(prompt_tokens,
+                              self.cfg.affinity_prefix_tokens),
+                {r.replica_id for r in accepting})
+            if owner is not None and depth[owner] <= (
+                    min(depth.values()) + self.cfg.affinity_max_imbalance):
+                ordered.sort(key=lambda r: r.replica_id != owner)
+                return ordered, True
+        return ordered, False
+
+    def pending_total(self) -> int:
+        """Queued-but-not-resident requests fleet-wide (admission bound)."""
+        return (sum(r.queue_depth() for r in self.replicas)
+                + len(self._parked))
+
+    # -- submission ----------------------------------------------------------
+
+    def submit(self, prompt_tokens: Sequence[int],
+               sampling: Optional[SamplingParams] = None,
+               request_id: Optional[str] = None,
+               on_complete: Optional[Callable[[Request], None]] = None,
+               ) -> Request:
+        """Admit one request into the fleet. Returns the (QUEUED) Request;
+        raises FleetSaturated on backpressure. ``on_complete`` fires (from
+        an engine thread) when the request reaches a terminal state, however
+        many replicas it crossed on the way."""
+        req = Request(
+            request_id=request_id or f"fleet-{uuid.uuid4().hex[:24]}",
+            prompt_tokens=list(prompt_tokens),
+            sampling=sampling or SamplingParams())
+        if self.pending_total() >= self.cfg.max_pending:
+            with self._lock:
+                self.total_rejected += 1
+            raise FleetSaturated(
+                f"fleet saturated: {self.pending_total()} pending >= "
+                f"max_pending {self.cfg.max_pending}",
+                self.cfg.retry_after_s)
+        cands, affinity_first = self._candidates(req.prompt_tokens)
+        with self._lock:
+            self._meta[req.request_id] = {"requeues": 0, "replica": None}
+            if on_complete is not None:
+                self._waiters[req.request_id] = on_complete
+        for i, r in enumerate(cands):
+            if r.submit(req):
+                with self._lock:
+                    self.total_submitted += 1
+                    self.routed_per_replica[r.replica_id] = (
+                        self.routed_per_replica.get(r.replica_id, 0) + 1)
+                    self._meta[req.request_id]["replica"] = r.replica_id
+                    if affinity_first and i == 0:
+                        self.total_affinity_hits += 1
+                return req
+        # nobody accepted: either zero healthy replicas or every queue full
+        with self._lock:
+            self._meta.pop(req.request_id, None)
+            self._waiters.pop(req.request_id, None)
+            self.total_rejected += 1
+        if req.error:      # per-replica validation rejected it (too long)
+            raise ValueError(req.error)
+        raise FleetSaturated(
+            "fleet saturated: no replica accepted the request",
+            self.cfg.retry_after_s)
+
+    # -- completion / requeue ------------------------------------------------
+
+    def on_request_exit(self, replica_id: int, req: Request) -> None:
+        """Per-replica engine ``on_finish`` hook (fires on the engine
+        thread, possibly under that engine's lock — must not call back
+        into any engine)."""
+        with self._lock:
+            meta = self._meta.pop(req.request_id, None)
+            waiter = self._waiters.pop(req.request_id, None)
+            if meta is not None:
+                if req.state is RequestState.FAILED:
+                    self.total_failed += 1
+                else:
+                    self.total_completed += 1
+                    self.completed_per_replica[replica_id] = (
+                        self.completed_per_replica.get(replica_id, 0) + 1)
+                final_meta = {**meta, "replica": replica_id}
+        if meta is not None:
+            req.fleet_meta = final_meta      # per-replica loadgen breakdown
+        if waiter is not None:
+            waiter(req)
+
+    def _fail(self, req: Request, error: str) -> None:
+        req.state = RequestState.FAILED
+        req.error = error
+        req.finish_time = time.monotonic()
+        req.finish_reason = "error"
+        with self._lock:
+            self.total_failed += 1
+            meta = self._meta.pop(req.request_id, None)
+            waiter = self._waiters.pop(req.request_id, None)
+        if meta is not None:
+            req.fleet_meta = meta
+        if waiter is not None:
+            waiter(req)
+
+    def requeue(self, reqs: Sequence[Request], from_replica: int) -> int:
+        """Re-place requests extracted from a crashed/drained replica.
+        Requests over their requeue budget fail loudly; ones that no healthy
+        replica can take are parked until ``flush_parked``. Returns how many
+        were placed immediately."""
+        placed = 0
+        for req in reqs:
+            with self._lock:
+                meta = self._meta.get(req.request_id)
+                if meta is None:      # completed/cancelled concurrently
+                    continue
+                meta["requeues"] += 1
+                n = meta["requeues"]
+                self.total_requeues += 1
+                self.requeues_per_replica[from_replica] = (
+                    self.requeues_per_replica.get(from_replica, 0) + 1)
+            if n > self.cfg.max_requeues:
+                self._fail(req, f"requeued {n} times (max_requeues="
+                                f"{self.cfg.max_requeues})")
+                continue
+            reset_for_requeue(req)
+            if self._place(req, exclude=frozenset({from_replica})):
+                placed += 1
+            elif self._place(req):    # lone-replica fleet: same one is fine
+                placed += 1
+            else:
+                with self._lock:
+                    overflow = (len(self._parked)
+                                >= self.cfg.max_pending)
+                    if not overflow:
+                        self._parked.append(req)
+                if overflow:
+                    self._fail(req, "no healthy replica and the requeue "
+                                    "buffer is full")
+        self.observer("fleet_requeue", {"from_replica": from_replica,
+                                        "count": len(reqs)})
+        return placed
+
+    def _place(self, req: Request, exclude: frozenset = frozenset()) -> bool:
+        cands, _ = self._candidates(req.prompt_tokens, exclude=exclude)
+        for r in cands:
+            if r.submit(req):
+                with self._lock:
+                    self.routed_per_replica[r.replica_id] = (
+                        self.routed_per_replica.get(r.replica_id, 0) + 1)
+                    meta = self._meta.get(req.request_id)
+                    if meta is not None:
+                        meta["replica"] = r.replica_id
+                return True
+        return False
+
+    def flush_parked(self) -> int:
+        """Retry parked requeues (called by the supervisor after a replica
+        returns to rotation). Returns how many found a home."""
+        with self._lock:
+            parked, self._parked = self._parked, []
+        placed = 0
+        still_parked = []
+        for req in parked:
+            if self._place(req):
+                placed += 1
+            else:
+                still_parked.append(req)
+        if still_parked:
+            with self._lock:
+                self._parked = still_parked + self._parked
+        return placed
+
+    def cancel(self, request_id: str) -> bool:
+        """Client-timeout path: cancel wherever the request currently is
+        (its meta records the last placement; a requeue between the read
+        and the call falls through to the all-replicas sweep)."""
+        with self._lock:
+            meta = self._meta.get(request_id)
+            last = meta.get("replica") if meta else None
+        ordered = ([self.by_id[last]] if last in self.by_id else []) + [
+            r for r in self.replicas if r.replica_id != last]
+        for r in ordered:
+            if getattr(r, "cancel", None) is not None \
+                    and r.cancel(request_id):
+                return True
+        with self._lock:     # parked: cancel locally
+            for i, req in enumerate(self._parked):
+                if req.request_id == request_id:
+                    self._parked.pop(i)
+                    self._meta.pop(request_id, None)
+                    self._waiters.pop(request_id, None)
+                    return True
+        return False
+
+    # -- introspection -------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            in_flight = len(self._meta)
+            return {
+                "submitted": self.total_submitted,
+                "completed": self.total_completed,
+                "failed": self.total_failed,
+                "rejected": self.total_rejected,
+                "requeues": self.total_requeues,
+                "affinity_hits": self.total_affinity_hits,
+                "parked": len(self._parked),
+                "in_flight": in_flight,
+                "completed_per_replica": dict(self.completed_per_replica),
+                "routed_per_replica": dict(self.routed_per_replica),
+                "requeues_per_replica": dict(self.requeues_per_replica),
+            }

@@ -1,0 +1,207 @@
+"""Replica supervisor: health probes, restart-with-backoff, drain.
+
+The supervisor is the fleet's failure detector and janitor. Each poll it:
+
+1. collects orphans — requests a crashed or drained replica extracted —
+   and hands them to the router for re-placement on surviving replicas;
+2. probes healthy replicas (queue depth + liveness; the fault injector can
+   make a probe time out to model a hung/partitioned replica). After
+   ``probe_failures`` consecutive misses the replica is torn down exactly
+   like a crash: thread stopped, in-flight work requeued, engine rebuilt;
+3. restarts dead replicas under exponential backoff (base doubles per
+   consecutive restart, capped), then flushes any parked requeues at them.
+
+Everything runs on one supervisor thread (or, in tests and the dryrun
+regime, via explicit ``poll_once`` calls — no background thread, fully
+deterministic scheduling), so per-replica state needs no locking beyond
+what the replicas themselves provide.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from ...config.schema import FleetConfig
+from . import replica as replica_mod
+from .faults import FaultInjector
+from .replica import EngineReplica
+from .router import FleetRouter
+
+logger = logging.getLogger("llmctl.serve.fleet.supervisor")
+
+
+class ReplicaSupervisor:
+    def __init__(self, replicas: list[EngineReplica], router: FleetRouter,
+                 cfg: Optional[FleetConfig] = None,
+                 injector: Optional[FaultInjector] = None,
+                 params=None,
+                 observer: Optional[Callable[[str, dict], None]] = None):
+        self.cfg = cfg or FleetConfig()
+        self.replicas = replicas
+        self.router = router
+        self.injector = injector
+        self.params = params          # shared weights for engine rebuilds
+        self.observer = observer or (lambda event, payload: None)
+        self._misses: dict[int, int] = {r.replica_id: 0 for r in replicas}
+        self._next_restart: dict[int, float] = {}
+        self._backoff: dict[int, float] = {}
+        self.total_restarts = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- one supervision pass ------------------------------------------------
+
+    def poll_once(self, now: Optional[float] = None) -> dict:
+        """One probe/requeue/restart pass; returns the fleet snapshot it
+        acted on. Deterministic: tests drive this directly."""
+        now = time.monotonic() if now is None else now
+        recovered = False
+        for r in self.replicas:
+            state = r.state
+            if state in (replica_mod.CRASHED, replica_mod.STOPPED):
+                self._requeue_orphans(r)
+                recovered |= self._maybe_restart(r, now)
+            elif state == replica_mod.DRAINED:
+                self._requeue_orphans(r)   # drain victims move elsewhere
+            elif state == replica_mod.HEALTHY:
+                self._probe(r)
+        if recovered:
+            self.router.flush_parked()
+        snap = self.snapshot()
+        self.observer("fleet", snap)
+        return snap
+
+    def _requeue_orphans(self, r: EngineReplica) -> None:
+        orphans = r.take_orphans()
+        if orphans:
+            logger.info("requeuing %d orphans from replica %d",
+                        len(orphans), r.replica_id)
+            self.router.requeue(orphans, from_replica=r.replica_id)
+
+    def _probe(self, r: EngineReplica) -> None:
+        try:
+            if self.injector is not None:
+                self.injector.on_probe(r.replica_id)
+            r.probe()
+        except Exception as e:
+            self._misses[r.replica_id] = self._misses.get(
+                r.replica_id, 0) + 1
+            logger.warning("probe miss %d/%d on replica %d: %s",
+                           self._misses[r.replica_id],
+                           self.cfg.probe_failures, r.replica_id, e)
+            if self._misses[r.replica_id] >= self.cfg.probe_failures:
+                # declared dead: tear down like a crash — requests move,
+                # the engine rebuilds under backoff
+                logger.warning("replica %d declared dead after %d probe "
+                               "misses", r.replica_id,
+                               self._misses[r.replica_id])
+                orphans = r.teardown()
+                if orphans:
+                    self.router.requeue(orphans,
+                                        from_replica=r.replica_id)
+                self._schedule_restart(r, time.monotonic())
+            return
+        self._misses[r.replica_id] = 0
+
+    def _schedule_restart(self, r: EngineReplica, now: float) -> None:
+        if r.replica_id not in self._next_restart:
+            backoff = self._backoff.get(r.replica_id,
+                                        self.cfg.restart_backoff_s)
+            self._next_restart[r.replica_id] = now + backoff
+            # exponential: the NEXT consecutive failure waits twice as long
+            self._backoff[r.replica_id] = min(
+                max(backoff, 1e-3) * 2, self.cfg.restart_backoff_max_s)
+
+    def _maybe_restart(self, r: EngineReplica, now: float) -> bool:
+        if self.cfg.max_restarts and r.restarts >= self.cfg.max_restarts:
+            return False               # permanently failed; stays dead
+        self._schedule_restart(r, now)
+        if now < self._next_restart[r.replica_id]:
+            return False
+        try:
+            r.stop()                    # idempotent; joins a dead thread
+            r.restart(params=self.params)
+            self.total_restarts += 1
+            self._misses[r.replica_id] = 0
+            del self._next_restart[r.replica_id]
+            logger.info("replica %d restarted (restart #%d, next backoff "
+                        "%.2fs)", r.replica_id, r.restarts,
+                        self._backoff[r.replica_id])
+            return True
+        except Exception:
+            logger.exception("replica %d restart failed", r.replica_id)
+            # keep CRASHED; back off again before the next attempt
+            del self._next_restart[r.replica_id]
+            self._schedule_restart(r, time.monotonic())
+            return False
+
+    def current_backoff_s(self, replica_id: int) -> float:
+        """The delay the NEXT restart of this replica will wait (test +
+        status surface for the exponential schedule)."""
+        return self._backoff.get(replica_id, self.cfg.restart_backoff_s)
+
+    # -- operator actions ----------------------------------------------------
+
+    def drain(self, replica_id: int) -> bool:
+        r = next((x for x in self.replicas if x.replica_id == replica_id),
+                 None)
+        if r is None:
+            return False
+        r.request_drain()
+        return True
+
+    def undrain(self, replica_id: int) -> bool:
+        r = next((x for x in self.replicas if x.replica_id == replica_id),
+                 None)
+        if r is None:
+            return False
+        r.undrain()
+        self.router.flush_parked()
+        return True
+
+    # -- background loop -----------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.cfg.probe_interval_s):
+                try:
+                    self.poll_once()
+                except Exception:
+                    logger.exception("supervisor poll failed")
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="llmctl-fleet-supervisor")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    # -- introspection -------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Fleet-wide status: per-replica health + router ledger. Feeds
+        /fleet/status, `llmctl fleet status`, and the Prometheus pump."""
+        reps = []
+        for r in self.replicas:
+            reps.append({
+                "replica": r.replica_id,
+                "state": r.state,
+                "queue_depth": r.queue_depth(),
+                "active": r.active_count(),
+                "outstanding_tokens": r.outstanding_tokens(),
+                "restarts": r.restarts,
+                "probe_misses": self._misses.get(r.replica_id, 0),
+                "last_error": r.last_error,
+            })
+        return {"replicas": reps, "router": self.router.stats(),
+                "restarts": self.total_restarts}

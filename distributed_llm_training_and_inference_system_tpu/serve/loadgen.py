@@ -54,6 +54,13 @@ class LoadResult:
     queue_peak: int = 0
     goodput_tokens_per_s: float = 0.0
     decode_ms_per_token_device: Optional[float] = None
+    # fleet targets only: 429-rejected submissions, cross-replica requeues,
+    # and the per-replica breakdown {rid: {requests, p50/p99_ttft_ms,
+    # requeues}} — the numbers that show whether routing spread the load
+    # and what the crash/drain paths cost
+    rejected: int = 0
+    requeues: int = 0
+    per_replica: dict = field(default_factory=dict)
 
     def percentile(self, xs, q):
         return float(np.percentile(np.asarray(xs), q)) if xs else float("nan")
@@ -82,6 +89,9 @@ class LoadResult:
                 "decode_ms_per_token_device":
                 round(self.decode_ms_per_token_device, 3)}
                if self.ttft_device_ms else {}),
+            **({"rejected": self.rejected, "requeues": self.requeues,
+                "per_replica": self.per_replica}
+               if self.per_replica else {}),
         }
 
 
@@ -126,6 +136,142 @@ def attach_device_times(res: LoadResult, reqs: list,
     return res
 
 
+def _is_fleet(target) -> bool:
+    """Fleet targets (serve/fleet ServeFleet) quack with a .router; plain
+    engines are stepped inline by the generator."""
+    return hasattr(target, "router")
+
+
+def _finalize_fleet(res: LoadResult, reqs: list, fleet,
+                    t0: float) -> LoadResult:
+    """Fleet-side accounting: aggregate latencies like _finalize, then the
+    per-replica breakdown (requests, p50/p99 TTFT, requeues) from each
+    request's routing metadata + the router ledger."""
+    res.duration_s = time.monotonic() - t0
+    done_tokens = 0
+    by_replica: dict[int, dict] = {}
+    for r in reqs:
+        if r.state is RequestState.FINISHED:
+            res.completed += 1
+            done_tokens += len(r.generated_tokens)
+            if r.ttft_ms is not None:
+                res.ttft_ms.append(r.ttft_ms)
+            if len(r.generated_tokens) > 1 and r.finish_time is not None \
+                    and r.first_token_time is not None:
+                res.tpot_ms.append(
+                    (r.finish_time - r.first_token_time) * 1000.0
+                    / (len(r.generated_tokens) - 1))
+            meta = getattr(r, "fleet_meta", None) or {}
+            rid = meta.get("replica")
+            if rid is not None:
+                slot = by_replica.setdefault(
+                    rid, {"requests": 0, "ttfts": []})
+                slot["requests"] += 1
+                if r.ttft_ms is not None:
+                    slot["ttfts"].append(r.ttft_ms)
+        elif r.state in (RequestState.FAILED, RequestState.CANCELLED):
+            res.failed += 1
+    stats = fleet.router.stats()
+    res.requeues = stats["requeues"]
+    res.preemptions = sum(rep.engine.total_preemptions
+                          for rep in fleet.replicas)
+    res.goodput_tokens_per_s = done_tokens / max(res.duration_s, 1e-9)
+    def pct(xs, q):
+        # None, not NaN: summaries are JSON-serialized and NaN is not a
+        # standard JSON token (same rule as offered_rps above)
+        return round(res.percentile(xs, q), 1) if xs else None
+
+    for rid, slot in sorted(by_replica.items()):
+        res.per_replica[rid] = {
+            "requests": slot["requests"],
+            "p50_ttft_ms": pct(slot["ttfts"], 50),
+            "p99_ttft_ms": pct(slot["ttfts"], 99),
+            "requeues": stats["requeues_per_replica"].get(rid, 0),
+        }
+    # replicas that served nothing still appear (an operator reading the
+    # breakdown must see the idle replica, not infer it from absence)
+    for rep in fleet.replicas:
+        res.per_replica.setdefault(rep.replica_id, {
+            "requests": 0, "p50_ttft_ms": None, "p99_ttft_ms": None,
+            "requeues": stats["requeues_per_replica"].get(
+                rep.replica_id, 0)})
+    return res
+
+
+def _submit_fleet(fleet, prompt, max_tokens, reqs, events, res):
+    """One fleet submission; 429-style rejections are counted, not raised."""
+    import threading
+
+    from .fleet.router import FleetSaturated
+    ev = threading.Event()
+    try:
+        reqs.append(fleet.submit(
+            prompt,
+            SamplingParams(temperature=0.0, max_tokens=max_tokens),
+            on_complete=lambda _r, ev=ev: ev.set()))
+        events.append(ev)
+    except FleetSaturated:
+        res.rejected += 1
+        res.failed += 1
+
+
+def _run_poisson_fleet(fleet, *, offered_rps, num_requests, prompt_len,
+                       max_tokens, seed, vocab_hi, prompt_pool) -> LoadResult:
+    """Open-loop arrivals against a fleet router: replica threads do the
+    stepping; the generator only submits on schedule and waits. The
+    supervisor is polled inline when no background supervisor runs, so
+    injected faults recover deterministically inside the measured window."""
+    rng = np.random.default_rng(seed)
+    hi = vocab_hi or fleet.model_cfg.vocab_size
+    gaps = rng.exponential(1.0 / offered_rps, size=num_requests)
+    arrivals = np.cumsum(gaps)
+    pool = [rng.integers(1, hi, size=prompt_len).tolist()
+            for _ in range(max(prompt_pool, 1))]
+    reqs: list[Request] = []
+    events: list = []
+    res = LoadResult(offered_rps=offered_rps)
+    supervised = fleet.supervisor._thread is not None
+    t0 = time.monotonic()
+    i = 0
+    while i < num_requests or not all(e.is_set() for e in events):
+        now = time.monotonic() - t0
+        while i < num_requests and arrivals[i] <= now:
+            prompt = (pool[int(rng.integers(len(pool)))] if prompt_pool
+                      else rng.integers(1, hi, size=prompt_len).tolist())
+            _submit_fleet(fleet, prompt, max_tokens, reqs, events, res)
+            i += 1
+        res.queue_peak = max(res.queue_peak, fleet.router.pending_total())
+        if not supervised:
+            fleet.supervisor.poll_once()
+        time.sleep(0.005)
+    return _finalize_fleet(res, reqs, fleet, t0)
+
+
+def _run_closed_loop_fleet(fleet, *, concurrency, num_requests, prompt_len,
+                           max_tokens, seed, vocab_hi) -> LoadResult:
+    rng = np.random.default_rng(seed)
+    hi = vocab_hi or fleet.model_cfg.vocab_size
+    reqs: list[Request] = []
+    events: list = []
+    res = LoadResult(offered_rps=float("inf"))
+    supervised = fleet.supervisor._thread is not None
+    submitted = 0
+    t0 = time.monotonic()
+    while submitted < num_requests or not all(e.is_set() for e in events):
+        in_flight = sum(1 for e in events if not e.is_set())
+        while submitted < num_requests and in_flight < concurrency:
+            _submit_fleet(fleet,
+                          rng.integers(1, hi, size=prompt_len).tolist(),
+                          max_tokens, reqs, events, res)
+            submitted += 1
+            in_flight += 1
+        res.queue_peak = max(res.queue_peak, fleet.router.pending_total())
+        if not supervised:
+            fleet.supervisor.poll_once()
+        time.sleep(0.005)
+    return _finalize_fleet(res, reqs, fleet, t0)
+
+
 def run_poisson(engine: InferenceEngine, *, offered_rps: float,
                 num_requests: int, prompt_len: int, max_tokens: int,
                 seed: int = 0, vocab_hi: Optional[int] = None,
@@ -134,8 +280,17 @@ def run_poisson(engine: InferenceEngine, *, offered_rps: float,
     """Open-loop run: arrivals follow a seeded Poisson process regardless of
     engine progress; steps until everything admitted drains.
 
+    ``engine`` may also be a fleet (serve.fleet.ServeFleet): submissions go
+    through the router, replica threads do the stepping, and the result
+    carries the per-replica breakdown (+429 rejections count as failed).
+
     ``prompt_pool > 0`` draws prompts from that many distinct prompts
     (prefix-cache-friendly workloads); 0 = every prompt unique."""
+    if _is_fleet(engine):
+        return _run_poisson_fleet(
+            engine, offered_rps=offered_rps, num_requests=num_requests,
+            prompt_len=prompt_len, max_tokens=max_tokens, seed=seed,
+            vocab_hi=vocab_hi, prompt_pool=prompt_pool)
     rng = np.random.default_rng(seed)
     hi = vocab_hi or engine.cfg.vocab_size
     gaps = rng.exponential(1.0 / offered_rps, size=num_requests)
@@ -178,7 +333,13 @@ def run_closed_loop(engine: InferenceEngine, *, concurrency: int,
                     seed: int = 0, vocab_hi: Optional[int] = None,
                     device_times: bool = False) -> LoadResult:
     """Closed-loop run: keep ``concurrency`` requests in flight (a new one
-    arrives the moment one finishes) — the standard saturation probe."""
+    arrives the moment one finishes) — the standard saturation probe.
+    Fleet targets route through the router like run_poisson."""
+    if _is_fleet(engine):
+        return _run_closed_loop_fleet(
+            engine, concurrency=concurrency, num_requests=num_requests,
+            prompt_len=prompt_len, max_tokens=max_tokens, seed=seed,
+            vocab_hi=vocab_hi)
     rng = np.random.default_rng(seed)
     hi = vocab_hi or engine.cfg.vocab_size
     reqs: list[Request] = []

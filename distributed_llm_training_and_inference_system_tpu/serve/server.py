@@ -34,6 +34,56 @@ from .tokenizer import load_tokenizer
 logger = logging.getLogger("llmctl.serve.server")
 
 
+class BadRequest(ValueError):
+    """Completion-body validation failure -> HTTP 400 upstream."""
+
+
+def parse_completion_body(body: dict, tokenizer, vocab_size: int
+                          ) -> tuple[list, SamplingParams, bool]:
+    """Validate an OpenAI-style /v1/completions body into
+    (prompt_tokens, sampling, stream). Shared by the single-server and
+    fleet HTTP fronts so the two cannot drift on what they accept.
+    Raises BadRequest with a client-facing message."""
+    prompt = body.get("prompt", "")
+    if isinstance(prompt, list):           # OpenAI also accepts token ids
+        # strict: int(t) would silently truncate floats / coerce bools,
+        # generating from a different prompt than the client sent
+        if any(isinstance(t, bool) or not isinstance(t, int)
+               for t in prompt):
+            raise BadRequest("prompt token ids must be integers")
+        prompt_tokens = list(prompt)
+        bad = [t for t in prompt_tokens if not 0 <= t < vocab_size]
+        if bad:
+            # OOB ids would clamp silently in the embedding gather and
+            # produce wrong completions — reject instead
+            raise BadRequest(f"prompt token id {bad[0]} outside "
+                             f"[0, {vocab_size})")
+    else:
+        prompt_tokens = tokenizer.encode(str(prompt))
+    if not prompt_tokens:
+        raise BadRequest("empty prompt")
+
+    seed = body.get("seed")
+    if seed is not None and (isinstance(seed, bool)
+                             or not isinstance(seed, int)):
+        # an unvalidated seed would raise inside the engine thread
+        raise BadRequest(f"seed must be an integer, got {seed!r}")
+    try:
+        sampling = SamplingParams(
+            temperature=float(body.get("temperature", 1.0)),
+            top_k=int(body.get("top_k", 0)),
+            top_p=float(body.get("top_p", 1.0)),
+            max_tokens=int(body.get("max_tokens", 64)),
+            seed=seed,
+        )
+    except (TypeError, ValueError) as e:
+        raise BadRequest(f"invalid sampling parameter: {e}") from None
+    if sampling.max_tokens < 1:
+        raise BadRequest(
+            f"max_tokens must be >= 1, got {sampling.max_tokens}")
+    return prompt_tokens, sampling, bool(body.get("stream", False))
+
+
 class InferenceServer:
     def __init__(self, model_cfg: ModelConfig, serve_cfg: ServeConfig,
                  params=None, observer=None):
@@ -150,51 +200,11 @@ class InferenceServer:
         except json.JSONDecodeError:
             return web.json_response({"error": "invalid JSON"}, status=400)
 
-        prompt = body.get("prompt", "")
-        if isinstance(prompt, list):           # OpenAI also accepts token ids
-            # strict: int(t) would silently truncate floats / coerce bools,
-            # generating from a different prompt than the client sent
-            if any(isinstance(t, bool) or not isinstance(t, int)
-                   for t in prompt):
-                return web.json_response(
-                    {"error": "prompt token ids must be integers"}, status=400)
-            prompt_tokens = list(prompt)
-            bad = [t for t in prompt_tokens
-                   if not 0 <= t < self.model_cfg.vocab_size]
-            if bad:
-                # OOB ids would clamp silently in the embedding gather and
-                # produce wrong completions — reject instead
-                return web.json_response(
-                    {"error": f"prompt token id {bad[0]} outside "
-                              f"[0, {self.model_cfg.vocab_size})"}, status=400)
-        else:
-            prompt_tokens = self.tokenizer.encode(str(prompt))
-        if not prompt_tokens:
-            return web.json_response({"error": "empty prompt"}, status=400)
-
-        seed = body.get("seed")
-        if seed is not None and (isinstance(seed, bool)
-                                 or not isinstance(seed, int)):
-            # an unvalidated seed would raise inside the engine thread
-            return web.json_response(
-                {"error": f"seed must be an integer, got {seed!r}"},
-                status=400)
         try:
-            sampling = SamplingParams(
-                temperature=float(body.get("temperature", 1.0)),
-                top_k=int(body.get("top_k", 0)),
-                top_p=float(body.get("top_p", 1.0)),
-                max_tokens=int(body.get("max_tokens", 64)),
-                seed=seed,
-            )
-        except (TypeError, ValueError) as e:
-            return web.json_response(
-                {"error": f"invalid sampling parameter: {e}"}, status=400)
-        if sampling.max_tokens < 1:
-            return web.json_response(
-                {"error": f"max_tokens must be >= 1, got "
-                          f"{sampling.max_tokens}"}, status=400)
-        stream = bool(body.get("stream", False))
+            prompt_tokens, sampling, stream = parse_completion_body(
+                body, self.tokenizer, self.model_cfg.vocab_size)
+        except BadRequest as e:
+            return web.json_response({"error": str(e)}, status=400)
         req = Request(request_id=f"cmpl-{uuid.uuid4().hex[:24]}",
                       prompt_tokens=prompt_tokens, sampling=sampling)
         loop = asyncio.get_running_loop()
@@ -463,5 +473,19 @@ class InferenceServer:
 
 def create_inference_server(model_cfg: ModelConfig, serve_cfg: ServeConfig,
                             params=None, observer=None) -> InferenceServer:
+    return InferenceServer(model_cfg, serve_cfg, params=params,
+                           observer=observer)
+
+
+def create_server(model_cfg: ModelConfig, serve_cfg: ServeConfig,
+                  fleet_cfg=None, params=None, observer=None):
+    """Single entry point for the serve CLI: one replica -> the classic
+    InferenceServer; ``fleet_cfg.replicas > 1`` -> the fleet front
+    (router + supervisor over N threaded engine replicas,
+    serve/fleet/http.py). Both expose the same /v1 surface."""
+    if fleet_cfg is not None and fleet_cfg.replicas > 1:
+        from .fleet.http import FleetServer
+        return FleetServer(model_cfg, serve_cfg, fleet_cfg, params=params,
+                           observer=observer)
     return InferenceServer(model_cfg, serve_cfg, params=params,
                            observer=observer)

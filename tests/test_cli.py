@@ -28,10 +28,11 @@ def invoke(runner, args, **kw):
 
 
 class TestBasics:
-    def test_help_lists_all_13_commands(self, runner):
+    def test_help_lists_all_14_commands(self, runner):
         result = invoke(runner, ["--help"])
         for cmd in ("init", "hw", "plan", "train", "eval", "export", "serve",
-                    "bench", "trace", "replay", "tune", "health", "admin"):
+                    "fleet", "bench", "trace", "replay", "tune", "health",
+                    "admin"):
             assert cmd in result.output
 
     def test_version(self, runner):
