@@ -157,6 +157,11 @@ def kernels(op, seq_len, hidden, heads, batch):
               help="serve-load: route int8 decode matmuls through the "
                    "in-kernel-dequant Pallas kernel (A/B vs XLA's fused "
                    "dequant; see ServeConfig.int8_pallas_matmul).")
+@click.option("--serve-max-retries", default=0, show_default=True, type=int,
+              help="serve-load fleet: honor Retry-After on 429s with up "
+                   "to this many resubmissions per request (0 = count "
+                   "rejections as failures, the PR-2 behaviour); lets "
+                   "saturation sweeps measure goodput under backpressure.")
 @click.option("--serve-replicas", default=1, show_default=True, type=int,
               help="serve-load: drive a fleet of this many threaded "
                    "engine replicas through the serve/fleet router "
@@ -165,7 +170,7 @@ def kernels(op, seq_len, hidden, heads, batch):
 def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
         requests, rps, concurrency, admission, kv_blocks, device_times,
         preemption, latency_dispatch_steps, artifact, quant, kv_quant,
-        slots, pipelined, int8_pallas, serve_replicas):
+        slots, pipelined, int8_pallas, serve_max_retries, serve_replicas):
     """End-to-end train step throughput / serve TTFT+throughput
     (parity: reference bench.py:35-49). ``serve-load`` runs open-loop
     (Poisson) and closed-loop sweeps with p50/p99 TTFT, per-token latency,
@@ -326,7 +331,8 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
             engines = ([r.engine for r in target.replicas]
                        if hasattr(target, "router") else [target])
             keys = ("short_dispatches", "decode_steps",
-                    "padded_slot_steps", "prefill_tokens", "preemptions")
+                    "padded_slot_steps", "prefill_tokens", "preemptions",
+                    "requeue_cached_tokens")
             agg = {k: sum(e.stats().get(k) or 0 for e in engines)
                    for k in keys}
             B = engines[0].serve_cfg.max_batch_size
@@ -342,6 +348,7 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
             out = run_poisson(warmed_engine(), offered_rps=r,
                               num_requests=requests, prompt_len=prompt_len,
                               max_tokens=gen_len, seed=0,
+                              max_retries=serve_max_retries,
                               device_times=device_times)
             s = out.summary()
             s["engine"] = engine_counters()
@@ -351,6 +358,7 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
                                   num_requests=requests,
                                   prompt_len=prompt_len,
                                   max_tokens=gen_len, seed=0,
+                                  max_retries=serve_max_retries,
                                   device_times=device_times)
             s = out.summary()
             s["concurrency"] = c

@@ -61,15 +61,18 @@ def status(url, as_json):
     from rich.table import Table
     table = Table(title="Fleet replicas")
     for col in ("replica", "state", "queue", "active", "outstanding tok",
-                "restarts", "last error"):
+                "restarts", "migr out", "prefix hit", "last error"):
         table.add_column(col)
     for r in snap["replicas"]:
         color = {"healthy": "green", "draining": "yellow",
                  "drained": "yellow"}.get(r["state"], "red")
+        hit = r.get("prefix_hit_rate")
         table.add_row(str(r["replica"]),
                       f"[{color}]{r['state']}[/{color}]",
                       str(r["queue_depth"]), str(r["active"]),
                       str(r["outstanding_tokens"]), str(r["restarts"]),
+                      str(r.get("migrations", 0)),
+                      f"{hit:.0%}" if hit is not None else "-",
                       (r.get("last_error") or "")[:48])
     console = Console()
     console.print(table)
@@ -78,6 +81,13 @@ def status(url, as_json):
         f"router: {rt['completed']}/{rt['submitted']} completed, "
         f"{rt['rejected']} rejected (429), {rt['requeues']} requeues, "
         f"{rt['in_flight']} in flight, {rt['parked']} parked")
+    mig = snap.get("migration")
+    if mig:
+        console.print(
+            f"migration: {mig['migrations']} moved "
+            f"({mig['migrated_tokens']} KV tokens, "
+            f"{mig['reprefill_tokens_avoided']} re-prefill tokens "
+            f"avoided, {mig['in_flight']} in flight)")
 
 
 @app.command()
@@ -104,3 +114,21 @@ def undrain(replica, url):
     except Exception as e:
         _die(e)
     click.echo(f"replica {out['replica']}: back in rotation")
+
+
+@app.command()
+@click.argument("request_id")
+@click.argument("replica", type=int)
+@click.option("--url", default="http://127.0.0.1:8080", show_default=True)
+def migrate(request_id, replica, url):
+    """Live-migrate REQUEST_ID to REPLICA with its KV pages: the source
+    pre-copies full pages while it keeps decoding, stop-and-copies only
+    the partial tail, and the destination resumes the sequence
+    token-identically with zero re-prefill."""
+    try:
+        out = _post(f"{url.rstrip('/')}/fleet/migrate",
+                    {"request_id": request_id, "replica": replica})
+    except Exception as e:
+        _die(e)
+    click.echo(f"request {out['request_id']}: migrating to replica "
+               f"{out['replica']}")

@@ -118,6 +118,24 @@ def app(ctx):
               help="Prompt-prefix length hashed for replica affinity "
                    "(keeps per-replica prefix caches hot; 0 = pure "
                    "least-outstanding-tokens routing).")
+@click.option("--fleet-migrate-on-drain/--fleet-no-migrate-on-drain",
+              "fleet_migrate_on_drain", default=True, show_default=True,
+              help="Drained replicas hand their resident sequences to "
+                   "survivors WITH their KV pages (two-phase live copy, "
+                   "zero re-prefill) instead of re-prefilling "
+                   "prompt+generated.")
+@click.option("--fleet-rebalance-ratio", default=0.0, show_default=True,
+              type=float,
+              help="Outstanding-token imbalance fraction that triggers "
+                   "migration-driven rebalancing (hot replica's longest "
+                   "sequences move to the coldest); 0 disables.")
+@click.option("--fleet-rebalance-hysteresis", default=3, show_default=True,
+              type=int,
+              help="Consecutive supervisor polls the imbalance must "
+                   "persist before the rebalancer moves KV.")
+@click.option("--fleet-max-migrations", default=2, show_default=True,
+              type=int,
+              help="Concurrently in-flight KV migrations, fleet-wide.")
 def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
           kv_block_size, kv_hbm_gb, scheduler, dtype, prometheus_port,
           speculative, spec_tokens, prefix_cache, tensor_parallel,
@@ -125,7 +143,9 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
           preemption, latency_dispatch_steps, pipelined_decode,
           int8_pallas, cors_origins, replicas, fleet_max_pending,
           fleet_probe_interval, fleet_restart_backoff,
-          fleet_affinity_tokens):
+          fleet_affinity_tokens, fleet_migrate_on_drain,
+          fleet_rebalance_ratio, fleet_rebalance_hysteresis,
+          fleet_max_migrations):
     """Start the OpenAI-compatible inference server."""
     import jax
 
@@ -159,7 +179,11 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
             replicas=replicas, max_pending=fleet_max_pending,
             probe_interval_s=fleet_probe_interval,
             restart_backoff_s=fleet_restart_backoff,
-            affinity_prefix_tokens=fleet_affinity_tokens)
+            affinity_prefix_tokens=fleet_affinity_tokens,
+            migrate_on_drain=fleet_migrate_on_drain,
+            rebalance_imbalance_ratio=fleet_rebalance_ratio,
+            rebalance_poll_hysteresis=fleet_rebalance_hysteresis,
+            max_concurrent_migrations=fleet_max_migrations)
         fleet_cfg.validate()
 
     observer = None

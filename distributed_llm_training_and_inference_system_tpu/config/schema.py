@@ -754,6 +754,24 @@ class FleetConfig:
     # per-request requeue budget (crash/drain rerouting); above it the
     # request fails loudly instead of ping-ponging between dying replicas
     max_requeues: int = 3
+    # -- KV migration (serve/fleet/migration.py) ------------------------------
+    # drain moves resident sequences to survivors WITH their paged KV
+    # (two-phase copy: full pages pre-copied while decode continues, only
+    # the partial tail stop-and-copied), so the destination restores pages
+    # and resumes decode — zero re-prefill. Off = PR-2 behaviour: victims
+    # re-prefill prompt+generated on the survivor.
+    migrate_on_drain: bool = True
+    # proactive rebalancing: when (hottest - coldest) outstanding tokens
+    # exceed this fraction of the hottest replica's load for
+    # `rebalance_poll_hysteresis` consecutive supervisor polls, the
+    # longest-remaining resident sequences migrate hot -> cold. 0 disables
+    # (placement bias on new requests remains the only balancing force).
+    rebalance_imbalance_ratio: float = 0.0
+    rebalance_poll_hysteresis: int = 3
+    # fleet-wide bound on concurrently in-flight migrations: each one
+    # holds a host-side page copy and steals a source step boundary, so
+    # unbounded migration under churn would thrash instead of balance
+    max_concurrent_migrations: int = 2
 
     def validate(self) -> None:
         if self.replicas < 1:
@@ -772,6 +790,13 @@ class FleetConfig:
             raise ConfigError("max_pending must be >= 1")
         if self.max_requeues < 0:
             raise ConfigError("max_requeues must be >= 0")
+        if not 0.0 <= self.rebalance_imbalance_ratio < 1.0:
+            raise ConfigError(
+                "rebalance_imbalance_ratio must be in [0, 1) (0 disables)")
+        if self.rebalance_poll_hysteresis < 1:
+            raise ConfigError("rebalance_poll_hysteresis must be >= 1")
+        if self.max_concurrent_migrations < 1:
+            raise ConfigError("max_concurrent_migrations must be >= 1")
 
     @classmethod
     def from_dict(cls, d: dict[str, Any] | None) -> "FleetConfig":
@@ -780,7 +805,12 @@ class FleetConfig:
         kw = {}
         for f_ in dataclasses.fields(cls):
             if f_.name in d:
-                kw[f_.name] = type(f_.default)(d[f_.name])
+                if isinstance(f_.default, bool):
+                    # bool("false") is True — string configs need the shared
+                    # parser, same as ServeConfig
+                    kw[f_.name] = _parse_bool(f_.name, d[f_.name])
+                else:
+                    kw[f_.name] = type(f_.default)(d[f_.name])
         cfg = cls(**kw)
         cfg.validate()
         return cfg

@@ -213,6 +213,27 @@ class PrometheusExporter:
                                 "drained replica")
         self.fleet_rejected = c("llmctl_fleet_rejected",
                                 "Requests refused with 429 + Retry-After")
+        # KV migration plane (serve/fleet/migration.py): how much work
+        # moved between replicas and what it saved vs re-prefill
+        self.fleet_migrations = c(
+            "llmctl_fleet_migrations",
+            "Sequences moved between replicas with their KV pages")
+        self.fleet_migrated_tokens = c(
+            "llmctl_fleet_migrated_tokens",
+            "KV entries (tokens) moved by cross-replica migration")
+        self.fleet_reprefill_avoided = c(
+            "llmctl_fleet_reprefill_tokens_avoided",
+            "Prefill tokens NOT recomputed thanks to KV migration and "
+            "warm-prefix orphan requeue")
+        self.fleet_migration_pause = h(
+            "llmctl_fleet_migration_pause_ms",
+            "Stop-and-copy pause per migration (ms; the two-phase copy's "
+            "stop phase only)",
+            buckets=(.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 1000))
+        self.fleet_prefix_hit_rate = g(
+            "llmctl_fleet_replica_prefix_hit_rate",
+            "Prefix-cache page hit rate per replica (affinity-ring payoff)",
+            ["replica"])
         self._last_totals: dict[str, float] = {}
         self._server_started = False
 
@@ -282,6 +303,9 @@ class PrometheusExporter:
             if delta > 0:
                 self.fleet_restarts.labels(replica=rid).inc(delta)
             self._last_totals[key] = rep.get("restarts", 0)
+            if "prefix_hit_rate" in rep:
+                self.fleet_prefix_hit_rate.labels(replica=rid).set(
+                    rep["prefix_hit_rate"])
         router = snap.get("router", {})
         for key, counter in (("requeues", self.fleet_requeues),
                              ("rejected", self.fleet_rejected)):
@@ -290,6 +314,26 @@ class PrometheusExporter:
             if delta > 0:
                 counter.inc(delta)
             self._last_totals[f"fleet_{key}"] = total
+        mig = snap.get("migration", {})
+        for key, counter in (
+                ("migrations", self.fleet_migrations),
+                ("migrated_tokens", self.fleet_migrated_tokens),
+                ("reprefill_tokens_avoided", self.fleet_reprefill_avoided)):
+            total = mig.get(key, 0)
+            delta = total - self._last_totals.get(f"fleet_mig_{key}", 0)
+            if delta > 0:
+                counter.inc(delta)
+            self._last_totals[f"fleet_mig_{key}"] = total
+        # pauses arrive as a bounded recent list + a cumulative count:
+        # observe only the count delta's worth of newest entries, so a
+        # repeated snapshot can't double-fill the histogram
+        count = mig.get("pause_count", 0)
+        new = int(count - self._last_totals.get("fleet_mig_pauses", 0))
+        pauses = mig.get("pauses_ms", [])
+        if new > 0:
+            for p in pauses[-min(new, len(pauses)):]:
+                self.fleet_migration_pause.observe(p)
+        self._last_totals["fleet_mig_pauses"] = count
 
 
 class OTLPExporter:

@@ -265,6 +265,9 @@ class InferenceEngine:
         self.total_decode_steps = 0
         self.total_prefill_tokens = 0      # tokens actually computed
         self.total_prefix_cached_tokens = 0  # prompt tokens skipped via cache
+        # of the cached tokens, the ones on fleet-requeued orphans (warm-
+        # prefix requeue payoff — feeds reprefill_tokens_avoided)
+        self.total_requeue_cached_tokens = 0
         # decode always runs over all slots (one compiled program); padded
         # slots are wasted work — tracked so batch-size tuning isn't blind
         self.total_padded_slot_steps = 0
@@ -466,6 +469,11 @@ class InferenceEngine:
         # hit-rate stats once per successful admission (not per retry)
         self.kv.prefix_queries += usable
         self.kv.prefix_hits += len(pins)
+        if pins and req.fleet_requeued:
+            # a crash/drain orphan whose prompt pages are already warm
+            # here: these tokens are NOT re-prefilled — the fleet's
+            # reprefill_tokens_avoided metric sums this across replicas
+            self.total_requeue_cached_tokens += len(pins) * self.kv.page_size
         self._reserved_pages += need
         self._reserved_by[req.request_id] = need
         return True
@@ -1549,6 +1557,7 @@ class InferenceEngine:
             "short_dispatches": self.total_short_dispatches,
             "prefill_tokens": self.total_prefill_tokens,
             "prefix_cached_tokens": self.total_prefix_cached_tokens,
+            "requeue_cached_tokens": self.total_requeue_cached_tokens,
             "padded_slot_steps": self.total_padded_slot_steps,
             "decode_slot_utilization": round(
                 1.0 - self.total_padded_slot_steps

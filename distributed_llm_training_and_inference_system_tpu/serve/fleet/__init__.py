@@ -33,6 +33,7 @@ from typing import Callable, Optional, Sequence
 from ...config.schema import FleetConfig, ModelConfig, ServeConfig
 from ..scheduler import Request, SamplingParams
 from .faults import FaultInjector, FaultPlan, InjectedCrash, ProbeTimeout
+from .migration import MigrationTicket
 from .replica import EngineReplica, reset_for_requeue
 from .router import FleetRouter, FleetSaturated, prefix_digest
 from .supervisor import ReplicaSupervisor
@@ -44,6 +45,7 @@ __all__ = [
     "FleetRouter",
     "FleetSaturated",
     "InjectedCrash",
+    "MigrationTicket",
     "ProbeTimeout",
     "ReplicaSupervisor",
     "ServeFleet",
@@ -81,7 +83,8 @@ class ServeFleet:
                 # mirror each other across replicas (greedy / explicit
                 # seeds are unaffected)
                 seed=seed + 1000 * i, injector=self.injector,
-                on_finish=self._on_request_exit, eos_token_id=eos_token_id)
+                on_finish=self._on_request_exit, eos_token_id=eos_token_id,
+                fleet_cfg=self.fleet_cfg)
             if params is None:          # replica 0 owns the load; share it
                 params = r.engine.params
                 model_cfg = r.model_cfg
@@ -158,6 +161,11 @@ class ServeFleet:
 
     def undrain(self, replica_id: int) -> bool:
         return self.supervisor.undrain(replica_id)
+
+    def migrate(self, request_id: str, dest_replica: int) -> bool:
+        """Move one in-flight request to ``dest_replica`` WITH its KV
+        pages (no re-prefill) — `llmctl fleet migrate`."""
+        return self.supervisor.migrate(request_id, dest_replica)
 
     def status(self) -> dict:
         return self.supervisor.snapshot()

@@ -7,8 +7,11 @@ operator endpoints:
 
 - ``GET  /fleet/status``  — per-replica health + router ledger
 - ``POST /fleet/drain``   — ``{"replica": N}``: graceful drain (in-flight
-  requests requeue to surviving replicas, nothing is dropped)
+  requests requeue to surviving replicas, nothing is dropped; with
+  ``migrate_on_drain`` they move WITH their KV pages — zero re-prefill)
 - ``POST /fleet/undrain`` — return a drained replica to rotation
+- ``POST /fleet/migrate`` — ``{"request_id": ..., "replica": N}``: move
+  one in-flight request to replica N with its KV (two-phase live copy)
 
 Backpressure contract: when every replica saturates, completions answer
 **429 with a Retry-After header** (seconds) instead of queueing without
@@ -179,6 +182,28 @@ class FleetServer:
                                   "action": "drain" if drain
                                   else "undrain"})
 
+    async def handle_fleet_migrate(self, request: web.Request
+                                   ) -> web.Response:
+        try:
+            body = await request.json()
+            request_id = str(body["request_id"])
+            replica = int(body["replica"])
+        except (json.JSONDecodeError, KeyError, TypeError, ValueError):
+            return web.json_response(
+                {"error": "body must be {\"request_id\": <id>, "
+                          "\"replica\": <id>}"}, status=400)
+        if all(r.replica_id != replica for r in self.fleet.replicas):
+            return web.json_response(
+                {"error": f"no replica {replica}"}, status=404)
+        if not self.fleet.migrate(request_id, replica):
+            return web.json_response(
+                {"error": f"request {request_id!r} is not resident on a "
+                          "healthy replica other than the destination"},
+                status=404)
+        return web.json_response({"ok": True, "request_id": request_id,
+                                  "replica": replica,
+                                  "action": "migrate"})
+
     async def handle_metrics(self, request: web.Request) -> web.Response:
         try:
             from prometheus_client import generate_latest
@@ -197,6 +222,7 @@ class FleetServer:
         app.router.add_get("/fleet/status", self.handle_fleet_status)
         app.router.add_post("/fleet/drain", self.handle_fleet_drain)
         app.router.add_post("/fleet/undrain", self.handle_fleet_undrain)
+        app.router.add_post("/fleet/migrate", self.handle_fleet_migrate)
         return app
 
     # -- lifecycle -----------------------------------------------------------

@@ -1,0 +1,107 @@
+"""Cross-replica KV migration: move a sequence WITH its pages.
+
+PR 2 shipped re-prefill-on-requeue: every drained or rebalanced sequence
+paid an O(context) recompute on the survivor before emitting its next
+token. This module is the Llumnix-style alternative (PAPERS.md): the
+source replica extracts the victim's paged KV at an engine-step boundary
+and the destination restores the pages through the engine's existing
+swap-in path (``engine._restore_swapped``), so decode resumes
+token-identically with ZERO prefill compute — the assigned_seed +
+position-folded PRNG already guarantees the stream continues bit-exactly.
+
+The pause is bounded with a **two-phase copy**:
+
+- *pre-copy* (``precopy_slot``): every FULL page of the victim is copied
+  to host memory while the source keeps decoding. Full pages are
+  immutable — decode only ever appends to the partial tail page — so
+  nothing pre-copied can go stale.
+- *stop-and-copy* (``stop_and_copy``): at the next step boundary the
+  sequence is frozen and only the pages written since the pre-copy (the
+  old partial tail plus whatever decode filled in between — at most one
+  dispatch of tokens) cross; the payloads merge into one restore-shaped
+  dict and the sequence leaves the source.
+
+Payloads are host numpy arrays in exactly the ``Request.swapped_kv``
+schema the intra-engine preemption=swap path defined, so the destination
+needs NO new restore code — and because they are plain serializable
+arrays, a cross-host courier (or prefill/decode disaggregation) can ship
+the same payload over a transport later without touching either engine.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+
+@dataclass
+class MigrationTicket:
+    """One in-flight migration, owned by the SOURCE replica's engine
+    thread (phases advance only at its step boundaries)."""
+    request_id: str
+    dest: Optional[int] = None          # preferred replica; None = router
+    reason: str = "operator"            # operator | drain | rebalance
+    phase: str = "precopy"              # precopy -> stop
+    pre: Optional[dict] = None          # phase-1 result
+    detail: dict = field(default_factory=dict)
+
+
+def _concat_pages(a, b):
+    """Concatenate two extract payload buffers along the page axis (1);
+    handles both plain arrays and int8 QuantPages {values, scale} dicts."""
+    if isinstance(a, dict):
+        return {k: np.concatenate([a[k], b[k]], axis=1) for k in a}
+    return np.concatenate([a, b], axis=1)
+
+
+def precopy_slot(engine, slot: int) -> dict:
+    """Phase 1: copy the slot's FULL pages to host. Caller is the engine
+    thread at a step boundary (pipelined dispatch drained), holding
+    ``engine.lock``."""
+    pos = int(engine.positions[slot])
+    full = pos // engine.kv.page_size
+    return {
+        "pages": (engine.kv.extract_slot_pages(slot, 0, full)
+                  if full > 0 else None),
+        "full_pages": full,
+        "positions": pos,
+    }
+
+
+def stop_and_copy(engine, slot: int, pre: dict) -> tuple[dict, dict]:
+    """Phase 2: freeze the sequence and copy only what phase 1 could not —
+    pages [full_pages, pages(written)) — then merge into one
+    ``swapped_kv``-shaped payload. Returns (payload, detail); ``detail``
+    carries the pause/page accounting the metrics and tests assert.
+
+    Caller is the engine thread, holding ``engine.lock``; the slot must
+    still be RUNNING and un-preempted since phase 1 (same request id)."""
+    t0 = time.perf_counter()
+    pos = int(engine.positions[slot])
+    total = engine.kv.pages_needed(pos)
+    lo = pre["full_pages"]
+    delta = engine.kv.extract_slot_pages(slot, lo, total)
+    if pre["pages"] is not None:
+        pages = {"k": _concat_pages(pre["pages"]["k"], delta["k"]),
+                 "v": _concat_pages(pre["pages"]["v"], delta["v"]),
+                 "num_pages": total}
+    else:
+        pages = delta
+    payload = {
+        "pages": pages,
+        "positions": pos,
+        "last_token": int(engine.last_tokens[slot]),
+    }
+    pause_ms = (time.perf_counter() - t0) * 1e3
+    detail = {
+        "pause_ms": pause_ms,
+        "precopy_pages": lo,
+        "stop_pages": total - lo,
+        "total_pages": total,
+        "positions_precopy": pre["positions"],
+        "positions_stop": pos,
+    }
+    return payload, detail

@@ -26,12 +26,15 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from collections import deque
 from typing import Callable, Optional
 
-from ...config.schema import ModelConfig, ServeConfig
+from ...config.schema import FleetConfig, ModelConfig, ServeConfig
 from ..engine import InferenceEngine
 from ..scheduler import Request, RequestState
+from . import migration
 from .faults import FaultInjector
+from .migration import MigrationTicket
 
 logger = logging.getLogger("llmctl.serve.fleet.replica")
 
@@ -44,21 +47,36 @@ CRASHED = "crashed"       # engine thread died; orphans await requeue
 STOPPED = "stopped"
 
 
-def reset_for_requeue(req: Request) -> None:
+def reset_for_requeue(req: Request, keep_kv: bool = False) -> None:
     """Make a request admissible on another replica. Generated tokens and
     ``assigned_seed`` are KEPT: the new replica re-prefills prompt+generated
     (the engine's preemption-resume path) and continues the same per-position
     PRNG stream, so greedy and seeded-sampled output is token-identical to
-    an undisturbed run. Replica-local state (slot, prefix hashes, swapped
-    pages — all tied to the old replica's KV pool) is dropped."""
+    an undisturbed run. Replica-local state (the slot) is dropped.
+
+    ``prefix_hashes`` are NOT replica-local — they digest token content,
+    and a survivor holding the prompt's pages in its prefix cache serves
+    them without recompute — so they are preserved whenever they still
+    describe the full resume context (no tokens generated yet: the common
+    crash-orphan case). Once decode produced tokens the context outgrew
+    the hashed chain and the survivor rehashes at admission (keeping the
+    short chain would make the publish loop index past its end).
+
+    ``keep_kv=True`` preserves ``swapped_kv``: the payload is host memory,
+    independent of the source engine — the KV-migration handoff
+    (serve/fleet/migration.py). Default drops it (crash paths, where a
+    partially-built payload must not travel)."""
     req.state = RequestState.QUEUED
     req.slot = None
     req.error = None
     req.finish_time = None
     req.finish_reason = None
     req.cancel_requested = False
-    req.prefix_hashes = None
-    req.swapped_kv = None
+    req.fleet_requeued = True
+    if req.generated_tokens:
+        req.prefix_hashes = None
+    if not keep_kv:
+        req.swapped_kv = None
 
 
 class EngineReplica:
@@ -68,12 +86,26 @@ class EngineReplica:
                  serve_cfg: ServeConfig, params=None, seed: int = 0,
                  injector: Optional[FaultInjector] = None,
                  on_finish: Optional[Callable[[int, Request], None]] = None,
-                 eos_token_id: Optional[int] = None):
+                 eos_token_id: Optional[int] = None,
+                 fleet_cfg: Optional[FleetConfig] = None):
         self.replica_id = replica_id
         self.serve_cfg = serve_cfg
         self.seed = seed
         self.injector = injector
         self.eos_token_id = eos_token_id
+        self._migrate_on_drain = bool(fleet_cfg.migrate_on_drain) \
+            if fleet_cfg is not None else False
+        # single-request migrations (rebalance / operator): ticket state
+        # advances ONLY on the engine thread at step boundaries; the dict
+        # itself is shared with the supervisor thread (_state_lock)
+        self._migrations: dict[str, MigrationTicket] = {}
+        self._migrated: list[tuple[Request, MigrationTicket]] = []
+        self.migrations_out = 0
+        self.migrated_tokens = 0            # KV entries moved (source side)
+        self.reprefill_avoided_tokens = 0   # drain path: context NOT recomputed
+        self.migrations_by_reason: dict[str, int] = {}
+        self.migration_pauses_ms: deque = deque(maxlen=64)
+        self.migration_log: deque = deque(maxlen=64)   # per-move detail
         # fired with (replica_id, request) whenever a request leaves its
         # slot terminally on this replica (finished/cancelled) — the
         # router's completion hook. NOT fired on crash/drain extraction.
@@ -113,6 +145,12 @@ class EngineReplica:
                 self._drain_on_thread()
                 self._drain_requested.clear()
                 continue
+            if self._migrations:
+                try:
+                    self._service_migrations()
+                except Exception as e:   # broken engine mid-copy
+                    self._crash(e)
+                    return
             with eng.lock:
                 busy = (eng.scheduler.queue_depth > 0
                         or eng.scheduler.active_count > 0)
@@ -140,6 +178,12 @@ class EngineReplica:
         with self._state_lock:
             self.state = CRASHED
             self.last_error = f"{type(exc).__name__}: {exc}"
+            # in-flight migration tickets die with the engine: their
+            # half-built payloads must not travel — the victims fall back
+            # to plain requeue (re-prefill) via the orphan path below.
+            # COMPLETED migrations (_migrated) survive: those payloads are
+            # host memory and their requests already left this engine.
+            self._migrations.clear()
         self._orphans.extend(self._rip_out())
 
     def _rip_out(self) -> list[Request]:
@@ -163,13 +207,54 @@ class EngineReplica:
         """Graceful eviction, executed BY the engine thread between steps:
         catch up the pipelined dispatch, preempt every resident request
         through the engine's own path (KV pages released, prefix pages
-        published), then empty the queue. Orphans resume on other replicas
-        from prompt+generated."""
+        published), then empty the queue.
+
+        With ``migrate_on_drain`` the resident sequences leave WITH their
+        paged KV (two-phase: pre-copy full pages, run one more decode
+        dispatch while the bulk is already copied, stop-and-copy only the
+        tail) — the survivor restores the pages and resumes with zero
+        re-prefill. Otherwise orphans resume elsewhere from
+        prompt+generated, PR-2 style."""
         eng = self.engine
         try:
             eng._drain_pending()
+            tickets: list[tuple[Request, dict]] = []
+            if self._migrate_on_drain:
+                with eng.lock:
+                    for slot, r in enumerate(eng.scheduler.slots):
+                        if r is not None and r.state is RequestState.RUNNING:
+                            tickets.append(
+                                (r, migration.precopy_slot(eng, slot)))
+                if tickets and any(eng.active):
+                    # phase 1 done: let decode advance one dispatch while
+                    # the full pages are already on host — the stop phase
+                    # then covers only the tail written since. Decode-only
+                    # (not eng.step()): a drain must not START a queued
+                    # request's prefill just to evict it again.
+                    with eng.lock:
+                        eng._ensure_decode_capacity()
+                    if any(eng.active):
+                        sampled = eng._decode_device()
+                        with eng.lock:
+                            eng._apply_decode(sampled)
+                            eng.scheduler.step_finished(eng.eos_token_id)
             victims: list[Request] = []
             with eng.lock:
+                # phase 2: stop-and-copy sequences still resident (ones
+                # that finished during the interleaved dispatch are done —
+                # the best outcome a migration can have)
+                for r, pre in tickets:
+                    slot = eng._req_slot.get(r.request_id)
+                    if slot is None or eng.scheduler.slots[slot] is not r \
+                            or r.state is not RequestState.RUNNING:
+                        continue
+                    payload, detail = migration.stop_and_copy(eng, slot, pre)
+                    eng._preempt(slot)   # pages freed, -> waiting head
+                    # AFTER _preempt: in preemption=swap mode it stashes
+                    # its own full-chain extraction, which the two-phase
+                    # payload supersedes
+                    r.swapped_kv = payload
+                    self._note_migration(r, payload, detail, reason="drain")
                 # chunked prefills: drop progress, release the slot's pages
                 # manually (there is no preemption path for PREFILLING)
                 for rid in list(eng._partial_prefills):
@@ -197,7 +282,10 @@ class EngineReplica:
                 victims = list(eng.scheduler.waiting)
                 eng.scheduler.waiting.clear()
             for r in victims:
-                reset_for_requeue(r)
+                # migrated victims carry their two-phase payload; under
+                # migrate_on_drain, queued swap-preempted victims keep
+                # theirs too (host arrays restore anywhere)
+                reset_for_requeue(r, keep_kv=self._migrate_on_drain)
             self._orphans.extend(victims)
             with self._state_lock:
                 self.state = DRAINED
@@ -209,6 +297,80 @@ class EngineReplica:
     def _engine_finished(self, req: Request) -> None:
         if self.on_finish is not None:
             self.on_finish(self.replica_id, req)
+
+    # -- KV migration (engine-thread half) -----------------------------------
+
+    def _note_migration(self, req: Request, payload: dict, detail: dict,
+                        reason: str) -> None:
+        self.migrations_out += 1
+        self.migrated_tokens += int(payload["positions"])
+        self.migrations_by_reason[reason] = (
+            self.migrations_by_reason.get(reason, 0) + 1)
+        if reason == "drain":
+            # the counterfactual was re-prefilling prompt+generated on the
+            # survivor; a rebalance move avoids nothing (it would simply
+            # have stayed put), so only drain credits avoided tokens
+            self.reprefill_avoided_tokens += len(req.context_tokens)
+        self.migration_pauses_ms.append(float(detail["pause_ms"]))
+        self.migration_log.append({**detail, "request_id": req.request_id,
+                                   "reason": reason})
+        logger.info(
+            "replica %d migrated %s out (%s): %d tokens, %d pages "
+            "pre-copied + %d stop-copied, pause %.2f ms",
+            self.replica_id, req.request_id, reason, payload["positions"],
+            detail["precopy_pages"], detail["stop_pages"],
+            detail["pause_ms"])
+
+    def _service_migrations(self) -> None:
+        """Advance in-flight single-request migrations (rebalance /
+        operator) at a step boundary, ON the engine thread. One phase per
+        boundary visit: phase 1 pre-copies the victim's full (immutable)
+        pages and returns — the loop keeps decoding — and the NEXT visit
+        stop-and-copies only the pages written since, evicts through the
+        engine's own preemption path, and stashes (request, ticket) for
+        the supervisor's courier."""
+        with self._state_lock:
+            tickets = list(self._migrations.items())
+        eng = self.engine
+        eng._drain_pending()
+        for rid, t in tickets:
+            handoff: Optional[Request] = None
+            with eng.lock:
+                slot = eng._req_slot.get(rid)
+                req = (eng.scheduler.slots[slot]
+                       if slot is not None else None)
+                valid = (req is not None and req.request_id == rid
+                         and req.state is RequestState.RUNNING)
+                if valid and t.phase == "precopy":
+                    t.pre = migration.precopy_slot(eng, slot)
+                    t.phase = "stop"
+                elif valid:
+                    payload, t.detail = migration.stop_and_copy(
+                        eng, slot, t.pre)
+                    eng._preempt(slot)
+                    # _preempt parked it at the waiting head; a migrating
+                    # request leaves this engine entirely
+                    if eng.scheduler.waiting and \
+                            eng.scheduler.waiting[0] is req:
+                        eng.scheduler.waiting.popleft()
+                    else:
+                        eng.scheduler.waiting.remove(req)
+                    handoff = req
+            if not valid:
+                # finished / preempted / requeued since the request was
+                # ticketed: nothing to move (and the pre-copy, if any, is
+                # stale) — drop the ticket, the request is wherever the
+                # normal paths put it
+                with self._state_lock:
+                    self._migrations.pop(rid, None)
+                continue
+            if handoff is not None:
+                reset_for_requeue(handoff, keep_kv=True)
+                handoff.swapped_kv = payload
+                self._note_migration(handoff, payload, t.detail, t.reason)
+                with self._state_lock:
+                    self._migrations.pop(rid, None)
+                    self._migrated.append((handoff, t))
 
     # -- fleet-facing API ----------------------------------------------------
 
@@ -281,6 +443,55 @@ class EngineReplica:
         """Hand the stashed crash/drain victims to the caller (supervisor)."""
         out, self._orphans = self._orphans, []
         return out
+
+    def request_migrate(self, request_id: str, dest: Optional[int] = None,
+                        reason: str = "operator") -> bool:
+        """Ask the engine thread to migrate one RESIDENT request out with
+        its KV (two-phase; see migration.py). Returns False when this
+        replica can't (not healthy, already migrating it, or the request
+        isn't resident here) — the caller treats that as 'nothing moved'."""
+        with self._state_lock:
+            if self.state != HEALTHY or request_id in self._migrations:
+                return False
+        with self.engine.lock:
+            if request_id not in self.engine._req_slot:
+                return False
+        with self._state_lock:
+            self._migrations[request_id] = MigrationTicket(
+                request_id=request_id, dest=dest, reason=reason)
+        self._wake.set()
+        return True
+
+    def migrations_in_flight(self) -> int:
+        with self._state_lock:
+            return len(self._migrations)
+
+    def take_migrated(self) -> list[tuple[Request, MigrationTicket]]:
+        """Hand completed migrations (request + ticket with dest hint) to
+        the supervisor for placement. Survives a crash: payloads are host
+        memory and these requests already left the engine."""
+        with self._state_lock:
+            out, self._migrated = self._migrated, []
+        return out
+
+    def resident_requests(self) -> list[tuple[str, int]]:
+        """(request_id, remaining_tokens) of RUNNING requests — the
+        rebalancer's victim-selection input."""
+        out = []
+        with self.engine.lock:
+            for r in self.engine.scheduler.slots:
+                if r is not None and r.state is RequestState.RUNNING:
+                    out.append((r.request_id, r.remaining_tokens))
+        return out
+
+    def prefix_cache_stats(self) -> tuple[int, int, int]:
+        """(prefix_hits, prefix_queries, requeue_cached_tokens) from the
+        engine — per-replica cache observability (hit-rate gauge)."""
+        kv = getattr(self.engine, "kv", None)
+        if kv is None:                     # engine released
+            return 0, 0, 0
+        return (kv.prefix_hits, kv.prefix_queries,
+                getattr(self.engine, "total_requeue_cached_tokens", 0))
 
     def stop(self, timeout: float = 10.0) -> None:
         self._stop.set()

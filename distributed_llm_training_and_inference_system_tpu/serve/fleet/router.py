@@ -94,6 +94,7 @@ class FleetRouter:
         self.total_rejected = 0
         self.total_requeues = 0
         self.total_affinity_hits = 0
+        self.total_migrations = 0       # migrated sequences placed
         self.completed_per_replica: dict[int, int] = {
             r.replica_id: 0 for r in self.replicas}
         self.routed_per_replica: dict[int, int] = {
@@ -252,7 +253,10 @@ class FleetRouter:
                 self._fail(req, f"requeued {n} times (max_requeues="
                                 f"{self.cfg.max_requeues})")
                 continue
-            reset_for_requeue(req)
+            # keep_kv: payload presence was decided replica-side — drain
+            # victims under migrate_on_drain travel WITH their KV pages;
+            # crash paths already stripped theirs in _rip_out
+            reset_for_requeue(req, keep_kv=True)
             if self._place(req, exclude=frozenset({from_replica})):
                 placed += 1
             elif self._place(req):    # lone-replica fleet: same one is fine
@@ -268,6 +272,57 @@ class FleetRouter:
                                     "buffer is full")
         self.observer("fleet_requeue", {"from_replica": from_replica,
                                         "count": len(reqs)})
+        return placed
+
+    def replica_of(self, request_id: str) -> Optional[int]:
+        """Last known placement of an in-flight request (None when unknown
+        or already terminal) — the operator-migrate source lookup."""
+        with self._lock:
+            meta = self._meta.get(request_id)
+            return meta.get("replica") if meta else None
+
+    def place_migrated(self, req: Request, from_replica: int,
+                       dest: Optional[int] = None) -> bool:
+        """Place a sequence that left ``from_replica`` WITH its KV payload
+        (serve/fleet/migration.py). The rebalancer's destination hint is
+        tried first; otherwise normal candidate order (excluding the
+        source). Does NOT charge the requeue budget — migrations are
+        voluntary moves, not failures. Unplaceable sequences park like
+        requeues; the payload rides along and restores wherever they land
+        (or the destination falls back to re-prefill if its pool is full).
+        """
+        with self._lock:
+            known = req.request_id in self._meta
+        if not known:            # completed/cancelled concurrently
+            return False
+        placed = False
+        if dest is not None:
+            r = self.by_id.get(dest)
+            if r is not None and r.accepting() and r.submit(req):
+                placed = True
+                with self._lock:
+                    self.routed_per_replica[dest] = (
+                        self.routed_per_replica.get(dest, 0) + 1)
+                    meta = self._meta.get(req.request_id)
+                    if meta is not None:
+                        meta["replica"] = dest
+        if not placed:
+            placed = (self._place(req, exclude=frozenset({from_replica}))
+                      or self._place(req))
+        if placed:
+            with self._lock:
+                self.total_migrations += 1
+        else:
+            with self._lock:
+                overflow = len(self._parked) >= self.cfg.max_pending
+                if not overflow:
+                    self._parked.append(req)
+            if overflow:
+                self._fail(req, "no healthy replica for a migrated "
+                                "sequence and the requeue buffer is full")
+        self.observer("fleet_migration", {
+            "from_replica": from_replica, "dest": dest,
+            "request_id": req.request_id, "placed": placed})
         return placed
 
     def _place(self, req: Request, exclude: frozenset = frozenset()) -> bool:
@@ -334,6 +389,7 @@ class FleetRouter:
                 "rejected": self.total_rejected,
                 "requeues": self.total_requeues,
                 "affinity_hits": self.total_affinity_hits,
+                "migrations": self.total_migrations,
                 "parked": len(self._parked),
                 "in_flight": in_flight,
                 "completed_per_replica": dict(self.completed_per_replica),

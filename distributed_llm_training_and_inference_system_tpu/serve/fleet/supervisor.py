@@ -49,6 +49,10 @@ class ReplicaSupervisor:
         self._next_restart: dict[int, float] = {}
         self._backoff: dict[int, float] = {}
         self.total_restarts = 0
+        # migration-driven rebalancer state: consecutive polls over the
+        # imbalance bound (hysteresis — one bursty poll must not move KV)
+        self._imbalance_streak = 0
+        self.total_rebalance_migrations = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -59,6 +63,10 @@ class ReplicaSupervisor:
         acted on. Deterministic: tests drive this directly."""
         now = time.monotonic() if now is None else now
         recovered = False
+        # courier first: completed migrations carry live KV payloads and
+        # their requests are homeless until placed — before any probe or
+        # restart work, whatever the source replica's state is now
+        self._collect_migrated()
         for r in self.replicas:
             state = r.state
             if state in (replica_mod.CRASHED, replica_mod.STOPPED):
@@ -68,11 +76,68 @@ class ReplicaSupervisor:
                 self._requeue_orphans(r)   # drain victims move elsewhere
             elif state == replica_mod.HEALTHY:
                 self._probe(r)
+        self._maybe_rebalance()
         if recovered:
             self.router.flush_parked()
         snap = self.snapshot()
         self.observer("fleet", snap)
         return snap
+
+    def _collect_migrated(self) -> None:
+        for r in self.replicas:
+            for req, ticket in r.take_migrated():
+                self.router.place_migrated(req, from_replica=r.replica_id,
+                                           dest=ticket.dest)
+
+    def _maybe_rebalance(self) -> None:
+        """Migration-driven load rebalancing: when the outstanding-token
+        spread between the hottest and coldest healthy replica exceeds
+        the configured fraction of the hottest's load for
+        ``rebalance_poll_hysteresis`` consecutive polls, the hottest
+        replica's longest-remaining resident sequences migrate hot ->
+        cold (bounded by ``max_concurrent_migrations``). Placement bias
+        on NEW requests can't fix a skew of long-running residents —
+        moving the sequences themselves can."""
+        cfg = self.cfg
+        if cfg.rebalance_imbalance_ratio <= 0:
+            return
+        healthy = [r for r in self.replicas
+                   if r.state == replica_mod.HEALTHY]
+        if len(healthy) < 2:
+            self._imbalance_streak = 0
+            return
+        load = {r.replica_id: r.outstanding_tokens() for r in healthy}
+        hot = max(healthy, key=lambda r: (load[r.replica_id], -r.replica_id))
+        cold = min(healthy, key=lambda r: (load[r.replica_id], r.replica_id))
+        spread = load[hot.replica_id] - load[cold.replica_id]
+        if load[hot.replica_id] <= 0 or \
+                spread <= cfg.rebalance_imbalance_ratio \
+                * load[hot.replica_id]:
+            self._imbalance_streak = 0
+            return
+        self._imbalance_streak += 1
+        if self._imbalance_streak < cfg.rebalance_poll_hysteresis:
+            return
+        budget = cfg.max_concurrent_migrations - sum(
+            r.migrations_in_flight() for r in self.replicas)
+        if budget <= 0:
+            return
+        residents = sorted(hot.resident_requests(),
+                           key=lambda x: x[1], reverse=True)
+        moved = 0
+        for rid, _remaining in residents[:budget]:
+            if hot.request_migrate(rid, dest=cold.replica_id,
+                                   reason="rebalance"):
+                moved += 1
+        if moved:
+            self.total_rebalance_migrations += moved
+            # re-arm: let the moves land before measuring the spread again
+            self._imbalance_streak = 0
+            logger.info(
+                "rebalancer: migrating %d sequence(s) replica %d -> %d "
+                "(outstanding %d vs %d)", moved, hot.replica_id,
+                cold.replica_id, load[hot.replica_id],
+                load[cold.replica_id])
 
     def _requeue_orphans(self, r: EngineReplica) -> None:
         orphans = r.take_orphans()
@@ -162,6 +227,23 @@ class ReplicaSupervisor:
         self.router.flush_parked()
         return True
 
+    def migrate(self, request_id: str, dest_replica: int) -> bool:
+        """Operator action (`llmctl fleet migrate`): move one in-flight
+        request to ``dest_replica`` with its KV. Returns False when the
+        destination doesn't exist, the request isn't resident anywhere,
+        or it already lives on the destination."""
+        if all(r.replica_id != dest_replica for r in self.replicas):
+            return False
+        src_id = self.router.replica_of(request_id)
+        if src_id is None or src_id == dest_replica:
+            return False
+        src = next((r for r in self.replicas if r.replica_id == src_id),
+                   None)
+        if src is None:
+            return False
+        return src.request_migrate(request_id, dest=dest_replica,
+                                   reason="operator")
+
     # -- background loop -----------------------------------------------------
 
     def start(self) -> None:
@@ -192,7 +274,15 @@ class ReplicaSupervisor:
         """Fleet-wide status: per-replica health + router ledger. Feeds
         /fleet/status, `llmctl fleet status`, and the Prometheus pump."""
         reps = []
+        requeue_cached = 0
+        pauses: list[float] = []
+        by_reason: dict[str, int] = {}
         for r in self.replicas:
+            hits, queries, cached = r.prefix_cache_stats()
+            requeue_cached += cached
+            pauses.extend(r.migration_pauses_ms)
+            for reason, n in r.migrations_by_reason.items():
+                by_reason[reason] = by_reason.get(reason, 0) + n
             reps.append({
                 "replica": r.replica_id,
                 "state": r.state,
@@ -202,6 +292,28 @@ class ReplicaSupervisor:
                 "restarts": r.restarts,
                 "probe_misses": self._misses.get(r.replica_id, 0),
                 "last_error": r.last_error,
+                "migrations": r.migrations_out,
+                "prefix_hits": hits,
+                "prefix_queries": queries,
+                "prefix_hit_rate": round(hits / max(queries, 1), 4),
             })
+        migration = {
+            "migrations": sum(r.migrations_out for r in self.replicas),
+            "migrated_tokens": sum(r.migrated_tokens
+                                   for r in self.replicas),
+            # drain migrations skip re-prefill of prompt+generated; warm-
+            # prefix requeues skip the cached prompt pages — both are
+            # prefill FLOPs the fleet did NOT spend
+            "reprefill_tokens_avoided": requeue_cached + sum(
+                r.reprefill_avoided_tokens for r in self.replicas),
+            "in_flight": sum(r.migrations_in_flight()
+                             for r in self.replicas),
+            "by_reason": by_reason,
+            # recent stop-and-copy pauses (bounded per replica) plus the
+            # cumulative count, so the Prometheus pump can histogram only
+            # the NEW ones (delta on pause_count)
+            "pauses_ms": pauses,
+            "pause_count": sum(r.migrations_out for r in self.replicas),
+        }
         return {"replicas": reps, "router": self.router.stats(),
-                "restarts": self.total_restarts}
+                "restarts": self.total_restarts, "migration": migration}

@@ -240,9 +240,20 @@ class PagedKVCache:
         """Copy ``slot``'s written pages to HOST memory (swap-out half of
         preemption=swap). One device fetch per buffer — the page gather
         runs on-device, only the slot's own pages cross the link."""
-        import jax
-        n = self._chain_len.get(slot, 0)
-        pages = self.block_tables[slot, :n].copy()
+        return self.extract_slot_pages(slot, 0, self._chain_len.get(slot, 0))
+
+    def extract_slot_pages(self, slot: int, lo: int, hi: int) -> dict:
+        """Copy chain entries [lo, hi) of ``slot`` to host memory.
+
+        The page-range form is the two-phase migration courier
+        (serve/fleet/migration.py): phase 1 pre-copies the full (immutable)
+        pages while decode keeps appending to the tail, phase 2
+        stop-and-copies only [full, written) — the partial tail plus pages
+        filled since the pre-copy. Payloads are plain numpy (host) arrays,
+        so they survive the source engine's death and serialize for a
+        cross-host courier later."""
+        hi = max(hi, lo)
+        pages = self.block_tables[slot, lo:hi].copy()
         idx = jnp.asarray(pages)
 
         def grab(buf):
@@ -252,7 +263,7 @@ class PagedKVCache:
                         "scale": np.asarray(buf.scale[:, idx])}
             return np.asarray(buf[:, idx])
         return {"k": grab(self.k_pages), "v": grab(self.v_pages),
-                "num_pages": int(n)}
+                "num_pages": int(hi - lo)}
 
     def _restore_fn(self, n_bucket: int):
         """Jitted donated page-write for swap-in: out-of-place .at[].set

@@ -61,6 +61,17 @@ class LoadResult:
     rejected: int = 0
     requeues: int = 0
     per_replica: dict = field(default_factory=dict)
+    # Retry-After honoring (max_retries > 0): resubmissions after a 429.
+    # `rejected` then counts only FINAL rejections (budget exhausted), so
+    # saturation sweeps measure goodput under backpressure instead of
+    # conflating it with failure.
+    retries: int = 0
+    # KV-migration plane: sequences moved with their pages and the prefill
+    # tokens the fleet did NOT recompute (drain migration + warm-prefix
+    # requeue) — the with/without-migration A/B readout
+    migrations: int = 0
+    migrated_tokens: int = 0
+    reprefill_tokens_avoided: int = 0
 
     def percentile(self, xs, q):
         return float(np.percentile(np.asarray(xs), q)) if xs else float("nan")
@@ -90,6 +101,10 @@ class LoadResult:
                 round(self.decode_ms_per_token_device, 3)}
                if self.ttft_device_ms else {}),
             **({"rejected": self.rejected, "requeues": self.requeues,
+                "retries": self.retries,
+                "migrations": self.migrations,
+                "migrated_tokens": self.migrated_tokens,
+                "reprefill_tokens_avoided": self.reprefill_tokens_avoided,
                 "per_replica": self.per_replica}
                if self.per_replica else {}),
         }
@@ -173,6 +188,10 @@ def _finalize_fleet(res: LoadResult, reqs: list, fleet,
             res.failed += 1
     stats = fleet.router.stats()
     res.requeues = stats["requeues"]
+    mig = fleet.supervisor.snapshot().get("migration", {})
+    res.migrations = mig.get("migrations", 0)
+    res.migrated_tokens = mig.get("migrated_tokens", 0)
+    res.reprefill_tokens_avoided = mig.get("reprefill_tokens_avoided", 0)
     res.preemptions = sum(rep.engine.total_preemptions
                           for rep in fleet.replicas)
     res.goodput_tokens_per_s = done_tokens / max(res.duration_s, 1e-9)
@@ -198,8 +217,16 @@ def _finalize_fleet(res: LoadResult, reqs: list, fleet,
     return res
 
 
-def _submit_fleet(fleet, prompt, max_tokens, reqs, events, res):
-    """One fleet submission; 429-style rejections are counted, not raised."""
+def _submit_fleet(fleet, prompt, max_tokens, reqs, events, res,
+                  retryq: Optional[list] = None, max_retries: int = 0,
+                  tries: int = 0):
+    """One fleet submission; 429-style rejections are counted, not raised.
+
+    With ``max_retries > 0`` a saturated submission honors the server's
+    Retry-After hint: it re-enters ``retryq`` as (due_time, prompt, tries)
+    and is resubmitted by the drive loop once due — the client half of the
+    backpressure contract. Budget exhausted -> counted rejected+failed,
+    exactly like max_retries=0."""
     import threading
 
     from .fleet.router import FleetSaturated
@@ -210,13 +237,30 @@ def _submit_fleet(fleet, prompt, max_tokens, reqs, events, res):
             SamplingParams(temperature=0.0, max_tokens=max_tokens),
             on_complete=lambda _r, ev=ev: ev.set()))
         events.append(ev)
-    except FleetSaturated:
-        res.rejected += 1
-        res.failed += 1
+    except FleetSaturated as e:
+        if retryq is not None and tries < max_retries:
+            res.retries += 1
+            retryq.append((time.monotonic() + e.retry_after_s, prompt,
+                           tries + 1))
+        else:
+            res.rejected += 1
+            res.failed += 1
+
+
+def _drain_retryq(fleet, retryq, max_tokens, reqs, events, res,
+                  max_retries) -> None:
+    """Resubmit every due Retry-After entry (oldest first)."""
+    now = time.monotonic()
+    due = [x for x in retryq if x[0] <= now]
+    for x in sorted(due):
+        retryq.remove(x)
+        _submit_fleet(fleet, x[1], max_tokens, reqs, events, res,
+                      retryq=retryq, max_retries=max_retries, tries=x[2])
 
 
 def _run_poisson_fleet(fleet, *, offered_rps, num_requests, prompt_len,
-                       max_tokens, seed, vocab_hi, prompt_pool) -> LoadResult:
+                       max_tokens, seed, vocab_hi, prompt_pool,
+                       max_retries=0) -> LoadResult:
     """Open-loop arrivals against a fleet router: replica threads do the
     stepping; the generator only submits on schedule and waits. The
     supervisor is polled inline when no background supervisor runs, so
@@ -229,17 +273,22 @@ def _run_poisson_fleet(fleet, *, offered_rps, num_requests, prompt_len,
             for _ in range(max(prompt_pool, 1))]
     reqs: list[Request] = []
     events: list = []
+    retryq: list = []
     res = LoadResult(offered_rps=offered_rps)
     supervised = fleet.supervisor._thread is not None
     t0 = time.monotonic()
     i = 0
-    while i < num_requests or not all(e.is_set() for e in events):
+    while i < num_requests or retryq \
+            or not all(e.is_set() for e in events):
         now = time.monotonic() - t0
         while i < num_requests and arrivals[i] <= now:
             prompt = (pool[int(rng.integers(len(pool)))] if prompt_pool
                       else rng.integers(1, hi, size=prompt_len).tolist())
-            _submit_fleet(fleet, prompt, max_tokens, reqs, events, res)
+            _submit_fleet(fleet, prompt, max_tokens, reqs, events, res,
+                          retryq=retryq, max_retries=max_retries)
             i += 1
+        _drain_retryq(fleet, retryq, max_tokens, reqs, events, res,
+                      max_retries)
         res.queue_peak = max(res.queue_peak, fleet.router.pending_total())
         if not supervised:
             fleet.supervisor.poll_once()
@@ -248,23 +297,29 @@ def _run_poisson_fleet(fleet, *, offered_rps, num_requests, prompt_len,
 
 
 def _run_closed_loop_fleet(fleet, *, concurrency, num_requests, prompt_len,
-                           max_tokens, seed, vocab_hi) -> LoadResult:
+                           max_tokens, seed, vocab_hi,
+                           max_retries=0) -> LoadResult:
     rng = np.random.default_rng(seed)
     hi = vocab_hi or fleet.model_cfg.vocab_size
     reqs: list[Request] = []
     events: list = []
+    retryq: list = []
     res = LoadResult(offered_rps=float("inf"))
     supervised = fleet.supervisor._thread is not None
     submitted = 0
     t0 = time.monotonic()
-    while submitted < num_requests or not all(e.is_set() for e in events):
+    while submitted < num_requests or retryq \
+            or not all(e.is_set() for e in events):
         in_flight = sum(1 for e in events if not e.is_set())
         while submitted < num_requests and in_flight < concurrency:
             _submit_fleet(fleet,
                           rng.integers(1, hi, size=prompt_len).tolist(),
-                          max_tokens, reqs, events, res)
+                          max_tokens, reqs, events, res,
+                          retryq=retryq, max_retries=max_retries)
             submitted += 1
             in_flight += 1
+        _drain_retryq(fleet, retryq, max_tokens, reqs, events, res,
+                      max_retries)
         res.queue_peak = max(res.queue_peak, fleet.router.pending_total())
         if not supervised:
             fleet.supervisor.poll_once()
@@ -275,7 +330,7 @@ def _run_closed_loop_fleet(fleet, *, concurrency, num_requests, prompt_len,
 def run_poisson(engine: InferenceEngine, *, offered_rps: float,
                 num_requests: int, prompt_len: int, max_tokens: int,
                 seed: int = 0, vocab_hi: Optional[int] = None,
-                prompt_pool: int = 0,
+                prompt_pool: int = 0, max_retries: int = 0,
                 device_times: bool = False) -> LoadResult:
     """Open-loop run: arrivals follow a seeded Poisson process regardless of
     engine progress; steps until everything admitted drains.
@@ -283,6 +338,10 @@ def run_poisson(engine: InferenceEngine, *, offered_rps: float,
     ``engine`` may also be a fleet (serve.fleet.ServeFleet): submissions go
     through the router, replica threads do the stepping, and the result
     carries the per-replica breakdown (+429 rejections count as failed).
+    ``max_retries > 0`` honors Retry-After on fleet 429s — capped
+    resubmission, so saturation sweeps measure goodput under backpressure
+    instead of counting backpressure as failure (default 0 keeps
+    rejections final). Ignored for plain engines (no 429 path).
 
     ``prompt_pool > 0`` draws prompts from that many distinct prompts
     (prefix-cache-friendly workloads); 0 = every prompt unique."""
@@ -290,7 +349,8 @@ def run_poisson(engine: InferenceEngine, *, offered_rps: float,
         return _run_poisson_fleet(
             engine, offered_rps=offered_rps, num_requests=num_requests,
             prompt_len=prompt_len, max_tokens=max_tokens, seed=seed,
-            vocab_hi=vocab_hi, prompt_pool=prompt_pool)
+            vocab_hi=vocab_hi, prompt_pool=prompt_pool,
+            max_retries=max_retries)
     rng = np.random.default_rng(seed)
     hi = vocab_hi or engine.cfg.vocab_size
     gaps = rng.exponential(1.0 / offered_rps, size=num_requests)
@@ -331,15 +391,17 @@ def run_poisson(engine: InferenceEngine, *, offered_rps: float,
 def run_closed_loop(engine: InferenceEngine, *, concurrency: int,
                     num_requests: int, prompt_len: int, max_tokens: int,
                     seed: int = 0, vocab_hi: Optional[int] = None,
+                    max_retries: int = 0,
                     device_times: bool = False) -> LoadResult:
     """Closed-loop run: keep ``concurrency`` requests in flight (a new one
     arrives the moment one finishes) — the standard saturation probe.
-    Fleet targets route through the router like run_poisson."""
+    Fleet targets route through the router like run_poisson; see there for
+    ``max_retries`` (Retry-After honoring)."""
     if _is_fleet(engine):
         return _run_closed_loop_fleet(
             engine, concurrency=concurrency, num_requests=num_requests,
             prompt_len=prompt_len, max_tokens=max_tokens, seed=seed,
-            vocab_hi=vocab_hi)
+            vocab_hi=vocab_hi, max_retries=max_retries)
     rng = np.random.default_rng(seed)
     hi = vocab_hi or engine.cfg.vocab_size
     reqs: list[Request] = []

@@ -63,8 +63,16 @@ class Request:
     assigned_seed: Optional[int] = None
     preemptions: int = 0
     # preemption=swap: the evicted slot's KV pages + decode cursor, held
-    # in host memory until readmission (engine._preempt/_restore_swapped)
+    # in host memory until readmission (engine._preempt/_restore_swapped).
+    # Cross-replica migration (serve/fleet/migration.py) reuses the same
+    # schema: the destination replica restores the pages through the
+    # engine's swap-in path — zero re-prefill.
     swapped_kv: Optional[dict] = field(default=None, repr=False)
+    # set by the fleet's reset_for_requeue: this request crossed replicas
+    # (crash/drain/migration). The engine credits prefix-cache hits on
+    # such requests to the fleet's reprefill_tokens_avoided metric — the
+    # warm-prefix payoff of routing orphans through the affinity ring.
+    fleet_requeued: bool = False
     arrival_time: float = field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None   # for TTFT
     # when the engine dispatched this request's prefill (host clock, no

@@ -61,7 +61,7 @@ def ref_engine(model_cfg):
 
 
 def make_fleet(model_cfg, params, *, replicas=2, plan=None, fleet_kw=None,
-               serve_kw=None) -> ServeFleet:
+               serve_kw=None, warm=False) -> ServeFleet:
     fc_kw = dict(replicas=replicas, affinity_prefix_tokens=0,
                  restart_backoff_s=0.05, probe_interval_s=0.05)
     fc_kw.update(fleet_kw or {})
@@ -69,6 +69,14 @@ def make_fleet(model_cfg, params, *, replicas=2, plan=None, fleet_kw=None,
     fleet = ServeFleet(model_cfg, serve_cfg(**(serve_kw or {})), fc,
                        params=params, fault_plan=plan, supervise=False,
                        seed=0)
+    if warm:
+        # compile every replica's programs BEFORE the engine threads run:
+        # migration scenarios must interrupt sequences mid-DECODE, and an
+        # un-warmed replica spends its first seconds compiling while its
+        # sibling races ahead
+        for r in fleet.replicas:
+            r.engine.generate([[1, 2, 3]],
+                              SamplingParams(temperature=0.0, max_tokens=4))
     fleet.start()
     return fleet
 
@@ -223,6 +231,225 @@ class TestDrain:
             fleet.shutdown()
 
 
+class TestMigration:
+    """Cross-replica KV migration (serve/fleet/migration.py): sequences
+    move WITH their pages — zero re-prefill, token-identical resume —
+    and every failure mode degrades to the PR-2 requeue path."""
+
+    def _submit(self, fleet, prompts, sampling):
+        events, reqs = [], []
+        for p in prompts:
+            ev = threading.Event()
+            reqs.append(fleet.submit(
+                p, sampling, on_complete=lambda _r, ev=ev: ev.set()))
+            events.append(ev)
+        return reqs, events
+
+    def _await_all(self, fleet, events, timeout=240.0):
+        deadline = time.monotonic() + timeout
+        while not all(e.is_set() for e in events):
+            fleet.supervisor.poll_once()
+            time.sleep(0.005)
+            assert time.monotonic() < deadline, "migration test hung"
+
+    def _wait_decoding(self, reqs, events, n_tokens=2, timeout=120.0,
+                      mode=all):
+        deadline = time.monotonic() + timeout
+        while not mode(len(r.generated_tokens) >= n_tokens or e.is_set()
+                       for r, e in zip(reqs, events)):
+            time.sleep(0.002)
+            assert time.monotonic() < deadline
+
+    def test_drain_migration_zero_reprefill_token_identical(
+            self, model_cfg, ref_engine):
+        """Acceptance criterion: drain-with-migration emits ZERO re-prefill
+        tokens for migrated sequences (engine total_prefill_tokens is flat
+        across the drain) and output is token-identical to an undisturbed
+        run."""
+        greedy = SamplingParams(temperature=0.0, max_tokens=48)
+        ref = [r.generated_tokens
+               for r in ref_engine.generate(PROMPTS[:4], greedy)]
+        fleet = make_fleet(model_cfg, ref_engine.params, warm=True)
+        try:
+            reqs, events = self._submit(fleet, PROMPTS[:4], greedy)
+            self._wait_decoding(reqs, events)
+            pre = sum(rep.engine.total_prefill_tokens
+                      for rep in fleet.replicas)
+            assert fleet.drain(0)
+            self._await_all(fleet, events)
+            post = sum(rep.engine.total_prefill_tokens
+                       for rep in fleet.replicas)
+            assert [r.generated_tokens for r in reqs] == ref
+            assert post == pre, (
+                f"drain-with-migration re-prefilled: {pre} -> {post}")
+            snap = fleet.status()
+            assert snap["migration"]["migrations"] >= 1
+            assert snap["migration"]["migrated_tokens"] > 0
+            assert snap["migration"]["reprefill_tokens_avoided"] > 0
+            assert snap["migration"]["by_reason"].get("drain", 0) >= 1
+            st = fleet.router.stats()
+            assert st["completed"] == 4
+            assert st["completed"] + st["failed"] + st["rejected"] \
+                == st["submitted"]
+        finally:
+            fleet.shutdown()
+
+    def test_migration_token_identity_seeded_sampling(
+            self, model_cfg, ref_engine):
+        """Operator-path migration (fleet.migrate) mid-decode under
+        temperature>0 sampling: the restored sequence continues the same
+        position-folded PRNG stream on the destination — bit-identical
+        output, no re-prefill for the migrated sequence."""
+        sampled = SamplingParams(temperature=0.9, top_k=16, max_tokens=48,
+                                 seed=4321)
+        ref = [r.generated_tokens
+               for r in ref_engine.generate([PROMPTS[0]], sampled)]
+        fleet = make_fleet(model_cfg, ref_engine.params, warm=True)
+        try:
+            reqs, events = self._submit(fleet, [PROMPTS[0]], sampled)
+            self._wait_decoding(reqs, events, n_tokens=4)
+            src = fleet.router.replica_of(reqs[0].request_id)
+            dest = 1 - src
+            assert fleet.migrate(reqs[0].request_id, dest)
+            self._await_all(fleet, events)
+            assert reqs[0].generated_tokens == ref[0]
+            snap = fleet.status()
+            assert snap["migration"]["by_reason"].get("operator", 0) == 1
+            # the sequence landed (and finished) on the destination
+            assert fleet.router.stats()["migrations"] == 1
+        finally:
+            fleet.shutdown()
+
+    def test_crash_during_migration_falls_back_to_requeue(
+            self, model_cfg, ref_engine):
+        """FaultInjector crash racing an in-flight migration: the ticket
+        dies with the engine, the victim falls back to plain requeue
+        (re-prefill), and the ledger still balances — nothing dropped,
+        output still token-identical."""
+        greedy = SamplingParams(temperature=0.0, max_tokens=24)
+        ref = [r.generated_tokens
+               for r in ref_engine.generate(PROMPTS, greedy)]
+        plan = FaultPlan(crash_replica=0, crash_after_steps=4)
+        fleet = make_fleet(model_cfg, ref_engine.params, plan=plan,
+                           warm=True)
+        try:
+            reqs, events = self._submit(fleet, PROMPTS, greedy)
+            self._wait_decoding(reqs, events, n_tokens=1, mode=any)
+            # start a migration off replica 0 just before its planned
+            # crash; whether the crash lands between the copy phases or
+            # just after, every invariant below must hold
+            for req in reqs:
+                if fleet.router.replica_of(req.request_id) == 0 \
+                        and not req.generated_tokens:
+                    continue
+                if fleet.router.replica_of(req.request_id) == 0:
+                    fleet.replicas[0].request_migrate(req.request_id,
+                                                      dest=1)
+                    break
+            self._await_all(fleet, events)
+            st = fleet.router.stats()
+            assert [r.generated_tokens for r in reqs] == ref
+            assert st["completed"] == len(PROMPTS)
+            assert st["completed"] + st["failed"] + st["rejected"] \
+                == st["submitted"]
+            assert st["in_flight"] == 0
+            assert fleet.replicas[0].migrations_in_flight() == 0
+        finally:
+            fleet.shutdown()
+
+    def test_two_phase_pause_bounded_with_straggler_source(
+            self, model_cfg, ref_engine):
+        """The stop-and-copy pause covers only the pages written since the
+        pre-copy — asserted structurally on a straggler-injected source
+        (slow decode must not widen the stop phase, which is the point of
+        pre-copying while the source keeps decoding)."""
+        greedy = SamplingParams(temperature=0.0, max_tokens=64)
+        ref = [r.generated_tokens
+               for r in ref_engine.generate([PROMPTS[0]], greedy)]
+        plan = FaultPlan(slow_replica=0, slow_ms=20.0)
+        fleet = make_fleet(model_cfg, ref_engine.params, plan=plan,
+                           warm=True)
+        try:
+            reqs, events = self._submit(fleet, [PROMPTS[0]], greedy)
+            # replica 0 is the least-loaded tiebreak winner -> our victim
+            assert fleet.router.replica_of(reqs[0].request_id) == 0
+            self._wait_decoding(reqs, events, n_tokens=18)
+            assert fleet.replicas[0].request_migrate(
+                reqs[0].request_id, dest=1, reason="rebalance")
+            self._await_all(fleet, events)
+            assert reqs[0].generated_tokens == ref[0]
+            log = list(fleet.replicas[0].migration_log)
+            assert len(log) == 1, log
+            d = log[0]
+            # >=18 tokens decoded before the ticket -> >=2 full pages
+            # (page_size 8) pre-copied while decode kept running
+            assert d["precopy_pages"] >= 2, d
+            # the stop phase copied strictly less than the whole sequence:
+            # only the tail written since the pre-copy (bounded by one
+            # decode dispatch + the partial page, NOT by context length)
+            grown = d["positions_stop"] - d["positions_precopy"]
+            ps = fleet.replicas[0].engine.kv.page_size
+            assert d["stop_pages"] < d["total_pages"], d
+            assert d["stop_pages"] <= grown // ps + 2, d
+            assert d["pause_ms"] > 0
+        finally:
+            fleet.shutdown()
+
+    def test_drain_migration_int8_kv_pages(self, model_cfg, ref_engine):
+        """Quantized pages migrate too: the QuantPages {values, scale}
+        payload splits/merges across the two copy phases and restores on
+        the destination bit-identically."""
+        from distributed_llm_training_and_inference_system_tpu.serve import (
+            InferenceEngine)
+        greedy = SamplingParams(temperature=0.0, max_tokens=64)
+        q8_ref = InferenceEngine(model_cfg,
+                                 serve_cfg(kv_quantization="int8"),
+                                 params=ref_engine.params, seed=0)
+        ref = [r.generated_tokens
+               for r in q8_ref.generate([PROMPTS[0]], greedy)]
+        fleet = make_fleet(model_cfg, ref_engine.params, warm=True,
+                           serve_kw={"kv_quantization": "int8"})
+        try:
+            reqs, events = self._submit(fleet, [PROMPTS[0]], greedy)
+            self._wait_decoding(reqs, events, n_tokens=4)
+            src = fleet.router.replica_of(reqs[0].request_id)
+            assert fleet.drain(src)
+            self._await_all(fleet, events)
+            assert reqs[0].generated_tokens == ref[0]
+            logs = [d for r in fleet.replicas for d in r.migration_log]
+            assert len(logs) == 1 and logs[0]["precopy_pages"] >= 1, logs
+        finally:
+            fleet.shutdown()
+
+    def test_orphan_requeue_keeps_prompt_prefix_hashes(
+            self, model_cfg, ref_engine):
+        """Satellite: a crash orphan that never decoded keeps its prompt
+        hashes through reset_for_requeue, so a survivor holding the prefix
+        serves it from cache (counted in reprefill_tokens_avoided via the
+        engine's requeue-cached counter)."""
+        from distributed_llm_training_and_inference_system_tpu.serve.fleet import (  # noqa: E501
+            reset_for_requeue)
+        from distributed_llm_training_and_inference_system_tpu.serve.scheduler import (  # noqa: E501
+            Request)
+        req = Request(request_id="r1", prompt_tokens=list(range(40)),
+                      sampling=SamplingParams(max_tokens=4))
+        req.prefix_hashes = [b"a", b"b"]
+        reset_for_requeue(req)
+        assert req.prefix_hashes == [b"a", b"b"]   # content, not replica
+        assert req.fleet_requeued
+        # once tokens were generated the hashed chain no longer covers the
+        # resume context -> rehashed at admission on the survivor
+        req.generated_tokens = [1, 2]
+        reset_for_requeue(req)
+        assert req.prefix_hashes is None
+        # keep_kv carries a migration payload; default drops it
+        req.swapped_kv = {"pages": {}}
+        reset_for_requeue(req, keep_kv=True)
+        assert req.swapped_kv is not None
+        reset_for_requeue(req)
+        assert req.swapped_kv is None
+
+
 class TestSupervisor:
     def test_probe_timeout_teardown_restart_backoff(
             self, model_cfg, ref_engine):
@@ -362,6 +589,16 @@ class TestFleetHTTP:
         assert rq.post(f"{base}/fleet/drain", json={"replica": 9},
                        timeout=10).status_code == 404
 
+        # migrate surface: unknown replica / unknown request / bad body
+        assert rq.post(f"{base}/fleet/migrate",
+                       json={"request_id": "nope", "replica": 9},
+                       timeout=10).status_code == 404
+        assert rq.post(f"{base}/fleet/migrate",
+                       json={"request_id": "nope", "replica": 1},
+                       timeout=10).status_code == 404
+        assert rq.post(f"{base}/fleet/migrate", json={"replica": 1},
+                       timeout=10).status_code == 400
+
         # contract edges: SSE refused, bad body refused
         assert rq.post(f"{base}/v1/completions",
                        json={"prompt": [1, 2], "stream": True},
@@ -387,11 +624,16 @@ class TestFleetMetrics:
         snap = {
             "replicas": [
                 {"replica": 0, "state": "healthy", "queue_depth": 3,
-                 "active": 2, "outstanding_tokens": 170, "restarts": 1},
+                 "active": 2, "outstanding_tokens": 170, "restarts": 1,
+                 "prefix_hit_rate": 0.75},
                 {"replica": 1, "state": "crashed", "queue_depth": 0,
-                 "active": 0, "outstanding_tokens": 0, "restarts": 0},
+                 "active": 0, "outstanding_tokens": 0, "restarts": 0,
+                 "prefix_hit_rate": 0.0},
             ],
             "router": {"requeues": 5, "rejected": 2},
+            "migration": {"migrations": 2, "migrated_tokens": 300,
+                          "reprefill_tokens_avoided": 123,
+                          "pauses_ms": [1.5, 3.5], "pause_count": 2},
         }
         exporter.export_fleet(snap)
         samples = {}
@@ -407,10 +649,27 @@ class TestFleetMetrics:
         assert samples[("llmctl_fleet_replica_restarts_total", "0")] == 1
         assert samples[("llmctl_fleet_requeues_total", None)] == 5
         assert samples[("llmctl_fleet_rejected_total", None)] == 2
+        # KV-migration plane (this PR): counters, the pause histogram,
+        # and the per-replica prefix-hit-rate gauge
+        assert samples[("llmctl_fleet_migrations_total", None)] == 2
+        assert samples[("llmctl_fleet_migrated_tokens_total", None)] == 300
+        assert samples[
+            ("llmctl_fleet_reprefill_tokens_avoided_total", None)] == 123
+        assert samples[
+            ("llmctl_fleet_migration_pause_ms_count", None)] == 2
+        assert samples[("llmctl_fleet_migration_pause_ms_sum", None)] \
+            == pytest.approx(5.0)
+        assert samples[("llmctl_fleet_replica_prefix_hit_rate", "0")] \
+            == 0.75
         # counters export deltas: a second identical snapshot must not
-        # double-count the running totals
+        # double-count the running totals (incl. the pause histogram)
         exporter.export_fleet(snap)
         for metric in prometheus_client.REGISTRY.collect():
             for s in metric.samples:
-                if s.name == "llmctl_fleet_requeues_total":
-                    assert s.value == 5
+                if s.name in ("llmctl_fleet_requeues_total",
+                              "llmctl_fleet_migrations_total"):
+                    assert s.value == {"llmctl_fleet_requeues_total": 5,
+                                       "llmctl_fleet_migrations_total": 2}[
+                                           s.name]
+                if s.name == "llmctl_fleet_migration_pause_ms_count":
+                    assert s.value == 2

@@ -39,6 +39,19 @@ class FakeReplica:
         self.load = load
         self.queue: list = []
         self.up = True
+        self.state = "healthy"
+        # migration duck surface (supervisor rebalancer + courier)
+        self.residents: list = []          # (request_id, remaining_tokens)
+        self.migrate_calls: list = []      # (request_id, dest, reason)
+        self.accept_migrations = True
+        self.in_flight_migrations = 0
+        self.migrations_out = 0
+        self.migrated_tokens = 0
+        self.reprefill_avoided_tokens = 0
+        self.migrations_by_reason: dict = {}
+        self.migration_pauses_ms: list = []
+        self.restarts = 0
+        self.last_error = None
 
     def accepting(self):
         return self.up
@@ -52,10 +65,37 @@ class FakeReplica:
     def queue_depth(self):
         return len(self.queue)
 
+    def active_count(self):
+        return len(self.residents)
+
     def outstanding_tokens(self):
         return self.load + sum(
             len(r.prompt_tokens) + r.sampling.max_tokens
             for r in self.queue)
+
+    def resident_requests(self):
+        return list(self.residents)
+
+    def request_migrate(self, request_id, dest=None, reason="operator"):
+        if not self.accept_migrations:
+            return False
+        self.migrate_calls.append((request_id, dest, reason))
+        return True
+
+    def migrations_in_flight(self):
+        return self.in_flight_migrations
+
+    def take_migrated(self):
+        return []
+
+    def take_orphans(self):
+        return []
+
+    def probe(self):
+        return {"replica": self.replica_id}
+
+    def prefix_cache_stats(self):
+        return 0, 0, 0
 
 
 def make_router(n=3, cfg=None, **fake_kw):
@@ -252,6 +292,191 @@ class TestRequeue:
         assert req.fleet_meta["replica"] == 0
 
 
+class TestMigrationPlacement:
+    def test_place_migrated_prefers_dest_hint(self):
+        router, reps = make_router(3)
+        req = router.submit([1, 2], SamplingParams(max_tokens=4))
+        for r in reps:
+            if req in r.queue:
+                r.queue.remove(req)        # "migrated out" of its source
+        # hint replica 2 even though 1 is less loaded
+        reps[1].load, reps[2].load = 0, 900
+        assert router.place_migrated(req, from_replica=0, dest=2)
+        assert req in reps[2].queue
+        assert router.stats()["migrations"] == 1
+        # a migration is voluntary: the requeue budget is untouched
+        assert router.stats()["requeues"] == 0
+
+    def test_place_migrated_falls_back_when_dest_down(self):
+        router, reps = make_router(3)
+        req = router.submit([1, 2], SamplingParams(max_tokens=4))
+        for r in reps:
+            if req in r.queue:
+                r.queue.remove(req)
+        reps[2].up = False
+        assert router.place_migrated(req, from_replica=0, dest=2)
+        assert req in reps[1].queue       # not source, not the dead dest
+
+    def test_place_migrated_parks_without_healthy_replica(self):
+        router, reps = make_router(2)
+        req = router.submit([1, 2], SamplingParams(max_tokens=4))
+        for r in reps:
+            if req in r.queue:
+                r.queue.remove(req)
+        for r in reps:
+            r.up = False
+        assert not router.place_migrated(req, from_replica=0, dest=1)
+        assert router.stats()["parked"] == 1
+        reps[1].up = True
+        assert router.flush_parked() == 1
+        assert req in reps[1].queue
+
+    def test_requeue_preserves_migration_payload(self):
+        """Drain victims under migrate_on_drain travel with swapped_kv;
+        the router's requeue must not strip it (the replica side decides
+        payload presence)."""
+        router, reps = make_router(2)
+        req = router.submit([1, 2], SamplingParams(max_tokens=4))
+        src = next(r for r in reps if req in r.queue)
+        src.queue.remove(req)
+        req.swapped_kv = {"pages": {"num_pages": 1}}
+        assert router.requeue([req], from_replica=src.replica_id) == 1
+        assert req.swapped_kv is not None
+
+
+class TestRebalancer:
+    def _supervisor(self, n=2, **cfg_kw):
+        from distributed_llm_training_and_inference_system_tpu.serve.fleet.supervisor import (  # noqa: E501
+            ReplicaSupervisor)
+        kw = dict(replicas=n, affinity_prefix_tokens=0,
+                  rebalance_imbalance_ratio=0.5,
+                  rebalance_poll_hysteresis=2,
+                  max_concurrent_migrations=2)
+        kw.update(cfg_kw)
+        cfg = FleetConfig(**kw)
+        reps = [FakeReplica(i) for i in range(n)]
+        router = FleetRouter(reps, cfg)
+        return ReplicaSupervisor(reps, router, cfg), reps
+
+    def test_hysteresis_then_migrate_hot_to_cold(self):
+        sup, reps = self._supervisor()
+        reps[0].load = 1000
+        reps[0].residents = [("short", 5), ("long", 40)]
+        sup.poll_once()                     # streak 1: no move yet
+        assert reps[0].migrate_calls == []
+        sup.poll_once()                     # streak 2 = hysteresis -> move
+        # longest-remaining first, destined for the coldest replica
+        assert reps[0].migrate_calls[0] == ("long", 1, "rebalance")
+        assert sup.total_rebalance_migrations >= 1
+
+    def test_balanced_load_resets_streak(self):
+        sup, reps = self._supervisor()
+        reps[0].load = 1000
+        reps[0].residents = [("a", 10)]
+        sup.poll_once()                     # streak 1
+        reps[1].load = 1000                 # balance restored
+        sup.poll_once()                     # streak resets
+        reps[1].load = 0
+        sup.poll_once()                     # streak 1 again
+        assert reps[0].migrate_calls == []
+
+    def test_respects_max_concurrent_migrations(self):
+        sup, reps = self._supervisor(max_concurrent_migrations=1)
+        reps[0].load = 1000
+        reps[0].residents = [("a", 10), ("b", 20)]
+        reps[1].in_flight_migrations = 1    # budget already spent
+        sup.poll_once()
+        sup.poll_once()
+        assert reps[0].migrate_calls == []
+        reps[1].in_flight_migrations = 0
+        sup.poll_once()
+        sup.poll_once()
+        assert len(reps[0].migrate_calls) == 1   # bounded, longest first
+        assert reps[0].migrate_calls[0][0] == "b"
+
+    def test_disabled_by_default(self):
+        sup, reps = self._supervisor(rebalance_imbalance_ratio=0.0)
+        reps[0].load = 10_000
+        reps[0].residents = [("a", 10)]
+        for _ in range(5):
+            sup.poll_once()
+        assert reps[0].migrate_calls == []
+
+    def test_operator_migrate_resolves_source_from_ledger(self):
+        sup, reps = self._supervisor()
+        router = sup.router
+        req = router.submit([1, 2], SamplingParams(max_tokens=4))
+        src = next(r for r in reps if req in r.queue)
+        other = next(r for r in reps if r is not src)
+        assert sup.migrate(req.request_id, other.replica_id)
+        assert src.migrate_calls == [
+            (req.request_id, other.replica_id, "operator")]
+        # unknown request / unknown dest / same-replica are refused
+        assert not sup.migrate("nope", other.replica_id)
+        assert not sup.migrate(req.request_id, 99)
+        assert not sup.migrate(req.request_id, src.replica_id)
+
+
+class TestLoadgenRetryAfter:
+    class _SatFleet:
+        """Duck fleet for _submit_fleet: saturates N times, then accepts."""
+
+        def __init__(self, fail_times, retry_after_s=0.0):
+            from distributed_llm_training_and_inference_system_tpu.serve.fleet.router import (  # noqa: E501
+                FleetSaturated)
+            self._exc = FleetSaturated
+            self.fail_times = fail_times
+            self.retry_after_s = retry_after_s
+            self.accepted: list = []
+
+        def submit(self, prompt, sampling, on_complete=None):
+            if self.fail_times > 0:
+                self.fail_times -= 1
+                raise self._exc("saturated", self.retry_after_s)
+            req = Request(request_id=f"ok-{len(self.accepted)}",
+                          prompt_tokens=list(prompt), sampling=sampling)
+            self.accepted.append(req)
+            return req
+
+    def test_default_counts_rejection_as_failure(self):
+        from distributed_llm_training_and_inference_system_tpu.serve.loadgen import (  # noqa: E501
+            LoadResult, _submit_fleet)
+        fleet = self._SatFleet(fail_times=1)
+        res = LoadResult(offered_rps=1.0)
+        reqs, events, retryq = [], [], []
+        _submit_fleet(fleet, [1, 2], 4, reqs, events, res, retryq=retryq,
+                      max_retries=0)
+        assert res.rejected == 1 and res.failed == 1
+        assert retryq == [] and res.retries == 0
+
+    def test_retry_after_honored_until_success(self):
+        from distributed_llm_training_and_inference_system_tpu.serve.loadgen import (  # noqa: E501
+            LoadResult, _drain_retryq, _submit_fleet)
+        fleet = self._SatFleet(fail_times=2)
+        res = LoadResult(offered_rps=1.0)
+        reqs, events, retryq = [], [], []
+        _submit_fleet(fleet, [1, 2], 4, reqs, events, res, retryq=retryq,
+                      max_retries=3)
+        assert res.retries == 1 and len(retryq) == 1
+        _drain_retryq(fleet, retryq, 4, reqs, events, res, 3)  # 2nd 429
+        assert res.retries == 2 and len(retryq) == 1
+        _drain_retryq(fleet, retryq, 4, reqs, events, res, 3)  # accepted
+        assert retryq == [] and len(reqs) == 1
+        assert res.rejected == 0 and res.failed == 0
+
+    def test_retry_budget_exhausted_counts_rejected(self):
+        from distributed_llm_training_and_inference_system_tpu.serve.loadgen import (  # noqa: E501
+            LoadResult, _drain_retryq, _submit_fleet)
+        fleet = self._SatFleet(fail_times=10)
+        res = LoadResult(offered_rps=1.0)
+        reqs, events, retryq = [], [], []
+        _submit_fleet(fleet, [1, 2], 4, reqs, events, res, retryq=retryq,
+                      max_retries=1)
+        _drain_retryq(fleet, retryq, 4, reqs, events, res, 1)
+        assert retryq == []
+        assert res.retries == 1 and res.rejected == 1 and res.failed == 1
+
+
 class TestFaults:
     def test_crash_fires_once_at_exact_step(self):
         inj = FaultInjector(FaultPlan(crash_replica=1, crash_after_steps=3))
@@ -297,11 +522,20 @@ class TestFleetConfig:
     @pytest.mark.parametrize("bad", [
         {"replicas": 0}, {"probe_interval_s": 0}, {"probe_failures": 0},
         {"affinity_vnodes": 0}, {"max_pending": 0}, {"max_requeues": -1},
-        {"restart_backoff_s": -1.0},
+        {"restart_backoff_s": -1.0}, {"rebalance_imbalance_ratio": 1.5},
+        {"rebalance_imbalance_ratio": -0.1},
+        {"rebalance_poll_hysteresis": 0},
+        {"max_concurrent_migrations": 0},
     ])
     def test_validation_rejects(self, bad):
         with pytest.raises(ConfigError):
             FleetConfig.from_dict(bad)
+
+    def test_from_dict_parses_bool_strings(self):
+        assert FleetConfig.from_dict(
+            {"migrate_on_drain": "false"}).migrate_on_drain is False
+        assert FleetConfig.from_dict(
+            {"migrate_on_drain": "true"}).migrate_on_drain is True
 
     def test_prefix_digest_stable(self):
         assert prefix_digest([1, 2, 3, 4, 5], 3) == \

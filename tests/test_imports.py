@@ -1,0 +1,45 @@
+"""Import every module in the package (fast tier).
+
+The CLI builds its command tree lazily (click groups import subcommand
+modules on first use), so tier-1 only exercises the commands a test
+happens to invoke — a syntax error or import cycle in a rarely-touched
+module ships silently. This walk imports EVERY module under the package
+so such regressions fail here, not in an operator's terminal.
+
+Third-party deps that are genuinely optional in this container (exporter
+backends, cloud storage clients) skip rather than fail; a missing
+*internal* module is always a hard failure.
+"""
+
+import importlib
+import pkgutil
+
+import pytest
+
+import distributed_llm_training_and_inference_system_tpu as pkg
+
+PKG = pkg.__name__
+
+MODULES = sorted(
+    m.name for m in pkgutil.walk_packages(pkg.__path__, PKG + "."))
+
+
+def test_walk_found_the_tree():
+    # sanity: the walk actually saw the package (a broken __path__ would
+    # make the parametrized test below vacuously green)
+    assert len(MODULES) > 40
+    for expected in (f"{PKG}.serve.fleet.migration",
+                     f"{PKG}.cli.commands.fleet",
+                     f"{PKG}.metrics.observability"):
+        assert expected in MODULES
+
+
+@pytest.mark.parametrize("name", MODULES)
+def test_module_imports(name):
+    try:
+        importlib.import_module(name)
+    except ModuleNotFoundError as e:
+        root = (e.name or "").split(".")[0]
+        if root and root != PKG.split(".")[0]:
+            pytest.skip(f"optional third-party dep missing: {root}")
+        raise
