@@ -167,10 +167,18 @@ def kernels(op, seq_len, hidden, heads, batch):
                    "engine replicas through the serve/fleet router "
                    "instead of one engine; results gain the per-replica "
                    "requests/p99-TTFT/requeue breakdown.")
+@click.option("--serve-disagg/--no-serve-disagg", default=False,
+              show_default=True,
+              help="serve-load fleet: disaggregated prefill/decode — the "
+                   "first half of --serve-replicas take the prefill role, "
+                   "the rest decode, and every sequence crosses the KV "
+                   "handoff courier; results gain the per-phase TTFT/ITL "
+                   "breakdown with handoff counts + stall percentiles.")
 def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
         requests, rps, concurrency, admission, kv_blocks, device_times,
         preemption, latency_dispatch_steps, artifact, quant, kv_quant,
-        slots, pipelined, int8_pallas, serve_max_retries, serve_replicas):
+        slots, pipelined, int8_pallas, serve_max_retries, serve_replicas,
+        serve_disagg):
     """End-to-end train step throughput / serve TTFT+throughput
     (parity: reference bench.py:35-49). ``serve-load`` runs open-loop
     (Poisson) and closed-loop sweeps with p50/p99 TTFT, per-token latency,
@@ -290,8 +298,14 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
                 last_engine.pop().shutdown()
                 gc.collect()
                 jax.clear_caches()
+            fc_kw = dict(replicas=serve_replicas)
+            if serve_disagg and serve_replicas >= 2:
+                n_pre = max(serve_replicas // 2, 1)
+                fc_kw["roles"] = ",".join(
+                    ["prefill"] * n_pre
+                    + ["decode"] * (serve_replicas - n_pre))
             fleet = ServeFleet(cfg, point_serve_cfg(),
-                               FleetConfig(replicas=serve_replicas))
+                               FleetConfig(**fc_kw))
             for r in fleet.replicas:
                 r.engine.generate([list(range(1, prompt_len + 1))],
                                   SamplingParams(temperature=0.0,

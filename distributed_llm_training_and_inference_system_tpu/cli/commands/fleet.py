@@ -60,8 +60,9 @@ def status(url, as_json):
     from rich.console import Console
     from rich.table import Table
     table = Table(title="Fleet replicas")
-    for col in ("replica", "state", "queue", "active", "outstanding tok",
-                "restarts", "migr out", "prefix hit", "last error"):
+    for col in ("replica", "state", "role", "queue", "active",
+                "outstanding tok", "restarts", "migr out", "handoffs",
+                "prefix hit", "last error"):
         table.add_column(col)
     for r in snap["replicas"]:
         color = {"healthy": "green", "draining": "yellow",
@@ -69,9 +70,11 @@ def status(url, as_json):
         hit = r.get("prefix_hit_rate")
         table.add_row(str(r["replica"]),
                       f"[{color}]{r['state']}[/{color}]",
+                      r.get("role", "mixed"),
                       str(r["queue_depth"]), str(r["active"]),
                       str(r["outstanding_tokens"]), str(r["restarts"]),
                       str(r.get("migrations", 0)),
+                      str(r.get("handoffs", 0)),
                       f"{hit:.0%}" if hit is not None else "-",
                       (r.get("last_error") or "")[:48])
     console = Console()
@@ -88,6 +91,15 @@ def status(url, as_json):
             f"({mig['migrated_tokens']} KV tokens, "
             f"{mig['reprefill_tokens_avoided']} re-prefill tokens "
             f"avoided, {mig['in_flight']} in flight)")
+    ho = snap.get("handoff")
+    if ho and (ho.get("handoffs") or ho.get("local_fallbacks")
+               or ho.get("reroles") or ho.get("promotions")):
+        console.print(
+            f"disagg: {ho.get('handoffs', 0)} prefill->decode handoffs "
+            f"({ho.get('handoff_tokens', 0)} KV tokens, "
+            f"{ho.get('local_fallbacks', 0)} local fallbacks, "
+            f"{ho.get('reroles', 0)} re-roles, "
+            f"{ho.get('promotions', 0)} promotions)")
 
 
 @app.command()
@@ -114,6 +126,24 @@ def undrain(replica, url):
     except Exception as e:
         _die(e)
     click.echo(f"replica {out['replica']}: back in rotation")
+
+
+@app.command()
+@click.argument("replica", type=int)
+@click.argument("role", type=click.Choice(["prefill", "decode", "mixed"]))
+@click.option("--url", default="http://127.0.0.1:8080", show_default=True)
+def role(replica, role, url):
+    """Re-role REPLICA for disaggregated prefill/decode serving. A
+    prefill replica admits new prompts and hands each freshly-prefilled
+    sequence (with its KV) to a decode replica; decode replicas only
+    restore and decode; mixed does both. Drain the replica first if the
+    switch must be loss-free for its residents."""
+    try:
+        out = _post(f"{url.rstrip('/')}/fleet/role",
+                    {"replica": replica, "role": role})
+    except Exception as e:
+        _die(e)
+    click.echo(f"replica {out['replica']}: role set to {out['role']}")
 
 
 @app.command()

@@ -136,6 +136,19 @@ def app(ctx):
 @click.option("--fleet-max-migrations", default=2, show_default=True,
               type=int,
               help="Concurrently in-flight KV migrations, fleet-wide.")
+@click.option("--fleet-roles", default="", show_default=True,
+              help="Disaggregated prefill/decode: comma-separated "
+                   "per-replica roles (prefill|decode|mixed), e.g. "
+                   "'prefill,decode'. Prefill replicas hand each "
+                   "freshly-prefilled sequence (with its KV) to a decode "
+                   "replica — long prompts stop stalling co-resident "
+                   "decode streams. Empty = every replica mixed.")
+@click.option("--fleet-role-balance-ratio", default=0.0, show_default=True,
+              type=float,
+              help="Re-role replicas when one phase's per-replica queue "
+                   "depth exceeds this multiple of the other's for "
+                   "consecutive supervisor polls (drain-with-migration "
+                   "first, so nothing is lost); 0 disables.")
 def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
           kv_block_size, kv_hbm_gb, scheduler, dtype, prometheus_port,
           speculative, spec_tokens, prefix_cache, tensor_parallel,
@@ -145,7 +158,7 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
           fleet_probe_interval, fleet_restart_backoff,
           fleet_affinity_tokens, fleet_migrate_on_drain,
           fleet_rebalance_ratio, fleet_rebalance_hysteresis,
-          fleet_max_migrations):
+          fleet_max_migrations, fleet_roles, fleet_role_balance_ratio):
     """Start the OpenAI-compatible inference server."""
     import jax
 
@@ -183,7 +196,9 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
             migrate_on_drain=fleet_migrate_on_drain,
             rebalance_imbalance_ratio=fleet_rebalance_ratio,
             rebalance_poll_hysteresis=fleet_rebalance_hysteresis,
-            max_concurrent_migrations=fleet_max_migrations)
+            max_concurrent_migrations=fleet_max_migrations,
+            roles=fleet_roles,
+            role_balance_ratio=fleet_role_balance_ratio)
         fleet_cfg.validate()
 
     observer = None

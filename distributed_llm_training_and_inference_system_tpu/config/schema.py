@@ -772,6 +772,38 @@ class FleetConfig:
     # holds a host-side page copy and steals a source step boundary, so
     # unbounded migration under churn would thrash instead of balance
     max_concurrent_migrations: int = 2
+    # -- disaggregated prefill/decode (DistServe/Splitwise — PAPERS.md) ------
+    # comma-separated per-replica roles, e.g. "prefill,decode" (must name
+    # one role per replica). Empty = every replica "mixed" (classic
+    # fleet). New requests route only to prefill-capable replicas
+    # (prefill|mixed); when a prefill-role replica finishes a prompt's
+    # prefill, the sequence leaves WITH its KV over the migration courier
+    # to the least-outstanding-tokens decode-capable replica — the
+    # degenerate one-phase migration (every page full and immutable) —
+    # and decodes there with zero prefill compute. When no decode pool
+    # has room the source decodes locally instead (handoff is an
+    # optimization, never a correctness dependency). Needs at least one
+    # prefill-capable replica: a decode-only fleet could admit nothing.
+    roles: str = ""
+    # role balancer: when the average prefill-replica queue depth exceeds
+    # ratio * (average decode-replica queue depth + 1) for `hysteresis`
+    # consecutive supervisor polls — or vice versa (decode-slot pressure
+    # shows up as handoff backlog in decode queues: handoffs only queue
+    # when every slot is busy) — the least-loaded replica of the
+    # over-provisioned class is drained (with migration, so its
+    # residents move out losslessly) and re-roled. 0 disables. The
+    # floors keep at least this many replicas per role class so the
+    # balancer can never starve a phase entirely.
+    role_balance_ratio: float = 0.0
+    role_balance_poll_hysteresis: int = 3
+    role_min_prefill: int = 1
+    role_min_decode: int = 1
+
+    def role_list(self) -> list[str]:
+        """Per-replica role assignment; empty config = all mixed."""
+        if not self.roles:
+            return ["mixed"] * self.replicas
+        return [s.strip().lower() for s in self.roles.split(",")]
 
     def validate(self) -> None:
         if self.replicas < 1:
@@ -797,6 +829,28 @@ class FleetConfig:
             raise ConfigError("rebalance_poll_hysteresis must be >= 1")
         if self.max_concurrent_migrations < 1:
             raise ConfigError("max_concurrent_migrations must be >= 1")
+        if self.roles:
+            rl = self.role_list()
+            if len(rl) != self.replicas:
+                raise ConfigError(
+                    f"fleet roles names {len(rl)} replicas but the fleet "
+                    f"has {self.replicas}")
+            bad = sorted(set(rl) - {"prefill", "decode", "mixed"})
+            if bad:
+                raise ConfigError(
+                    f"unknown fleet role(s) {bad}; each must be "
+                    "prefill|decode|mixed")
+            if not any(r in ("prefill", "mixed") for r in rl):
+                raise ConfigError(
+                    "fleet roles need at least one prefill-capable "
+                    "(prefill or mixed) replica — a decode-only fleet "
+                    "could never admit a new request")
+        if self.role_balance_ratio < 0:
+            raise ConfigError("role_balance_ratio must be >= 0 (0 disables)")
+        if self.role_balance_poll_hysteresis < 1:
+            raise ConfigError("role_balance_poll_hysteresis must be >= 1")
+        if self.role_min_prefill < 1 or self.role_min_decode < 1:
+            raise ConfigError("role_min_prefill/role_min_decode must be >= 1")
 
     @classmethod
     def from_dict(cls, d: dict[str, Any] | None) -> "FleetConfig":

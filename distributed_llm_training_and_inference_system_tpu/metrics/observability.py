@@ -234,6 +234,20 @@ class PrometheusExporter:
             "llmctl_fleet_replica_prefix_hit_rate",
             "Prefix-cache page hit rate per replica (affinity-ring payoff)",
             ["replica"])
+        # disaggregated prefill/decode plane (serve/fleet/ roles): how
+        # many sequences crossed the prefill->decode seam, what each
+        # crossing stalled the stream, and which role every replica
+        # currently plays (the balancer / promotion moves show up here)
+        self.fleet_handoffs = c(
+            "llmctl_fleet_handoffs",
+            "Prefill->decode KV handoffs (disaggregated serving)")
+        self.fleet_handoff_stall = h(
+            "llmctl_fleet_handoff_stall_ms",
+            "Per-handoff stall (one-phase KV extract + placement, ms)",
+            buckets=(.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 1000))
+        self.fleet_replica_role = g(
+            "llmctl_fleet_replica_role",
+            "Replica role (0=mixed, 1=prefill, 2=decode)", ["replica"])
         self._last_totals: dict[str, float] = {}
         self._server_started = False
 
@@ -306,6 +320,10 @@ class PrometheusExporter:
             if "prefix_hit_rate" in rep:
                 self.fleet_prefix_hit_rate.labels(replica=rid).set(
                     rep["prefix_hit_rate"])
+            if "role" in rep:
+                self.fleet_replica_role.labels(replica=rid).set(
+                    {"mixed": 0, "prefill": 1, "decode": 2}.get(
+                        rep["role"], 0))
         router = snap.get("router", {})
         for key, counter in (("requeues", self.fleet_requeues),
                              ("rejected", self.fleet_rejected)):
@@ -334,6 +352,21 @@ class PrometheusExporter:
             for p in pauses[-min(new, len(pauses)):]:
                 self.fleet_migration_pause.observe(p)
         self._last_totals["fleet_mig_pauses"] = count
+        # disaggregation plane: handoff counter + stall histogram follow
+        # the same delta-on-running-totals contract as migration above
+        ho = snap.get("handoff", {})
+        total = ho.get("handoffs", 0)
+        delta = total - self._last_totals.get("fleet_handoffs", 0)
+        if delta > 0:
+            self.fleet_handoffs.inc(delta)
+        self._last_totals["fleet_handoffs"] = total
+        count = ho.get("stall_count", 0)
+        new = int(count - self._last_totals.get("fleet_handoff_stalls", 0))
+        stalls = ho.get("stalls_ms", [])
+        if new > 0:
+            for s in stalls[-min(new, len(stalls)):]:
+                self.fleet_handoff_stall.observe(s)
+        self._last_totals["fleet_handoff_stalls"] = count
 
 
 class OTLPExporter:

@@ -41,7 +41,8 @@ from ..models import gpt
 from .decode import decode_scan, extend_step_forward
 from .kv_cache import PagedKVCache
 from .sampling import sample_tokens
-from .scheduler import ContinuousBatchingScheduler, Request, SamplingParams
+from .scheduler import (ContinuousBatchingScheduler, Request, RequestState,
+                        SamplingParams)
 
 logger = logging.getLogger("llmctl.serve.engine")
 
@@ -186,6 +187,21 @@ class InferenceEngine:
         # a request — the streaming hook (multi-step decode delivers up to
         # K per call)
         self.on_token: Optional[Callable[[Request, list], None]] = None
+        # fired (engine thread, NO locks held) for each request that
+        # survives its prefill-complete step boundary still RUNNING —
+        # before this engine spends any decode dispatch on it. The
+        # disaggregated fleet's prefill-role replicas extract the
+        # sequence (with its KV) here and hand it to a decode replica.
+        self.on_prefill_complete: Optional[Callable[[Request], None]] = None
+        # pure-decode expectation (decode-role replica): dispatching a
+        # prefill is still ALLOWED — the restore-fallback path needs it
+        # when the pool can't hold a handoff payload — but it is counted
+        # and logged so a mis-routed fleet is visible, not silent
+        self.expect_pure_decode = False
+        self.total_unexpected_prefills = 0
+        # partial swap-in restores (crash-surviving migration pre-copies:
+        # covered pages written back, only the tail re-prefilled)
+        self.total_partial_restores = 0
 
         # per-slot host state
         self.last_tokens = np.zeros(S, np.int32)
@@ -599,6 +615,11 @@ class InferenceEngine:
         ctx = req.context_tokens
         n = len(ctx)
         rid = req.request_id
+        if self.expect_pure_decode:
+            self.total_unexpected_prefills += 1
+            logger.warning(
+                "pure-decode engine starting a chunked prefill for %s "
+                "(restore fallback or fleet mis-routing)", rid)
         with self.lock:
             pins = self._prefix_pins.get(rid, [])
             self.kv.allocate(slot, n + self._admission_tail(req),
@@ -704,6 +725,17 @@ class InferenceEngine:
         n = len(ctx)
         rid = req.request_id
         PS = self.kv.page_size
+        if self.expect_pure_decode:
+            self.total_unexpected_prefills += 1
+            logger.warning(
+                "pure-decode engine dispatching a prefill for %s "
+                "(restore fallback or fleet mis-routing)", rid)
+        # crash-salvaged migration pre-copy: the payload's FULL pages are
+        # host memory covering a prefix of the context — written back
+        # below, so only the uncovered tail re-prefills
+        partial = (req.swapped_kv
+                   if req.swapped_kv is not None
+                   and req.swapped_kv.get("partial") else None)
         with self.lock:   # page bookkeeping is shared with cancel/release
             pins = self._prefix_pins.get(rid, [])
             self.kv.allocate(slot, n + self._admission_tail(req),
@@ -711,6 +743,16 @@ class InferenceEngine:
             self._reserved_pages -= self._reserved_by.pop(rid, 0)
             self._req_slot[rid] = slot
             cached = len(pins) * PS       # context tokens served from cache
+            if partial is not None:
+                self.kv.write_slot_pages(slot, partial["pages"])
+                cached = int(partial["positions"])
+                req.swapped_kv = None
+                self.total_partial_restores += 1
+                if req.fleet_requeued:
+                    # prefill FLOPs the fleet did NOT respend thanks to
+                    # the salvaged pre-copy — feeds the fleet's
+                    # reprefill_tokens_avoided metric
+                    self.total_requeue_cached_tokens += cached
             if cached == 0:
                 # table entries for the bucket: beyond-length -> scratch 0
                 bucket = self._bucket(n)
@@ -1299,9 +1341,13 @@ class InferenceEngine:
         C = self.serve_cfg.chunked_prefill_tokens
         pending = []
         for req in admitted:
-            if req.swapped_kv is not None:
+            if req.swapped_kv is not None \
+                    and not req.swapped_kv.get("partial"):
                 # preemption=swap readmission: write the saved KV back
-                # (no prefill); on pool pressure fall back to recompute
+                # (no prefill); on pool pressure fall back to recompute.
+                # PARTIAL payloads (crash-salvaged migration pre-copies)
+                # are not decode-resumable — they take the _prefill path,
+                # which writes the covered pages and computes the tail.
                 if self._restore_swapped(req):
                     continue
                 req.swapped_kv = None
@@ -1310,7 +1356,8 @@ class InferenceEngine:
             # threshold even when the original prompt didn't — and the
             # high-KV-pressure regime that preempts is exactly where a
             # dense multi-thousand-token dispatch would stall residents
-            if C > 0 and len(req.context_tokens) > C:
+            if C > 0 and len(req.context_tokens) > C \
+                    and req.swapped_kv is None:
                 self._start_chunked_prefill(req)
             else:
                 pending.append(self._prefill(req))
@@ -1323,6 +1370,15 @@ class InferenceEngine:
             with self.lock:
                 # prompt-is-whole-request edge: finished on the first token
                 self.scheduler.step_finished(self.eos_token_id)
+            if self.on_prefill_complete is not None:
+                # prefill-complete boundary hook (disaggregated serving):
+                # fires with no locks held for requests that survived the
+                # boundary still RUNNING — the fleet replica may extract
+                # the sequence WITH its KV before this engine spends a
+                # single decode dispatch on it
+                for req, _tok in pending:
+                    if req.state is RequestState.RUNNING:
+                        self.on_prefill_complete(req)
         with self.lock:
             # on-demand admission: make sure every active slot has pages
             # for one dispatch of writes, preempting newest-first if the
@@ -1558,6 +1614,8 @@ class InferenceEngine:
             "prefill_tokens": self.total_prefill_tokens,
             "prefix_cached_tokens": self.total_prefix_cached_tokens,
             "requeue_cached_tokens": self.total_requeue_cached_tokens,
+            "unexpected_prefills": self.total_unexpected_prefills,
+            "partial_restores": self.total_partial_restores,
             "padded_slot_steps": self.total_padded_slot_steps,
             "decode_slot_utilization": round(
                 1.0 - self.total_padded_slot_steps

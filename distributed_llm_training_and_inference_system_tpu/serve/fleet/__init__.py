@@ -34,7 +34,8 @@ from ...config.schema import FleetConfig, ModelConfig, ServeConfig
 from ..scheduler import Request, SamplingParams
 from .faults import FaultInjector, FaultPlan, InjectedCrash, ProbeTimeout
 from .migration import MigrationTicket
-from .replica import EngineReplica, reset_for_requeue
+from .replica import (ROLE_DECODE, ROLE_MIXED, ROLE_PREFILL, EngineReplica,
+                      reset_for_requeue)
 from .router import FleetRouter, FleetSaturated, prefix_digest
 from .supervisor import ReplicaSupervisor
 
@@ -47,6 +48,9 @@ __all__ = [
     "InjectedCrash",
     "MigrationTicket",
     "ProbeTimeout",
+    "ROLE_DECODE",
+    "ROLE_MIXED",
+    "ROLE_PREFILL",
     "ReplicaSupervisor",
     "ServeFleet",
     "prefix_digest",
@@ -75,6 +79,7 @@ class ServeFleet:
         self.fleet_cfg.validate()
         self.serve_cfg = serve_cfg
         self.injector = FaultInjector(fault_plan) if fault_plan else None
+        roles = self.fleet_cfg.role_list()
         self.replicas: list[EngineReplica] = []
         for i in range(self.fleet_cfg.replicas):
             r = EngineReplica(
@@ -84,7 +89,7 @@ class ServeFleet:
                 # seeds are unaffected)
                 seed=seed + 1000 * i, injector=self.injector,
                 on_finish=self._on_request_exit, eos_token_id=eos_token_id,
-                fleet_cfg=self.fleet_cfg)
+                fleet_cfg=self.fleet_cfg, role=roles[i])
             if params is None:          # replica 0 owns the load; share it
                 params = r.engine.params
                 model_cfg = r.model_cfg
@@ -93,6 +98,13 @@ class ServeFleet:
         self._params = params
         self.router = FleetRouter(self.replicas, self.fleet_cfg,
                                   observer=observer)
+        for r in self.replicas:
+            # disaggregation wiring: a prefill-role replica asks the
+            # router for a decode destination BEFORE extracting (local-
+            # decode fallback when no pool has room), then places the
+            # handed-off sequence synchronously from its engine thread
+            r.handoff_dest = self.router.handoff_dest
+            r.on_handoff = self._place_handoff
         self.supervisor = ReplicaSupervisor(
             self.replicas, self.router, self.fleet_cfg,
             injector=self.injector, params=params, observer=observer)
@@ -100,6 +112,10 @@ class ServeFleet:
 
     def _on_request_exit(self, replica_id: int, req: Request) -> None:
         self.router.on_request_exit(replica_id, req)
+
+    def _place_handoff(self, replica_id: int, req: Request,
+                       dest: Optional[int]) -> None:
+        self.router.place_handoff(req, from_replica=replica_id, dest=dest)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -166,6 +182,11 @@ class ServeFleet:
         """Move one in-flight request to ``dest_replica`` WITH its KV
         pages (no re-prefill) — `llmctl fleet migrate`."""
         return self.supervisor.migrate(request_id, dest_replica)
+
+    def set_role(self, replica_id: int, role: str) -> bool:
+        """Manually re-role one replica (prefill|decode|mixed) —
+        `llmctl fleet role` / POST /fleet/role."""
+        return self.supervisor.set_role(replica_id, role)
 
     def status(self) -> dict:
         return self.supervisor.snapshot()

@@ -12,6 +12,9 @@ operator endpoints:
 - ``POST /fleet/undrain`` — return a drained replica to rotation
 - ``POST /fleet/migrate`` — ``{"request_id": ..., "replica": N}``: move
   one in-flight request to replica N with its KV (two-phase live copy)
+- ``POST /fleet/role``    — ``{"replica": N, "role": "prefill|decode|
+  mixed"}``: manual re-role for disaggregated prefill/decode serving
+  (``FleetConfig.roles``; drain first for a loss-free switch)
 
 Backpressure contract: when every replica saturates, completions answer
 **429 with a Retry-After header** (seconds) instead of queueing without
@@ -204,6 +207,25 @@ class FleetServer:
                                   "replica": replica,
                                   "action": "migrate"})
 
+    async def handle_fleet_role(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+            replica = int(body["replica"])
+            role = str(body["role"]).lower()
+        except (json.JSONDecodeError, KeyError, TypeError, ValueError):
+            return web.json_response(
+                {"error": "body must be {\"replica\": <id>, "
+                          "\"role\": \"prefill|decode|mixed\"}"}, status=400)
+        if role not in ("prefill", "decode", "mixed"):
+            return web.json_response(
+                {"error": f"unknown role {role!r} (prefill|decode|mixed)"},
+                status=400)
+        if not self.fleet.set_role(replica, role):
+            return web.json_response(
+                {"error": f"no replica {replica}"}, status=404)
+        return web.json_response({"ok": True, "replica": replica,
+                                  "role": role, "action": "role"})
+
     async def handle_metrics(self, request: web.Request) -> web.Response:
         try:
             from prometheus_client import generate_latest
@@ -223,6 +245,7 @@ class FleetServer:
         app.router.add_post("/fleet/drain", self.handle_fleet_drain)
         app.router.add_post("/fleet/undrain", self.handle_fleet_undrain)
         app.router.add_post("/fleet/migrate", self.handle_fleet_migrate)
+        app.router.add_post("/fleet/role", self.handle_fleet_role)
         return app
 
     # -- lifecycle -----------------------------------------------------------

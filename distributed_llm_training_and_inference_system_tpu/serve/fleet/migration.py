@@ -57,6 +57,19 @@ def _concat_pages(a, b):
     return np.concatenate([a, b], axis=1)
 
 
+def handoff_slot(engine, slot: int) -> tuple[dict, dict]:
+    """Post-prefill prefill->decode handoff: the degenerate ONE-phase
+    migration. At prefill completion every written page is full and
+    immutable (nothing has decoded yet), so there is no tail to chase —
+    a single stop-and-copy over an empty pre-copy moves the whole
+    sequence. Caller is the engine thread, holding ``engine.lock``, at
+    the prefill-complete boundary (before any decode dispatch touched
+    the slot)."""
+    pos = int(engine.positions[slot])
+    return stop_and_copy(engine, slot,
+                         {"pages": None, "full_pages": 0, "positions": pos})
+
+
 def precopy_slot(engine, slot: int) -> dict:
     """Phase 1: copy the slot's FULL pages to host. Caller is the engine
     thread at a step boundary (pipelined dispatch drained), holding

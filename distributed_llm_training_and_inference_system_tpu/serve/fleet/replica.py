@@ -46,6 +46,16 @@ DRAINED = "drained"       # out of rotation, engine alive and empty
 CRASHED = "crashed"       # engine thread died; orphans await requeue
 STOPPED = "stopped"
 
+# disaggregated prefill/decode roles (DistServe/Splitwise — PAPERS.md).
+# A prefill-role replica admits new prompts, prefills them, and hands
+# each sequence WITH its KV to a decode-capable replica at the
+# prefill-complete boundary (the degenerate one-phase migration); a
+# decode-role replica only ever restores handed-off payloads and
+# decodes. Mixed = classic fleet replica (both phases).
+ROLE_PREFILL = "prefill"
+ROLE_DECODE = "decode"
+ROLE_MIXED = "mixed"
+
 
 def reset_for_requeue(req: Request, keep_kv: bool = False) -> None:
     """Make a request admissible on another replica. Generated tokens and
@@ -87,12 +97,14 @@ class EngineReplica:
                  injector: Optional[FaultInjector] = None,
                  on_finish: Optional[Callable[[int, Request], None]] = None,
                  eos_token_id: Optional[int] = None,
-                 fleet_cfg: Optional[FleetConfig] = None):
+                 fleet_cfg: Optional[FleetConfig] = None,
+                 role: str = ROLE_MIXED):
         self.replica_id = replica_id
         self.serve_cfg = serve_cfg
         self.seed = seed
         self.injector = injector
         self.eos_token_id = eos_token_id
+        self.role = role
         self._migrate_on_drain = bool(fleet_cfg.migrate_on_drain) \
             if fleet_cfg is not None else False
         # single-request migrations (rebalance / operator): ticket state
@@ -106,6 +118,19 @@ class EngineReplica:
         self.migrations_by_reason: dict[str, int] = {}
         self.migration_pauses_ms: deque = deque(maxlen=64)
         self.migration_log: deque = deque(maxlen=64)   # per-move detail
+        # prefill->decode handoff plane (disaggregated serving):
+        # `handoff_dest` is the router's pre-extraction advisory (which
+        # decode replica has pool room — None means decode locally);
+        # `on_handoff` places the extracted sequence, synchronously on
+        # THIS engine thread, so a handoff never waits for a supervisor
+        # poll (that latency would land in every stream's ITL)
+        self.handoff_dest: Optional[Callable] = None
+        self.on_handoff: Optional[Callable] = None
+        self.handoffs_out = 0
+        self.handoff_tokens = 0          # KV entries shipped at handoff
+        self.handoffs_local = 0          # fallbacks: decoded at the source
+        self.handoff_stalls_ms: deque = deque(maxlen=64)
+        self.handoff_log: deque = deque(maxlen=64)
         # fired with (replica_id, request) whenever a request leaves its
         # slot terminally on this replica (finished/cancelled) — the
         # router's completion hook. NOT fired on crash/drain extraction.
@@ -124,8 +149,23 @@ class EngineReplica:
         # the engine may refine model_cfg from an artifact; later restarts
         # and sibling replicas must build from the EFFECTIVE config
         self.model_cfg = self.engine.cfg
-        self.engine.on_finish = self._engine_finished
+        self._wire_engine()
         self.state = HEALTHY
+
+    def _wire_engine(self) -> None:
+        """Attach the fleet hooks + role expectations to self.engine (also
+        re-run after restart() builds a fresh one)."""
+        self.engine.on_finish = self._engine_finished
+        self.engine.on_prefill_complete = self._on_prefill_complete
+        self.engine.expect_pure_decode = (self.role == ROLE_DECODE)
+
+    def set_role(self, role: str) -> None:
+        """Re-role this replica (balancer / operator). Takes effect for
+        requests admitted from now on; residents finish where they are."""
+        with self._state_lock:
+            self.role = role
+        self.engine.expect_pure_decode = (role == ROLE_DECODE)
+        logger.info("replica %d role -> %s", self.replica_id, role)
 
     # -- engine thread -------------------------------------------------------
 
@@ -178,13 +218,43 @@ class EngineReplica:
         with self._state_lock:
             self.state = CRASHED
             self.last_error = f"{type(exc).__name__}: {exc}"
-            # in-flight migration tickets die with the engine: their
-            # half-built payloads must not travel — the victims fall back
-            # to plain requeue (re-prefill) via the orphan path below.
-            # COMPLETED migrations (_migrated) survive: those payloads are
-            # host memory and their requests already left this engine.
+            # in-flight migration tickets die with the engine — but a
+            # ticket caught BETWEEN its two phases already copied the
+            # victim's full (immutable) pages to host memory, and host
+            # memory doesn't die with the engine thread. Those pre-copies
+            # are salvaged as PARTIAL payloads: the destination writes
+            # the covered pages back and re-prefills only the uncovered
+            # tail (engine._prefill partial-restore path), crediting
+            # reprefill_tokens_avoided. Tickets still in phase 1 have
+            # copied nothing and fall back to plain requeue.
+            # COMPLETED migrations (_migrated) survive as before: those
+            # payloads are whole and their requests already left.
+            partials = self._salvage_precopies()
             self._migrations.clear()
-        self._orphans.extend(self._rip_out())
+        orphans = self._rip_out()
+        for r in orphans:
+            p = partials.get(r.request_id)
+            if p is not None:
+                r.swapped_kv = p
+        self._orphans.extend(orphans)
+
+    def _salvage_precopies(self) -> dict[str, dict]:
+        """Partial ``swapped_kv`` payloads from migration tickets whose
+        phase-1 pre-copy completed before the engine died. Caller holds
+        ``_state_lock``; the engine object (and its page-size constant)
+        outlives its thread."""
+        kv = getattr(self.engine, "kv", None)
+        if kv is None:
+            return {}
+        out = {}
+        for rid, t in self._migrations.items():
+            if t.pre and t.pre.get("pages") is not None:
+                out[rid] = {
+                    "pages": t.pre["pages"],
+                    "positions": t.pre["full_pages"] * kv.page_size,
+                    "partial": True,
+                }
+        return out
 
     def _rip_out(self) -> list[Request]:
         """Remove every queued + resident request from a dead (or stopping)
@@ -298,6 +368,63 @@ class EngineReplica:
         if self.on_finish is not None:
             self.on_finish(self.replica_id, req)
 
+    # -- prefill->decode handoff (engine-thread half) ------------------------
+
+    def _on_prefill_complete(self, req: Request) -> None:
+        """Engine prefill-complete hook (engine thread, no locks held):
+        on a prefill-role replica the freshly-prefilled sequence leaves
+        WITH its KV instead of occupying a decode slot — the one-phase
+        handoff (serve/fleet/migration.py ``handoff_slot``), placed
+        synchronously so the stream's first decode token is delayed only
+        by the copy itself, never by a supervisor poll. When no decode
+        replica has pool room the sequence stays and decodes here (local
+        fallback: correct, just not disaggregated)."""
+        if self.role != ROLE_PREFILL or self.on_handoff is None:
+            return
+        if self._thread is None or not self._thread.is_alive():
+            return        # offline use (warmup/compile): no fleet to hand to
+        dest = (self.handoff_dest(req, self.replica_id)
+                if self.handoff_dest is not None else None)
+        if dest is None:
+            self.handoffs_local += 1
+            logger.info("replica %d: no decode pool room for %s, "
+                        "decoding locally", self.replica_id, req.request_id)
+            return
+        eng = self.engine
+        t0 = time.perf_counter()
+        with eng.lock:
+            slot = eng._req_slot.get(req.request_id)
+            if slot is None or eng.scheduler.slots[slot] is not req \
+                    or req.state is not RequestState.RUNNING:
+                return
+            payload, detail = migration.handoff_slot(eng, slot)
+            eng._preempt(slot)   # pages freed, prefix pages published
+            # _preempt parked it at the waiting head; a handed-off
+            # sequence leaves this engine entirely
+            if eng.scheduler.waiting and eng.scheduler.waiting[0] is req:
+                eng.scheduler.waiting.popleft()
+            else:
+                eng.scheduler.waiting.remove(req)
+        reset_for_requeue(req, keep_kv=True)
+        req.swapped_kv = payload
+        req.handoff_time = time.monotonic()
+        req.handoffs += 1
+        self.on_handoff(self.replica_id, req, dest)
+        stall_ms = (time.perf_counter() - t0) * 1e3
+        self._note_handoff(req, payload, detail, stall_ms, dest)
+
+    def _note_handoff(self, req: Request, payload: dict, detail: dict,
+                      stall_ms: float, dest: Optional[int]) -> None:
+        self.handoffs_out += 1
+        self.handoff_tokens += int(payload["positions"])
+        self.handoff_stalls_ms.append(float(stall_ms))
+        self.handoff_log.append({**detail, "request_id": req.request_id,
+                                 "dest": dest, "stall_ms": stall_ms})
+        logger.info(
+            "replica %d handed off %s -> replica %s: %d prefill tokens in "
+            "%d pages, stall %.2f ms", self.replica_id, req.request_id,
+            dest, payload["positions"], detail["total_pages"], stall_ms)
+
     # -- KV migration (engine-thread half) -----------------------------------
 
     def _note_migration(self, req: Request, payload: dict, detail: dict,
@@ -410,6 +537,21 @@ class EngineReplica:
                 total += max(r.remaining_tokens, 0)
         return total
 
+    def pool_room_for(self, req: Request) -> bool:
+        """Advisory handoff-destination check: could this replica restore
+        ``req``'s context pages plus one dispatch of decode growth right
+        now? Lock-free read of the pool counters — the binding check is
+        the destination's own admission reserve; a stale answer costs
+        one local-decode fallback or one head-of-line wait, never
+        correctness."""
+        eng = self.engine
+        kv = getattr(eng, "kv", None)
+        if kv is None:
+            return False
+        need = kv.pages_needed(len(req.context_tokens)
+                               + eng._decode_lookahead)
+        return need <= kv.free_pages - eng._reserved_pages
+
     def probe(self) -> dict:
         """Health snapshot for the supervisor. Raises if the engine thread
         is dead — a crashed replica must not look merely idle."""
@@ -420,6 +562,7 @@ class EngineReplica:
         return {
             "replica": self.replica_id,
             "state": state,
+            "role": self.role,
             "queue_depth": self.queue_depth(),
             "active": self.active_count(),
             "outstanding_tokens": self.outstanding_tokens(),
@@ -508,7 +651,14 @@ class EngineReplica:
         when a replica is declared dead by probes: the engine may be fine,
         but the fleet has already decided to rebuild it)."""
         self.stop()
+        with self._state_lock:
+            partials = self._salvage_precopies()
+            self._migrations.clear()
         orphans = self.take_orphans() + self._rip_out()
+        for r in orphans:
+            p = partials.get(r.request_id)
+            if p is not None:
+                r.swapped_kv = p
         try:
             self.engine.release()
         except Exception:
@@ -522,7 +672,7 @@ class EngineReplica:
         self.engine = InferenceEngine(
             self.model_cfg, self.serve_cfg, params=params, seed=self.seed,
             eos_token_id=self.eos_token_id)
-        self.engine.on_finish = self._engine_finished
+        self._wire_engine()
         with self._state_lock:
             self.state = HEALTHY
             self.last_error = None

@@ -64,6 +64,20 @@ def prefix_digest(prompt_tokens: Sequence[int], k: int) -> int:
     return _hash_point(head.encode())
 
 
+def _role(replica) -> str:
+    """Replica role for routing; anything not declaring one is mixed."""
+    return getattr(replica, "role", "mixed")
+
+
+def _needs_prefill(req: Request) -> bool:
+    """Whether placing this request requires prefill compute on the
+    destination. Payload-carrying requests (migrations, handoffs, drain
+    victims) restore their pages — any replica can take them, decode-role
+    included — EXCEPT partial payloads (crash-salvaged pre-copies), whose
+    uncovered tail still needs a prefill-capable replica."""
+    return req.swapped_kv is None or bool(req.swapped_kv.get("partial"))
+
+
 class FleetRouter:
     def __init__(self, replicas: Iterable, cfg: Optional[FleetConfig] = None,
                  observer: Optional[Callable[[str, dict], None]] = None):
@@ -95,6 +109,7 @@ class FleetRouter:
         self.total_requeues = 0
         self.total_affinity_hits = 0
         self.total_migrations = 0       # migrated sequences placed
+        self.total_handoffs = 0         # prefill->decode handoffs placed
         self.completed_per_replica: dict[int, int] = {
             r.replica_id: 0 for r in self.replicas}
         self.routed_per_replica: dict[int, int] = {
@@ -118,21 +133,36 @@ class FleetRouter:
         return None
 
     def _candidates(self, prompt_tokens: Sequence[int],
-                    exclude: frozenset = frozenset()
-                    ) -> tuple[list, bool]:
+                    exclude: frozenset = frozenset(),
+                    needs_prefill: bool = True) -> tuple[list, bool]:
         """(replicas to try in order, affinity_applied): affinity owner
         first when within the imbalance bound, then by least outstanding
         tokens. ``affinity_applied`` is True only when the ring owner was
         actually promoted — the affinity-hit stat must not count plain
-        least-loaded placements that happened to coincide."""
+        least-loaded placements that happened to coincide.
+
+        Role awareness (disaggregated serving): ``needs_prefill`` requests
+        never see decode-role replicas (they couldn't compute the prompt),
+        and prefix affinity is therefore automatically restricted to the
+        prefill-capable subset. Payload-carrying requests can land
+        anywhere — ordered decode-first (that's what decode replicas are
+        FOR; a prefill replica is the last resort) and skipping affinity
+        (their pages travel with them, there is no cache to chase)."""
         accepting = [r for r in self.replicas
                      if r.replica_id not in exclude and r.accepting()]
+        if needs_prefill:
+            accepting = [r for r in accepting if _role(r) != "decode"]
         if not accepting:
             return [], False
         load = {r.replica_id: r.outstanding_tokens() for r in accepting}
         depth = {r.replica_id: r.queue_depth() for r in accepting}
         ordered = sorted(accepting,
                          key=lambda r: (load[r.replica_id], r.replica_id))
+        if not needs_prefill:
+            # stable sort: decode < mixed < prefill, least-loaded within
+            ordered.sort(key=lambda r: {"decode": 0, "mixed": 1}.get(
+                _role(r), 2))
+            return ordered, False
         if self.cfg.affinity_prefix_tokens > 0 and len(accepting) > 1:
             owner = self._ring_owner(
                 prefix_digest(prompt_tokens,
@@ -282,7 +312,8 @@ class FleetRouter:
             return meta.get("replica") if meta else None
 
     def place_migrated(self, req: Request, from_replica: int,
-                       dest: Optional[int] = None) -> bool:
+                       dest: Optional[int] = None,
+                       kind: str = "migration") -> bool:
         """Place a sequence that left ``from_replica`` WITH its KV payload
         (serve/fleet/migration.py). The rebalancer's destination hint is
         tried first; otherwise normal candidate order (excluding the
@@ -311,22 +342,63 @@ class FleetRouter:
                       or self._place(req))
         if placed:
             with self._lock:
-                self.total_migrations += 1
+                if kind == "handoff":
+                    self.total_handoffs += 1
+                else:
+                    self.total_migrations += 1
         else:
             with self._lock:
                 overflow = len(self._parked) >= self.cfg.max_pending
                 if not overflow:
                     self._parked.append(req)
             if overflow:
-                self._fail(req, "no healthy replica for a migrated "
+                self._fail(req, f"no healthy replica for a {kind} "
                                 "sequence and the requeue buffer is full")
-        self.observer("fleet_migration", {
+        self.observer(f"fleet_{kind}", {
             "from_replica": from_replica, "dest": dest,
             "request_id": req.request_id, "placed": placed})
         return placed
 
+    # -- disaggregated prefill/decode handoff --------------------------------
+
+    def handoff_dest(self, req: Request,
+                     from_replica: int) -> Optional[int]:
+        """Pre-extraction advisory for a prefill-role replica: the
+        decode-capable replica this freshly-prefilled sequence should land
+        on — pure decode role first, least outstanding tokens within a
+        class — or None when no decode pool has room (the source then
+        decodes locally: the DistServe fallback that keeps handoff an
+        optimization, never a liveness dependency)."""
+        cands = [r for r in self.replicas
+                 if r.replica_id != from_replica and r.accepting()
+                 and _role(r) in ("decode", "mixed")]
+        cands.sort(key=lambda r: ({"decode": 0}.get(_role(r), 1),
+                                  r.outstanding_tokens(), r.replica_id))
+        for r in cands:
+            room = getattr(r, "pool_room_for", None)
+            if room is None or room(req):
+                return r.replica_id
+        return None
+
+    def place_handoff(self, req: Request, from_replica: int,
+                      dest: Optional[int] = None) -> bool:
+        """Place a post-prefill handoff (called synchronously from the
+        source replica's engine thread). Same machinery as
+        ``place_migrated`` — dest hint first, then decode-first candidate
+        order, park on total outage — but counted in the handoff ledger.
+        The final fallback includes the SOURCE replica itself: the
+        payload restores anywhere with zero prefill, landing back home is
+        merely un-disaggregated, not wrong."""
+        return self.place_migrated(req, from_replica, dest=dest,
+                                   kind="handoff")
+
+    def parked_count(self) -> int:
+        with self._lock:
+            return len(self._parked)
+
     def _place(self, req: Request, exclude: frozenset = frozenset()) -> bool:
-        cands, _ = self._candidates(req.prompt_tokens, exclude=exclude)
+        cands, _ = self._candidates(req.prompt_tokens, exclude=exclude,
+                                    needs_prefill=_needs_prefill(req))
         for r in cands:
             if r.submit(req):
                 with self._lock:
@@ -390,6 +462,7 @@ class FleetRouter:
                 "requeues": self.total_requeues,
                 "affinity_hits": self.total_affinity_hits,
                 "migrations": self.total_migrations,
+                "handoffs": self.total_handoffs,
                 "parked": len(self._parked),
                 "in_flight": in_flight,
                 "completed_per_replica": dict(self.completed_per_replica),

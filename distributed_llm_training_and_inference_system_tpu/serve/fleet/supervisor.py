@@ -27,7 +27,7 @@ from typing import Callable, Optional
 from ...config.schema import FleetConfig
 from . import replica as replica_mod
 from .faults import FaultInjector
-from .replica import EngineReplica
+from .replica import (ROLE_DECODE, ROLE_MIXED, ROLE_PREFILL, EngineReplica)
 from .router import FleetRouter
 
 logger = logging.getLogger("llmctl.serve.fleet.supervisor")
@@ -53,6 +53,14 @@ class ReplicaSupervisor:
         # imbalance bound (hysteresis — one bursty poll must not move KV)
         self._imbalance_streak = 0
         self.total_rebalance_migrations = 0
+        # role balancer state (disaggregated prefill/decode): one re-role
+        # in flight at a time — (replica_id, new_role) while the donor
+        # drains (with migration) before switching class
+        self._rerole: Optional[tuple[int, str]] = None
+        self._role_streak = 0
+        self._role_want: Optional[str] = None
+        self.total_reroles = 0
+        self.total_role_promotions = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -76,8 +84,10 @@ class ReplicaSupervisor:
                 self._requeue_orphans(r)   # drain victims move elsewhere
             elif state == replica_mod.HEALTHY:
                 self._probe(r)
+        self._ensure_role_coverage()
+        self._maybe_role_balance()
         self._maybe_rebalance()
-        if recovered:
+        if recovered or self.router.parked_count():
             self.router.flush_parked()
         snap = self.snapshot()
         self.observer("fleet", snap)
@@ -138,6 +148,128 @@ class ReplicaSupervisor:
                 "(outstanding %d vs %d)", moved, hot.replica_id,
                 cold.replica_id, load[hot.replica_id],
                 load[cold.replica_id])
+
+    # -- disaggregated prefill/decode roles ----------------------------------
+
+    @staticmethod
+    def _role(r) -> str:
+        return getattr(r, "role", ROLE_MIXED)
+
+    def _ensure_role_coverage(self) -> None:
+        """Role-aware health: if every prefill-capable replica is down,
+        new requests have nowhere to go (and payload-less orphans park
+        forever); if every decode-capable one is down, handoffs all fall
+        back to local decode. Either way the fix is the same — promote a
+        healthy survivor of the other class to MIXED so the fleet
+        degrades to classic (un-disaggregated) serving instead of
+        deadlocking. Promotions are one-way: when the crashed class
+        restarts, the operator (or the role balancer) re-splits."""
+        roles = {r.replica_id: self._role(r) for r in self.replicas}
+        if all(v == ROLE_MIXED for v in roles.values()):
+            return
+        healthy = [r for r in self.replicas
+                   if r.state == replica_mod.HEALTHY
+                   and hasattr(r, "set_role")]
+
+        def promote(donors: list, lost: str) -> None:
+            if not donors:
+                return
+            r = min(donors, key=lambda x: (x.outstanding_tokens(),
+                                           x.replica_id))
+            logger.warning(
+                "no healthy %s-capable replica left: promoting replica "
+                "%d (%s) to mixed", lost, r.replica_id, self._role(r))
+            r.set_role(ROLE_MIXED)
+            self.total_role_promotions += 1
+            self.router.flush_parked()
+
+        def provisioned(kind: str) -> bool:
+            # the capability exists SOMEWHERE in the fleet (any state):
+            # losing it to crashes warrants promotion. A fleet the
+            # operator built without it (e.g. prefill-only, where local
+            # decode IS the design) must not self-promote.
+            return any(v in (kind, ROLE_MIXED) for v in roles.values())
+
+        if provisioned(ROLE_PREFILL) and not any(
+                roles[r.replica_id] in (ROLE_PREFILL, ROLE_MIXED)
+                for r in healthy):
+            promote([r for r in healthy
+                     if roles[r.replica_id] == ROLE_DECODE], ROLE_PREFILL)
+        healthy = [r for r in self.replicas
+                   if r.state == replica_mod.HEALTHY
+                   and hasattr(r, "set_role")]
+        if provisioned(ROLE_DECODE) and not any(
+                self._role(r) in (ROLE_DECODE, ROLE_MIXED)
+                for r in healthy):
+            promote([r for r in healthy
+                     if self._role(r) == ROLE_PREFILL], ROLE_DECODE)
+
+    def _maybe_role_balance(self) -> None:
+        """Re-role replicas from observed phase pressure. Prefill pressure
+        is the queue of un-prefilled prompts on prefill-role replicas;
+        decode-slot pressure is observed through the handoff backlog
+        (handoffs only queue on a decode replica when every slot is
+        busy). When one class's per-replica queue depth exceeds
+        ``role_balance_ratio`` x the other's (+1, so idle fleets don't
+        flap) for ``role_balance_poll_hysteresis`` consecutive polls, the
+        least-loaded replica of the over-provisioned class drains (with
+        migration — its residents move out losslessly) and joins the
+        starved class. Floors keep every class minimally staffed; one
+        re-role in flight at a time."""
+        cfg = self.cfg
+        if cfg.role_balance_ratio <= 0:
+            return
+        if self._rerole is not None:
+            rid, new_role = self._rerole
+            r = next((x for x in self.replicas if x.replica_id == rid),
+                     None)
+            if r is None or r.state in (replica_mod.CRASHED,
+                                        replica_mod.STOPPED):
+                self._rerole = None     # died mid-drain: abandon the move
+            elif r.state == replica_mod.DRAINED:
+                r.set_role(new_role)
+                r.undrain()
+                self.router.flush_parked()
+                self.total_reroles += 1
+                self._rerole = None
+                logger.info("role balancer: replica %d re-roled to %s",
+                            rid, new_role)
+            return                      # one move at a time
+        healthy = [r for r in self.replicas
+                   if r.state == replica_mod.HEALTHY
+                   and hasattr(r, "set_role")]
+        pre = [r for r in healthy if self._role(r) == ROLE_PREFILL]
+        dec = [r for r in healthy if self._role(r) == ROLE_DECODE]
+        if not pre or not dec:
+            self._role_streak = 0
+            return
+        p = sum(r.queue_depth() for r in pre) / len(pre)
+        d = sum(r.queue_depth() for r in dec) / len(dec)
+        if p > cfg.role_balance_ratio * (d + 1.0) \
+                and len(dec) > cfg.role_min_decode:
+            want, donors = ROLE_PREFILL, dec
+        elif d > cfg.role_balance_ratio * (p + 1.0) \
+                and len(pre) > cfg.role_min_prefill:
+            want, donors = ROLE_DECODE, pre
+        else:
+            self._role_streak = 0
+            self._role_want = None
+            return
+        if self._role_want != want:     # direction flip restarts the count
+            self._role_streak = 0
+            self._role_want = want
+        self._role_streak += 1
+        if self._role_streak < cfg.role_balance_poll_hysteresis:
+            return
+        donor = min(donors, key=lambda r: (r.outstanding_tokens(),
+                                           r.replica_id))
+        self._rerole = (donor.replica_id, want)
+        self._role_streak = 0
+        logger.info(
+            "role balancer: draining replica %d (%s) to re-role as %s "
+            "(prefill q %.1f vs decode q %.1f per replica)",
+            donor.replica_id, self._role(donor), want, p, d)
+        donor.request_drain()
 
     def _requeue_orphans(self, r: EngineReplica) -> None:
         orphans = r.take_orphans()
@@ -227,6 +359,22 @@ class ReplicaSupervisor:
         self.router.flush_parked()
         return True
 
+    def set_role(self, replica_id: int, role: str) -> bool:
+        """Operator action (`llmctl fleet role` / POST /fleet/role):
+        manually re-role one replica. Immediate — the operator drains
+        first if they want the switch loss-free for residents (the
+        balancer's automated path does exactly that)."""
+        if role not in (ROLE_PREFILL, ROLE_DECODE, ROLE_MIXED):
+            return False
+        r = next((x for x in self.replicas if x.replica_id == replica_id),
+                 None)
+        if r is None or not hasattr(r, "set_role"):
+            return False
+        r.set_role(role)
+        self.total_reroles += 1
+        self.router.flush_parked()
+        return True
+
     def migrate(self, request_id: str, dest_replica: int) -> bool:
         """Operator action (`llmctl fleet migrate`): move one in-flight
         request to ``dest_replica`` with its KV. Returns False when the
@@ -276,16 +424,19 @@ class ReplicaSupervisor:
         reps = []
         requeue_cached = 0
         pauses: list[float] = []
+        stalls: list[float] = []
         by_reason: dict[str, int] = {}
         for r in self.replicas:
             hits, queries, cached = r.prefix_cache_stats()
             requeue_cached += cached
             pauses.extend(r.migration_pauses_ms)
+            stalls.extend(getattr(r, "handoff_stalls_ms", ()))
             for reason, n in r.migrations_by_reason.items():
                 by_reason[reason] = by_reason.get(reason, 0) + n
             reps.append({
                 "replica": r.replica_id,
                 "state": r.state,
+                "role": self._role(r),
                 "queue_depth": r.queue_depth(),
                 "active": r.active_count(),
                 "outstanding_tokens": r.outstanding_tokens(),
@@ -293,6 +444,7 @@ class ReplicaSupervisor:
                 "probe_misses": self._misses.get(r.replica_id, 0),
                 "last_error": r.last_error,
                 "migrations": r.migrations_out,
+                "handoffs": getattr(r, "handoffs_out", 0),
                 "prefix_hits": hits,
                 "prefix_queries": queries,
                 "prefix_hit_rate": round(hits / max(queries, 1), 4),
@@ -315,5 +467,23 @@ class ReplicaSupervisor:
             "pauses_ms": pauses,
             "pause_count": sum(r.migrations_out for r in self.replicas),
         }
+        # disaggregated prefill/decode plane: handoff counters arrive as
+        # running totals (the Prometheus pump deltas them), the stall
+        # list as a bounded recent window + cumulative count (same
+        # contract as migration pauses)
+        handoff = {
+            "handoffs": sum(getattr(r, "handoffs_out", 0)
+                            for r in self.replicas),
+            "handoff_tokens": sum(getattr(r, "handoff_tokens", 0)
+                                  for r in self.replicas),
+            "local_fallbacks": sum(getattr(r, "handoffs_local", 0)
+                                   for r in self.replicas),
+            "stalls_ms": stalls,
+            "stall_count": sum(getattr(r, "handoffs_out", 0)
+                               for r in self.replicas),
+            "reroles": self.total_reroles,
+            "promotions": self.total_role_promotions,
+        }
         return {"replicas": reps, "router": self.router.stats(),
-                "restarts": self.total_restarts, "migration": migration}
+                "restarts": self.total_restarts, "migration": migration,
+                "handoff": handoff}

@@ -298,11 +298,29 @@ class PagedKVCache:
         if n > self.free_pages:
             return False
         self.allocate(slot, n * self.page_size)
+        self.write_slot_pages(slot, content)
+        return True
+
+    def write_slot_pages(self, slot: int, content: dict,
+                         lo: int = 0) -> None:
+        """Write a host payload's pages into chain entries
+        [lo, lo+num_pages) of an ALREADY-allocated slot.
+
+        The partial-restore half of crash-payload salvage
+        (serve/fleet/replica.py): a migration ticket killed between its
+        two copy phases leaves the victim's FULL pages on host memory —
+        the destination allocates the slot's whole chain, writes those
+        pages here, and extend-prefills only the uncovered tail. The
+        full-chain restore path (``restore_slot``) goes through here too.
+        """
+        n = content["num_pages"]
+        if n <= 0:
+            return
         bucket = 1
         while bucket < n:
             bucket <<= 1
         idx = np.zeros(bucket, np.int32)        # pad -> scratch page 0
-        idx[:n] = self.block_tables[slot, :n]
+        idx[:n] = self.block_tables[slot, lo:lo + n]
 
         def pad(data):
             if isinstance(data, dict):
@@ -323,7 +341,6 @@ class PagedKVCache:
         self.k_pages, self.v_pages = self._restore_fn(bucket)(
             self.k_pages, self.v_pages, jnp.asarray(idx),
             as_arg(self.k_pages, kd), as_arg(self.v_pages, vd))
-        return True
 
     # -- prefix cache --------------------------------------------------------
 

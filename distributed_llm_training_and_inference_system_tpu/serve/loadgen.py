@@ -72,6 +72,13 @@ class LoadResult:
     migrations: int = 0
     migrated_tokens: int = 0
     reprefill_tokens_avoided: int = 0
+    # disaggregated prefill/decode (FleetConfig.roles / bench e2e
+    # --serve-disagg): prefill->decode handoffs, local-decode fallbacks,
+    # and the per-phase latency breakdown — TTFT belongs to the prefill
+    # phase (+ the handoff crossing), ITL/TPOT to the decode phase
+    handoffs: int = 0
+    handoffs_local: int = 0
+    phases: dict = field(default_factory=dict)
 
     def percentile(self, xs, q):
         return float(np.percentile(np.asarray(xs), q)) if xs else float("nan")
@@ -107,6 +114,10 @@ class LoadResult:
                 "reprefill_tokens_avoided": self.reprefill_tokens_avoided,
                 "per_replica": self.per_replica}
                if self.per_replica else {}),
+            **({"handoffs": self.handoffs,
+                "handoffs_local": self.handoffs_local,
+                "phases": self.phases}
+               if self.phases else {}),
         }
 
 
@@ -188,7 +199,8 @@ def _finalize_fleet(res: LoadResult, reqs: list, fleet,
             res.failed += 1
     stats = fleet.router.stats()
     res.requeues = stats["requeues"]
-    mig = fleet.supervisor.snapshot().get("migration", {})
+    snap = fleet.supervisor.snapshot()
+    mig = snap.get("migration", {})
     res.migrations = mig.get("migrations", 0)
     res.migrated_tokens = mig.get("migrated_tokens", 0)
     res.reprefill_tokens_avoided = mig.get("reprefill_tokens_avoided", 0)
@@ -199,6 +211,42 @@ def _finalize_fleet(res: LoadResult, reqs: list, fleet,
         # None, not NaN: summaries are JSON-serialized and NaN is not a
         # standard JSON token (same rule as offered_rps above)
         return round(res.percentile(xs, q), 1) if xs else None
+
+    # disaggregated fleets (any non-mixed role): per-phase breakdown.
+    # TTFT is the prefill phase's latency (queue + prefill + the handoff
+    # crossing); ITL/TPOT is the decode phase's. The handoff stalls come
+    # from the supervisor snapshot (bounded recent window).
+    roles = {rep.replica_id: getattr(rep, "role", "mixed")
+             for rep in fleet.replicas}
+    ho = snap.get("handoff", {})
+    if ho.get("handoffs", 0) or set(roles.values()) - {"mixed"}:
+        res.handoffs = ho.get("handoffs", 0)
+        res.handoffs_local = ho.get("local_fallbacks", 0)
+        stalls = ho.get("stalls_ms", [])
+
+        def pct2(xs, q):
+            return round(res.percentile(xs, q), 2) if xs else None
+
+        res.phases = {
+            "prefill": {
+                "p50_ttft_ms": pct(res.ttft_ms, 50),
+                "p99_ttft_ms": pct(res.ttft_ms, 99),
+                "replicas": sorted(rid for rid, ro in roles.items()
+                                   if ro in ("prefill", "mixed")),
+            },
+            "decode": {
+                "p50_itl_ms": pct2(res.tpot_ms, 50),
+                "p99_itl_ms": pct2(res.tpot_ms, 99),
+                "replicas": sorted(rid for rid, ro in roles.items()
+                                   if ro in ("decode", "mixed")),
+            },
+            "handoff": {
+                "count": res.handoffs,
+                "local_fallbacks": res.handoffs_local,
+                "p50_stall_ms": pct2(stalls, 50),
+                "p99_stall_ms": pct2(stalls, 99),
+            },
+        }
 
     for rid, slot in sorted(by_replica.items()):
         res.per_replica[rid] = {

@@ -73,6 +73,13 @@ class Request:
     # such requests to the fleet's reprefill_tokens_avoided metric — the
     # warm-prefix payoff of routing orphans through the affinity ring.
     fleet_requeued: bool = False
+    # disaggregated serving (serve/fleet/): stamped when a prefill-role
+    # replica extracts this sequence's KV at the prefill-complete
+    # boundary for the prefill->decode handoff; `handoffs` counts them.
+    # The loadgen per-phase breakdown and the handoff-stall histogram
+    # key off these.
+    handoff_time: Optional[float] = None
+    handoffs: int = 0
     arrival_time: float = field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None   # for TTFT
     # when the engine dispatched this request's prefill (host clock, no

@@ -589,6 +589,26 @@ class TestFleetHTTP:
         assert rq.post(f"{base}/fleet/drain", json={"replica": 9},
                        timeout=10).status_code == 404
 
+        # role surface: set/readback round trip; bad role / unknown
+        # replica / bad body refused
+        assert rq.post(f"{base}/fleet/role",
+                       json={"replica": 1, "role": "decode"},
+                       timeout=10).json()["ok"]
+        snap = rq.get(f"{base}/fleet/status", timeout=10).json()
+        roles = {x["replica"]: x.get("role") for x in snap["replicas"]}
+        assert roles[1] == "decode"
+        assert rq.post(f"{base}/fleet/role",
+                       json={"replica": 1, "role": "mixed"},
+                       timeout=10).json()["ok"]
+        assert rq.post(f"{base}/fleet/role",
+                       json={"replica": 9, "role": "decode"},
+                       timeout=10).status_code == 404
+        assert rq.post(f"{base}/fleet/role",
+                       json={"replica": 1, "role": "driver"},
+                       timeout=10).status_code == 400
+        assert rq.post(f"{base}/fleet/role", json={"replica": 1},
+                       timeout=10).status_code == 400
+
         # migrate surface: unknown replica / unknown request / bad body
         assert rq.post(f"{base}/fleet/migrate",
                        json={"request_id": "nope", "replica": 9},
@@ -625,15 +645,18 @@ class TestFleetMetrics:
             "replicas": [
                 {"replica": 0, "state": "healthy", "queue_depth": 3,
                  "active": 2, "outstanding_tokens": 170, "restarts": 1,
-                 "prefix_hit_rate": 0.75},
+                 "prefix_hit_rate": 0.75, "role": "prefill"},
                 {"replica": 1, "state": "crashed", "queue_depth": 0,
                  "active": 0, "outstanding_tokens": 0, "restarts": 0,
-                 "prefix_hit_rate": 0.0},
+                 "prefix_hit_rate": 0.0, "role": "decode"},
             ],
             "router": {"requeues": 5, "rejected": 2},
             "migration": {"migrations": 2, "migrated_tokens": 300,
                           "reprefill_tokens_avoided": 123,
                           "pauses_ms": [1.5, 3.5], "pause_count": 2},
+            "handoff": {"handoffs": 3, "handoff_tokens": 96,
+                        "local_fallbacks": 1,
+                        "stalls_ms": [2.0, 4.0, 6.0], "stall_count": 3},
         }
         exporter.export_fleet(snap)
         samples = {}
@@ -661,15 +684,29 @@ class TestFleetMetrics:
             == pytest.approx(5.0)
         assert samples[("llmctl_fleet_replica_prefix_hit_rate", "0")] \
             == 0.75
+        # disaggregation plane (this PR): the prefill->decode handoff
+        # counter, the per-handoff stall histogram, and the per-replica
+        # role gauge (0=mixed, 1=prefill, 2=decode)
+        assert samples[("llmctl_fleet_handoffs_total", None)] == 3
+        assert samples[("llmctl_fleet_handoff_stall_ms_count", None)] == 3
+        assert samples[("llmctl_fleet_handoff_stall_ms_sum", None)] \
+            == pytest.approx(12.0)
+        assert samples[("llmctl_fleet_replica_role", "0")] == 1
+        assert samples[("llmctl_fleet_replica_role", "1")] == 2
         # counters export deltas: a second identical snapshot must not
         # double-count the running totals (incl. the pause histogram)
         exporter.export_fleet(snap)
         for metric in prometheus_client.REGISTRY.collect():
             for s in metric.samples:
                 if s.name in ("llmctl_fleet_requeues_total",
-                              "llmctl_fleet_migrations_total"):
+                              "llmctl_fleet_migrations_total",
+                              "llmctl_fleet_handoffs_total"):
                     assert s.value == {"llmctl_fleet_requeues_total": 5,
-                                       "llmctl_fleet_migrations_total": 2}[
+                                       "llmctl_fleet_migrations_total": 2,
+                                       "llmctl_fleet_handoffs_total": 3}[
                                            s.name]
-                if s.name == "llmctl_fleet_migration_pause_ms_count":
-                    assert s.value == 2
+                if s.name in ("llmctl_fleet_migration_pause_ms_count",
+                              "llmctl_fleet_handoff_stall_ms_count"):
+                    assert s.value == {
+                        "llmctl_fleet_migration_pause_ms_count": 2,
+                        "llmctl_fleet_handoff_stall_ms_count": 3}[s.name]

@@ -1,0 +1,652 @@
+"""Disaggregated prefill/decode serving (serve/fleet/ roles).
+
+Three layers, mirroring the subsystem's acceptance bar:
+
+- **Router units on fakes**: new requests never land on decode-role
+  replicas (prefix affinity restricted to the prefill-capable subset),
+  payload-carrying requests prefer decode replicas, partial payloads
+  (crash-salvaged pre-copies) still need prefill capability, and
+  ``handoff_dest`` picks the least-outstanding decode replica WITH pool
+  room (None = decode locally).
+- **Role balancer / promotion units on fakes**: hysteresis, floors,
+  drain-then-re-role sequencing, and role-aware health (a role class
+  emptied by crashes promotes a survivor to mixed instead of
+  deadlocking the fleet).
+- **Engine-backed handoff**: prefill on one replica, decode on the
+  other, token-identical to an undisturbed single engine (greedy AND
+  seeded sampling, fp AND int8-KV pages) with zero prefill compute on
+  the decode replica; local-decode fallback when no decode pool exists;
+  crash-dropped migration tickets requeue with their surviving pre-copy
+  payload and re-prefill only the uncovered tail.
+"""
+
+import threading
+import time
+
+import pytest
+
+from distributed_llm_training_and_inference_system_tpu.config import (
+    get_model_config)
+from distributed_llm_training_and_inference_system_tpu.config.schema import (
+    ConfigError,
+    FleetConfig,
+    ServeConfig,
+)
+from distributed_llm_training_and_inference_system_tpu.serve import (
+    InferenceEngine,
+    SamplingParams,
+)
+from distributed_llm_training_and_inference_system_tpu.serve.fleet import (
+    ServeFleet,
+)
+from distributed_llm_training_and_inference_system_tpu.serve.fleet.router import (  # noqa: E501
+    FleetRouter,
+    FleetSaturated,
+)
+from distributed_llm_training_and_inference_system_tpu.serve.fleet.supervisor import (  # noqa: E501
+    ReplicaSupervisor,
+)
+from distributed_llm_training_and_inference_system_tpu.serve.scheduler import (
+    SamplingParams as SP,
+)
+
+PROMPTS = [[5, 17, 99, 3, 42, 7, 23], [1, 2, 3, 4, 5], [9, 8, 7, 6],
+           [11, 12, 13]]
+
+
+def serve_cfg(**overrides) -> ServeConfig:
+    kw = dict(model="gpt-test", max_batch_size=2, max_seq_len=256,
+              prefill_chunk=32, kv_block_size=8, dtype="float32")
+    kw.update(overrides)
+    return ServeConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def model_cfg():
+    return get_model_config("gpt-test")
+
+
+@pytest.fixture(scope="module")
+def ref_engine(model_cfg):
+    return InferenceEngine(model_cfg, serve_cfg(), seed=0)
+
+
+# -- fakes --------------------------------------------------------------------
+
+
+class RoleFake:
+    """Router/supervisor duck surface with the disaggregation extras."""
+
+    def __init__(self, rid, role="mixed", load=0, pool_room=True):
+        self.replica_id = rid
+        self.role = role
+        self.load = load
+        self.pool_room = pool_room
+        self.queue: list = []
+        self.up = True
+        self.state = "healthy"
+        self.drain_requests = 0
+        self.residents: list = []
+        self.migrate_calls: list = []
+        self.migrations_out = 0
+        self.migrated_tokens = 0
+        self.reprefill_avoided_tokens = 0
+        self.migrations_by_reason: dict = {}
+        self.migration_pauses_ms: list = []
+        self.restarts = 0
+        self.last_error = None
+
+    def accepting(self):
+        return self.up and self.state == "healthy"
+
+    def submit(self, req):
+        self.queue.append(req)
+        return True
+
+    def queue_depth(self):
+        return len(self.queue)
+
+    def active_count(self):
+        return len(self.residents)
+
+    def outstanding_tokens(self):
+        return self.load + sum(
+            len(r.prompt_tokens) + r.sampling.max_tokens
+            for r in self.queue)
+
+    def pool_room_for(self, req):
+        return self.pool_room
+
+    def set_role(self, role):
+        self.role = role
+
+    def request_drain(self):
+        self.drain_requests += 1
+        self.state = "draining"
+
+    def undrain(self):
+        if self.state == "drained":
+            self.state = "healthy"
+
+    def resident_requests(self):
+        return list(self.residents)
+
+    def request_migrate(self, request_id, dest=None, reason="operator"):
+        self.migrate_calls.append((request_id, dest, reason))
+        return True
+
+    def migrations_in_flight(self):
+        return 0
+
+    def take_migrated(self):
+        return []
+
+    def take_orphans(self):
+        return []
+
+    def probe(self):
+        return {"replica": self.replica_id}
+
+    def prefix_cache_stats(self):
+        return 0, 0, 0
+
+
+def make_router(roles, cfg=None, **fake_kw):
+    reps = [RoleFake(i, role=ro, **fake_kw) for i, ro in enumerate(roles)]
+    cfg = cfg or FleetConfig(replicas=len(roles),
+                             affinity_prefix_tokens=0)
+    return FleetRouter(reps, cfg), reps
+
+
+# -- router units -------------------------------------------------------------
+
+
+class TestRoleRouting:
+    def test_new_requests_skip_decode_replicas(self):
+        router, reps = make_router(["decode", "prefill", "decode"])
+        for _ in range(4):
+            router.submit([1, 2, 3], SP(max_tokens=4))
+        assert not reps[0].queue and not reps[2].queue
+        assert len(reps[1].queue) == 4
+
+    def test_no_prefill_capable_replica_saturates(self):
+        # reachable only transiently (validation refuses decode-only
+        # fleets; crashes empty the class until promotion runs)
+        router, reps = make_router(["prefill", "decode"])
+        reps[0].up = False
+        with pytest.raises(FleetSaturated):
+            router.submit([1, 2], SP(max_tokens=2))
+
+    def test_payload_requeue_prefers_decode_replica(self):
+        router, reps = make_router(["prefill", "decode"])
+        req = router.submit([1, 2], SP(max_tokens=4))
+        reps[0].queue.remove(req)
+        req.swapped_kv = {"pages": {"num_pages": 1}, "positions": 2,
+                          "last_token": 7}
+        assert router.requeue([req], from_replica=0) == 1
+        assert req in reps[1].queue       # decode-first for payloads
+        assert req.swapped_kv is not None
+
+    def test_partial_payload_needs_prefill_capable(self):
+        # a crash-salvaged pre-copy still re-prefills its tail: the
+        # decode replica (less loaded here) must NOT receive it
+        router, reps = make_router(["prefill", "decode"])
+        reps[0].load = 500
+        req = router.submit([1, 2], SP(max_tokens=4))
+        reps[0].queue.remove(req)
+        req.swapped_kv = {"pages": {"num_pages": 1}, "positions": 8,
+                          "partial": True}
+        assert router.requeue([req], from_replica=1) == 1
+        assert req in reps[0].queue
+
+    def test_handoff_dest_least_outstanding_decode_with_room(self):
+        router, reps = make_router(
+            ["prefill", "decode", "decode", "mixed"])
+        reps[1].load, reps[2].load, reps[3].load = 50, 10, 0
+        req = router.submit([1, 2], SP(max_tokens=4))
+        assert router.handoff_dest(req, from_replica=0) == 2
+        reps[2].pool_room = False
+        assert router.handoff_dest(req, from_replica=0) == 1
+        # pure-decode replicas out of room: a mixed replica may catch it
+        reps[1].pool_room = False
+        assert router.handoff_dest(req, from_replica=0) == 3
+        reps[3].pool_room = False
+        assert router.handoff_dest(req, from_replica=0) is None
+
+    def test_place_handoff_counts_ledger_not_requeues(self):
+        router, reps = make_router(["prefill", "decode"])
+        req = router.submit([1, 2], SP(max_tokens=4))
+        reps[0].queue.remove(req)
+        req.swapped_kv = {"pages": {"num_pages": 1}, "positions": 2,
+                          "last_token": 7}
+        assert router.place_handoff(req, from_replica=0, dest=1)
+        assert req in reps[1].queue
+        st = router.stats()
+        assert st["handoffs"] == 1
+        assert st["migrations"] == 0 and st["requeues"] == 0
+
+    def test_place_handoff_falls_back_to_source(self):
+        # no other accepting replica: the payload restores at home (zero
+        # prefill, just not disaggregated) rather than parking
+        router, reps = make_router(["prefill", "decode"])
+        req = router.submit([1, 2], SP(max_tokens=4))
+        reps[0].queue.remove(req)
+        reps[1].up = False
+        req.swapped_kv = {"pages": {"num_pages": 1}, "positions": 2,
+                          "last_token": 7}
+        assert router.place_handoff(req, from_replica=0, dest=1)
+        assert req in reps[0].queue
+
+
+# -- role balancer / promotion units -----------------------------------------
+
+
+class TestRoleBalancer:
+    def _sup(self, roles, **cfg_kw):
+        kw = dict(replicas=len(roles), affinity_prefix_tokens=0,
+                  roles=",".join(roles), role_balance_ratio=2.0,
+                  role_balance_poll_hysteresis=2)
+        kw.update(cfg_kw)
+        cfg = FleetConfig(**kw)
+        reps = [RoleFake(i, role=ro) for i, ro in enumerate(roles)]
+        router = FleetRouter(reps, cfg)
+        return ReplicaSupervisor(reps, router, cfg), reps
+
+    def _pad(self, rep, n):
+        from distributed_llm_training_and_inference_system_tpu.serve.scheduler import (  # noqa: E501
+            Request)
+        rep.queue.extend(
+            Request(request_id=f"pad-{rep.replica_id}-{i}",
+                    prompt_tokens=[1], sampling=SP(max_tokens=1))
+            for i in range(n))
+
+    def test_hysteresis_then_drain_then_rerole(self):
+        sup, reps = self._sup(["prefill", "decode", "decode"])
+        self._pad(reps[0], 10)            # prefill queue pressure
+        sup.poll_once()                   # streak 1: nothing yet
+        assert reps[1].drain_requests == 0 and reps[2].drain_requests == 0
+        sup.poll_once()                   # streak 2 = hysteresis -> drain
+        donor = min((reps[1], reps[2]),
+                    key=lambda r: r.outstanding_tokens())
+        assert donor.drain_requests == 1
+        # re-role completes only once the drain lands
+        sup.poll_once()
+        assert donor.role == "decode"
+        donor.state = "drained"
+        sup.poll_once()
+        assert donor.role == "prefill"
+        assert donor.state == "healthy"   # undrained back into rotation
+        assert sup.total_reroles == 1
+
+    def test_decode_pressure_reroles_prefill_replica(self):
+        sup, reps = self._sup(["prefill", "prefill", "decode"])
+        self._pad(reps[2], 10)            # handoff backlog on decode
+        sup.poll_once()
+        sup.poll_once()
+        donors = [r for r in reps[:2] if r.drain_requests]
+        assert len(donors) == 1
+        donors[0].state = "drained"
+        sup.poll_once()
+        assert donors[0].role == "decode"
+
+    def test_min_floor_blocks_rerole(self):
+        sup, reps = self._sup(["prefill", "decode"])   # min_decode=1
+        self._pad(reps[0], 50)
+        for _ in range(5):
+            sup.poll_once()
+        assert reps[1].drain_requests == 0
+        assert sup.total_reroles == 0
+
+    def test_balanced_pressure_resets_streak(self):
+        sup, reps = self._sup(["prefill", "decode", "decode"])
+        self._pad(reps[0], 10)
+        sup.poll_once()                   # streak 1
+        reps[0].queue.clear()             # pressure gone
+        sup.poll_once()                   # resets
+        self._pad(reps[0], 10)
+        sup.poll_once()                   # streak 1 again
+        assert all(r.drain_requests == 0 for r in reps)
+
+    def test_disabled_by_default(self):
+        sup, reps = self._sup(["prefill", "decode", "decode"],
+                              role_balance_ratio=0.0)
+        self._pad(reps[0], 100)
+        for _ in range(5):
+            sup.poll_once()
+        assert all(r.drain_requests == 0 for r in reps)
+
+    def test_decode_class_crash_promotes_prefill_survivor(self):
+        sup, reps = self._sup(["prefill", "decode"])
+        reps[1].state = "crashed"
+        sup.poll_once()
+        assert reps[0].role == "mixed"
+        assert sup.total_role_promotions == 1
+        # idempotent: a second poll must not promote again
+        sup.poll_once()
+        assert sup.total_role_promotions == 1
+
+    def test_prefill_class_crash_promotes_decode_survivor(self):
+        sup, reps = self._sup(["prefill", "decode", "decode"])
+        reps[0].state = "crashed"
+        sup.poll_once()
+        promoted = [r for r in reps[1:] if r.role == "mixed"]
+        assert len(promoted) == 1
+        assert sup.total_role_promotions == 1
+
+    def test_all_mixed_fleet_never_promotes(self):
+        sup, reps = self._sup(["mixed", "mixed"])
+        reps[0].state = "crashed"
+        sup.poll_once()
+        assert all(r.role == "mixed" for r in reps)
+        assert sup.total_role_promotions == 0
+
+    def test_operator_set_role(self):
+        sup, reps = self._sup(["prefill", "decode"])
+        assert sup.set_role(1, "mixed")
+        assert reps[1].role == "mixed"
+        assert not sup.set_role(9, "decode")
+        assert not sup.set_role(0, "bogus")
+
+
+class TestFleetConfigRoles:
+    @pytest.mark.parametrize("bad", [
+        {"replicas": 2, "roles": "prefill"},            # count mismatch
+        {"replicas": 2, "roles": "prefill,driver"},     # unknown role
+        {"replicas": 2, "roles": "decode,decode"},      # nothing admits
+        {"role_balance_ratio": -0.5},
+        {"role_balance_poll_hysteresis": 0},
+        {"role_min_prefill": 0},
+        {"role_min_decode": 0},
+    ])
+    def test_validation_rejects(self, bad):
+        with pytest.raises(ConfigError):
+            FleetConfig.from_dict(bad)
+
+    def test_role_list(self):
+        assert FleetConfig(replicas=3).role_list() == ["mixed"] * 3
+        cfg = FleetConfig(replicas=2, roles="Prefill, DECODE")
+        cfg.validate()
+        assert cfg.role_list() == ["prefill", "decode"]
+
+
+# -- engine-backed handoff ----------------------------------------------------
+
+
+def make_disagg_fleet(model_cfg, params, *, roles="prefill,decode",
+                      serve_kw=None, fleet_kw=None) -> ServeFleet:
+    fc_kw = dict(replicas=len(roles.split(",")), roles=roles,
+                 affinity_prefix_tokens=0, restart_backoff_s=0.05,
+                 probe_interval_s=0.05)
+    fc_kw.update(fleet_kw or {})
+    fleet = ServeFleet(model_cfg, serve_cfg(**(serve_kw or {})),
+                       FleetConfig(**fc_kw), params=params,
+                       supervise=False, seed=0)
+    for r in fleet.replicas:
+        # compile BEFORE the engine threads run, then zero the prefill
+        # counters the zero-prefill assertions read (warmup prefills
+        # locally even on the decode replica)
+        r.engine.generate([[1, 2, 3]],
+                          SamplingParams(temperature=0.0, max_tokens=4))
+        r.engine.total_prefill_tokens = 0
+        r.engine.total_unexpected_prefills = 0
+    fleet.start()
+    return fleet
+
+
+class TestDisaggHandoff:
+    def _run(self, fleet, prompts, sampling, timeout=240.0):
+        events, reqs = [], []
+        for p in prompts:
+            ev = threading.Event()
+            reqs.append(fleet.submit(
+                p, sampling, on_complete=lambda _r, ev=ev: ev.set()))
+            events.append(ev)
+        deadline = time.monotonic() + timeout
+        while not all(e.is_set() for e in events):
+            fleet.supervisor.poll_once()
+            time.sleep(0.005)
+            assert time.monotonic() < deadline, "disagg test hung"
+        return reqs
+
+    def test_greedy_token_identity_zero_decode_side_prefill(
+            self, model_cfg, ref_engine):
+        """Acceptance criterion: every handoff resumes token-identically
+        with zero prefill compute on the destination, and the decode
+        replica never dispatches a prefill batch."""
+        greedy = SamplingParams(temperature=0.0, max_tokens=24)
+        ref = [r.generated_tokens
+               for r in ref_engine.generate(PROMPTS, greedy)]
+        fleet = make_disagg_fleet(model_cfg, ref_engine.params)
+        try:
+            reqs = self._run(fleet, PROMPTS, greedy)
+            assert [r.generated_tokens for r in reqs] == ref
+            decode_rep = fleet.replicas[1]
+            assert decode_rep.engine.total_prefill_tokens == 0
+            assert decode_rep.engine.total_unexpected_prefills == 0
+            total = sum(r.engine.total_prefill_tokens
+                        for r in fleet.replicas)
+            assert total == sum(len(p) for p in PROMPTS), (
+                f"re-prefill detected: {total}")
+            snap = fleet.status()
+            assert snap["handoff"]["handoffs"] == len(PROMPTS)
+            assert snap["handoff"]["handoff_tokens"] == sum(
+                len(p) for p in PROMPTS)
+            assert len(snap["handoff"]["stalls_ms"]) == len(PROMPTS)
+            assert {r["replica"]: r["role"] for r in snap["replicas"]} \
+                == {0: "prefill", 1: "decode"}
+            # every request decoded on (and finished from) the decode
+            # replica, and crossed exactly one handoff
+            assert all(r.handoffs == 1 and r.handoff_time is not None
+                       for r in reqs)
+            st = fleet.router.stats()
+            assert st["handoffs"] == len(PROMPTS)
+            assert st["completed"] == len(PROMPTS)
+            assert st["completed"] + st["failed"] + st["rejected"] \
+                == st["submitted"]
+        finally:
+            fleet.shutdown()
+
+    def test_seeded_sampling_token_identity(self, model_cfg, ref_engine):
+        sampled = SamplingParams(temperature=0.9, top_k=16, max_tokens=32,
+                                 seed=1234)
+        ref = [r.generated_tokens
+               for r in ref_engine.generate([PROMPTS[0]], sampled)]
+        fleet = make_disagg_fleet(model_cfg, ref_engine.params)
+        try:
+            reqs = self._run(fleet, [PROMPTS[0]], sampled)
+            assert reqs[0].generated_tokens == ref[0]
+            assert fleet.replicas[1].engine.total_prefill_tokens == 0
+            assert fleet.status()["handoff"]["handoffs"] == 1
+        finally:
+            fleet.shutdown()
+
+    def test_int8_kv_handoff_token_identity(self, model_cfg, ref_engine):
+        """Quantized pages cross the handoff courier: the QuantPages
+        {values, scale} payload restores on the decode replica
+        bit-identically to an undisturbed int8-KV engine."""
+        greedy = SamplingParams(temperature=0.0, max_tokens=32)
+        q8_ref = InferenceEngine(model_cfg,
+                                 serve_cfg(kv_quantization="int8"),
+                                 params=ref_engine.params, seed=0)
+        ref = [r.generated_tokens
+               for r in q8_ref.generate([PROMPTS[0]], greedy)]
+        fleet = make_disagg_fleet(model_cfg, ref_engine.params,
+                                  serve_kw={"kv_quantization": "int8"})
+        try:
+            reqs = self._run(fleet, [PROMPTS[0]], greedy)
+            assert reqs[0].generated_tokens == ref[0]
+            assert fleet.replicas[1].engine.total_prefill_tokens == 0
+            assert fleet.status()["handoff"]["handoffs"] == 1
+        finally:
+            fleet.shutdown()
+
+    def test_local_decode_fallback_without_decode_pool(
+            self, model_cfg, ref_engine):
+        """Satellite: when no decode replica has pool room the prefill
+        replica decodes locally — completion, not deadlock, and the
+        fallback is counted."""
+        greedy = SamplingParams(temperature=0.0, max_tokens=16)
+        ref = [r.generated_tokens
+               for r in ref_engine.generate(PROMPTS[:2], greedy)]
+        # a one-replica prefill-only fleet is the degenerate no-room case
+        fleet = make_disagg_fleet(model_cfg, ref_engine.params,
+                                  roles="prefill")
+        try:
+            reqs = self._run(fleet, PROMPTS[:2], greedy)
+            assert [r.generated_tokens for r in reqs] == ref
+            snap = fleet.status()
+            assert snap["handoff"]["handoffs"] == 0
+            assert snap["handoff"]["local_fallbacks"] == 2
+            st = fleet.router.stats()
+            assert st["completed"] == 2
+        finally:
+            fleet.shutdown()
+
+    def test_decode_pool_full_falls_back_locally(
+            self, model_cfg, ref_engine):
+        """pool_room_for answers False once the decode replica's free
+        pages can't hold the context: the source keeps the sequence."""
+        fleet = make_disagg_fleet(model_cfg, ref_engine.params)
+        try:
+            from distributed_llm_training_and_inference_system_tpu.serve.scheduler import (  # noqa: E501
+                Request)
+            req = Request(request_id="probe", prompt_tokens=[1] * 16,
+                          sampling=SamplingParams(max_tokens=8))
+            assert fleet.replicas[1].pool_room_for(req)
+            kv = fleet.replicas[1].engine.kv
+            taken = [kv._take_free_page() for _ in range(kv.free_pages)]
+            assert not fleet.replicas[1].pool_room_for(req)
+            assert fleet.router.handoff_dest(req, from_replica=0) is None
+            kv._free.extend(taken)    # put the pool back before shutdown
+        finally:
+            fleet.shutdown()
+
+
+class TestDisaggLoadgen:
+    def test_poisson_reports_phase_breakdown(self, model_cfg, ref_engine):
+        """Satellite: loadgen against a disaggregated fleet reports the
+        per-phase TTFT/ITL breakdown plus handoff count + stall
+        percentiles (the `bench e2e --serve-disagg` readout)."""
+        from distributed_llm_training_and_inference_system_tpu.serve.loadgen import (  # noqa: E501
+            run_poisson)
+        fleet = make_disagg_fleet(model_cfg, ref_engine.params)
+        try:
+            res = run_poisson(fleet, offered_rps=30.0, num_requests=6,
+                              prompt_len=8, max_tokens=12, seed=0)
+            assert res.completed == 6, res.summary()
+            assert res.handoffs >= 1
+            assert set(res.phases) == {"prefill", "decode", "handoff"}
+            assert res.phases["prefill"]["replicas"] == [0]
+            assert res.phases["decode"]["replicas"] == [1]
+            assert res.phases["prefill"]["p50_ttft_ms"] is not None
+            assert res.phases["decode"]["p50_itl_ms"] is not None
+            assert res.phases["handoff"]["count"] == res.handoffs
+            assert res.phases["handoff"]["p50_stall_ms"] is not None
+            s = res.summary()
+            assert "phases" in s and "handoffs" in s
+        finally:
+            fleet.shutdown()
+
+    def test_mixed_fleet_has_no_phase_breakdown(self, model_cfg,
+                                                ref_engine):
+        from distributed_llm_training_and_inference_system_tpu.serve.loadgen import (  # noqa: E501
+            run_closed_loop)
+        fleet = ServeFleet(model_cfg, serve_cfg(),
+                           FleetConfig(replicas=2,
+                                       affinity_prefix_tokens=0),
+                           params=ref_engine.params, supervise=False,
+                           seed=0)
+        fleet.start()
+        try:
+            res = run_closed_loop(fleet, concurrency=2, num_requests=4,
+                                  prompt_len=6, max_tokens=6, seed=1)
+            assert res.completed == 4
+            assert res.phases == {}
+            assert "phases" not in res.summary()
+        finally:
+            fleet.shutdown()
+
+
+class TestCrashPayloadSalvage:
+    """PR-3 known gap closed: a migration ticket killed between its two
+    copy phases requeues its victim WITH the surviving pre-copy payload;
+    the destination restores the covered pages and re-prefills only the
+    uncovered tail, crediting reprefill_tokens_avoided."""
+
+    def test_crash_between_phases_reuses_precopy(
+            self, model_cfg, ref_engine):
+        greedy = SamplingParams(temperature=0.0, max_tokens=40)
+        ref = [r.generated_tokens
+               for r in ref_engine.generate([PROMPTS[0]], greedy)]
+        fleet = ServeFleet(model_cfg, serve_cfg(),
+                           FleetConfig(replicas=2,
+                                       affinity_prefix_tokens=0),
+                           params=ref_engine.params, supervise=False,
+                           seed=0)
+        # engine threads NOT started: every step is driven by this test,
+        # so the crash lands deterministically between the two phases
+        try:
+            done = threading.Event()
+            req = fleet.submit(PROMPTS[0], greedy,
+                               on_complete=lambda _r: done.set())
+            home = fleet.router.replica_of(req.request_id)
+            src, dst = fleet.replicas[home], fleet.replicas[1 - home]
+            while len(req.generated_tokens) < 18:
+                src.engine.step()
+            assert src.request_migrate(req.request_id,
+                                       dest=dst.replica_id)
+            src._service_migrations()          # phase 1: pre-copy done
+            ticket = src._migrations[req.request_id]
+            assert ticket.phase == "stop"
+            full = ticket.pre["full_pages"]
+            assert full >= 2                   # >=18 tokens, page size 8
+            src._crash(RuntimeError("boom"))
+            orphans = src.take_orphans()
+            assert req in orphans
+            assert req.swapped_kv is not None
+            assert req.swapped_kv["partial"]
+            ps = src.engine.kv.page_size
+            covered = full * ps
+            assert req.swapped_kv["positions"] == covered
+            ctx_len = len(req.context_tokens)
+            assert fleet.router.requeue(orphans,
+                                        from_replica=home) == 1
+            pre_pf = dst.engine.total_prefill_tokens
+            while not done.is_set():
+                dst.engine.step()
+            assert req.generated_tokens == ref[0]
+            # only the uncovered tail was computed on the destination
+            assert dst.engine.total_prefill_tokens - pre_pf \
+                == ctx_len - covered
+            assert dst.engine.total_requeue_cached_tokens == covered
+            assert dst.engine.total_partial_restores == 1
+            # the fleet metric credits the salvaged tokens
+            snap = fleet.supervisor.snapshot()
+            assert snap["migration"]["reprefill_tokens_avoided"] \
+                >= covered
+        finally:
+            fleet.shutdown()
+
+    def test_phase1_crash_has_no_payload(self, model_cfg, ref_engine):
+        """A ticket that never finished its pre-copy salvages nothing:
+        the victim falls back to plain re-prefill requeue."""
+        greedy = SamplingParams(temperature=0.0, max_tokens=24)
+        fleet = ServeFleet(model_cfg, serve_cfg(),
+                           FleetConfig(replicas=2,
+                                       affinity_prefix_tokens=0),
+                           params=ref_engine.params, supervise=False,
+                           seed=0)
+        try:
+            req = fleet.submit(PROMPTS[0], greedy)
+            home = fleet.router.replica_of(req.request_id)
+            src = fleet.replicas[home]
+            while len(req.generated_tokens) < 4:
+                src.engine.step()
+            assert src.request_migrate(req.request_id)
+            # no _service_migrations call: the ticket is still pre-phase-1
+            src._crash(RuntimeError("boom"))
+            orphans = src.take_orphans()
+            assert req in orphans
+            assert req.swapped_kv is None
+        finally:
+            fleet.shutdown()
