@@ -174,11 +174,20 @@ def kernels(op, seq_len, hidden, heads, batch):
                    "the rest decode, and every sequence crosses the KV "
                    "handoff courier; results gain the per-phase TTFT/ITL "
                    "breakdown with handoff counts + stall percentiles.")
+@click.option("--serve-courier-chaos", default=0.0, show_default=True,
+              type=float,
+              help="serve-load fleet: inject seeded courier chunk faults "
+                   "at this rate (split evenly across drop/corrupt/"
+                   "delay), with a 1 KiB chunk size so payloads span "
+                   "many chunks — the resilience A/B: compare goodput "
+                   "and transfer-stall percentiles against 0.0 (clean "
+                   "link). Results always carry the courier section "
+                   "(transfers/retries/aborts + p50/p99_transfer_ms).")
 def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
         requests, rps, concurrency, admission, kv_blocks, device_times,
         preemption, latency_dispatch_steps, artifact, quant, kv_quant,
         slots, pipelined, int8_pallas, serve_max_retries, serve_replicas,
-        serve_disagg):
+        serve_disagg, serve_courier_chaos):
     """End-to-end train step throughput / serve TTFT+throughput
     (parity: reference bench.py:35-49). ``serve-load`` runs open-loop
     (Poisson) and closed-loop sweeps with p50/p99 TTFT, per-token latency,
@@ -304,8 +313,25 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
                 fc_kw["roles"] = ",".join(
                     ["prefill"] * n_pre
                     + ["decode"] * (serve_replicas - n_pre))
+            fault_plan = None
+            if serve_courier_chaos > 0:
+                # lossy-link A/B: small chunks so every payload spans
+                # many frames, generous retry budget so the run measures
+                # degradation (stall), not abort-to-re-prefill
+                from ...serve.fleet import FaultPlan
+                fc_kw.update(courier_chunk_bytes=1024,
+                             courier_max_retries=12,
+                             courier_retry_backoff_ms=0.5,
+                             courier_retry_backoff_max_ms=8.0,
+                             courier_chunk_deadline_ms=50.0)
+                rate = serve_courier_chaos / 3.0
+                fault_plan = FaultPlan(seed=0, chunk_drop_rate=rate,
+                                       chunk_corrupt_rate=rate,
+                                       chunk_delay_rate=rate,
+                                       chunk_delay_ms=60.0)
             fleet = ServeFleet(cfg, point_serve_cfg(),
-                               FleetConfig(**fc_kw))
+                               FleetConfig(**fc_kw),
+                               fault_plan=fault_plan)
             for r in fleet.replicas:
                 r.engine.generate([list(range(1, prompt_len + 1))],
                                   SamplingParams(temperature=0.0,

@@ -62,19 +62,28 @@ def status(url, as_json):
     table = Table(title="Fleet replicas")
     for col in ("replica", "state", "role", "queue", "active",
                 "outstanding tok", "restarts", "migr out", "handoffs",
-                "prefix hit", "last error"):
+                "courier out", "courier aborts", "prefix hit",
+                "last error"):
         table.add_column(col)
+    per_src = snap.get("courier", {}).get("per_src", {})
     for r in snap["replicas"]:
         color = {"healthy": "green", "draining": "yellow",
                  "drained": "yellow"}.get(r["state"], "red")
         hit = r.get("prefix_hit_rate")
+        role = r.get("role", "mixed")
+        if r.get("promoted_from"):
+            # crash-promoted; auto-demotes once the lost class returns
+            role = f"{role} (was {r['promoted_from']})"
+        src = per_src.get(str(r["replica"]), {})
         table.add_row(str(r["replica"]),
                       f"[{color}]{r['state']}[/{color}]",
-                      r.get("role", "mixed"),
+                      role,
                       str(r["queue_depth"]), str(r["active"]),
                       str(r["outstanding_tokens"]), str(r["restarts"]),
                       str(r.get("migrations", 0)),
                       str(r.get("handoffs", 0)),
+                      str(src.get("transfers", 0)),
+                      str(src.get("aborts", 0)),
                       f"{hit:.0%}" if hit is not None else "-",
                       (r.get("last_error") or "")[:48])
     console = Console()
@@ -93,13 +102,27 @@ def status(url, as_json):
             f"avoided, {mig['in_flight']} in flight)")
     ho = snap.get("handoff")
     if ho and (ho.get("handoffs") or ho.get("local_fallbacks")
-               or ho.get("reroles") or ho.get("promotions")):
+               or ho.get("reroles") or ho.get("promotions")
+               or ho.get("demotions")):
         console.print(
             f"disagg: {ho.get('handoffs', 0)} prefill->decode handoffs "
             f"({ho.get('handoff_tokens', 0)} KV tokens, "
             f"{ho.get('local_fallbacks', 0)} local fallbacks, "
             f"{ho.get('reroles', 0)} re-roles, "
-            f"{ho.get('promotions', 0)} promotions)")
+            f"{ho.get('promotions', 0)} promotions, "
+            f"{ho.get('demotions', 0)} demotions)")
+    cour = snap.get("courier")
+    if cour and (cour.get("transfers") or cour.get("aborts")
+                 or cour.get("in_flight")):
+        console.print(
+            f"courier: {cour.get('in_flight', 0)} in flight, "
+            f"{cour.get('transfers', 0)} transfers "
+            f"({cour.get('bytes_moved', 0)} bytes, "
+            f"{cour.get('chunks', 0)} chunks, "
+            f"{cour.get('retries', 0)} retries, "
+            f"{cour.get('corruptions', 0)} corruptions, "
+            f"{cour.get('resumes', 0)} resumes, "
+            f"{cour.get('aborts', 0)} aborts)")
 
 
 @app.command()

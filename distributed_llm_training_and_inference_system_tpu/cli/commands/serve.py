@@ -149,6 +149,30 @@ def app(ctx):
                    "depth exceeds this multiple of the other's for "
                    "consecutive supervisor polls (drain-with-migration "
                    "first, so nothing is lost); 0 disables.")
+@click.option("--fleet-courier-transport", "fleet_courier_transport",
+              type=click.Choice(["inproc", "http"]), default="inproc",
+              show_default=True,
+              help="KV courier link for migration/handoff payloads: "
+                   "inproc (threaded replicas, this process) or http "
+                   "(POST chunks to --fleet-courier-endpoint's "
+                   "/fleet/courier/chunk — cross-host movement).")
+@click.option("--fleet-courier-chunk-bytes", default=256 * 1024,
+              show_default=True,
+              help="Courier frame size: payloads are split into chunks "
+                   "of at most this many bytes, each CRC32-checksummed "
+                   "and individually retryable.")
+@click.option("--fleet-courier-retries", default=4, show_default=True,
+              help="Resend rounds before a transfer aborts (only missing "
+                   "chunks resend, backoff doubles per round). An "
+                   "aborted transfer drops the payload and the "
+                   "destination re-prefills — degraded, never wrong.")
+@click.option("--fleet-courier-deadline-ms", default=100.0,
+              show_default=True, type=float,
+              help="Per-chunk delivery deadline; a chunk slower than "
+                   "this counts as lost and is retransmitted (the "
+                   "receiver absorbs the late duplicate idempotently).")
+@click.option("--fleet-courier-endpoint", default="", show_default=True,
+              help="http transport only: destination fleet base URL.")
 def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
           kv_block_size, kv_hbm_gb, scheduler, dtype, prometheus_port,
           speculative, spec_tokens, prefix_cache, tensor_parallel,
@@ -158,7 +182,10 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
           fleet_probe_interval, fleet_restart_backoff,
           fleet_affinity_tokens, fleet_migrate_on_drain,
           fleet_rebalance_ratio, fleet_rebalance_hysteresis,
-          fleet_max_migrations, fleet_roles, fleet_role_balance_ratio):
+          fleet_max_migrations, fleet_roles, fleet_role_balance_ratio,
+          fleet_courier_transport, fleet_courier_chunk_bytes,
+          fleet_courier_retries, fleet_courier_deadline_ms,
+          fleet_courier_endpoint):
     """Start the OpenAI-compatible inference server."""
     import jax
 
@@ -198,7 +225,12 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
             rebalance_poll_hysteresis=fleet_rebalance_hysteresis,
             max_concurrent_migrations=fleet_max_migrations,
             roles=fleet_roles,
-            role_balance_ratio=fleet_role_balance_ratio)
+            role_balance_ratio=fleet_role_balance_ratio,
+            courier_transport=fleet_courier_transport,
+            courier_chunk_bytes=fleet_courier_chunk_bytes,
+            courier_max_retries=fleet_courier_retries,
+            courier_chunk_deadline_ms=fleet_courier_deadline_ms,
+            courier_endpoint=fleet_courier_endpoint)
         fleet_cfg.validate()
 
     observer = None

@@ -798,6 +798,29 @@ class FleetConfig:
     role_balance_poll_hysteresis: int = 3
     role_min_prefill: int = 1
     role_min_decode: int = 1
+    # crash-promoted mixed replicas (role-aware health) demote back to
+    # their provisioned role once the crashed class is healthy again for
+    # this many consecutive supervisor polls. 0 disables auto-demotion
+    # (promotions then stay until the operator re-splits, PR-4 behavior).
+    role_restore_hysteresis: int = 3
+    # -- courier transport (serve/fleet/transport.py) ------------------------
+    # every migration / handoff / salvaged-partial payload crosses the
+    # courier: framed into <= courier_chunk_bytes chunks (CRC32 each,
+    # whole-payload CRC verified end-to-end), per-chunk deadline, lost or
+    # corrupt chunks retried with doubling backoff for up to
+    # courier_max_retries resend rounds (ONLY missing chunks resend —
+    # resumable transfer). A transfer that exhausts the budget drops the
+    # payload and the destination re-prefills from tokens: degraded,
+    # never wrong. "inproc" delivers within this process (threaded
+    # replicas, byte-for-byte what PR-3/4 shipped); "http" POSTs chunks
+    # to courier_endpoint's /fleet/courier/chunk (cross-host movement).
+    courier_transport: str = "inproc"
+    courier_chunk_bytes: int = 256 * 1024
+    courier_max_retries: int = 4
+    courier_retry_backoff_ms: float = 2.0
+    courier_retry_backoff_max_ms: float = 100.0
+    courier_chunk_deadline_ms: float = 100.0
+    courier_endpoint: str = ""      # http transport: dest fleet base URL
 
     def role_list(self) -> list[str]:
         """Per-replica role assignment; empty config = all mixed."""
@@ -851,6 +874,26 @@ class FleetConfig:
             raise ConfigError("role_balance_poll_hysteresis must be >= 1")
         if self.role_min_prefill < 1 or self.role_min_decode < 1:
             raise ConfigError("role_min_prefill/role_min_decode must be >= 1")
+        if self.role_restore_hysteresis < 0:
+            raise ConfigError(
+                "role_restore_hysteresis must be >= 0 (0 disables)")
+        if self.courier_transport not in ("inproc", "http"):
+            raise ConfigError(
+                f"unknown courier_transport "
+                f"{self.courier_transport!r} (inproc|http)")
+        if self.courier_transport == "http" and not self.courier_endpoint:
+            raise ConfigError(
+                "courier_transport=http needs courier_endpoint (the "
+                "destination fleet front's base URL)")
+        if self.courier_chunk_bytes < 1024:
+            raise ConfigError("courier_chunk_bytes must be >= 1024")
+        if self.courier_max_retries < 0:
+            raise ConfigError("courier_max_retries must be >= 0")
+        if self.courier_retry_backoff_ms < 0 \
+                or self.courier_retry_backoff_max_ms < 0:
+            raise ConfigError("courier retry backoff values must be >= 0")
+        if self.courier_chunk_deadline_ms <= 0:
+            raise ConfigError("courier_chunk_deadline_ms must be > 0")
 
     @classmethod
     def from_dict(cls, d: dict[str, Any] | None) -> "FleetConfig":

@@ -248,6 +248,30 @@ class PrometheusExporter:
         self.fleet_replica_role = g(
             "llmctl_fleet_replica_role",
             "Replica role (0=mixed, 1=prefill, 2=decode)", ["replica"])
+        # courier transport plane (serve/fleet/transport.py): how hard
+        # the KV link is working and how often it fails. Retries /
+        # corruptions / resumes are the lossy-link health signals;
+        # aborts count transfers that degraded to re-prefill.
+        self.fleet_courier_chunks = c(
+            "llmctl_fleet_courier_chunks",
+            "Courier chunk send attempts (incl. retransmissions)")
+        self.fleet_courier_retries = c(
+            "llmctl_fleet_courier_retries",
+            "Courier chunk retransmissions (lost, late, or corrupt)")
+        self.fleet_courier_corruptions = c(
+            "llmctl_fleet_courier_corruptions",
+            "Courier chunks rejected by CRC32 at the receiver")
+        self.fleet_courier_resumes = c(
+            "llmctl_fleet_courier_resumes",
+            "Courier resend rounds (only missing chunks resent)")
+        self.fleet_courier_aborts = c(
+            "llmctl_fleet_courier_aborts",
+            "Courier transfers that exhausted their retry budget "
+            "(payload dropped; destination re-prefilled)")
+        self.fleet_courier_transfer = h(
+            "llmctl_fleet_courier_transfer_ms",
+            "End-to-end courier transfer time per payload (ms)",
+            buckets=(.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 1000, 5000))
         self._last_totals: dict[str, float] = {}
         self._server_started = False
 
@@ -367,6 +391,28 @@ class PrometheusExporter:
             for s in stalls[-min(new, len(stalls)):]:
                 self.fleet_handoff_stall.observe(s)
         self._last_totals["fleet_handoff_stalls"] = count
+        # courier transport plane: counters on running totals, the
+        # transfer histogram on the bounded recent window (same delta
+        # contract as migration pauses / handoff stalls above)
+        cour = snap.get("courier", {})
+        for key, counter in (
+                ("chunks", self.fleet_courier_chunks),
+                ("retries", self.fleet_courier_retries),
+                ("corruptions", self.fleet_courier_corruptions),
+                ("resumes", self.fleet_courier_resumes),
+                ("aborts", self.fleet_courier_aborts)):
+            total = cour.get(key, 0)
+            delta = total - self._last_totals.get(f"fleet_cour_{key}", 0)
+            if delta > 0:
+                counter.inc(delta)
+            self._last_totals[f"fleet_cour_{key}"] = total
+        count = cour.get("transfer_count", 0)
+        new = int(count - self._last_totals.get("fleet_cour_transfers", 0))
+        xfers = cour.get("transfer_ms", [])
+        if new > 0:
+            for t in xfers[-min(new, len(xfers)):]:
+                self.fleet_courier_transfer.observe(t)
+        self._last_totals["fleet_cour_transfers"] = count
 
 
 class OTLPExporter:

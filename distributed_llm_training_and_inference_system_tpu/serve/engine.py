@@ -744,15 +744,23 @@ class InferenceEngine:
             self._req_slot[rid] = slot
             cached = len(pins) * PS       # context tokens served from cache
             if partial is not None:
-                self.kv.write_slot_pages(slot, partial["pages"])
-                cached = int(partial["positions"])
+                try:
+                    self.kv.write_slot_pages(slot, partial["pages"])
+                    cached = int(partial["positions"])
+                    self.total_partial_restores += 1
+                    if req.fleet_requeued:
+                        # prefill FLOPs the fleet did NOT respend thanks
+                        # to the salvaged pre-copy — feeds the fleet's
+                        # reprefill_tokens_avoided metric
+                        self.total_requeue_cached_tokens += cached
+                except (ValueError, KeyError, TypeError) as e:
+                    # malformed salvage payload: fall back to a FULL
+                    # prefill over the already-allocated chain — slower,
+                    # never wrong, never a dead engine thread
+                    logger.warning(
+                        "partial restore payload for %s rejected (%s); "
+                        "re-prefilling the whole context", rid, e)
                 req.swapped_kv = None
-                self.total_partial_restores += 1
-                if req.fleet_requeued:
-                    # prefill FLOPs the fleet did NOT respend thanks to
-                    # the salvaged pre-copy — feeds the fleet's
-                    # reprefill_tokens_avoided metric
-                    self.total_requeue_cached_tokens += cached
             if cached == 0:
                 # table entries for the bucket: beyond-length -> scratch 0
                 bucket = self._bucket(n)
@@ -1204,7 +1212,18 @@ class InferenceEngine:
         rid = req.request_id
         saved = req.swapped_kv
         with self.lock:
-            if not self.kv.restore_slot(slot, saved["pages"]):
+            try:
+                ok = self.kv.restore_slot(slot, saved["pages"])
+            except (ValueError, KeyError, TypeError) as e:
+                # malformed payload (courier bug / schema drift): treat
+                # exactly like a pool-full restore — the caller clears
+                # swapped_kv and re-prefills from tokens. Wrong tokens
+                # are the one unacceptable outcome; extra compute is not.
+                logger.warning(
+                    "swap-in payload for %s rejected (%s); falling back "
+                    "to re-prefill", rid, e)
+                ok = False
+            if not ok:
                 return False
             self._reserved_pages -= self._reserved_by.pop(rid, 0)
             self._req_slot[rid] = slot

@@ -32,20 +32,27 @@ from typing import Callable, Optional, Sequence
 
 from ...config.schema import FleetConfig, ModelConfig, ServeConfig
 from ..scheduler import Request, SamplingParams
-from .faults import FaultInjector, FaultPlan, InjectedCrash, ProbeTimeout
+from .faults import (DestUnreachable, FaultInjector, FaultPlan,
+                     InjectedCrash, ProbeTimeout)
 from .migration import MigrationTicket
 from .replica import (ROLE_DECODE, ROLE_MIXED, ROLE_PREFILL, EngineReplica,
                       reset_for_requeue)
 from .router import FleetRouter, FleetSaturated, prefix_digest
 from .supervisor import ReplicaSupervisor
+from .transport import (HTTPCourierTransport, InProcTransport, KVCourier,
+                        TransferAborted, TransportError, build_transport)
 
 __all__ = [
+    "DestUnreachable",
     "EngineReplica",
     "FaultInjector",
     "FaultPlan",
     "FleetRouter",
     "FleetSaturated",
+    "HTTPCourierTransport",
+    "InProcTransport",
     "InjectedCrash",
+    "KVCourier",
     "MigrationTicket",
     "ProbeTimeout",
     "ROLE_DECODE",
@@ -53,6 +60,9 @@ __all__ = [
     "ROLE_PREFILL",
     "ReplicaSupervisor",
     "ServeFleet",
+    "TransferAborted",
+    "TransportError",
+    "build_transport",
     "prefix_digest",
     "reset_for_requeue",
 ]
@@ -96,8 +106,22 @@ class ServeFleet:
             self.replicas.append(r)
         self.model_cfg = model_cfg
         self._params = params
+        # KV courier: every migration / handoff / salvaged-partial
+        # payload crosses this chunked, checksummed, retrying transport
+        # (serve/fleet/transport.py). InProc by default — byte-for-byte
+        # today's behavior, with the injector able to drop / corrupt /
+        # delay / duplicate chunks deterministically.
+        self.courier = KVCourier(build_transport(
+            self.fleet_cfg, injector=self.injector))
+        # inbound chunk reassembly for the HTTP front
+        # (/fleet/courier/chunk): the in-proc transport's own receiver
+        # when there is one, so loopback HTTP and in-proc transfers share
+        # state; a standalone receiver otherwise
+        from .transport import CourierReceiver
+        self.courier_receiver = getattr(self.courier.transport, "receiver",
+                                        None) or CourierReceiver()
         self.router = FleetRouter(self.replicas, self.fleet_cfg,
-                                  observer=observer)
+                                  observer=observer, courier=self.courier)
         for r in self.replicas:
             # disaggregation wiring: a prefill-role replica asks the
             # router for a decode destination BEFORE extracting (local-

@@ -15,6 +15,10 @@ Faults are injected at the same seams real failures enter:
                or partitioned replica whose engine thread still runs
 - straggler  — a fixed per-step delay, modelling a thermally throttled or
                noisy-neighbour chip that is slow but not dead
+- transport  — per-chunk drop / corrupt / delay / duplicate plus
+               dest-unreachable, drawn per courier chunk send from a
+               dedicated seeded RNG stream (serve/fleet/transport.py), so
+               the KV courier's whole failure matrix replays from a seed
 """
 
 from __future__ import annotations
@@ -32,6 +36,11 @@ class InjectedCrash(RuntimeError):
 
 class ProbeTimeout(RuntimeError):
     """Raised from a health probe to simulate a hung/partitioned replica."""
+
+
+class DestUnreachable(RuntimeError):
+    """Raised at courier transfer open to simulate a partitioned or
+    connection-refused destination host."""
 
 
 @dataclass
@@ -56,6 +65,24 @@ class FaultPlan:
     # straggler: every engine step of `slow_replica` is delayed `slow_ms`
     slow_replica: Optional[int] = None
     slow_ms: float = 0.0
+    # transport (courier chunk) faults: each chunk send draws once from a
+    # seeded RNG stream; at most one fault kind fires per chunk (drop
+    # beats corrupt beats delay beats duplicate, in that order). Rates
+    # are probabilities in [0, 1]; rate 1.0 makes EVERY chunk fail that
+    # way (the abort-path test). `chunk_fault_budget` caps how many
+    # chunk faults fire in total (0 = unlimited) so a lossy link can be
+    # modelled as transiently bad rather than forever-broken.
+    chunk_drop_rate: float = 0.0
+    chunk_corrupt_rate: float = 0.0
+    chunk_delay_rate: float = 0.0
+    chunk_delay_ms: float = 0.0      # stall applied when a delay fires
+    chunk_duplicate_rate: float = 0.0
+    chunk_fault_budget: int = 0
+    # dest unreachable: the next `dest_unreachable_count` TRANSFERS whose
+    # destination is `dest_unreachable_replica` fail before any chunk
+    # moves (connection refused / network partition at transfer open)
+    dest_unreachable_replica: Optional[int] = None
+    dest_unreachable_count: int = 0
 
 
 class FaultInjector:
@@ -74,6 +101,12 @@ class FaultInjector:
         if p.crash_replica is not None and p.crash_after_steps <= 0:
             self._crash_step = int(np.random.default_rng(p.seed).integers(
                 p.crash_step_lo, max(p.crash_step_hi, p.crash_step_lo + 1)))
+        # transport-fault state: a dedicated RNG stream (seed+1 so chunk
+        # draws never alias the crash-step draw) + remaining budgets
+        self._chunk_rng = np.random.default_rng(p.seed + 1)
+        self._chunk_faults_left = (p.chunk_fault_budget
+                                   if p.chunk_fault_budget > 0 else None)
+        self._unreachable_left = p.dest_unreachable_count
 
     def before_step(self, replica_id: int) -> None:
         """Called by the replica loop before each engine step; raises
@@ -106,6 +139,50 @@ class FaultInjector:
         if fire:
             raise ProbeTimeout(
                 f"injected probe timeout: replica {replica_id}")
+
+    def on_transfer(self, dest) -> None:
+        """Called by the courier before each send round; raises
+        DestUnreachable for the planned number of rounds to the planned
+        destination (the sender retries the whole round under its normal
+        backoff schedule, so a healed partition resumes the transfer)."""
+        with self._lock:
+            fire = (self.plan.dest_unreachable_replica is not None
+                    and dest == self.plan.dest_unreachable_replica
+                    and self._unreachable_left > 0)
+            if fire:
+                self._unreachable_left -= 1
+        if fire:
+            raise DestUnreachable(
+                f"injected unreachable destination: replica {dest}")
+
+    def on_chunk(self, src, dest, ticket: str, seq: int) -> Optional[dict]:
+        """Called by the courier transport per chunk send attempt.
+        Returns None (no fault) or one of {"drop": True},
+        {"corrupt": True}, {"delay_ms": X}, {"duplicate": True}. Draws
+        come from a seeded stream under the lock, so a single-courier
+        scenario replays bit-identically from the plan's seed."""
+        p = self.plan
+        if not (p.chunk_drop_rate or p.chunk_corrupt_rate
+                or p.chunk_delay_rate or p.chunk_duplicate_rate):
+            return None
+        with self._lock:
+            if self._chunk_faults_left is not None \
+                    and self._chunk_faults_left <= 0:
+                return None
+            u = float(self._chunk_rng.random())
+            edge = p.chunk_drop_rate
+            fault = None
+            if u < edge:
+                fault = {"drop": True}
+            elif u < (edge := edge + p.chunk_corrupt_rate):
+                fault = {"corrupt": True}
+            elif u < (edge := edge + p.chunk_delay_rate):
+                fault = {"delay_ms": p.chunk_delay_ms}
+            elif u < edge + p.chunk_duplicate_rate:
+                fault = {"duplicate": True}
+            if fault is not None and self._chunk_faults_left is not None:
+                self._chunk_faults_left -= 1
+        return fault
 
     def steps_taken(self, replica_id: int) -> int:
         with self._lock:

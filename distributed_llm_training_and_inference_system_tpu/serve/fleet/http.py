@@ -15,6 +15,14 @@ operator endpoints:
 - ``POST /fleet/role``    — ``{"replica": N, "role": "prefill|decode|
   mixed"}``: manual re-role for disaggregated prefill/decode serving
   (``FleetConfig.roles``; drain first for a loss-free switch)
+- ``POST /fleet/courier/chunk`` — one KV-courier frame (ticket, seq,
+  total, crc32, base64 data; chunk 0 carries the manifest). Idempotent:
+  duplicates ack without effect; a CRC mismatch acks ``ok: false`` and
+  the sender retransmits. The ack lists which sequence numbers are still
+  missing, so a resumed transfer sends only those.
+- ``POST /fleet/courier/claim`` — ``{"ticket": ...}``: hand a completed
+  transfer's (manifest, blob) back; 404 while chunks are missing. The
+  remote half of :class:`~.transport.HTTPCourierTransport`.
 
 Backpressure contract: when every replica saturates, completions answer
 **429 with a Retry-After header** (seconds) instead of queueing without
@@ -226,6 +234,44 @@ class FleetServer:
         return web.json_response({"ok": True, "replica": replica,
                                   "role": role, "action": "role"})
 
+    async def handle_courier_chunk(self, request: web.Request
+                                   ) -> web.Response:
+        """One courier frame in; the reassembly ack out. Always HTTP 200
+        with {"ok": bool, ...} — transport-level failures (corrupt CRC)
+        are data for the sender's retry loop, not HTTP errors."""
+        from .transport import CourierChunk
+        try:
+            body = await request.json()
+            chunk = CourierChunk.from_wire(body)
+        except Exception:
+            return web.json_response(
+                {"error": "body must be a courier chunk frame "
+                          "{ticket, seq, total, crc32, data(b64)}"},
+                status=400)
+        return web.json_response(
+            self.fleet.courier_receiver.add_chunk(chunk))
+
+    async def handle_courier_claim(self, request: web.Request
+                                   ) -> web.Response:
+        import base64 as _b64
+
+        from .transport import TransferAborted
+        try:
+            body = await request.json()
+            ticket = str(body["ticket"])
+        except Exception:
+            return web.json_response(
+                {"error": "body must be {\"ticket\": <id>}"}, status=400)
+        try:
+            manifest, blob = \
+                self.fleet.courier_receiver.claim_encoded(ticket)
+        except TransferAborted as e:
+            return web.json_response({"ok": False, "error": str(e)},
+                                     status=404)
+        return web.json_response({
+            "ok": True, "ticket": ticket, "manifest": manifest,
+            "blob": _b64.b64encode(blob).decode()})
+
     async def handle_metrics(self, request: web.Request) -> web.Response:
         try:
             from prometheus_client import generate_latest
@@ -246,6 +292,10 @@ class FleetServer:
         app.router.add_post("/fleet/undrain", self.handle_fleet_undrain)
         app.router.add_post("/fleet/migrate", self.handle_fleet_migrate)
         app.router.add_post("/fleet/role", self.handle_fleet_role)
+        app.router.add_post("/fleet/courier/chunk",
+                            self.handle_courier_chunk)
+        app.router.add_post("/fleet/courier/claim",
+                            self.handle_courier_claim)
         return app
 
     # -- lifecycle -----------------------------------------------------------

@@ -24,8 +24,11 @@ The pause is bounded with a **two-phase copy**:
 Payloads are host numpy arrays in exactly the ``Request.swapped_kv``
 schema the intra-engine preemption=swap path defined, so the destination
 needs NO new restore code — and because they are plain serializable
-arrays, a cross-host courier (or prefill/decode disaggregation) can ship
-the same payload over a transport later without touching either engine.
+arrays, the courier transport (serve/fleet/transport.py) frames them
+into checksummed, retryable chunks at placement time: every payload this
+module extracts crosses that link (in-proc today, HTTP cross-host) and
+a transfer that fails end-to-end degrades to re-prefill, never to wrong
+tokens.
 """
 
 from __future__ import annotations
@@ -55,6 +58,20 @@ def _concat_pages(a, b):
     if isinstance(a, dict):
         return {k: np.concatenate([a[k], b[k]], axis=1) for k in a}
     return np.concatenate([a, b], axis=1)
+
+
+def payload_nbytes(payload: Optional[dict]) -> int:
+    """Host bytes a courier transfer moves for this payload (the chunk
+    count is ceil(nbytes / courier_chunk_bytes)) — sizing input for the
+    transport layer and the per-move log detail."""
+    if not payload:
+        return 0
+
+    def walk(node) -> int:
+        if isinstance(node, dict):
+            return sum(walk(v) for v in node.values())
+        return node.nbytes if isinstance(node, np.ndarray) else 0
+    return walk(payload)
 
 
 def handoff_slot(engine, slot: int) -> tuple[dict, dict]:

@@ -419,7 +419,9 @@ class EngineReplica:
         self.handoff_tokens += int(payload["positions"])
         self.handoff_stalls_ms.append(float(stall_ms))
         self.handoff_log.append({**detail, "request_id": req.request_id,
-                                 "dest": dest, "stall_ms": stall_ms})
+                                 "dest": dest, "stall_ms": stall_ms,
+                                 "payload_bytes":
+                                     migration.payload_nbytes(payload)})
         logger.info(
             "replica %d handed off %s -> replica %s: %d prefill tokens in "
             "%d pages, stall %.2f ms", self.replica_id, req.request_id,
@@ -440,7 +442,9 @@ class EngineReplica:
             self.reprefill_avoided_tokens += len(req.context_tokens)
         self.migration_pauses_ms.append(float(detail["pause_ms"]))
         self.migration_log.append({**detail, "request_id": req.request_id,
-                                   "reason": reason})
+                                   "reason": reason,
+                                   "payload_bytes":
+                                       migration.payload_nbytes(payload)})
         logger.info(
             "replica %d migrated %s out (%s): %d tokens, %d pages "
             "pre-copied + %d stop-copied, pause %.2f ms",

@@ -80,11 +80,16 @@ def _needs_prefill(req: Request) -> bool:
 
 class FleetRouter:
     def __init__(self, replicas: Iterable, cfg: Optional[FleetConfig] = None,
-                 observer: Optional[Callable[[str, dict], None]] = None):
+                 observer: Optional[Callable[[str, dict], None]] = None,
+                 courier=None):
         self.cfg = cfg or FleetConfig()
         self.replicas = list(replicas)
         self.by_id = {r.replica_id: r for r in self.replicas}
         self.observer = observer or (lambda event, payload: None)
+        # KV courier (serve/fleet/transport.py): every payload-carrying
+        # placement ships the pages through it src->dest before submit.
+        # None = legacy direct hand-off (fake-replica unit tests).
+        self.courier = courier
         # _lock guards router bookkeeping ONLY. It is never held across a
         # replica.submit() call: submit takes the engine lock, and the
         # engine thread calls back into on_request_exit under that same
@@ -284,13 +289,15 @@ class FleetRouter:
                                 f"{self.cfg.max_requeues})")
                 continue
             # keep_kv: payload presence was decided replica-side — drain
-            # victims under migrate_on_drain travel WITH their KV pages;
+            # victims under migrate_on_drain travel WITH their KV pages
+            # (and crash-salvaged partial pre-copies ride here too);
             # crash paths already stripped theirs in _rip_out
             reset_for_requeue(req, keep_kv=True)
-            if self._place(req, exclude=frozenset({from_replica})):
+            if self._place(req, exclude=frozenset({from_replica}),
+                           src=from_replica):
                 placed += 1
-            elif self._place(req):    # lone-replica fleet: same one is fine
-                placed += 1
+            elif self._place(req, src=from_replica):
+                placed += 1           # lone-replica fleet: same one is fine
             else:
                 with self._lock:
                     overflow = (len(self._parked)
@@ -329,7 +336,9 @@ class FleetRouter:
         placed = False
         if dest is not None:
             r = self.by_id.get(dest)
-            if r is not None and r.accepting() and r.submit(req):
+            if r is not None and r.accepting() \
+                    and self._ship(req, from_replica, dest) \
+                    and r.submit(req):
                 placed = True
                 with self._lock:
                     self.routed_per_replica[dest] = (
@@ -338,8 +347,9 @@ class FleetRouter:
                     if meta is not None:
                         meta["replica"] = dest
         if not placed:
-            placed = (self._place(req, exclude=frozenset({from_replica}))
-                      or self._place(req))
+            placed = (self._place(req, exclude=frozenset({from_replica}),
+                                  src=from_replica)
+                      or self._place(req, src=from_replica))
         if placed:
             with self._lock:
                 if kind == "handoff":
@@ -396,19 +406,41 @@ class FleetRouter:
         with self._lock:
             return len(self._parked)
 
-    def _place(self, req: Request, exclude: frozenset = frozenset()) -> bool:
-        cands, _ = self._candidates(req.prompt_tokens, exclude=exclude,
-                                    needs_prefill=_needs_prefill(req))
-        for r in cands:
-            if r.submit(req):
-                with self._lock:
-                    self.routed_per_replica[r.replica_id] = (
-                        self.routed_per_replica.get(r.replica_id, 0) + 1)
-                    meta = self._meta.get(req.request_id)
-                    if meta is not None:
-                        meta["replica"] = r.replica_id
-                return True
-        return False
+    def _ship(self, req: Request, src: Optional[int],
+              dest: int) -> bool:
+        """Move the request's KV payload src->dest over the courier
+        transport before submission. True = ready to submit (payload
+        delivered, or nothing to ship). False = the transfer aborted:
+        the payload is gone and the request now needs prefill — the
+        caller must recompute its candidate set (a decode-role replica
+        chosen for a payload can no longer take it)."""
+        if self.courier is None:
+            return True
+        return self.courier.ship(req, src, dest)
+
+    def _place(self, req: Request, exclude: frozenset = frozenset(),
+               src: Optional[int] = None) -> bool:
+        while True:
+            cands, _ = self._candidates(req.prompt_tokens, exclude=exclude,
+                                        needs_prefill=_needs_prefill(req))
+            for r in cands:
+                if not self._ship(req, src, r.replica_id):
+                    # courier abort dropped the payload; the candidate
+                    # order (decode-first, affinity-skipped) is stale —
+                    # re-plan as a needs-prefill placement. Terminates:
+                    # with no payload left, _ship can never fail again.
+                    break
+                if r.submit(req):
+                    with self._lock:
+                        self.routed_per_replica[r.replica_id] = (
+                            self.routed_per_replica.get(r.replica_id, 0)
+                            + 1)
+                        meta = self._meta.get(req.request_id)
+                        if meta is not None:
+                            meta["replica"] = r.replica_id
+                    return True
+            else:
+                return False
 
     def flush_parked(self) -> int:
         """Retry parked requeues (called by the supervisor after a replica
@@ -418,7 +450,13 @@ class FleetRouter:
         placed = 0
         still_parked = []
         for req in parked:
-            if self._place(req):
+            with self._lock:
+                meta = self._meta.get(req.request_id)
+                # a parked payload still sits on its LAST placement's
+                # host; that replica is the courier source when the
+                # request finally finds a home
+                src = meta.get("replica") if meta else None
+            if self._place(req, src=src):
                 placed += 1
             else:
                 still_parked.append(req)

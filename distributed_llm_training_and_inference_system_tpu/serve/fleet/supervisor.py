@@ -61,6 +61,15 @@ class ReplicaSupervisor:
         self._role_want: Optional[str] = None
         self.total_reroles = 0
         self.total_role_promotions = 0
+        # crash-promotion bookkeeping for auto-demotion: replica_id ->
+        # the role it was provisioned with before health promoted it to
+        # mixed, plus the per-replica healthy-again streak (demotion
+        # fires after `role_restore_hysteresis` consecutive polls with
+        # the crashed class back in rotation — one flapping restart must
+        # not bounce roles)
+        self._promoted: dict[int, str] = {}
+        self._restore_streak: dict[int, int] = {}
+        self.total_role_demotions = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -85,6 +94,7 @@ class ReplicaSupervisor:
             elif state == replica_mod.HEALTHY:
                 self._probe(r)
         self._ensure_role_coverage()
+        self._maybe_role_restore()
         self._maybe_role_balance()
         self._maybe_rebalance()
         if recovered or self.router.parked_count():
@@ -162,8 +172,10 @@ class ReplicaSupervisor:
         back to local decode. Either way the fix is the same — promote a
         healthy survivor of the other class to MIXED so the fleet
         degrades to classic (un-disaggregated) serving instead of
-        deadlocking. Promotions are one-way: when the crashed class
-        restarts, the operator (or the role balancer) re-splits."""
+        deadlocking. Promotions reverse automatically: once the crashed
+        class is healthy again for ``role_restore_hysteresis`` polls,
+        ``_maybe_role_restore`` demotes the survivor back to its
+        provisioned role (0 disables — operator re-splits manually)."""
         roles = {r.replica_id: self._role(r) for r in self.replicas}
         if all(v == ROLE_MIXED for v in roles.values()):
             return
@@ -179,6 +191,10 @@ class ReplicaSupervisor:
             logger.warning(
                 "no healthy %s-capable replica left: promoting replica "
                 "%d (%s) to mixed", lost, r.replica_id, self._role(r))
+            # remember the provisioned role so _maybe_role_restore can
+            # demote once the crashed class returns to rotation
+            self._promoted.setdefault(r.replica_id, self._role(r))
+            self._restore_streak.pop(r.replica_id, None)
             r.set_role(ROLE_MIXED)
             self.total_role_promotions += 1
             self.router.flush_parked()
@@ -203,6 +219,49 @@ class ReplicaSupervisor:
                 for r in healthy):
             promote([r for r in healthy
                      if self._role(r) == ROLE_PREFILL], ROLE_DECODE)
+
+    def _maybe_role_restore(self) -> None:
+        """Auto-demotion (PR-4 known gap): a replica that role-aware
+        health promoted to MIXED returns to its provisioned role once the
+        class it was covering for is healthy again — held for
+        ``role_restore_hysteresis`` consecutive polls so one flapping
+        restart cannot bounce roles. A promoted replica the operator (or
+        balancer) has since re-roled away from mixed is no longer ours to
+        demote; its record is dropped."""
+        if not self._promoted or self.cfg.role_restore_hysteresis <= 0:
+            return
+        for rid, provisioned in list(self._promoted.items()):
+            r = next((x for x in self.replicas if x.replica_id == rid),
+                     None)
+            if r is None or self._role(r) != ROLE_MIXED \
+                    or not hasattr(r, "set_role"):
+                self._promoted.pop(rid, None)
+                self._restore_streak.pop(rid, None)
+                continue
+            # the capability this promotion was covering is the OPPOSITE
+            # of the provisioned role (a decode replica went mixed
+            # because prefill died, and vice versa)
+            lost = (ROLE_DECODE if provisioned == ROLE_PREFILL
+                    else ROLE_PREFILL)
+            covered = any(
+                x.replica_id != rid and x.state == replica_mod.HEALTHY
+                and self._role(x) in (lost, ROLE_MIXED)
+                for x in self.replicas)
+            if not covered:
+                self._restore_streak.pop(rid, None)
+                continue
+            streak = self._restore_streak.get(rid, 0) + 1
+            self._restore_streak[rid] = streak
+            if streak < self.cfg.role_restore_hysteresis:
+                continue
+            logger.info(
+                "%s class healthy again: demoting replica %d back to "
+                "provisioned role %s", lost, rid, provisioned)
+            r.set_role(provisioned)
+            self.total_role_demotions += 1
+            self._promoted.pop(rid, None)
+            self._restore_streak.pop(rid, None)
+            self.router.flush_parked()
 
     def _maybe_role_balance(self) -> None:
         """Re-role replicas from observed phase pressure. Prefill pressure
@@ -437,6 +496,9 @@ class ReplicaSupervisor:
                 "replica": r.replica_id,
                 "state": r.state,
                 "role": self._role(r),
+                # crash-promoted to mixed; auto-demotes back to this
+                # provisioned role once the lost class is healthy again
+                "promoted_from": self._promoted.get(r.replica_id),
                 "queue_depth": r.queue_depth(),
                 "active": r.active_count(),
                 "outstanding_tokens": r.outstanding_tokens(),
@@ -483,7 +545,13 @@ class ReplicaSupervisor:
                                for r in self.replicas),
             "reroles": self.total_reroles,
             "promotions": self.total_role_promotions,
+            "demotions": self.total_role_demotions,
         }
+        # courier transport plane (serve/fleet/transport.py): running
+        # totals + a bounded recent transfer_ms window, same Prometheus
+        # delta contract as the migration pauses above
+        courier = getattr(self.router, "courier", None)
         return {"replicas": reps, "router": self.router.stats(),
                 "restarts": self.total_restarts, "migration": migration,
-                "handoff": handoff}
+                "handoff": handoff,
+                "courier": courier.snapshot() if courier else {}}

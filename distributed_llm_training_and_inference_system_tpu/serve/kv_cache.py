@@ -250,9 +250,17 @@ class PagedKVCache:
         pages while decode keeps appending to the tail, phase 2
         stop-and-copies only [full, written) — the partial tail plus pages
         filled since the pre-copy. Payloads are plain numpy (host) arrays,
-        so they survive the source engine's death and serialize for a
-        cross-host courier later."""
-        hi = max(hi, lo)
+        so they survive the source engine's death and serialize for the
+        cross-host courier (serve/fleet/transport.py).
+
+        Bounds are validated up front: an out-of-range request would
+        otherwise silently gather scratch page 0 (zeros presented as real
+        KV — wrong tokens downstream, no error)."""
+        chain = self._chain_len.get(slot, 0)
+        if not 0 <= lo <= hi <= chain:
+            raise ValueError(
+                f"extract_slot_pages: range [{lo}, {hi}) outside slot "
+                f"{slot}'s chain of {chain} page(s)")
         pages = self.block_tables[slot, lo:hi].copy()
         idx = jnp.asarray(pages)
 
@@ -294,12 +302,71 @@ class PagedKVCache:
         """Swap-in: allocate fresh pages for the slot and write the saved
         K/V back. Returns False (allocating nothing) when the pool can't
         supply the pages — the caller falls back to recompute."""
-        n = content["num_pages"]
+        if not isinstance(content, dict) or "num_pages" not in content:
+            raise ValueError(
+                "restore payload must be a dict with 'num_pages'; got "
+                f"{type(content).__name__}")
+        n = int(content["num_pages"])
         if n > self.free_pages:
             return False
         self.allocate(slot, n * self.page_size)
         self.write_slot_pages(slot, content)
         return True
+
+    def _validate_payload(self, slot: int, content: dict, lo: int) -> int:
+        """Schema + bounds check for a restore payload; returns its page
+        count. Raises ValueError naming exactly what is malformed."""
+        from ..ops.paged_attention import QuantPages
+        if not isinstance(content, dict) or "num_pages" not in content \
+                or "k" not in content or "v" not in content:
+            raise ValueError(
+                "restore payload must be a dict with 'k', 'v' and "
+                f"'num_pages'; got keys "
+                f"{sorted(content) if isinstance(content, dict) else type(content).__name__}")  # noqa: E501
+        try:
+            n = int(content["num_pages"])
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"restore payload num_pages must be an int, got "
+                f"{content['num_pages']!r}") from None
+        if n < 0:
+            raise ValueError(f"restore payload num_pages {n} < 0")
+        chain = self._chain_len.get(slot, 0)
+        if lo < 0 or lo + n > chain:
+            raise ValueError(
+                f"restore payload covers chain entries [{lo}, {lo + n}) "
+                f"but slot {slot} owns only {chain} page(s)")
+        cfg = self.cfg
+        expect = (cfg.num_layers, n, cfg.num_kv_heads, self.page_size,
+                  cfg.head_dim)
+        for name, buf in (("k", self.k_pages), ("v", self.v_pages)):
+            data = content[name]
+            if isinstance(buf, QuantPages):
+                if not isinstance(data, dict) or "values" not in data \
+                        or "scale" not in data:
+                    raise ValueError(
+                        f"restore payload '{name}' must be a quantized "
+                        "{values, scale} dict for an int8-KV pool; got "
+                        f"{type(data).__name__}")
+                shapes = {"values": expect, "scale": expect[:-1]}
+                for part, want in shapes.items():
+                    got = tuple(np.shape(data[part]))
+                    if got != want:
+                        raise ValueError(
+                            f"restore payload '{name}.{part}' shape "
+                            f"{got} != expected {want}")
+            else:
+                if isinstance(data, dict):
+                    raise ValueError(
+                        f"restore payload '{name}' is quantized but the "
+                        "pool holds plain pages — int8-KV payloads only "
+                        "restore into int8-KV engines")
+                got = tuple(np.shape(data))
+                if got != expect:
+                    raise ValueError(
+                        f"restore payload '{name}' shape {got} != "
+                        f"expected {expect}")
+        return n
 
     def write_slot_pages(self, slot: int, content: dict,
                          lo: int = 0) -> None:
@@ -312,8 +379,13 @@ class PagedKVCache:
         the destination allocates the slot's whole chain, writes those
         pages here, and extend-prefills only the uncovered tail. The
         full-chain restore path (``restore_slot``) goes through here too.
+
+        Payload schema and page-range bounds are validated up front
+        (clear ValueError) instead of failing deep inside the jitted
+        merge — a malformed courier payload must degrade to re-prefill,
+        never scatter garbage into the pool.
         """
-        n = content["num_pages"]
+        n = self._validate_payload(slot, content, lo)
         if n <= 0:
             return
         bucket = 1

@@ -79,6 +79,11 @@ class LoadResult:
     handoffs: int = 0
     handoffs_local: int = 0
     phases: dict = field(default_factory=dict)
+    # KV courier transport (serve/fleet/transport.py): transfers, chunk
+    # retries, aborted-to-re-prefill transfers, and the transfer-stall
+    # percentiles — reported alongside handoff stall so an operator can
+    # split "the crossing was slow" from "the link was lossy"
+    courier: dict = field(default_factory=dict)
 
     def percentile(self, xs, q):
         return float(np.percentile(np.asarray(xs), q)) if xs else float("nan")
@@ -118,6 +123,7 @@ class LoadResult:
                 "handoffs_local": self.handoffs_local,
                 "phases": self.phases}
                if self.phases else {}),
+            **({"courier": self.courier} if self.courier else {}),
         }
 
 
@@ -245,7 +251,30 @@ def _finalize_fleet(res: LoadResult, reqs: list, fleet,
                 "local_fallbacks": res.handoffs_local,
                 "p50_stall_ms": pct2(stalls, 50),
                 "p99_stall_ms": pct2(stalls, 99),
+                # the transport's share of the crossing: how much of the
+                # handoff stall was the courier link itself
+                "p50_transfer_ms": pct2(
+                    snap.get("courier", {}).get("transfer_ms", []), 50),
+                "p99_transfer_ms": pct2(
+                    snap.get("courier", {}).get("transfer_ms", []), 99),
             },
+        }
+
+    # courier transport plane: any payload that crossed replicas rode it
+    cour = snap.get("courier", {})
+    if cour.get("transfers", 0) or cour.get("aborts", 0):
+        def pct3(xs, q):
+            return round(res.percentile(xs, q), 2) if xs else None
+        xfer = cour.get("transfer_ms", [])
+        res.courier = {
+            "transfers": cour.get("transfers", 0),
+            "chunks": cour.get("chunks", 0),
+            "retries": cour.get("retries", 0),
+            "corruptions": cour.get("corruptions", 0),
+            "resumes": cour.get("resumes", 0),
+            "aborts": cour.get("aborts", 0),
+            "p50_transfer_ms": pct3(xfer, 50),
+            "p99_transfer_ms": pct3(xfer, 99),
         }
 
     for rid, slot in sorted(by_replica.items()):

@@ -1,0 +1,481 @@
+"""Courier transport framing + failure-matrix tests (fast tier).
+
+The transport's correctness bar is absolute: a payload that crosses the
+courier must reassemble BYTE-FOR-BYTE or not at all. These tests hold
+that bar over the framing primitives (encode/chunk/reassemble identity
+for fp, int8-quant, and partial payloads; out-of-order and duplicated
+delivery; corruption detected by checksum), the retry/backoff/resume
+loop under seeded faults, the abort -> re-prefill degradation, and the
+fleet-level integration on fake replicas. Engine-backed chaos scenarios
+live in tests/test_fleet.py (TestCourierChaos).
+"""
+
+import threading
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from distributed_llm_training_and_inference_system_tpu.serve.fleet.faults import (  # noqa: E501
+    FaultInjector,
+    FaultPlan,
+)
+from distributed_llm_training_and_inference_system_tpu.serve.fleet.transport import (  # noqa: E501
+    ChunkCorrupt,
+    ChunkReassembler,
+    CourierChunk,
+    CourierReceiver,
+    InProcTransport,
+    KVCourier,
+    TransferAborted,
+    decode_payload,
+    encode_payload,
+    make_chunks,
+)
+
+RNG = np.random.default_rng(7)
+
+
+def fp_payload(pages=5):
+    return {
+        "pages": {
+            "k": RNG.standard_normal((2, pages, 2, 8, 16)).astype(
+                np.float32),
+            "v": RNG.standard_normal((2, pages, 2, 8, 16)).astype(
+                np.float32),
+            "num_pages": pages,
+        },
+        "positions": pages * 8 - 3,
+        "last_token": 42,
+    }
+
+
+def int8_payload(pages=3):
+    def q():
+        return {"values": RNG.integers(-128, 127, (2, pages, 2, 8, 16))
+                .astype(np.int8),
+                "scale": RNG.random((2, pages, 2, 8)).astype(np.float32)}
+    return {
+        "pages": {"k": q(), "v": q(), "num_pages": pages},
+        "positions": pages * 8,
+        "last_token": 7,
+    }
+
+
+def partial_payload(pages=2):
+    p = fp_payload(pages)
+    return {"pages": p["pages"], "positions": pages * 8, "partial": True}
+
+
+def payloads_equal(a, b):
+    if isinstance(a, dict):
+        return (isinstance(b, dict) and set(a) == set(b)
+                and all(payloads_equal(a[k], b[k]) for k in a))
+    if isinstance(a, np.ndarray):
+        return (isinstance(b, np.ndarray) and a.dtype == b.dtype
+                and a.shape == b.shape and np.array_equal(a, b))
+    return a == b and type(a) is type(b)
+
+
+def cfg(**kw):
+    base = dict(courier_chunk_bytes=1024, courier_max_retries=10,
+                courier_retry_backoff_ms=0.2,
+                courier_retry_backoff_max_ms=2.0,
+                courier_chunk_deadline_ms=20.0)
+    base.update(kw)
+    return SimpleNamespace(**base)
+
+
+PAYLOAD_MAKERS = [fp_payload, int8_payload, partial_payload]
+
+
+class TestFraming:
+    @pytest.mark.parametrize("make", PAYLOAD_MAKERS,
+                             ids=["fp", "int8", "partial"])
+    def test_encode_decode_identity(self, make):
+        p = make()
+        manifest, blob = encode_payload(p)
+        assert manifest["nbytes"] == len(blob)
+        out = decode_payload(manifest, blob)
+        assert payloads_equal(out, p)
+        # decoded arrays own their memory (a view into the wire buffer
+        # would go stale when the receiver recycles it)
+        k = out["pages"]["k"]
+        (k["values"] if isinstance(k, dict) else k)[0] = 0  # must not raise
+
+    @pytest.mark.parametrize("make", PAYLOAD_MAKERS,
+                             ids=["fp", "int8", "partial"])
+    def test_chunk_reassemble_identity(self, make):
+        p = make()
+        manifest, blob = encode_payload(p)
+        chunks = make_chunks("t", manifest, blob, 512)
+        assert len(chunks) == max((len(blob) + 511) // 512, 1)
+        assert all(len(c.data) <= 512 for c in chunks)
+        r = ChunkReassembler(len(chunks))
+        for c in chunks:
+            r.add(c)
+        assert r.complete()
+        assert payloads_equal(r.payload(), p)
+
+    def test_out_of_order_and_duplicates_reassemble_identically(self):
+        p = fp_payload()
+        manifest, blob = encode_payload(p)
+        chunks = make_chunks("t", manifest, blob, 256)
+        assert len(chunks) >= 4
+        r = ChunkReassembler(len(chunks))
+        # reversed order + two duplicate deliveries: same bytes out
+        for c in reversed(chunks):
+            assert r.add(c)
+        assert r.add(chunks[1]) is False       # idempotent duplicate
+        assert r.add(chunks[0]) is False
+        assert r.duplicates == 2
+        assert payloads_equal(r.payload(), p)
+
+    def test_corrupted_chunk_detected_by_checksum(self):
+        manifest, blob = encode_payload(fp_payload())
+        chunks = make_chunks("t", manifest, blob, 256)
+        bad = chunks[2]
+        flipped = bytes([bad.data[0] ^ 0x01]) + bad.data[1:]
+        r = ChunkReassembler(len(chunks))
+        with pytest.raises(ChunkCorrupt):
+            r.add(CourierChunk(bad.ticket, bad.seq, bad.total, bad.crc32,
+                               flipped))
+        # the retransmitted clean copy still lands
+        assert r.add(bad)
+        assert bad.seq not in r.missing()
+
+    def test_end_to_end_crc_refuses_wrong_blob(self):
+        manifest, blob = encode_payload(fp_payload())
+        with pytest.raises(TransferAborted):
+            decode_payload(manifest, blob[:-1] + bytes([blob[-1] ^ 0xFF]))
+
+    def test_wire_round_trip(self):
+        """HTTP framing: to_wire/from_wire is lossless including the
+        chunk-0 manifest."""
+        manifest, blob = encode_payload(int8_payload())
+        for c in make_chunks("t", manifest, blob, 512):
+            back = CourierChunk.from_wire(c.to_wire())
+            assert (back.ticket, back.seq, back.total, back.crc32,
+                    back.data) == (c.ticket, c.seq, c.total, c.crc32,
+                                   c.data)
+            assert back.manifest == c.manifest
+
+    def test_empty_blob_still_frames(self):
+        """A scalars-only payload (no arrays) still moves: one chunk
+        carries the manifest."""
+        p = {"positions": 5, "partial": True}
+        manifest, blob = encode_payload(p)
+        chunks = make_chunks("t", manifest, blob, 1024)
+        assert len(chunks) == 1
+        r = ChunkReassembler(1)
+        r.add(chunks[0])
+        assert payloads_equal(r.payload(), p)
+
+
+class TestReceiver:
+    def test_receiver_acks_track_missing(self):
+        manifest, blob = encode_payload(fp_payload())
+        chunks = make_chunks("tkt", manifest, blob, 512)
+        rx = CourierReceiver()
+        ack = rx.add_chunk(chunks[0])
+        assert ack["ok"] and not ack["complete"]
+        assert set(ack["missing"]) == set(range(1, len(chunks)))
+        for c in chunks[1:]:
+            ack = rx.add_chunk(c)
+        assert ack["complete"] and ack["missing"] == []
+        assert payloads_equal(rx.claim("tkt"),
+                              decode_payload(manifest, blob))
+
+    def test_claim_unknown_or_incomplete_raises(self):
+        rx = CourierReceiver()
+        with pytest.raises(TransferAborted):
+            rx.claim("nope")
+        manifest, blob = encode_payload(fp_payload())
+        chunks = make_chunks("tkt", manifest, blob, 512)
+        rx.add_chunk(chunks[0])
+        with pytest.raises(TransferAborted):
+            rx.claim("tkt")
+
+
+class TestInProcTransport:
+    @pytest.mark.parametrize("make", PAYLOAD_MAKERS,
+                             ids=["fp", "int8", "partial"])
+    def test_clean_transfer_identity(self, make):
+        p = make()
+        t = InProcTransport(cfg())
+        assert payloads_equal(t.transfer(p, src=0, dest=1), p)
+        s = t.stats.snapshot()
+        assert s["transfers"] == 1 and s["aborts"] == 0 \
+            and s["retries"] == 0
+
+    def test_chaos_drop_corrupt_delay_duplicate_identity(self):
+        """Seeded drop+corrupt+delay+duplicate faults: every transfer
+        still reassembles byte-identically, with retries/corruptions/
+        duplicates counted and zero aborts."""
+        inj = FaultInjector(FaultPlan(
+            seed=3, chunk_drop_rate=0.2, chunk_corrupt_rate=0.15,
+            chunk_delay_rate=0.1, chunk_delay_ms=30.0,
+            chunk_duplicate_rate=0.1))
+        t = InProcTransport(cfg(), injector=inj)
+        p = fp_payload()
+        for _ in range(5):
+            assert payloads_equal(t.transfer(p, src=0, dest=1), p)
+        s = t.stats.snapshot()
+        assert s["transfers"] == 5 and s["aborts"] == 0
+        assert s["retries"] > 0 and s["corruptions"] > 0
+        assert s["duplicates"] > 0 and s["resumes"] > 0
+
+    def test_chaos_is_seed_reproducible(self):
+        p = int8_payload()
+
+        def run(seed):
+            inj = FaultInjector(FaultPlan(
+                seed=seed, chunk_drop_rate=0.3, chunk_corrupt_rate=0.2))
+            t = InProcTransport(cfg(), injector=inj)
+            t.transfer(p, src=0, dest=1)
+            s = t.stats.snapshot()
+            return (s["chunks"], s["retries"], s["corruptions"],
+                    s["resumes"])
+        assert run(11) == run(11)
+
+    def test_resume_resends_only_missing_chunks(self):
+        """Transient 100% loss for the first few chunks: the resend
+        round carries only what is missing, not the whole payload."""
+        inj = FaultInjector(FaultPlan(
+            seed=0, chunk_drop_rate=1.0, chunk_fault_budget=3))
+        t = InProcTransport(cfg(), injector=inj)
+        p = fp_payload()
+        assert payloads_equal(t.transfer(p, src=0, dest=1), p)
+        s = t.stats.snapshot()
+        n_chunks = (encode_payload(p)[0]["nbytes"] + 1023) // 1024
+        # first round loses exactly 3; one resume round resends only 3
+        assert s["retries"] == 3 and s["resumes"] == 1
+        assert s["chunks"] == n_chunks + 3
+
+    def test_retry_budget_exhaustion_aborts(self):
+        inj = FaultInjector(FaultPlan(seed=1, chunk_drop_rate=1.0))
+        t = InProcTransport(cfg(courier_max_retries=2), injector=inj)
+        with pytest.raises(TransferAborted):
+            t.transfer(fp_payload(), src=0, dest=1)
+        s = t.stats.snapshot()
+        assert s["aborts"] == 1 and s["transfers"] == 0
+        assert s["resumes"] == 2        # both budgeted rounds were used
+
+    def test_dest_unreachable_heals_then_completes(self):
+        inj = FaultInjector(FaultPlan(
+            dest_unreachable_replica=1, dest_unreachable_count=2))
+        t = InProcTransport(cfg(), injector=inj)
+        p = fp_payload()
+        assert payloads_equal(t.transfer(p, src=0, dest=1), p)
+        s = t.stats.snapshot()
+        assert s["resumes"] == 2 and s["transfers"] == 1
+        # a transfer to a DIFFERENT dest never saw the partition
+        t2 = InProcTransport(cfg(), injector=FaultInjector(FaultPlan(
+            dest_unreachable_replica=1, dest_unreachable_count=2)))
+        t2.transfer(p, src=0, dest=2)
+        assert t2.stats.snapshot()["resumes"] == 0
+
+    def test_dest_unreachable_forever_aborts(self):
+        inj = FaultInjector(FaultPlan(
+            dest_unreachable_replica=1, dest_unreachable_count=10**6))
+        t = InProcTransport(cfg(courier_max_retries=2), injector=inj)
+        with pytest.raises(TransferAborted):
+            t.transfer(fp_payload(), src=0, dest=1)
+        assert t.stats.snapshot()["aborts"] == 1
+
+    def test_concurrent_transfers_are_independent(self):
+        t = InProcTransport(cfg())
+        payloads = [fp_payload(i + 1) for i in range(4)]
+        out: dict = {}
+        errs: list = []
+
+        def go(i):
+            try:
+                out[i] = t.transfer(payloads[i], src=0, dest=1)
+            except Exception as e:          # pragma: no cover
+                errs.append(e)
+        threads = [threading.Thread(target=go, args=(i,))
+                   for i in range(4)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(10)
+        assert not errs
+        for i, p in enumerate(payloads):
+            assert payloads_equal(out[i], p)
+
+
+class TestKVCourier:
+    def req(self, payload):
+        return SimpleNamespace(request_id="r0", swapped_kv=payload)
+
+    def test_ship_delivers_and_counts_per_src(self):
+        c = KVCourier(InProcTransport(cfg()))
+        p = fp_payload()
+        r = self.req(p)
+        assert c.ship(r, src=0, dest=1)
+        assert payloads_equal(r.swapped_kv, p)
+        assert c.snapshot()["per_src"]["0"]["transfers"] == 1
+
+    def test_ship_abort_drops_payload_for_reprefill(self):
+        inj = FaultInjector(FaultPlan(seed=1, chunk_drop_rate=1.0))
+        c = KVCourier(InProcTransport(cfg(courier_max_retries=1),
+                                      injector=inj))
+        r = self.req(fp_payload())
+        assert c.ship(r, src=0, dest=1) is False
+        assert r.swapped_kv is None       # degrade to re-prefill
+        snap = c.snapshot()
+        assert snap["aborts"] == 1
+        assert snap["per_src"]["0"]["aborts"] == 1
+
+    def test_ship_noops_without_payload_or_cross_replica_move(self):
+        c = KVCourier(InProcTransport(cfg()))
+        assert c.ship(self.req(None), src=0, dest=1)
+        p = fp_payload()
+        r = self.req(p)
+        assert c.ship(r, src=1, dest=1)     # landing back home: no link
+        assert r.swapped_kv is p
+        assert c.snapshot()["transfers"] == 0
+
+
+class TestKVCacheValidation:
+    """Satellite: write_slot_pages / extract_slot_pages validate bounds
+    and payload schema up front with a clear ValueError instead of
+    failing deep inside the jitted merge (or silently gathering scratch
+    page 0 as real KV)."""
+
+    def cache(self, quantized=False):
+        from distributed_llm_training_and_inference_system_tpu.config import (  # noqa: E501
+            get_model_config)
+        from distributed_llm_training_and_inference_system_tpu.serve.kv_cache import (  # noqa: E501
+            PagedKVCache)
+        import jax.numpy as jnp
+        kv = PagedKVCache(get_model_config("gpt-test"), num_slots=2,
+                          max_seq_len=64, page_size=8, num_pages=17,
+                          dtype=jnp.float32, quantized=quantized)
+        kv.allocate(0, 24)            # 3 pages
+        return kv
+
+    def test_extract_bounds_validated(self):
+        kv = self.cache()
+        assert kv.extract_slot_pages(0, 0, 3)["num_pages"] == 3
+        assert kv.extract_slot_pages(0, 1, 1)["num_pages"] == 0
+        for lo, hi in ((-1, 2), (0, 4), (2, 1), (4, 4)):
+            with pytest.raises(ValueError, match="chain"):
+                kv.extract_slot_pages(0, lo, hi)
+        # an unallocated slot owns zero pages
+        with pytest.raises(ValueError):
+            kv.extract_slot_pages(1, 0, 1)
+
+    def test_write_schema_validated(self):
+        kv = self.cache()
+        good = kv.extract_slot_pages(0, 0, 3)
+        kv.write_slot_pages(0, good)              # valid round trip
+        with pytest.raises(ValueError, match="num_pages"):
+            kv.write_slot_pages(0, {"k": good["k"], "v": good["v"]})
+        with pytest.raises(ValueError, match="int"):
+            kv.write_slot_pages(0, {**good, "num_pages": "three"})
+        with pytest.raises(ValueError, match="owns only"):
+            kv.write_slot_pages(0, {**good, "num_pages": 4})
+        with pytest.raises(ValueError, match="owns only"):
+            kv.write_slot_pages(0, good, lo=1)    # 1 + 3 > 3
+        with pytest.raises(ValueError, match="shape"):
+            kv.write_slot_pages(0, {**good, "k": good["k"][:, :2]})
+        with pytest.raises(ValueError, match="quantized"):
+            kv.write_slot_pages(0, {
+                **good, "k": {"values": good["k"], "scale": good["k"]}})
+        with pytest.raises(ValueError, match="dict"):
+            kv.restore_slot(1, None)
+
+    def test_write_quant_schema_validated(self):
+        kv = self.cache(quantized=True)
+        good = kv.extract_slot_pages(0, 0, 3)
+        kv.write_slot_pages(0, good)
+        with pytest.raises(ValueError, match="values, scale"):
+            kv.write_slot_pages(0, {**good, "k": good["k"]["values"]})
+        bad_scale = {"values": good["k"]["values"],
+                     "scale": good["k"]["scale"][:, :2]}
+        with pytest.raises(ValueError, match="scale.*shape|shape"):
+            kv.write_slot_pages(0, {**good, "k": bad_scale})
+
+    def test_partial_write_at_offset(self):
+        """The crash-salvage partial path writes [lo, lo+n) of an
+        allocated chain — valid offsets pass, overruns are refused."""
+        kv = self.cache()
+        head = kv.extract_slot_pages(0, 0, 2)
+        kv.write_slot_pages(0, head, lo=0)
+        tail = kv.extract_slot_pages(0, 2, 3)
+        kv.write_slot_pages(0, tail, lo=2)
+        with pytest.raises(ValueError):
+            kv.write_slot_pages(0, tail, lo=3)
+
+
+class TestRouterCourierIntegration:
+    """Fake-replica integration: the router ships payloads through the
+    courier at placement time and re-plans when a transfer aborts."""
+
+    def make(self, courier, n=2, roles=None):
+        from distributed_llm_training_and_inference_system_tpu.config.schema import (  # noqa: E501
+            FleetConfig)
+        from distributed_llm_training_and_inference_system_tpu.serve.fleet.router import (  # noqa: E501
+            FleetRouter)
+
+        class Rep:
+            def __init__(self, rid, role):
+                self.replica_id = rid
+                self.role = role
+                self.queue: list = []
+
+            def accepting(self):
+                return True
+
+            def submit(self, req):
+                self.queue.append(req)
+                return True
+
+            def queue_depth(self):
+                return len(self.queue)
+
+            def outstanding_tokens(self):
+                return len(self.queue)
+
+        reps = [Rep(i, (roles or ["mixed"] * n)[i]) for i in range(n)]
+        router = FleetRouter(reps, FleetConfig(
+            replicas=n, affinity_prefix_tokens=0), courier=courier)
+        return router, reps
+
+    def submit_with_payload(self, router, payload):
+        from distributed_llm_training_and_inference_system_tpu.serve.scheduler import (  # noqa: E501
+            Request,
+            SamplingParams,
+        )
+        req = Request(request_id="m1", prompt_tokens=[1, 2, 3],
+                      sampling=SamplingParams())
+        router._meta[req.request_id] = {"requeues": 0, "replica": 0}
+        req.swapped_kv = payload
+        return req
+
+    def test_place_migrated_ships_payload(self):
+        courier = KVCourier(InProcTransport(cfg()))
+        router, reps = self.make(courier)
+        p = fp_payload()
+        req = self.submit_with_payload(router, p)
+        assert router.place_migrated(req, from_replica=0, dest=1)
+        assert req in reps[1].queue
+        assert payloads_equal(req.swapped_kv, p)
+        assert courier.snapshot()["transfers"] == 1
+
+    def test_abort_replans_off_decode_replica(self):
+        """A payload bound for a decode-role replica loses its transfer:
+        the request now needs prefill, so it must NOT land on the decode
+        replica — the router re-plans onto a prefill-capable one."""
+        inj = FaultInjector(FaultPlan(seed=1, chunk_drop_rate=1.0))
+        courier = KVCourier(InProcTransport(cfg(courier_max_retries=1),
+                                            injector=inj))
+        router, reps = self.make(courier, roles=["mixed", "decode"])
+        req = self.submit_with_payload(router, fp_payload())
+        assert router.place_migrated(req, from_replica=0, dest=1)
+        assert req.swapped_kv is None
+        assert req in reps[0].queue         # NOT the decode replica
+        assert not reps[1].queue
+        assert courier.snapshot()["aborts"] >= 1

@@ -450,6 +450,274 @@ class TestMigration:
         assert req.swapped_kv is None
 
 
+class TestCourierChaos:
+    """Engine-backed courier chaos (this PR's acceptance bar): under
+    seeded chunk drop + corruption + delay faults, drain migration and
+    handoff complete token-identically with retries counted and nothing
+    dropped; a transfer past its retry budget falls back to re-prefill
+    with a balanced ledger and an aborts increment."""
+
+    # share TestMigration's submit/await plumbing without inheriting its
+    # test methods (they must not run twice)
+    _submit = TestMigration._submit
+    _await_all = TestMigration._await_all
+    _wait_decoding = TestMigration._wait_decoding
+
+    CHAOS_KW = dict(courier_chunk_bytes=1024, courier_max_retries=12,
+                    courier_retry_backoff_ms=0.2,
+                    courier_retry_backoff_max_ms=2.0,
+                    courier_chunk_deadline_ms=20.0)
+    CHAOS_PLAN = dict(seed=5, chunk_drop_rate=0.2, chunk_corrupt_rate=0.15,
+                      chunk_delay_rate=0.1, chunk_delay_ms=30.0,
+                      chunk_duplicate_rate=0.1)
+
+    def test_drain_migration_under_chunk_chaos_greedy(
+            self, model_cfg, ref_engine):
+        """Drop+corrupt+delay+duplicate on every payload's chunks: the
+        drain migration still lands with ZERO re-prefill (transfers all
+        eventually verify end-to-end), token-identical, retries and
+        corruptions counted, no aborts."""
+        greedy = SamplingParams(temperature=0.0, max_tokens=48)
+        ref = [r.generated_tokens
+               for r in ref_engine.generate(PROMPTS[:4], greedy)]
+        fleet = make_fleet(model_cfg, ref_engine.params, warm=True,
+                           plan=FaultPlan(**self.CHAOS_PLAN),
+                           fleet_kw=dict(self.CHAOS_KW))
+        try:
+            reqs, events = self._submit(fleet, PROMPTS[:4], greedy)
+            self._wait_decoding(reqs, events)
+            pre = sum(rep.engine.total_prefill_tokens
+                      for rep in fleet.replicas)
+            assert fleet.drain(0)
+            self._await_all(fleet, events)
+            post = sum(rep.engine.total_prefill_tokens
+                       for rep in fleet.replicas)
+            assert [r.generated_tokens for r in reqs] == ref
+            assert post == pre, (
+                f"chaos courier re-prefilled: {pre} -> {post}")
+            cour = fleet.status()["courier"]
+            assert cour["transfers"] >= 1
+            assert cour["retries"] >= 1, cour
+            assert cour["aborts"] == 0, cour
+            st = fleet.router.stats()
+            assert st["completed"] == 4
+            assert st["completed"] + st["failed"] + st["rejected"] \
+                == st["submitted"]
+        finally:
+            fleet.shutdown()
+
+    def test_drain_migration_under_chaos_seeded_sampling(
+            self, model_cfg, ref_engine):
+        """Same chaos, temperature>0 with an explicit seed: the payload
+        that crossed a lossy link still resumes the exact PRNG stream."""
+        sampled = SamplingParams(temperature=0.9, top_k=16, max_tokens=32,
+                                 seed=97)
+        ref = [r.generated_tokens
+               for r in ref_engine.generate([PROMPTS[0]], sampled)]
+        fleet = make_fleet(model_cfg, ref_engine.params, warm=True,
+                           plan=FaultPlan(**self.CHAOS_PLAN),
+                           fleet_kw=dict(self.CHAOS_KW))
+        try:
+            reqs, events = self._submit(fleet, [PROMPTS[0]], sampled)
+            self._wait_decoding(reqs, events, n_tokens=4)
+            src = fleet.router.replica_of(reqs[0].request_id)
+            assert fleet.drain(src)
+            self._await_all(fleet, events)
+            assert reqs[0].generated_tokens == ref[0]
+            assert fleet.status()["courier"]["aborts"] == 0
+        finally:
+            fleet.shutdown()
+
+    def test_int8_kv_chaos_token_identity(self, model_cfg, ref_engine):
+        """Quantized {values, scale} payloads cross the lossy link too —
+        byte-for-byte, so int8-KV decode stays bit-identical."""
+        from distributed_llm_training_and_inference_system_tpu.serve import (
+            InferenceEngine)
+        greedy = SamplingParams(temperature=0.0, max_tokens=48)
+        q8_ref = InferenceEngine(model_cfg,
+                                 serve_cfg(kv_quantization="int8"),
+                                 params=ref_engine.params, seed=0)
+        ref = [r.generated_tokens
+               for r in q8_ref.generate([PROMPTS[0]], greedy)]
+        fleet = make_fleet(model_cfg, ref_engine.params, warm=True,
+                           plan=FaultPlan(**self.CHAOS_PLAN),
+                           serve_kw={"kv_quantization": "int8"},
+                           fleet_kw=dict(self.CHAOS_KW))
+        try:
+            reqs, events = self._submit(fleet, [PROMPTS[0]], greedy)
+            self._wait_decoding(reqs, events, n_tokens=4)
+            src = fleet.router.replica_of(reqs[0].request_id)
+            assert fleet.drain(src)
+            self._await_all(fleet, events)
+            assert reqs[0].generated_tokens == ref[0]
+            cour = fleet.status()["courier"]
+            assert cour["transfers"] >= 1 and cour["aborts"] == 0
+        finally:
+            fleet.shutdown()
+
+    def test_abort_falls_back_to_reprefill_balanced_ledger(
+            self, model_cfg, ref_engine):
+        """100% chunk loss with a tiny retry budget: every transfer
+        aborts, the payload is dropped, and the sequence re-prefills on
+        the destination — token-identical output, aborts counted, ledger
+        balanced, nothing stuck."""
+        greedy = SamplingParams(temperature=0.0, max_tokens=64)
+        ref = [r.generated_tokens
+               for r in ref_engine.generate(PROMPTS[:4], greedy)]
+        fleet = make_fleet(
+            model_cfg, ref_engine.params, warm=True,
+            plan=FaultPlan(seed=2, chunk_drop_rate=1.0),
+            fleet_kw=dict(courier_chunk_bytes=1024,
+                          courier_max_retries=1,
+                          courier_retry_backoff_ms=0.2,
+                          courier_retry_backoff_max_ms=1.0,
+                          courier_chunk_deadline_ms=20.0))
+        try:
+            reqs, events = self._submit(fleet, PROMPTS[:4], greedy)
+            self._wait_decoding(reqs, events)
+            pre = sum(rep.engine.total_prefill_tokens
+                      for rep in fleet.replicas)
+            # drain a replica that actually HOLDS residents (placement
+            # is load-driven; a fixed id could be empty on a fast run)
+            src = next(r.replica_id for r in fleet.replicas
+                       if r.resident_requests())
+            assert fleet.drain(src)
+            self._await_all(fleet, events)
+            post = sum(rep.engine.total_prefill_tokens
+                       for rep in fleet.replicas)
+            assert [r.generated_tokens for r in reqs] == ref
+            cour = fleet.status()["courier"]
+            assert cour["aborts"] >= 1, cour
+            assert cour["transfers"] == 0, cour
+            # the drained sequences DID re-prefill: the degradation is
+            # real, not a silent success
+            assert post > pre
+            st = fleet.router.stats()
+            assert st["completed"] == 4 and st["failed"] == 0
+            assert st["completed"] + st["failed"] + st["rejected"] \
+                == st["submitted"]
+            assert st["in_flight"] == 0
+        finally:
+            fleet.shutdown()
+
+    def test_disagg_handoff_under_chunk_chaos(self, model_cfg,
+                                              ref_engine):
+        """Prefill->decode handoffs ride the same lossy courier: token
+        identity and zero decode-side prefill hold under chunk chaos."""
+        greedy = SamplingParams(temperature=0.0, max_tokens=20)
+        ref = [r.generated_tokens
+               for r in ref_engine.generate(PROMPTS[:4], greedy)]
+        fleet = make_fleet(
+            model_cfg, ref_engine.params, warm=True,
+            plan=FaultPlan(**self.CHAOS_PLAN),
+            fleet_kw=dict(self.CHAOS_KW, roles="prefill,decode"))
+        for rep in fleet.replicas:
+            rep.engine.total_prefill_tokens = 0      # warmup prefilled
+            rep.engine.total_unexpected_prefills = 0
+        try:
+            reqs, events = self._submit(fleet, PROMPTS[:4], greedy)
+            self._await_all(fleet, events)
+            assert [r.generated_tokens for r in reqs] == ref
+            snap = fleet.status()
+            assert snap["handoff"]["handoffs"] == 4
+            assert snap["courier"]["transfers"] >= 4
+            assert snap["courier"]["aborts"] == 0
+            assert fleet.replicas[1].engine.total_prefill_tokens == 0
+            total = sum(rep.engine.total_prefill_tokens
+                        for rep in fleet.replicas)
+            assert total == sum(len(p) for p in PROMPTS[:4])
+        finally:
+            fleet.shutdown()
+
+
+class TestRoleAutoDemotion:
+    """Satellite (PR-4 known gap): crash-promoted mixed replicas demote
+    back to their provisioned role once the crashed class is healthy for
+    role_restore_hysteresis consecutive polls."""
+
+    def _sup(self, roles, **cfg_kw):
+        from distributed_llm_training_and_inference_system_tpu.serve.fleet.router import (  # noqa: E501
+            FleetRouter)
+        from distributed_llm_training_and_inference_system_tpu.serve.fleet.supervisor import (  # noqa: E501
+            ReplicaSupervisor)
+        from test_fleet_disagg import RoleFake
+        kw = dict(replicas=len(roles), affinity_prefix_tokens=0,
+                  roles=",".join(roles), role_restore_hysteresis=2)
+        kw.update(cfg_kw)
+        cfg = FleetConfig(**kw)
+        reps = [RoleFake(i, role=ro) for i, ro in enumerate(roles)]
+        return ReplicaSupervisor(reps, FleetRouter(reps, cfg), cfg), reps
+
+    def test_promote_then_demote_after_hysteresis(self):
+        sup, reps = self._sup(["prefill", "decode"])
+        reps[0].state = "crashed"           # prefill class gone
+        sup.poll_once()
+        assert reps[1].role == "mixed"
+        assert sup.total_role_promotions == 1
+        # crashed class returns: demotion waits out the hysteresis
+        reps[0].state = "healthy"
+        sup.poll_once()                     # streak 1
+        assert reps[1].role == "mixed"
+        sup.poll_once()                     # streak 2 = hysteresis
+        assert reps[1].role == "decode"     # provisioned role restored
+        assert sup.total_role_demotions == 1
+        assert sup.snapshot()["handoff"]["demotions"] == 1
+        # one-shot: further polls change nothing
+        sup.poll_once()
+        assert reps[1].role == "decode" and sup.total_role_demotions == 1
+
+    def test_flapping_restart_resets_streak(self):
+        sup, reps = self._sup(["prefill", "decode"],
+                              role_restore_hysteresis=3)
+        reps[0].state = "crashed"
+        sup.poll_once()
+        assert reps[1].role == "mixed"
+        reps[0].state = "healthy"
+        sup.poll_once()                     # streak 1
+        sup.poll_once()                     # streak 2
+        reps[0].state = "crashed"           # flap: class lost again
+        sup.poll_once()                     # streak resets
+        reps[0].state = "healthy"
+        sup.poll_once()
+        sup.poll_once()
+        assert reps[1].role == "mixed"      # only streak 2 of 3
+        sup.poll_once()
+        assert reps[1].role == "decode"
+
+    def test_operator_rerole_cancels_pending_demotion(self):
+        sup, reps = self._sup(["prefill", "decode"])
+        reps[0].state = "crashed"
+        sup.poll_once()
+        assert reps[1].role == "mixed"
+        # the operator takes over: the promotion record is dropped and
+        # the supervisor never demotes a role it no longer owns
+        sup.set_role(1, "prefill")
+        reps[0].state = "healthy"
+        for _ in range(4):
+            sup.poll_once()
+        assert reps[1].role == "prefill"
+        assert sup.total_role_demotions == 0
+
+    def test_disabled_hysteresis_keeps_promotion(self):
+        sup, reps = self._sup(["prefill", "decode"],
+                              role_restore_hysteresis=0)
+        reps[0].state = "crashed"
+        sup.poll_once()
+        assert reps[1].role == "mixed"
+        reps[0].state = "healthy"
+        for _ in range(5):
+            sup.poll_once()
+        assert reps[1].role == "mixed"      # PR-4 behavior preserved
+
+    def test_promoted_from_surfaces_in_snapshot(self):
+        sup, reps = self._sup(["prefill", "decode"])
+        reps[0].state = "crashed"
+        sup.poll_once()
+        rows = {r["replica"]: r for r in sup.snapshot()["replicas"]}
+        assert rows[1]["promoted_from"] == "decode"
+        assert rows[0]["promoted_from"] is None
+
+
 class TestSupervisor:
     def test_probe_timeout_teardown_restart_backoff(
             self, model_cfg, ref_engine):
@@ -627,6 +895,54 @@ class TestFleetHTTP:
                        json={"prompt": [1.5]},
                        timeout=10).status_code == 400
 
+        # courier surface: chunked payload in over POST, claim out —
+        # the cross-host half of the KV transport (this PR)
+        import numpy as np
+        from distributed_llm_training_and_inference_system_tpu.serve.fleet.transport import (  # noqa: E501
+            HTTPCourierTransport, encode_payload, make_chunks)
+        payload = {
+            "pages": {"k": np.arange(2 * 2 * 2 * 8 * 16, dtype=np.float32)
+                      .reshape(2, 2, 2, 8, 16),
+                      "v": np.ones((2, 2, 2, 8, 16), np.float32),
+                      "num_pages": 2},
+            "positions": 13, "last_token": 5,
+        }
+        manifest, blob = encode_payload(payload)
+        chunks = make_chunks("http-t1", manifest, blob, 1024)
+        for c in chunks:
+            ack = rq.post(f"{base}/fleet/courier/chunk",
+                          json=c.to_wire(), timeout=10).json()
+            assert ack["ok"]
+        assert ack["complete"] and ack["missing"] == []
+        # duplicate retransmit is idempotent
+        dup = rq.post(f"{base}/fleet/courier/chunk",
+                      json=chunks[0].to_wire(), timeout=10).json()
+        assert dup["ok"] and dup["duplicate"]
+        claim = rq.post(f"{base}/fleet/courier/claim",
+                        json={"ticket": "http-t1"}, timeout=10).json()
+        assert claim["ok"] and claim["manifest"]["crc32"] \
+            == manifest["crc32"]
+        # unknown ticket -> 404; corrupt chunk -> ok=false ack
+        assert rq.post(f"{base}/fleet/courier/claim",
+                       json={"ticket": "nope"},
+                       timeout=10).status_code == 404
+        wire = chunks[0].to_wire()
+        wire["crc32"] = wire["crc32"] ^ 1
+        bad = rq.post(f"{base}/fleet/courier/chunk", json=wire,
+                      timeout=10).json()
+        assert bad["ok"] is False
+        assert rq.post(f"{base}/fleet/courier/chunk",
+                       json={"ticket": "x"}, timeout=10).status_code == 400
+
+        # full HTTPCourierTransport loopback: transfer() drives the same
+        # endpoints end-to-end and returns the identical payload
+        t = HTTPCourierTransport(endpoint=base)
+        out = t.transfer(payload, src=0, dest=1)
+        assert out["positions"] == 13 and out["last_token"] == 5
+        assert np.array_equal(out["pages"]["k"], payload["pages"]["k"])
+        assert np.array_equal(out["pages"]["v"], payload["pages"]["v"])
+        assert t.stats.snapshot()["transfers"] == 1
+
 
 class TestFleetMetrics:
     def test_prometheus_gauge_names_and_labels(self):
@@ -657,6 +973,12 @@ class TestFleetMetrics:
             "handoff": {"handoffs": 3, "handoff_tokens": 96,
                         "local_fallbacks": 1,
                         "stalls_ms": [2.0, 4.0, 6.0], "stall_count": 3},
+            "courier": {"chunks": 40, "retries": 6, "corruptions": 2,
+                        "duplicates": 1, "resumes": 3, "aborts": 1,
+                        "transfers": 4, "bytes_moved": 4096,
+                        "in_flight": 0,
+                        "transfer_ms": [1.0, 2.0, 3.0, 4.0],
+                        "transfer_count": 4},
         }
         exporter.export_fleet(snap)
         samples = {}
@@ -693,6 +1015,18 @@ class TestFleetMetrics:
             == pytest.approx(12.0)
         assert samples[("llmctl_fleet_replica_role", "0")] == 1
         assert samples[("llmctl_fleet_replica_role", "1")] == 2
+        # courier transport plane (this PR): chunk/retry/corruption/
+        # resume/abort counters + the end-to-end transfer histogram
+        assert samples[("llmctl_fleet_courier_chunks_total", None)] == 40
+        assert samples[("llmctl_fleet_courier_retries_total", None)] == 6
+        assert samples[
+            ("llmctl_fleet_courier_corruptions_total", None)] == 2
+        assert samples[("llmctl_fleet_courier_resumes_total", None)] == 3
+        assert samples[("llmctl_fleet_courier_aborts_total", None)] == 1
+        assert samples[
+            ("llmctl_fleet_courier_transfer_ms_count", None)] == 4
+        assert samples[("llmctl_fleet_courier_transfer_ms_sum", None)] \
+            == pytest.approx(10.0)
         # counters export deltas: a second identical snapshot must not
         # double-count the running totals (incl. the pause histogram)
         exporter.export_fleet(snap)
@@ -700,11 +1034,15 @@ class TestFleetMetrics:
             for s in metric.samples:
                 if s.name in ("llmctl_fleet_requeues_total",
                               "llmctl_fleet_migrations_total",
-                              "llmctl_fleet_handoffs_total"):
-                    assert s.value == {"llmctl_fleet_requeues_total": 5,
-                                       "llmctl_fleet_migrations_total": 2,
-                                       "llmctl_fleet_handoffs_total": 3}[
-                                           s.name]
+                              "llmctl_fleet_handoffs_total",
+                              "llmctl_fleet_courier_retries_total",
+                              "llmctl_fleet_courier_aborts_total"):
+                    assert s.value == {
+                        "llmctl_fleet_requeues_total": 5,
+                        "llmctl_fleet_migrations_total": 2,
+                        "llmctl_fleet_handoffs_total": 3,
+                        "llmctl_fleet_courier_retries_total": 6,
+                        "llmctl_fleet_courier_aborts_total": 1}[s.name]
                 if s.name in ("llmctl_fleet_migration_pause_ms_count",
                               "llmctl_fleet_handoff_stall_ms_count"):
                     assert s.value == {

@@ -542,8 +542,15 @@ class TestDisaggLoadgen:
             assert res.phases["decode"]["p50_itl_ms"] is not None
             assert res.phases["handoff"]["count"] == res.handoffs
             assert res.phases["handoff"]["p50_stall_ms"] is not None
+            # courier transport readout (this PR): every handoff crossed
+            # the chunked link, so transfer-stall percentiles report
+            # alongside the handoff stall
+            assert res.courier["transfers"] >= res.handoffs
+            assert res.courier["aborts"] == 0
+            assert res.courier["p50_transfer_ms"] is not None
+            assert res.phases["handoff"]["p50_transfer_ms"] is not None
             s = res.summary()
-            assert "phases" in s and "handoffs" in s
+            assert "phases" in s and "handoffs" in s and "courier" in s
         finally:
             fleet.shutdown()
 
