@@ -60,10 +60,10 @@ def status(url, as_json):
     from rich.console import Console
     from rich.table import Table
     table = Table(title="Fleet replicas")
-    for col in ("replica", "state", "role", "queue", "active",
-                "outstanding tok", "restarts", "migr out", "handoffs",
-                "courier out", "courier aborts", "prefix hit",
-                "last error"):
+    for col in ("replica", "state", "role", "endpoint", "remote?",
+                "queue", "active", "outstanding tok", "restarts",
+                "migr out", "handoffs", "courier out", "courier aborts",
+                "prefix hit", "last error"):
         table.add_column(col)
     per_src = snap.get("courier", {}).get("per_src", {})
     for r in snap["replicas"]:
@@ -78,6 +78,8 @@ def status(url, as_json):
         table.add_row(str(r["replica"]),
                       f"[{color}]{r['state']}[/{color}]",
                       role,
+                      r.get("endpoint", "local"),
+                      "yes" if r.get("remote") else "-",
                       str(r["queue_depth"]), str(r["active"]),
                       str(r["outstanding_tokens"]), str(r["restarts"]),
                       str(r.get("migrations", 0)),
@@ -113,7 +115,7 @@ def status(url, as_json):
             f"{ho.get('demotions', 0)} demotions)")
     cour = snap.get("courier")
     if cour and (cour.get("transfers") or cour.get("aborts")
-                 or cour.get("in_flight")):
+                 or cour.get("in_flight") or cour.get("expired")):
         console.print(
             f"courier: {cour.get('in_flight', 0)} in flight, "
             f"{cour.get('transfers', 0)} transfers "
@@ -122,7 +124,8 @@ def status(url, as_json):
             f"{cour.get('retries', 0)} retries, "
             f"{cour.get('corruptions', 0)} corruptions, "
             f"{cour.get('resumes', 0)} resumes, "
-            f"{cour.get('aborts', 0)} aborts)")
+            f"{cour.get('aborts', 0)} aborts, "
+            f"{cour.get('expired', 0)} expired tickets)")
 
 
 @app.command()
@@ -185,3 +188,119 @@ def migrate(request_id, replica, url):
         _die(e)
     click.echo(f"request {out['request_id']}: migrating to replica "
                f"{out['replica']}")
+
+
+@app.command()
+@click.option("--model", "model_name", default="gpt-125m",
+              show_default=True, help="Model template name.")
+@click.option("--artifact", default="",
+              help="Checkpoint dir or exported weights file.")
+@click.option("--replica-id", default=0, show_default=True, type=int,
+              help="This worker's replica id in the parent fleet — must "
+                   "match its --fleet-endpoint entry.")
+@click.option("--role", default="mixed", show_default=True,
+              type=click.Choice(["prefill", "decode", "mixed"]))
+@click.option("--host", default="127.0.0.1", show_default=True)
+@click.option("--port", default=0, show_default=True, type=int,
+              help="0 binds an ephemeral port; the bound port is "
+                   "printed as 'LLMCTL_WORKER_READY port=N'.")
+@click.option("--max-batch-size", default=8, show_default=True, type=int)
+@click.option("--max-seq-len", default=2048, show_default=True, type=int)
+@click.option("--prefill-chunk", default=0, show_default=True, type=int,
+              help="Chunked prefill size (0 = engine default).")
+@click.option("--kv-block-size", default=64, show_default=True, type=int)
+@click.option("--dtype", default=None,
+              type=click.Choice(["bfloat16", "float32"]))
+@click.option("--kv-quantization", default="none", show_default=True,
+              type=click.Choice(["none", "int8"]))
+@click.option("--seed", default=0, show_default=True, type=int,
+              help="Engine sampling seed base.")
+@click.option("--param-seed", default=-1, show_default=True, type=int,
+              help="Initialise weights from this PRNG seed instead of "
+                   "loading an artifact (cross-process determinism for "
+                   "tests/dryrun; every worker and the reference must "
+                   "use the same value). -1 = normal artifact/init "
+                   "path.")
+@click.option("--courier-chunk-bytes", default=256 * 1024,
+              show_default=True, type=int)
+@click.option("--courier-retries", default=4, show_default=True,
+              type=int)
+@click.option("--courier-deadline-ms", default=100.0, show_default=True,
+              type=float)
+@click.option("--courier-backoff-ms", default=2.0, show_default=True,
+              type=float)
+@click.option("--courier-backoff-max-ms", default=100.0,
+              show_default=True, type=float)
+@click.option("--ticket-ttl-ms", default=60_000.0, show_default=True,
+              type=float,
+              help="Evict unclaimed courier tickets after this long.")
+@click.option("--restart-backoff", default=0.5, show_default=True,
+              type=float,
+              help="First local engine-rebuild delay after a crash; "
+                   "doubles per consecutive crash.")
+@click.option("--migrate-on-drain/--no-migrate-on-drain", default=True,
+              show_default=True)
+@click.option("--fault-plan", default="",
+              help="JSON FaultPlan for deterministic chaos (testing): "
+                   "e.g. '{\"seed\": 5, \"chunk_drop_rate\": 0.2}'.")
+def worker(model_name, artifact, replica_id, role, host, port,
+           max_batch_size, max_seq_len, prefill_chunk, kv_block_size,
+           dtype, kv_quantization, seed, param_seed, courier_chunk_bytes,
+           courier_retries, courier_deadline_ms, courier_backoff_ms,
+           courier_backoff_max_ms, ticket_ttl_ms, restart_backoff,
+           migrate_on_drain, fault_plan):
+    """Run ONE fleet replica as its own OS process behind an HTTP front.
+
+    The cross-host half of `llmctl serve start --fleet-remote-replicas`:
+    the parent fleet submits work and collects results over
+    /worker/* RPCs, and KV payloads arrive by push at
+    /fleet/courier/chunk (reassembled, CRC-verified, and attached by
+    ticket locally — the remote restorer). The worker supervises its
+    own engine; the parent only declares it dead when the process stops
+    answering."""
+    import json as _json
+
+    import jax
+
+    from ...config.presets import get_model_config
+    from ...config.schema import FleetConfig, ServeConfig
+    from ...serve.fleet.faults import FaultPlan
+    from ...serve.fleet.worker import FleetWorker
+
+    if dtype is None:
+        dtype = "bfloat16" if jax.default_backend() == "tpu" else "float32"
+    model_cfg = get_model_config(model_name)
+    serve_kw = dict(
+        model=model_name, artifact=artifact, host=host, port=port,
+        max_batch_size=max_batch_size,
+        max_seq_len=min(max_seq_len, model_cfg.max_position_embeddings),
+        kv_block_size=kv_block_size, dtype=dtype,
+        kv_quantization=kv_quantization)
+    if prefill_chunk > 0:
+        serve_kw["prefill_chunk"] = prefill_chunk
+    serve_cfg = ServeConfig(**serve_kw)
+    serve_cfg.validate()
+    fleet_cfg = FleetConfig(
+        replicas=1, migrate_on_drain=migrate_on_drain,
+        restart_backoff_s=restart_backoff,
+        courier_chunk_bytes=courier_chunk_bytes,
+        courier_max_retries=courier_retries,
+        courier_chunk_deadline_ms=courier_deadline_ms,
+        courier_retry_backoff_ms=courier_backoff_ms,
+        courier_retry_backoff_max_ms=courier_backoff_max_ms,
+        courier_ticket_ttl_ms=ticket_ttl_ms)
+    fleet_cfg.validate()
+    plan = None
+    if fault_plan:
+        try:
+            plan = FaultPlan(**_json.loads(fault_plan))
+        except (TypeError, ValueError) as e:
+            raise click.ClickException(f"bad --fault-plan JSON: {e}")
+    params = None
+    if param_seed >= 0:
+        from ...models import init as model_init
+        params = model_init(model_cfg, jax.random.PRNGKey(param_seed))
+    w = FleetWorker(replica_id, model_cfg, serve_cfg,
+                    fleet_cfg=fleet_cfg, role=role, params=params,
+                    seed=seed, fault_plan=plan)
+    w.run_forever(host=host, port=port)

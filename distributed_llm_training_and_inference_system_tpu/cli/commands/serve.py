@@ -173,6 +173,23 @@ def app(ctx):
                    "receiver absorbs the late duplicate idempotently).")
 @click.option("--fleet-courier-endpoint", default="", show_default=True,
               help="http transport only: destination fleet base URL.")
+@click.option("--fleet-courier-ticket-ttl-ms", default=60_000.0,
+              show_default=True, type=float,
+              help="Evict unclaimed courier reassembly buffers / "
+                   "attached payloads after this long (counted in "
+                   "llmctl_fleet_courier_expired_total; 0 = never).")
+@click.option("--fleet-endpoint", "fleet_endpoints", multiple=True,
+              metavar="REPLICA=URL",
+              help="Per-replica courier endpoint, repeatable (e.g. "
+                   "--fleet-endpoint 1=http://hostB:9001). Remote "
+                   "replicas need one; in-proc replicas may name this "
+                   "front's own URL so remote workers can push KV to "
+                   "them.")
+@click.option("--fleet-remote-replicas", default="", show_default=True,
+              help="Comma-separated replica ids served by `llmctl fleet "
+                   "worker` processes instead of in-process engines; "
+                   "each MUST have a --fleet-endpoint entry (validated "
+                   "at startup).")
 def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
           kv_block_size, kv_hbm_gb, scheduler, dtype, prometheus_port,
           speculative, spec_tokens, prefix_cache, tensor_parallel,
@@ -185,12 +202,14 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
           fleet_max_migrations, fleet_roles, fleet_role_balance_ratio,
           fleet_courier_transport, fleet_courier_chunk_bytes,
           fleet_courier_retries, fleet_courier_deadline_ms,
-          fleet_courier_endpoint):
+          fleet_courier_endpoint, fleet_courier_ticket_ttl_ms,
+          fleet_endpoints, fleet_remote_replicas):
     """Start the OpenAI-compatible inference server."""
     import jax
 
     from ...config.presets import get_model_config
-    from ...config.schema import FleetConfig, ServeConfig
+    from ...config.schema import (FleetConfig, ServeConfig,
+                                  parse_fleet_endpoints)
     from ...metrics.observability import setup_observability
     from ...serve.server import create_server
 
@@ -230,7 +249,10 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
             courier_chunk_bytes=fleet_courier_chunk_bytes,
             courier_max_retries=fleet_courier_retries,
             courier_chunk_deadline_ms=fleet_courier_deadline_ms,
-            courier_endpoint=fleet_courier_endpoint)
+            courier_endpoint=fleet_courier_endpoint,
+            courier_ticket_ttl_ms=fleet_courier_ticket_ttl_ms,
+            fleet_endpoints=parse_fleet_endpoints(list(fleet_endpoints)),
+            remote_replicas=fleet_remote_replicas)
         fleet_cfg.validate()
 
     observer = None

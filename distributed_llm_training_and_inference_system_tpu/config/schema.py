@@ -717,7 +717,51 @@ class ServeConfig:
         return cfg
 
 
-@dataclass
+def parse_fleet_endpoints(value) -> dict[int, str]:
+    """Normalize a fleet endpoint map to {replica_id: base_url}.
+
+    Accepts the three spellings operators actually produce: a dict with
+    string or int keys (the TOML table ``[fleet.fleet_endpoints]``), a
+    sequence of ``"id=url"`` strings (the repeated ``--fleet-endpoint``
+    CLI flag), or one comma-separated ``"id=url,id=url"`` string. Raises
+    :class:`ConfigError` (a ValueError) on malformed entries so a typo
+    fails at config time, not at first KV ship."""
+    if not value:
+        return {}
+    items: list[tuple[object, object]] = []
+    if isinstance(value, dict):
+        items = list(value.items())
+    else:
+        if isinstance(value, str):
+            value = [p for p in value.split(",") if p.strip()]
+        for entry in value:
+            if not isinstance(entry, str) or "=" not in entry:
+                raise ConfigError(
+                    f"fleet endpoint entries must be 'replica=url', "
+                    f"got {entry!r}")
+            rid, _, url = entry.partition("=")
+            items.append((rid, url))
+    out: dict[int, str] = {}
+    for rid, url in items:
+        try:
+            key = int(str(rid).strip())
+        except ValueError:
+            raise ConfigError(
+                f"fleet endpoint replica id must be an integer, "
+                f"got {rid!r}")
+        url = str(url).strip().rstrip("/")
+        if not url.startswith(("http://", "https://")):
+            raise ConfigError(
+                f"fleet endpoint for replica {key} must be an http(s) "
+                f"base URL, got {url!r}")
+        if key in out:
+            raise ConfigError(
+                f"duplicate fleet endpoint for replica {key}")
+        out[key] = url
+    return out
+
+
+@dataclasses.dataclass
 class FleetConfig:
     """Serve-fleet control plane (serve/fleet/): N engine replicas behind a
     router + supervisor. The per-replica engine is configured by ServeConfig;
@@ -821,12 +865,58 @@ class FleetConfig:
     courier_retry_backoff_max_ms: float = 100.0
     courier_chunk_deadline_ms: float = 100.0
     courier_endpoint: str = ""      # http transport: dest fleet base URL
+    # destination-side reassembly buffers and attached-but-unclaimed
+    # payloads are evicted after this TTL (a sender that died mid-push,
+    # or a placement that never submitted, must not leak host memory
+    # forever). Evictions count in llmctl_fleet_courier_expired_total.
+    # 0 disables expiry.
+    courier_ticket_ttl_ms: float = 60_000.0
+    # -- cross-host fleet (serve/fleet/remote.py + worker.py) ----------------
+    # per-replica courier endpoint map: replica id -> base URL of the host
+    # front that runs that replica's CourierReceiver (`llmctl fleet
+    # worker` for remote replicas; this process's own fleet front for
+    # in-proc replicas that must RECEIVE payloads pushed by remote
+    # workers). Accepts a dict ({"0": "http://hostA:9000"}, the TOML
+    # table form), a sequence of "id=url" strings (the repeated
+    # `--fleet-endpoint` CLI flag), or one comma-separated string.
+    fleet_endpoints: dict = dataclasses.field(default_factory=dict)
+    # comma-separated replica ids served by a remote `llmctl fleet
+    # worker` process instead of an in-process engine thread. Every id
+    # listed here MUST have an entry in fleet_endpoints — that is
+    # validated at fleet build time, not at first ship.
+    remote_replicas: str = ""
+    # per-call HTTP timeout for remote-replica control RPCs
+    # (submit/probe/outbox/drain); failed calls reconnect under a
+    # doubling backoff and probe misses tear the replica down exactly
+    # like an engine-thread crash.
+    remote_timeout_s: float = 5.0
+    # upper bound on one worker->worker payload ship command (the
+    # chunked push inside it already has per-chunk deadlines + retry
+    # budget; this bounds the whole RPC so a hung worker can't wedge
+    # placement).
+    courier_ship_timeout_s: float = 30.0
 
     def role_list(self) -> list[str]:
         """Per-replica role assignment; empty config = all mixed."""
         if not self.roles:
             return ["mixed"] * self.replicas
         return [s.strip().lower() for s in self.roles.split(",")]
+
+    def endpoint_map(self) -> dict[int, str]:
+        """Normalized {replica_id: base_url} courier endpoint map."""
+        return parse_fleet_endpoints(self.fleet_endpoints)
+
+    def remote_replica_ids(self) -> set[int]:
+        """Replica ids fronted by a remote `llmctl fleet worker`."""
+        if not self.remote_replicas:
+            return set()
+        try:
+            return {int(s) for s in
+                    str(self.remote_replicas).split(",") if s.strip()}
+        except ValueError:
+            raise ConfigError(
+                f"remote_replicas must be comma-separated replica ids, "
+                f"got {self.remote_replicas!r}")
 
     def validate(self) -> None:
         if self.replicas < 1:
@@ -887,6 +977,29 @@ class FleetConfig:
                 "destination fleet front's base URL)")
         if self.courier_chunk_bytes < 1024:
             raise ConfigError("courier_chunk_bytes must be >= 1024")
+        if self.courier_ticket_ttl_ms < 0:
+            raise ConfigError(
+                "courier_ticket_ttl_ms must be >= 0 (0 disables expiry)")
+        if self.remote_timeout_s <= 0 or self.courier_ship_timeout_s <= 0:
+            raise ConfigError(
+                "remote_timeout_s / courier_ship_timeout_s must be > 0")
+        endpoints = self.endpoint_map()       # raises on malformed entries
+        for rid in endpoints:
+            if not 0 <= rid < self.replicas:
+                raise ConfigError(
+                    f"fleet endpoint names replica {rid} but the fleet "
+                    f"has replicas 0..{self.replicas - 1}")
+        remote = self.remote_replica_ids()
+        for rid in sorted(remote):
+            if not 0 <= rid < self.replicas:
+                raise ConfigError(
+                    f"remote_replicas names replica {rid} but the fleet "
+                    f"has replicas 0..{self.replicas - 1}")
+            if rid not in endpoints:
+                raise ConfigError(
+                    f"remote replica {rid} has no fleet endpoint — every "
+                    f"remote replica needs a fleet_endpoints entry "
+                    f"(--fleet-endpoint {rid}=http://host:port)")
         if self.courier_max_retries < 0:
             raise ConfigError("courier_max_retries must be >= 0")
         if self.courier_retry_backoff_ms < 0 \
@@ -902,7 +1015,11 @@ class FleetConfig:
         kw = {}
         for f_ in dataclasses.fields(cls):
             if f_.name in d:
-                if isinstance(f_.default, bool):
+                if f_.name == "fleet_endpoints":
+                    # dict field (default_factory): accepts the TOML
+                    # table, the repeated-CLI-flag list, or one string
+                    kw[f_.name] = parse_fleet_endpoints(d[f_.name])
+                elif isinstance(f_.default, bool):
                     # bool("false") is True — string configs need the shared
                     # parser, same as ServeConfig
                     kw[f_.name] = _parse_bool(f_.name, d[f_.name])

@@ -268,6 +268,10 @@ class PrometheusExporter:
             "llmctl_fleet_courier_aborts",
             "Courier transfers that exhausted their retry budget "
             "(payload dropped; destination re-prefilled)")
+        self.fleet_courier_expired = c(
+            "llmctl_fleet_courier_expired",
+            "Courier tickets evicted by TTL before being claimed "
+            "(abandoned reassembly buffers and unattached payloads)")
         self.fleet_courier_transfer = h(
             "llmctl_fleet_courier_transfer_ms",
             "End-to-end courier transfer time per payload (ms)",
@@ -400,7 +404,8 @@ class PrometheusExporter:
                 ("retries", self.fleet_courier_retries),
                 ("corruptions", self.fleet_courier_corruptions),
                 ("resumes", self.fleet_courier_resumes),
-                ("aborts", self.fleet_courier_aborts)):
+                ("aborts", self.fleet_courier_aborts),
+                ("expired", self.fleet_courier_expired)):
             total = cour.get(key, 0)
             delta = total - self._last_totals.get(f"fleet_cour_{key}", 0)
             if delta > 0:

@@ -15,13 +15,15 @@ can outlive a single engine process:
   straggler injection so every path above is testable on CPU
 - :class:`ServeFleet` — the facade wiring them together
 
-Replicas here are threads over engines on the local (possibly virtual)
-mesh — the same in-process simulation strategy the repo uses for
-multi-chip training (tests/conftest.py): every routing, drain, and
-requeue decision executes the real code path, deterministically, on CPU.
-On real hardware each replica maps to its own chip slice; the control
-plane is transport-agnostic by construction (it only ever calls
-``submit``/``probe``/``take_orphans``).
+Replicas are threads over engines on the local (possibly virtual) mesh
+— the same in-process simulation strategy the repo uses for multi-chip
+training (tests/conftest.py) — OR separate OS processes / hosts running
+`llmctl fleet worker`, fronted by :class:`~.remote.RemoteReplica`
+(``FleetConfig.remote_replicas`` + the per-replica ``fleet_endpoints``
+courier map). The control plane is transport-agnostic by construction
+(it only ever calls ``submit``/``probe``/``take_orphans``), and KV
+payloads move over the push-based, destination-terminated courier
+(serve/fleet/transport.py) either way.
 """
 
 from __future__ import annotations
@@ -33,16 +35,20 @@ from typing import Callable, Optional, Sequence
 from ...config.schema import FleetConfig, ModelConfig, ServeConfig
 from ..scheduler import Request, SamplingParams
 from .faults import (DestUnreachable, FaultInjector, FaultPlan,
-                     InjectedCrash, ProbeTimeout)
+                     InjectedCrash, ProbeTimeout, RpcBlackhole)
 from .migration import MigrationTicket
+from .remote import RemoteReplica, RemoteUnavailable
 from .replica import (ROLE_DECODE, ROLE_MIXED, ROLE_PREFILL, EngineReplica,
                       reset_for_requeue)
 from .router import FleetRouter, FleetSaturated, prefix_digest
 from .supervisor import ReplicaSupervisor
-from .transport import (HTTPCourierTransport, InProcTransport, KVCourier,
-                        TransferAborted, TransportError, build_transport)
+from .transport import (CourierReceiver, HTTPCourierTransport,
+                        InProcTransport, KVCourier, TransferAborted,
+                        TransportError, build_transport, is_ticket_stub,
+                        ticket_stub)
 
 __all__ = [
+    "CourierReceiver",
     "DestUnreachable",
     "EngineReplica",
     "FaultInjector",
@@ -55,6 +61,9 @@ __all__ = [
     "KVCourier",
     "MigrationTicket",
     "ProbeTimeout",
+    "RemoteReplica",
+    "RemoteUnavailable",
+    "RpcBlackhole",
     "ROLE_DECODE",
     "ROLE_MIXED",
     "ROLE_PREFILL",
@@ -63,8 +72,10 @@ __all__ = [
     "TransferAborted",
     "TransportError",
     "build_transport",
+    "is_ticket_stub",
     "prefix_digest",
     "reset_for_requeue",
+    "ticket_stub",
 ]
 
 
@@ -86,43 +97,56 @@ class ServeFleet:
                  eos_token_id: Optional[int] = None, seed: int = 0,
                  supervise: bool = True):
         self.fleet_cfg = fleet_cfg or FleetConfig()
-        self.fleet_cfg.validate()
+        self.fleet_cfg.validate()    # incl. endpoint-map/remote mismatch
         self.serve_cfg = serve_cfg
         self.injector = FaultInjector(fault_plan) if fault_plan else None
         roles = self.fleet_cfg.role_list()
-        self.replicas: list[EngineReplica] = []
+        remote_ids = self.fleet_cfg.remote_replica_ids()
+        endpoints = self.fleet_cfg.endpoint_map()
+        # KV courier: every migration / handoff / salvaged-partial
+        # payload crosses this chunked, checksummed, retrying transport
+        # (serve/fleet/transport.py), push-based and destination-
+        # terminated: the completed payload attaches BY TICKET in the
+        # destination host's receiver and restores locally. In-proc
+        # destinations use the local receiver; remote destinations are
+        # pushed over HTTP per the fleet_endpoints map.
+        self.courier = KVCourier(self.fleet_cfg, injector=self.injector)
+        # inbound chunk reassembly for the HTTP front
+        # (/fleet/courier/chunk) shares the courier's receiver, so
+        # socket-delivered and in-proc transfers attach in one place
+        self.courier_receiver = self.courier.receiver
+        self.replicas: list = []
         for i in range(self.fleet_cfg.replicas):
-            r = EngineReplica(
-                i, model_cfg, serve_cfg, params=params,
-                # distinct base seeds so unseeded sampled requests don't
-                # mirror each other across replicas (greedy / explicit
-                # seeds are unaffected)
-                seed=seed + 1000 * i, injector=self.injector,
-                on_finish=self._on_request_exit, eos_token_id=eos_token_id,
-                fleet_cfg=self.fleet_cfg, role=roles[i])
-            if params is None:          # replica 0 owns the load; share it
-                params = r.engine.params
-                model_cfg = r.model_cfg
+            if i in remote_ids:
+                r = RemoteReplica(
+                    i, endpoints[i], fleet_cfg=self.fleet_cfg,
+                    injector=self.injector,
+                    on_finish=self._on_request_exit, role=roles[i])
+            else:
+                r = EngineReplica(
+                    i, model_cfg, serve_cfg, params=params,
+                    # distinct base seeds so unseeded sampled requests
+                    # don't mirror each other across replicas (greedy /
+                    # explicit seeds are unaffected)
+                    seed=seed + 1000 * i, injector=self.injector,
+                    on_finish=self._on_request_exit,
+                    eos_token_id=eos_token_id,
+                    fleet_cfg=self.fleet_cfg, role=roles[i])
+                r.courier_receiver = self.courier_receiver
+                if params is None:      # replica 0 owns the load; share
+                    params = r.engine.params
+                    model_cfg = r.model_cfg
             self.replicas.append(r)
         self.model_cfg = model_cfg
         self._params = params
-        # KV courier: every migration / handoff / salvaged-partial
-        # payload crosses this chunked, checksummed, retrying transport
-        # (serve/fleet/transport.py). InProc by default — byte-for-byte
-        # today's behavior, with the injector able to drop / corrupt /
-        # delay / duplicate chunks deterministically.
-        self.courier = KVCourier(build_transport(
-            self.fleet_cfg, injector=self.injector))
-        # inbound chunk reassembly for the HTTP front
-        # (/fleet/courier/chunk): the in-proc transport's own receiver
-        # when there is one, so loopback HTTP and in-proc transfers share
-        # state; a standalone receiver otherwise
-        from .transport import CourierReceiver
-        self.courier_receiver = getattr(self.courier.transport, "receiver",
-                                        None) or CourierReceiver()
         self.router = FleetRouter(self.replicas, self.fleet_cfg,
                                   observer=observer, courier=self.courier)
         for r in self.replicas:
+            if getattr(r, "remote", False):
+                # a remote prefill worker parks its handoffs under a
+                # ticket and publishes them through its outbox; the
+                # supervisor's migrated-collection places them
+                continue
             # disaggregation wiring: a prefill-role replica asks the
             # router for a decode destination BEFORE extracting (local-
             # decode fallback when no pool has room), then places the
@@ -153,10 +177,12 @@ class ServeFleet:
         self.supervisor.stop()
         for r in self.replicas:
             r.stop()
-            try:
-                r.engine.release()
-            except Exception:
-                pass
+            engine = getattr(r, "engine", None)   # remote: no engine here
+            if engine is not None:
+                try:
+                    engine.release()
+                except Exception:
+                    pass
 
     # -- serving -------------------------------------------------------------
 

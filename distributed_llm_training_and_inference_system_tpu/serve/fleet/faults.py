@@ -43,6 +43,14 @@ class DestUnreachable(RuntimeError):
     connection-refused destination host."""
 
 
+class RpcBlackhole(RuntimeError):
+    """Raised before a remote-replica control RPC (submit/probe/outbox/
+    ship) to simulate a black-holed worker endpoint: the process may be
+    alive, but nothing reaches it. A finite count models a partition
+    that heals; -1 models a dead route (the supervisor's probe-miss
+    teardown then fires exactly as for a SIGKILLed worker)."""
+
+
 @dataclass
 class FaultPlan:
     """Declarative fault schedule. All fields optional; the default plan
@@ -83,6 +91,13 @@ class FaultPlan:
     # moves (connection refused / network partition at transfer open)
     dest_unreachable_replica: Optional[int] = None
     dest_unreachable_count: int = 0
+    # process-level faults (cross-host fleet, serve/fleet/remote.py):
+    # black-hole every control RPC to `rpc_blackhole_replica` for the
+    # next `rpc_blackhole_count` calls (-1 = forever — the parent's
+    # probe-miss teardown must fire exactly like a SIGKILL; a finite
+    # count is a partition that heals before the miss budget runs out)
+    rpc_blackhole_replica: Optional[int] = None
+    rpc_blackhole_count: int = 0
 
 
 class FaultInjector:
@@ -107,6 +122,7 @@ class FaultInjector:
         self._chunk_faults_left = (p.chunk_fault_budget
                                    if p.chunk_fault_budget > 0 else None)
         self._unreachable_left = p.dest_unreachable_count
+        self._blackhole_left = p.rpc_blackhole_count
 
     def before_step(self, replica_id: int) -> None:
         """Called by the replica loop before each engine step; raises
@@ -154,6 +170,21 @@ class FaultInjector:
         if fire:
             raise DestUnreachable(
                 f"injected unreachable destination: replica {dest}")
+
+    def on_rpc(self, replica_id) -> None:
+        """Called before each remote-replica control RPC; raises
+        RpcBlackhole while the planned black-hole is in effect
+        (count -1 = forever; a positive count is consumed per call, so
+        the partition heals and subsequent RPCs go through)."""
+        with self._lock:
+            fire = (self.plan.rpc_blackhole_replica is not None
+                    and replica_id == self.plan.rpc_blackhole_replica
+                    and self._blackhole_left != 0)
+            if fire and self._blackhole_left > 0:
+                self._blackhole_left -= 1
+        if fire:
+            raise RpcBlackhole(
+                f"injected black-holed endpoint: replica {replica_id}")
 
     def on_chunk(self, src, dest, ticket: str, seq: int) -> Optional[dict]:
         """Called by the courier transport per chunk send attempt.

@@ -19,10 +19,12 @@ operator endpoints:
   total, crc32, base64 data; chunk 0 carries the manifest). Idempotent:
   duplicates ack without effect; a CRC mismatch acks ``ok: false`` and
   the sender retransmits. The ack lists which sequence numbers are still
-  missing, so a resumed transfer sends only those.
-- ``POST /fleet/courier/claim`` — ``{"ticket": ...}``: hand a completed
-  transfer's (manifest, blob) back; 404 while chunks are missing. The
-  remote half of :class:`~.transport.HTTPCourierTransport`.
+  missing, so a resumed transfer sends only those. The completing chunk
+  verifies the whole blob end-to-end and ATTACHES the decoded payload by
+  ticket in this host's receiver — the destination replica restores it
+  locally at submit time. (The old ``/fleet/courier/claim`` loopback,
+  which handed the blob back to the *sender*, is gone: transfers are
+  destination-terminated.)
 
 Backpressure contract: when every replica saturates, completions answer
 **429 with a Retry-After header** (seconds) instead of queueing without
@@ -251,27 +253,6 @@ class FleetServer:
         return web.json_response(
             self.fleet.courier_receiver.add_chunk(chunk))
 
-    async def handle_courier_claim(self, request: web.Request
-                                   ) -> web.Response:
-        import base64 as _b64
-
-        from .transport import TransferAborted
-        try:
-            body = await request.json()
-            ticket = str(body["ticket"])
-        except Exception:
-            return web.json_response(
-                {"error": "body must be {\"ticket\": <id>}"}, status=400)
-        try:
-            manifest, blob = \
-                self.fleet.courier_receiver.claim_encoded(ticket)
-        except TransferAborted as e:
-            return web.json_response({"ok": False, "error": str(e)},
-                                     status=404)
-        return web.json_response({
-            "ok": True, "ticket": ticket, "manifest": manifest,
-            "blob": _b64.b64encode(blob).decode()})
-
     async def handle_metrics(self, request: web.Request) -> web.Response:
         try:
             from prometheus_client import generate_latest
@@ -294,8 +275,6 @@ class FleetServer:
         app.router.add_post("/fleet/role", self.handle_fleet_role)
         app.router.add_post("/fleet/courier/chunk",
                             self.handle_courier_chunk)
-        app.router.add_post("/fleet/courier/claim",
-                            self.handle_courier_claim)
         return app
 
     # -- lifecycle -----------------------------------------------------------

@@ -135,6 +135,12 @@ class EngineReplica:
         # slot terminally on this replica (finished/cancelled) — the
         # router's completion hook. NOT fired on crash/drain extraction.
         self.on_finish = on_finish
+        # host-local CourierReceiver (set by ServeFleet / FleetWorker):
+        # payload-carrying requests arrive holding a ticket STUB; submit
+        # attaches the completed payload from this receiver — the
+        # destination-terminated half of the courier. None = direct
+        # payloads only (offline/unit use).
+        self.courier_receiver = None
         self._state_lock = threading.Lock()
         self.state = STARTING
         self.last_error: Optional[str] = None
@@ -236,7 +242,8 @@ class EngineReplica:
             p = partials.get(r.request_id)
             if p is not None:
                 r.swapped_kv = p
-        self._orphans.extend(orphans)
+        with self._state_lock:
+            self._orphans.extend(orphans)
 
     def _salvage_precopies(self) -> dict[str, dict]:
         """Partial ``swapped_kv`` payloads from migration tickets whose
@@ -356,8 +363,8 @@ class EngineReplica:
                 # migrate_on_drain, queued swap-preempted victims keep
                 # theirs too (host arrays restore anywhere)
                 reset_for_requeue(r, keep_kv=self._migrate_on_drain)
-            self._orphans.extend(victims)
             with self._state_lock:
+                self._orphans.extend(victims)
                 self.state = DRAINED
             logger.info("replica %d drained (%d requests requeued)",
                         self.replica_id, len(victims))
@@ -512,6 +519,21 @@ class EngineReplica:
     def submit(self, req: Request) -> bool:
         if not self.accepting():
             return False
+        from .transport import is_ticket_stub
+        if is_ticket_stub(req.swapped_kv):
+            # attach the courier-delivered payload by ticket, locally —
+            # no sender round-trip. A missing/expired ticket degrades to
+            # re-prefill (correct tokens, extra compute), never blocks.
+            ticket = req.swapped_kv["courier_ticket"]
+            recv = self.courier_receiver
+            payload = recv.take_payload(ticket) if recv is not None \
+                else None
+            if payload is None:
+                logger.warning(
+                    "replica %d: courier ticket %s missing/expired for "
+                    "%s; falling back to re-prefill", self.replica_id,
+                    ticket, req.request_id)
+            req.swapped_kv = payload
         with self.engine.lock:
             ok = self.engine.scheduler.add_request(req)
         if ok:
@@ -587,8 +609,12 @@ class EngineReplica:
                 self.state = HEALTHY
 
     def take_orphans(self) -> list[Request]:
-        """Hand the stashed crash/drain victims to the caller (supervisor)."""
-        out, self._orphans = self._orphans, []
+        """Hand the stashed crash/drain victims to the caller. The
+        supervisor collects on every poll (remote workers surface
+        orphans while healthy), so the swap must exclude a concurrent
+        crash/drain extend — hence the lock."""
+        with self._state_lock:
+            out, self._orphans = self._orphans, []
         return out
 
     def request_migrate(self, request_id: str, dest: Optional[int] = None,

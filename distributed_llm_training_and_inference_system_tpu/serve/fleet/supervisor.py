@@ -86,11 +86,14 @@ class ReplicaSupervisor:
         self._collect_migrated()
         for r in self.replicas:
             state = r.state
+            # orphans are collected unconditionally: a REMOTE worker
+            # self-heals engine crashes and surfaces the victims through
+            # its outbox while the parent still sees it healthy; in-proc
+            # replicas only ever stash orphans in crash/drain states, so
+            # the extra calls are free no-ops there
+            self._requeue_orphans(r)
             if state in (replica_mod.CRASHED, replica_mod.STOPPED):
-                self._requeue_orphans(r)
                 recovered |= self._maybe_restart(r, now)
-            elif state == replica_mod.DRAINED:
-                self._requeue_orphans(r)   # drain victims move elsewhere
             elif state == replica_mod.HEALTHY:
                 self._probe(r)
         self._ensure_role_coverage()
@@ -106,8 +109,13 @@ class ReplicaSupervisor:
     def _collect_migrated(self) -> None:
         for r in self.replicas:
             for req, ticket in r.take_migrated():
+                # remote prefill workers surface their prefill->decode
+                # handoffs here (they can't see the fleet to place them
+                # synchronously); keep them in the handoff ledger
+                kind = ("handoff" if ticket.reason == "handoff"
+                        else "migration")
                 self.router.place_migrated(req, from_replica=r.replica_id,
-                                           dest=ticket.dest)
+                                           dest=ticket.dest, kind=kind)
 
     def _maybe_rebalance(self) -> None:
         """Migration-driven load rebalancing: when the outstanding-token
@@ -485,6 +493,10 @@ class ReplicaSupervisor:
         pauses: list[float] = []
         stalls: list[float] = []
         by_reason: dict[str, int] = {}
+        try:
+            endpoints = self.cfg.endpoint_map()
+        except Exception:
+            endpoints = {}
         for r in self.replicas:
             hits, queries, cached = r.prefix_cache_stats()
             requeue_cached += cached
@@ -496,6 +508,10 @@ class ReplicaSupervisor:
                 "replica": r.replica_id,
                 "state": r.state,
                 "role": self._role(r),
+                # courier endpoint this replica receives payloads at
+                # ("local" = this process's receiver via the fleet front)
+                "endpoint": endpoints.get(r.replica_id, "local"),
+                "remote": bool(getattr(r, "remote", False)),
                 # crash-promoted to mixed; auto-demotes back to this
                 # provisioned role once the lost class is healthy again
                 "promoted_from": self._promoted.get(r.replica_id),
@@ -554,4 +570,6 @@ class ReplicaSupervisor:
         return {"replicas": reps, "router": self.router.stats(),
                 "restarts": self.total_restarts, "migration": migration,
                 "handoff": handoff,
+                # per-replica courier endpoint map (string keys: JSON)
+                "endpoints": {str(k): v for k, v in endpoints.items()},
                 "courier": courier.snapshot() if courier else {}}

@@ -16,26 +16,37 @@ is reliable with bounded tail latency. This module is that link layer:
 - ``CourierReceiver`` — destination half: per-ticket reassembly that is
   idempotent under duplicates, rejects corrupt chunks by checksum, and
   reports which sequence numbers are still missing so a retry sends ONLY
-  those (resumable transfer).
+  those (resumable transfer). A completed transfer is verified end-to-end
+  (whole-blob CRC), decoded, and **attached by ticket** in a host-local
+  ready store: the destination replica claims it locally at submit time
+  (``take_payload``), with no sender round-trip. Abandoned tickets —
+  reassembly buffers whose sender died, attached payloads whose
+  placement never landed — expire after ``courier_ticket_ttl_ms``.
 - ``CourierTransport`` — sender half: per-chunk deadline, retry with
-  doubling backoff, abort after ``courier_max_retries`` resend rounds,
-  end-to-end blob CRC verification before the payload is handed over.
-  :class:`InProcTransport` delivers to a local receiver (today's
-  threaded fleet — behavior byte-for-byte identical to the pre-courier
-  hand-off, now with the whole failure matrix injectable);
-  :class:`HTTPCourierTransport` POSTs each chunk to the aiohttp fleet
-  front (``/fleet/courier/chunk``), making real cross-host movement
-  possible over the same framing.
+  doubling backoff, abort after ``courier_max_retries`` resend rounds.
+  The transfer is **push-based and destination-terminated**: chunks flow
+  TO the destination host and the sender only ever sees acks.
+  :class:`InProcTransport` delivers to the host-local receiver (threaded
+  fleet replicas — behavior byte-for-byte identical, now with the whole
+  failure matrix injectable); :class:`HTTPCourierTransport` POSTs each
+  chunk to the *destination's* ``/fleet/courier/chunk`` endpoint,
+  resolved from the per-replica ``fleet_endpoints`` map — real
+  cross-host movement over the same framing.
 - ``KVCourier`` — the fleet-facing facade the router calls: ships a
-  request's ``swapped_kv`` src->dest; a transfer that exhausts its retry
-  budget or fails end-to-end verification DROPS the payload so the
+  request's ``swapped_kv`` src->dest and replaces it with a **ticket
+  stub** (``{"courier_ticket": ..., "at": <where the bytes now live>}``)
+  that the destination resolves locally; payloads already parked on a
+  remote worker are moved worker-to-worker with a ``/worker/ship``
+  command (the router moves control messages, never KV bytes). A
+  transfer that exhausts its retry budget DROPS the payload so the
   destination re-prefills from tokens — degraded, never wrong, never a
   stuck ticket.
 
 Failure semantics, in one line: corruption is detected (CRC per chunk +
 whole-blob), loss is retried (missing chunks only), duplication is
-idempotent, stalls are bounded (per-chunk deadline), and total failure
-degrades to the existing re-prefill fallback.
+idempotent, stalls are bounded (per-chunk deadline), abandoned state is
+garbage-collected (ticket TTL), and total failure degrades to the
+existing re-prefill fallback.
 """
 
 from __future__ import annotations
@@ -248,57 +259,134 @@ class CourierReceiver:
     """Destination half shared by every transport: per-ticket reassembly
     behind a lock (chunks may arrive from any thread / HTTP worker).
     The same object backs the in-proc delivery path AND the
-    ``/fleet/courier/chunk`` endpoint, so both are the same tested code."""
+    ``/fleet/courier/chunk`` endpoint, so both are the same tested code.
 
-    def __init__(self, max_tickets: int = 64):
+    A transfer that completes is immediately verified end-to-end,
+    decoded, and moved to the **ready store**: the destination replica
+    attaches it locally by ticket at submit time (:meth:`take_payload`)
+    — the remote restorer. ``put_payload`` parks a locally-extracted
+    payload in the same store (a worker stashing a drain victim's pages
+    until the control plane decides where they go). Both stores are
+    TTL-bounded: a ticket nobody finishes or claims within ``ttl_ms`` is
+    evicted (counted in ``expired``, logged) instead of leaking host
+    memory forever."""
+
+    def __init__(self, max_tickets: int = 64, ttl_ms: float = 0.0):
         self._lock = threading.Lock()
         self._tickets: "dict[str, ChunkReassembler]" = {}
+        self._born: dict[str, float] = {}           # reassembly birth
         self._order: deque = deque()
+        self._ready: "dict[str, tuple[float, dict]]" = {}
         self._max = max_tickets
+        self.ttl_s = float(ttl_ms) / 1e3
+        self.expired = 0          # tickets evicted by TTL or cap pressure
+        self.attached = 0         # payloads handed to a local restore
+
+    def _gc_locked(self, now: float) -> None:
+        if self.ttl_s <= 0:
+            return
+        stale = [t for t, born in self._born.items()
+                 if now - born > self.ttl_s]
+        for t in stale:
+            self._tickets.pop(t, None)
+            self._born.pop(t, None)
+            if t in self._order:
+                self._order.remove(t)
+            self.expired += 1
+            logger.warning("courier ticket %s expired mid-reassembly "
+                           "(ttl %.3gs)", t, self.ttl_s)
+        stale = [t for t, (born, _p) in self._ready.items()
+                 if now - born > self.ttl_s]
+        for t in stale:
+            self._ready.pop(t, None)
+            self.expired += 1
+            logger.warning("courier ticket %s expired unclaimed "
+                           "(ttl %.3gs)", t, self.ttl_s)
 
     def add_chunk(self, chunk: CourierChunk) -> dict:
         """Idempotent chunk ingestion. Returns the ack the sender's retry
         loop consumes: {ok, duplicate, complete, missing}. Corrupt chunks
-        return ok=False (the sender counts + retransmits)."""
+        return ok=False (the sender counts + retransmits). On the chunk
+        that completes the transfer, the blob is CRC-verified end-to-end
+        and decoded into the ready store; a verification failure is fatal
+        (every per-chunk CRC passed, so resending cannot fix it) and acks
+        ``{"ok": False, "fatal": True}`` so the sender aborts."""
+        now = time.monotonic()
         with self._lock:
+            self._gc_locked(now)
+            if chunk.ticket in self._ready:
+                # full retransmit of an already-attached transfer
+                return {"ok": True, "duplicate": True, "complete": True,
+                        "missing": []}
             r = self._tickets.get(chunk.ticket)
             if r is None:
                 r = ChunkReassembler(chunk.total)
                 self._tickets[chunk.ticket] = r
+                self._born[chunk.ticket] = now
                 self._order.append(chunk.ticket)
                 while len(self._order) > self._max:
-                    self._tickets.pop(self._order.popleft(), None)
+                    dropped = self._order.popleft()
+                    self._tickets.pop(dropped, None)
+                    self._born.pop(dropped, None)
+                    self.expired += 1
             try:
                 fresh = r.add(chunk)
             except ChunkCorrupt as e:
                 return {"ok": False, "error": str(e),
                         "missing": r.missing(), "complete": False}
-            return {"ok": True, "duplicate": not fresh,
-                    "complete": r.complete(), "missing": r.missing()}
+            if not r.complete():
+                return {"ok": True, "duplicate": not fresh,
+                        "complete": False, "missing": r.missing()}
+            # completion: verify + decode + attach, then drop reassembly
+            self._tickets.pop(chunk.ticket, None)
+            self._born.pop(chunk.ticket, None)
+            if chunk.ticket in self._order:
+                self._order.remove(chunk.ticket)
+            try:
+                payload = r.payload()       # end-to-end CRC inside
+            except TransportError as e:
+                return {"ok": False, "fatal": True, "error": str(e),
+                        "complete": False, "missing": []}
+            self._ready[chunk.ticket] = (now, payload)
+            self._cap_ready_locked()
+            return {"ok": True, "duplicate": not fresh, "complete": True,
+                    "missing": []}
 
-    def claim(self, ticket: str) -> dict:
-        """Hand the completed payload over (and drop the ticket state).
-        Raises TransferAborted when the ticket is unknown or incomplete,
-        or when end-to-end verification fails."""
-        with self._lock:
-            r = self._tickets.pop(ticket, None)
-            if ticket in self._order:
-                self._order.remove(ticket)
-        if r is None:
-            raise TransferAborted(f"unknown courier ticket {ticket!r}")
-        return r.payload()
+    def _cap_ready_locked(self) -> None:
+        while len(self._ready) > self._max:
+            oldest = min(self._ready, key=lambda t: self._ready[t][0])
+            self._ready.pop(oldest)
+            self.expired += 1
+            logger.warning("courier ticket %s evicted (ready store over "
+                           "%d tickets)", oldest, self._max)
 
-    def claim_encoded(self, ticket: str) -> tuple[dict, bytes]:
-        """(manifest, blob) form of claim — the HTTP endpoint returns this
-        so the remote sender (or a future remote restorer) decodes."""
+    def put_payload(self, ticket: str, payload: dict) -> None:
+        """Park an already-materialized payload in the ready store (a
+        worker stashing extracted pages until the router places them).
+        Subject to the same TTL as pushed transfers."""
+        now = time.monotonic()
         with self._lock:
-            r = self._tickets.pop(ticket, None)
-            if ticket in self._order:
-                self._order.remove(ticket)
-        if r is None or not r.complete():
-            raise TransferAborted(f"courier ticket {ticket!r} incomplete")
-        blob = b"".join(r._data[i] for i in range(r.total))
-        return r.manifest, blob
+            self._gc_locked(now)
+            self._ready[ticket] = (now, payload)
+            self._cap_ready_locked()
+
+    def take_payload(self, ticket: str) -> Optional[dict]:
+        """Attach a completed transfer to a local restore: pop and return
+        the decoded payload, or None when the ticket is unknown, still
+        incomplete, or expired — the caller falls back to re-prefill."""
+        with self._lock:
+            self._gc_locked(time.monotonic())
+            entry = self._ready.pop(ticket, None)
+            if entry is not None:
+                self.attached += 1
+                return entry[1]
+        return None
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"expired": self.expired, "attached": self.attached,
+                    "reassembling": len(self._tickets),
+                    "ready": len(self._ready)}
 
 
 # -- transport stats ---------------------------------------------------------
@@ -353,7 +441,10 @@ class TransportStats:
 class CourierTransport:
     """Sender-side framing + retry/deadline/backoff loop. Subclasses
     implement ``_send_chunk`` (one delivery attempt -> ack dict or None
-    for loss/timeout) and ``_claim`` (fetch the completed payload)."""
+    for loss/timeout). The transfer is destination-terminated: on
+    success the payload sits ATTACHED BY TICKET in the destination
+    host's receiver ready store — the sender never sees the bytes
+    again."""
 
     def __init__(self, cfg=None, injector=None,
                  stats: Optional[TransportStats] = None):
@@ -376,18 +467,16 @@ class CourierTransport:
                     dest: Optional[int]) -> Optional[dict]:
         raise NotImplementedError
 
-    def _claim(self, ticket: str, dest: Optional[int]) -> dict:
-        raise NotImplementedError
-
     # the transfer loop -----------------------------------------------------
 
     def transfer(self, payload: dict, src: Optional[int] = None,
                  dest: Optional[int] = None,
-                 ticket: Optional[str] = None) -> dict:
-        """Move one payload src->dest. Returns the reassembled payload
-        (byte-for-byte equal to the input); raises TransferAborted after
-        ``max_retries`` resend rounds or failed end-to-end verification.
-        Safe from any thread; each ticket's state is independent."""
+                 ticket: Optional[str] = None) -> str:
+        """Push one payload to the destination's receiver. Returns the
+        ticket under which the (verified, decoded) payload is now
+        attached there; raises TransferAborted after ``max_retries``
+        resend rounds or a fatal end-to-end verification failure. Safe
+        from any thread; each ticket's state is independent."""
         from .faults import DestUnreachable
         ticket = ticket or f"courier-{uuid.uuid4().hex[:16]}"
         t0 = time.perf_counter()
@@ -409,7 +498,16 @@ class CourierTransport:
                         if ack is None:      # lost or past its deadline
                             failed.append(seq)
                             continue
-                        if not ack.get("ok"):   # receiver CRC rejection
+                        if not ack.get("ok"):
+                            if ack.get("fatal"):
+                                # completion-time e2e verification
+                                # failed: every per-chunk CRC passed, so
+                                # a resend cannot fix it
+                                self.stats.bump(aborts=1)
+                                raise TransferAborted(
+                                    f"courier {ticket}: "
+                                    f"{ack.get('error', 'fatal')}")
+                            # receiver CRC rejection: retransmit
                             self.stats.bump(corruptions=1)
                             failed.append(seq)
                             continue
@@ -435,10 +533,9 @@ class CourierTransport:
                 time.sleep(backoff_s)
                 backoff_s = min(backoff_s * 2, self.backoff_max_ms / 1e3)
                 pending = failed
-            out = self._claim(ticket, dest)   # end-to-end CRC inside
             self.stats.note_transfer((time.perf_counter() - t0) * 1e3,
                                      len(blob))
-            return out
+            return ticket
         except TransportError:
             raise
         except Exception as e:               # wire-level surprises
@@ -454,9 +551,11 @@ class InProcTransport(CourierTransport):
     today's behavior is preserved byte-for-byte while the injector can
     exercise the entire failure matrix deterministically on CPU."""
 
-    def __init__(self, cfg=None, injector=None, stats=None):
+    def __init__(self, cfg=None, injector=None, stats=None, receiver=None):
         super().__init__(cfg, injector=injector, stats=stats)
-        self.receiver = CourierReceiver()
+        self.receiver = receiver if receiver is not None else \
+            CourierReceiver(ttl_ms=float(getattr(
+                cfg, "courier_ticket_ttl_ms", 0.0)))
 
     def _send_chunk(self, chunk, src, dest):
         fault = (self.injector.on_chunk(src, dest, chunk.ticket, chunk.seq)
@@ -486,38 +585,55 @@ class InProcTransport(CourierTransport):
                 self.receiver.add_chunk(chunk)    # the duplicate copy
         return self.receiver.add_chunk(chunk)
 
-    def _claim(self, ticket, dest):
-        return self.receiver.claim(ticket)
-
 
 class HTTPCourierTransport(CourierTransport):
-    """POSTs each chunk to a fleet front's ``/fleet/courier/chunk`` and
-    claims the completed payload from ``/fleet/courier/claim`` — the
-    cross-host path. ``endpoint`` is the destination base URL (per-dest
-    URL maps become config once replicas live on separate hosts; the
-    framing, retry, resume, and verification logic is identical either
-    way). Uses stdlib urllib so the sender side has no extra deps."""
+    """POSTs each chunk to the *destination's* ``/fleet/courier/chunk``
+    endpoint — the cross-host push path. The destination is resolved per
+    transfer from ``endpoints`` (the per-replica ``fleet_endpoints``
+    map), falling back to ``endpoint``/``cfg.courier_endpoint`` for
+    single-destination setups. Reassembly, verification, and attachment
+    all happen ON the destination host; the sender only sees acks.
+    Uses stdlib urllib so the sender side has no extra deps. The
+    injector's seeded chunk faults (drop/corrupt/delay/duplicate) apply
+    here exactly as in-proc, so chaos runs over real sockets too."""
 
     def __init__(self, cfg=None, injector=None, stats=None,
-                 endpoint: str = ""):
+                 endpoint: str = "", endpoints: Optional[dict] = None):
         super().__init__(cfg, injector=injector, stats=stats)
         self.endpoint = (endpoint
                          or getattr(cfg, "courier_endpoint", "")
                          or "").rstrip("/")
-        if not self.endpoint:
+        eps = endpoints
+        if eps is None:
+            eps = getattr(cfg, "fleet_endpoints", None) or {}
+            if callable(getattr(cfg, "endpoint_map", None)):
+                eps = cfg.endpoint_map()
+        self.endpoints = {int(k): str(v).rstrip("/")
+                          for k, v in dict(eps).items()}
+        if not self.endpoint and not self.endpoints:
             raise ValueError(
-                "HTTPCourierTransport needs courier_endpoint (the "
-                "destination fleet front's base URL)")
+                "HTTPCourierTransport needs a destination: either "
+                "courier_endpoint or a fleet_endpoints map")
 
-    def _post(self, path: str, body: dict) -> Optional[dict]:
+    def _endpoint_for(self, dest) -> str:
+        ep = self.endpoints.get(dest) if dest is not None else None
+        ep = ep or self.endpoint
+        if not ep:
+            raise TransferAborted(
+                f"no courier endpoint configured for replica {dest}")
+        return ep
+
+    def _post(self, endpoint: str, path: str, body: dict,
+              timeout_s: Optional[float] = None) -> Optional[dict]:
         import urllib.error
         import urllib.request
         req = urllib.request.Request(
-            f"{self.endpoint}{path}", data=json.dumps(body).encode(),
+            f"{endpoint}{path}", data=json.dumps(body).encode(),
             headers={"Content-Type": "application/json"}, method="POST")
         try:
             with urllib.request.urlopen(
-                    req, timeout=max(self.deadline_ms / 1e3, 0.05)) as resp:
+                    req, timeout=timeout_s
+                    or max(self.deadline_ms / 1e3, 0.05)) as resp:
                 return json.loads(resp.read().decode())
         except urllib.error.HTTPError as e:
             try:
@@ -529,26 +645,62 @@ class HTTPCourierTransport(CourierTransport):
             return None
 
     def _send_chunk(self, chunk, src, dest):
-        return self._post("/fleet/courier/chunk", chunk.to_wire())
-
-    def _claim(self, ticket, dest):
-        out = self._post("/fleet/courier/claim", {"ticket": ticket})
-        if not out or not out.get("ok"):
-            err = (out or {}).get("error", "no response")
-            raise TransferAborted(f"courier claim failed: {err}")
-        return decode_payload(out["manifest"],
-                              base64.b64decode(out["blob"]))
+        endpoint = self._endpoint_for(dest)
+        wire = chunk.to_wire()
+        fault = (self.injector.on_chunk(src, dest, chunk.ticket, chunk.seq)
+                 if self.injector is not None else None)
+        if fault:
+            if fault.get("drop"):
+                return None                  # never sent
+            if fault.get("corrupt"):
+                bad = bytes([chunk.data[0] ^ 0xFF]) + chunk.data[1:] \
+                    if chunk.data else b"\xff"
+                wire = dict(wire, data=base64.b64encode(bad).decode())
+            delay_ms = fault.get("delay_ms", 0.0)
+            if delay_ms > 0:
+                time.sleep(min(delay_ms, self.deadline_ms) / 1e3)
+                ack = self._post(endpoint, "/fleet/courier/chunk", wire)
+                if delay_ms >= self.deadline_ms:
+                    return None              # landed, but the sender
+                return ack                   # already called it lost
+            if fault.get("duplicate"):
+                self._post(endpoint, "/fleet/courier/chunk", wire)
+        return self._post(endpoint, "/fleet/courier/chunk", wire)
 
 
 def build_transport(cfg, injector=None,
-                    stats: Optional[TransportStats] = None):
+                    stats: Optional[TransportStats] = None,
+                    receiver=None):
     """FleetConfig.courier_transport -> transport instance."""
     kind = getattr(cfg, "courier_transport", "inproc") or "inproc"
     if kind == "inproc":
-        return InProcTransport(cfg, injector=injector, stats=stats)
+        return InProcTransport(cfg, injector=injector, stats=stats,
+                               receiver=receiver)
     if kind == "http":
         return HTTPCourierTransport(cfg, injector=injector, stats=stats)
     raise ValueError(f"unknown courier transport {kind!r} (inproc|http)")
+
+
+# -- ticket stubs ------------------------------------------------------------
+#
+# After a ship, the request no longer carries KV bytes: it carries a
+# ticket STUB naming where the payload is attached — "local" (this
+# process's receiver ready store) or a remote replica id (that worker's
+# receiver). The destination resolves the stub at submit time
+# (EngineReplica.submit -> receiver.take_payload; RemoteReplica.submit
+# sends the ticket over the wire and the worker attaches it). The
+# "partial" flag is mirrored onto the stub so routing (_needs_prefill)
+# keeps working without materializing the payload.
+
+TICKET_KEY = "courier_ticket"
+
+
+def ticket_stub(ticket: str, at, partial=False) -> dict:
+    return {TICKET_KEY: ticket, "at": at, "partial": bool(partial)}
+
+
+def is_ticket_stub(payload) -> bool:
+    return isinstance(payload, dict) and TICKET_KEY in payload
 
 
 # -- fleet-facing facade -----------------------------------------------------
@@ -556,50 +708,178 @@ def build_transport(cfg, injector=None,
 
 class KVCourier:
     """What the router actually calls: move ``req.swapped_kv`` src->dest
-    through the transport before the request is submitted to the
-    destination. On abort the payload is DROPPED (degrade to the
-    re-prefill fallback — correct tokens, extra compute) rather than ever
-    handing over unverified bytes. Tracks a per-source breakdown for
-    `llmctl fleet status` columns."""
+    before the request is submitted to the destination, leaving a ticket
+    stub behind. Three physical paths, one contract:
 
-    def __init__(self, transport: CourierTransport):
-        self.transport = transport
+    - bytes local, dest in-proc: push through :class:`InProcTransport`
+      into the host-local receiver (full frame->verify path, injectable
+      chaos), stub ``at="local"``;
+    - bytes local, dest remote: push chunks to the destination worker's
+      ``/fleet/courier/chunk`` (HTTP), stub ``at=dest``;
+    - bytes parked on a remote worker (stub already points there): issue
+      a ``/worker/ship`` command so the WORKER pushes directly to the
+      destination's endpoint — the control plane never relays KV bytes.
+
+    On abort the payload is DROPPED (degrade to the re-prefill fallback —
+    correct tokens, extra compute) rather than ever handing over
+    unverified bytes. Tracks a per-source breakdown for `llmctl fleet
+    status` columns."""
+
+    def __init__(self, cfg=None, injector=None, receiver=None):
+        self.cfg = cfg
+        self.injector = injector
+        self.stats = TransportStats()
+        ttl = float(getattr(cfg, "courier_ticket_ttl_ms", 0.0) or 0.0)
+        self.receiver = receiver if receiver is not None else \
+            CourierReceiver(ttl_ms=ttl)
+        eps = getattr(cfg, "fleet_endpoints", None) or {}
+        if callable(getattr(cfg, "endpoint_map", None)):
+            eps = cfg.endpoint_map()
+        self.endpoints = {int(k): str(v).rstrip("/")
+                          for k, v in dict(eps).items()}
+        remote = getattr(cfg, "remote_replica_ids", None)
+        self.remote_ids: set = remote() if callable(remote) else \
+            set(remote or ())
+        self.force_http = (getattr(cfg, "courier_transport", "inproc")
+                           == "http")
+        self.ship_timeout_s = float(getattr(cfg, "courier_ship_timeout_s",
+                                            30.0))
+        self.local_transport = InProcTransport(
+            cfg, injector=injector, stats=self.stats,
+            receiver=self.receiver)
+        self._http: Optional[HTTPCourierTransport] = None
         self._lock = threading.Lock()
         self.per_src: dict[int, dict] = {}
 
+    # kept for callers/tests that address the old attribute
     @property
-    def stats(self) -> TransportStats:
-        return self.transport.stats
+    def transport(self) -> CourierTransport:
+        return self.local_transport
+
+    def _http_transport(self) -> HTTPCourierTransport:
+        if self._http is None:
+            self._http = HTTPCourierTransport(
+                self.cfg, injector=self.injector, stats=self.stats,
+                endpoints=self.endpoints)
+        return self._http
+
+    def _slot(self, src) -> dict:
+        with self._lock:
+            return self.per_src.setdefault(
+                src, {"transfers": 0, "aborts": 0})
+
+    def _abort(self, req, src, why) -> bool:
+        logger.warning(
+            "courier ship -> aborted for %s (%s); payload dropped, "
+            "falling back to re-prefill",
+            getattr(req, "request_id", "?"), why)
+        req.swapped_kv = None
+        slot = self._slot(src)
+        with self._lock:
+            slot["aborts"] += 1
+        return False
 
     def ship(self, req, src: Optional[int], dest: Optional[int]) -> bool:
         """Returns True when the request is ready to submit to ``dest``
-        (payload delivered, or there was nothing to ship). False = the
-        transfer aborted and the payload is gone; the caller must re-plan
-        placement (the request now needs prefill)."""
+        (payload attached at the destination, or there was nothing to
+        ship). False = the transfer aborted and the payload is gone; the
+        caller must re-plan placement (the request now needs prefill)."""
         payload = getattr(req, "swapped_kv", None)
-        if payload is None or src is None or src == dest:
+        if payload is None or dest is None:
             return True
-        with self._lock:
-            slot = self.per_src.setdefault(
-                src, {"transfers": 0, "aborts": 0})
+        if is_ticket_stub(payload):
+            at = payload.get("at", "local")
+            if at == dest or (at == "local"
+                              and dest not in self.remote_ids
+                              and not self.force_http):
+                return True        # already attached where it's needed
+            ticket = payload[TICKET_KEY]
+            if at != "local":      # bytes parked on a remote worker
+                return self._ship_remote_held(req, payload, at, dest)
+            real = self.receiver.take_payload(ticket)
+            if real is None:
+                return self._abort(req, src,
+                                   f"ticket {ticket} missing/expired")
+            payload = real          # re-ship the materialized bytes
+        elif src is not None and src == dest \
+                and dest not in self.remote_ids:
+            # intra-replica restore (preemption=swap): the engine reads
+            # the bytes straight off the request, no movement needed
+            return True
+        ticket = f"courier-{uuid.uuid4().hex[:16]}"
+        remote_dest = dest in self.remote_ids
         try:
-            req.swapped_kv = self.transport.transfer(
-                payload, src=src, dest=dest)
-            with self._lock:
-                slot["transfers"] += 1
-            return True
+            if remote_dest or (self.force_http
+                               and (dest in self.endpoints
+                                    or getattr(self.cfg,
+                                               "courier_endpoint", ""))):
+                self._http_transport().transfer(
+                    payload, src=src, dest=dest, ticket=ticket)
+                at = dest if remote_dest else "local"
+            else:
+                self.local_transport.transfer(
+                    payload, src=src, dest=dest, ticket=ticket)
+                at = "local"
         except TransportError as e:
             logger.warning(
                 "courier transfer %s -> %s aborted for %s (%s); payload "
                 "dropped, falling back to re-prefill", src, dest,
                 getattr(req, "request_id", "?"), e)
             req.swapped_kv = None
+            slot = self._slot(src)
             with self._lock:
                 slot["aborts"] += 1
             return False
+        req.swapped_kv = ticket_stub(
+            ticket, at, partial=bool(payload.get("partial"))
+            if isinstance(payload, dict) else False)
+        slot = self._slot(src)
+        with self._lock:
+            slot["transfers"] += 1
+        return True
+
+    def _ship_remote_held(self, req, stub: dict, at: int,
+                          dest: int) -> bool:
+        """The payload sits in worker ``at``'s receiver; command that
+        worker to push it straight to ``dest``'s courier endpoint
+        (worker-to-worker, no relay through this process)."""
+        src_ep = self.endpoints.get(at)
+        if src_ep is None:
+            return self._abort(req, at, f"no endpoint for holder {at}")
+        dest_ep = self.endpoints.get(dest)
+        if dest_ep is None:
+            return self._abort(
+                req, at,
+                f"no endpoint for destination {dest} (in-proc replicas "
+                f"receiving remote payloads need a fleet_endpoints entry "
+                f"pointing at this front)")
+        ticket = stub[TICKET_KEY]
+        body = {"ticket": ticket, "dest": dest, "dest_endpoint": dest_ep}
+        try:
+            if self.injector is not None:
+                self.injector.on_rpc(at)
+            import urllib.request
+            wire = urllib.request.Request(
+                f"{src_ep}/worker/ship",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(
+                    wire, timeout=self.ship_timeout_s) as resp:
+                out = json.loads(resp.read().decode())
+        except Exception as e:
+            return self._abort(req, at, f"ship command failed: {e}")
+        if not out.get("ok"):
+            return self._abort(req, at, out.get("error", "ship refused"))
+        stub["at"] = dest if dest in self.remote_ids else "local"
+        slot = self._slot(at)
+        with self._lock:
+            slot["transfers"] += 1
+        return True
 
     def snapshot(self) -> dict:
         out = self.stats.snapshot()
+        out.update(self.receiver.stats())
         with self._lock:
             # string keys: this dict crosses the JSON /fleet/status
             # surface, where int keys would silently become strings

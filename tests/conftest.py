@@ -134,12 +134,21 @@ SLOW_TESTS = {
     "test_max_tokens_respected",
     "test_poisson_drains_and_reports",
     "test_plan_verify_moment_dtype",
+    # spawns a real `llmctl fleet worker` OS process (jax import +
+    # engine compile in the child): full-suite merge gate; the fast
+    # tier's multi-process coverage is the serve.fleet2+remote dryrun
+    "test_spawned_worker_round_trip",
 }
 
 
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: takes >= ~12s on CPU; excluded by -m 'not slow'")
+    config.addinivalue_line(
+        "markers", "socket: binds real TCP sockets (always ephemeral "
+                   "port 0 — never a fixed port, so tier-1 cannot flake "
+                   "on collisions); deselect with -m 'not socket' in "
+                   "network-restricted sandboxes")
 
 
 def pytest_collection_modifyitems(config, items):

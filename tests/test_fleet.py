@@ -781,6 +781,7 @@ class TestFleetLoadgen:
             fleet.shutdown()
 
 
+@pytest.mark.socket
 class TestFleetHTTP:
     @pytest.fixture()
     def server(self, model_cfg, ref_engine):
@@ -895,8 +896,10 @@ class TestFleetHTTP:
                        json={"prompt": [1.5]},
                        timeout=10).status_code == 400
 
-        # courier surface: chunked payload in over POST, claim out —
-        # the cross-host half of the KV transport (this PR)
+        # courier surface: chunks pushed in over POST reassemble, verify
+        # end-to-end, and ATTACH by ticket in the fleet's receiver (the
+        # destination-terminated cross-host transport; the old sender-
+        # return /fleet/courier/claim loopback is gone)
         import numpy as np
         from distributed_llm_training_and_inference_system_tpu.serve.fleet.transport import (  # noqa: E501
             HTTPCourierTransport, encode_payload, make_chunks)
@@ -914,18 +917,19 @@ class TestFleetHTTP:
                           json=c.to_wire(), timeout=10).json()
             assert ack["ok"]
         assert ack["complete"] and ack["missing"] == []
-        # duplicate retransmit is idempotent
+        # duplicate retransmit is idempotent (even after completion)
         dup = rq.post(f"{base}/fleet/courier/chunk",
                       json=chunks[0].to_wire(), timeout=10).json()
         assert dup["ok"] and dup["duplicate"]
-        claim = rq.post(f"{base}/fleet/courier/claim",
-                        json={"ticket": "http-t1"}, timeout=10).json()
-        assert claim["ok"] and claim["manifest"]["crc32"] \
-            == manifest["crc32"]
-        # unknown ticket -> 404; corrupt chunk -> ok=false ack
+        # the payload attached destination-side, by ticket
+        got = srv.fleet.courier_receiver.take_payload("http-t1")
+        assert got is not None and got["positions"] == 13
+        assert np.array_equal(got["pages"]["k"], payload["pages"]["k"])
+        # the claim loopback endpoint no longer exists
         assert rq.post(f"{base}/fleet/courier/claim",
-                       json={"ticket": "nope"},
+                       json={"ticket": "http-t1"},
                        timeout=10).status_code == 404
+        # corrupt chunk -> ok=false ack; malformed frame -> 400
         wire = chunks[0].to_wire()
         wire["crc32"] = wire["crc32"] ^ 1
         bad = rq.post(f"{base}/fleet/courier/chunk", json=wire,
@@ -934,14 +938,24 @@ class TestFleetHTTP:
         assert rq.post(f"{base}/fleet/courier/chunk",
                        json={"ticket": "x"}, timeout=10).status_code == 400
 
-        # full HTTPCourierTransport loopback: transfer() drives the same
-        # endpoints end-to-end and returns the identical payload
+        # full HTTPCourierTransport push: transfer() drives the socket
+        # endpoint end-to-end and the identical payload attaches by
+        # ticket in the destination's receiver
         t = HTTPCourierTransport(endpoint=base)
-        out = t.transfer(payload, src=0, dest=1)
+        ticket = t.transfer(payload, src=0, dest=1)
+        out = srv.fleet.courier_receiver.take_payload(ticket)
         assert out["positions"] == 13 and out["last_token"] == 5
         assert np.array_equal(out["pages"]["k"], payload["pages"]["k"])
         assert np.array_equal(out["pages"]["v"], payload["pages"]["v"])
         assert t.stats.snapshot()["transfers"] == 1
+
+        # /fleet/status surfaces the endpoint map + per-replica
+        # endpoint/remote columns (satellite)
+        snap = rq.get(f"{base}/fleet/status", timeout=10).json()
+        assert snap["endpoints"] == {}
+        for rep in snap["replicas"]:
+            assert rep["endpoint"] == "local"
+            assert rep["remote"] is False
 
 
 class TestFleetMetrics:
@@ -975,6 +989,7 @@ class TestFleetMetrics:
                         "stalls_ms": [2.0, 4.0, 6.0], "stall_count": 3},
             "courier": {"chunks": 40, "retries": 6, "corruptions": 2,
                         "duplicates": 1, "resumes": 3, "aborts": 1,
+                        "expired": 2,
                         "transfers": 4, "bytes_moved": 4096,
                         "in_flight": 0,
                         "transfer_ms": [1.0, 2.0, 3.0, 4.0],
@@ -1023,6 +1038,7 @@ class TestFleetMetrics:
             ("llmctl_fleet_courier_corruptions_total", None)] == 2
         assert samples[("llmctl_fleet_courier_resumes_total", None)] == 3
         assert samples[("llmctl_fleet_courier_aborts_total", None)] == 1
+        assert samples[("llmctl_fleet_courier_expired_total", None)] == 2
         assert samples[
             ("llmctl_fleet_courier_transfer_ms_count", None)] == 4
         assert samples[("llmctl_fleet_courier_transfer_ms_sum", None)] \

@@ -1,0 +1,601 @@
+"""Cross-host fleet: endpoint-map config, RemoteReplica client, and the
+push-based worker-to-worker courier (fast tier).
+
+The control plane's remote surface is exercised against a stdlib-only
+fake worker over REAL ephemeral sockets (port 0 — the satellite rule:
+socket tests never bind fixed ports), so the client's timeout/backoff/
+teardown behavior is tested without paying for an engine. Engine-backed
+multi-process scenarios (spawned `llmctl fleet worker` processes, drain
+migration and disagg handoff over sockets, SIGKILL chaos) live in the
+`serve.fleet2+remote` dryrun regime and the slow-tier spawn test below.
+"""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from distributed_llm_training_and_inference_system_tpu.config.schema import (  # noqa: E501
+    ConfigError,
+    FleetConfig,
+    parse_fleet_endpoints,
+)
+from distributed_llm_training_and_inference_system_tpu.serve.fleet.faults import (  # noqa: E501
+    FaultInjector,
+    FaultPlan,
+)
+from distributed_llm_training_and_inference_system_tpu.serve.fleet.transport import (  # noqa: E501
+    CourierReceiver,
+    HTTPCourierTransport,
+    KVCourier,
+    TransportError,
+    is_ticket_stub,
+    ticket_stub,
+)
+from distributed_llm_training_and_inference_system_tpu.serve.scheduler import (  # noqa: E501
+    Request,
+    RequestState,
+    SamplingParams,
+)
+
+
+# -- endpoint-map config parsing (no sockets) --------------------------------
+
+
+class TestEndpointConfig:
+    def test_toml_table_round_trip(self):
+        """The operator's TOML spelling: a [fleet.fleet_endpoints] table
+        with string replica-id keys."""
+        try:
+            import tomllib
+        except ModuleNotFoundError:
+            import tomli as tomllib
+        doc = tomllib.loads(
+            '[fleet]\n'
+            'replicas = 3\n'
+            'remote_replicas = "1,2"\n'
+            '[fleet.fleet_endpoints]\n'
+            '1 = "http://hostB:9001"\n'
+            '2 = "http://hostC:9002/"\n')
+        cfg = FleetConfig.from_dict(doc["fleet"])
+        assert cfg.endpoint_map() == {1: "http://hostB:9001",
+                                      2: "http://hostC:9002"}
+        assert cfg.remote_replica_ids() == {1, 2}
+
+    def test_repeated_cli_flag_form(self):
+        """The repeated --fleet-endpoint replica=url spelling."""
+        eps = parse_fleet_endpoints(
+            ["0=http://a:1", "2=http://b:2/"])
+        assert eps == {0: "http://a:1", 2: "http://b:2"}
+        # one comma-separated string also works (env-var style)
+        assert parse_fleet_endpoints("0=http://a:1,1=http://b:2") == {
+            0: "http://a:1", 1: "http://b:2"}
+
+    def test_malformed_entries_fail_loud(self):
+        for bad in (["nourl"], ["x=http://a"], ["0=ftp://a"],
+                    ["0=http://a", "0=http://b"]):
+            with pytest.raises(ConfigError):
+                parse_fleet_endpoints(bad)
+
+    def test_endpoint_for_unknown_replica_rejected_at_build(self):
+        with pytest.raises(ConfigError, match="replicas 0..1"):
+            FleetConfig(replicas=2,
+                        fleet_endpoints={"5": "http://x:1"}).validate()
+
+    def test_remote_replica_without_endpoint_rejected_at_build(self):
+        """The mismatch must fail at fleet BUILD time, not at first
+        ship."""
+        with pytest.raises(ConfigError, match="no fleet endpoint"):
+            FleetConfig(replicas=2, remote_replicas="1").validate()
+        with pytest.raises(ConfigError, match="replicas 0..1"):
+            FleetConfig(replicas=2, remote_replicas="7",
+                        fleet_endpoints={}).validate()
+        # ServeFleet validates on construction — same error, no engines
+        # are ever built
+        from distributed_llm_training_and_inference_system_tpu.serve.fleet import (  # noqa: E501
+            ServeFleet)
+        with pytest.raises(ConfigError, match="no fleet endpoint"):
+            ServeFleet(None, None,
+                       FleetConfig(replicas=2, remote_replicas="0"))
+
+    def test_valid_remote_config_passes(self):
+        cfg = FleetConfig(replicas=2, remote_replicas="0,1",
+                          fleet_endpoints={"0": "http://a:1",
+                                           "1": "http://b:2"})
+        cfg.validate()
+
+
+# -- fake worker over real sockets -------------------------------------------
+
+
+class FakeWorkerServer:
+    """Stdlib-only stand-in for `llmctl fleet worker`: the /worker/*
+    control surface plus a REAL CourierReceiver and a real ship
+    implementation, against in-memory queues instead of an engine."""
+
+    def __init__(self):
+        self.receiver = CourierReceiver(ttl_ms=60_000.0)
+        self.submitted: list = []
+        self.outbox: list = []
+        self.state = "healthy"
+        self.role = "mixed"
+        self.accept = True
+        self.probe_extra: dict = {}
+        self.requests_seen = 0
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):      # quiet
+                pass
+
+            def _reply(self, body, status=200):
+                data = json.dumps(body).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/worker/probe":
+                    self._reply(fake.probe_dict())
+                else:
+                    self._reply({"error": "nope"}, 404)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                if self.path == "/fleet/courier/chunk":
+                    from distributed_llm_training_and_inference_system_tpu.serve.fleet.transport import (  # noqa: E501
+                        CourierChunk)
+                    self._reply(fake.receiver.add_chunk(
+                        CourierChunk.from_wire(body)))
+                elif self.path == "/worker/submit":
+                    fake.requests_seen += 1
+                    if not fake.accept:
+                        self._reply({"ok": False})
+                        return
+                    fake.submitted.append(body)
+                    self._reply({"ok": True})
+                elif self.path == "/worker/outbox/take":
+                    entries, fake.outbox = fake.outbox, []
+                    self._reply({"entries": entries,
+                                 "probe": fake.probe_dict()})
+                elif self.path == "/worker/ship":
+                    payload = fake.receiver.take_payload(body["ticket"])
+                    if payload is None:
+                        self._reply({"ok": False,
+                                     "error": "unknown ticket"})
+                        return
+                    t = HTTPCourierTransport(
+                        SimpleNamespace(courier_chunk_bytes=1024,
+                                        courier_max_retries=4,
+                                        courier_chunk_deadline_ms=200.0),
+                        endpoint=body["dest_endpoint"])
+                    try:
+                        t.transfer(payload, dest=body.get("dest"),
+                                   ticket=body["ticket"])
+                        self._reply({"ok": True})
+                    except TransportError as e:
+                        self._reply({"ok": False, "error": str(e)})
+                elif self.path == "/worker/drain":
+                    fake.state = "drained"
+                    self._reply({"ok": True})
+                elif self.path == "/worker/undrain":
+                    fake.state = "healthy"
+                    self._reply({"ok": True})
+                elif self.path == "/worker/role":
+                    fake.role = body["role"]
+                    self._reply({"ok": True})
+                elif self.path == "/worker/cancel":
+                    self._reply({"ok": False})
+                elif self.path == "/worker/migrate":
+                    self._reply({"ok": True})
+                else:
+                    self._reply({"error": "nope"}, 404)
+
+        # port 0: the OS picks a free ephemeral port (satellite rule —
+        # fixed ports would flake under parallel CI)
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        self.endpoint = f"http://127.0.0.1:{self.port}"
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def probe_dict(self):
+        return {"state": self.state, "role": self.role,
+                "queue_depth": len(self.submitted), "active": 0,
+                "outstanding_tokens": 17 * len(self.submitted),
+                **self.probe_extra}
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.fixture()
+def fake_worker():
+    w = FakeWorkerServer()
+    yield w
+    w.close()
+
+
+def remote_cfg(**kw):
+    base = dict(remote_timeout_s=2.0, remote_reconnect_backoff_s=0.001,
+                courier_chunk_bytes=1024, courier_max_retries=4,
+                courier_chunk_deadline_ms=200.0,
+                courier_ship_timeout_s=10.0,
+                courier_ticket_ttl_ms=60_000.0)
+    base.update(kw)
+    return SimpleNamespace(**base)
+
+
+def make_remote(fake, rid=1, injector=None, on_finish=None, role="mixed"):
+    from distributed_llm_training_and_inference_system_tpu.serve.fleet.remote import (  # noqa: E501
+        RemoteReplica)
+    return RemoteReplica(rid, fake.endpoint, fleet_cfg=remote_cfg(),
+                         injector=injector, on_finish=on_finish,
+                         role=role)
+
+
+@pytest.mark.socket
+class TestRemoteReplica:
+    def req(self, rid="r1", prompt=(1, 2, 3)):
+        return Request(request_id=rid, prompt_tokens=list(prompt),
+                       sampling=SamplingParams(temperature=0.0,
+                                               max_tokens=8))
+
+    def test_submit_and_finished_round_trip(self, fake_worker):
+        done = []
+        rr = make_remote(fake_worker,
+                         on_finish=lambda rid, r: done.append((rid, r)))
+        req = self.req()
+        assert rr.submit(req)
+        wire = fake_worker.submitted[0]
+        assert wire["request_id"] == "r1"
+        assert wire["prompt_tokens"] == [1, 2, 3]
+        assert wire["sampling"]["temperature"] == 0.0
+        # the worker finishes it; the outbox carries the result back
+        fake_worker.outbox.append({
+            "kind": "finished", "request_id": "r1",
+            "generated_tokens": [9, 8, 7], "finish_reason": "stop",
+            "state": "completed", "ttft_ms": 12.0})
+        assert rr.poll_outbox() == 1
+        assert done and done[0][0] == rr.replica_id
+        assert req.generated_tokens == [9, 8, 7]
+        assert req.state is RequestState.FINISHED
+        assert req.finish_reason == "stop"
+        assert req.ttft_ms == pytest.approx(12.0, abs=1.0)
+
+    def test_orphan_comes_back_with_ticket_stub(self, fake_worker):
+        rr = make_remote(fake_worker)
+        req = self.req()
+        assert rr.submit(req)
+        fake_worker.outbox.append({
+            "kind": "orphan", "ticket": "tk-1", "partial": False,
+            "request": {"request_id": "r1", "prompt_tokens": [1, 2, 3],
+                        "generated_tokens": [5], "assigned_seed": 42,
+                        "sampling": {"temperature": 0.0,
+                                     "max_tokens": 8}}})
+        rr.poll_outbox()
+        orphans = rr.take_orphans()
+        assert len(orphans) == 1 and orphans[0] is req
+        # worker-side progress folded back onto the PARENT's object:
+        # generated tokens + the assigned seed travel (token identity
+        # across the requeue), and the payload rides as a stub naming
+        # the worker that holds the bytes
+        assert req.generated_tokens == [5]
+        assert req.assigned_seed == 42
+        assert is_ticket_stub(req.swapped_kv)
+        assert req.swapped_kv["at"] == rr.replica_id
+
+    def test_handoff_entry_lands_in_take_migrated(self, fake_worker):
+        rr = make_remote(fake_worker, role="prefill")
+        req = self.req()
+        assert rr.submit(req)
+        fake_worker.outbox.append({
+            "kind": "handoff", "ticket": "tk-2", "partial": False,
+            "dest": None,
+            "request": {"request_id": "r1", "prompt_tokens": [1, 2, 3],
+                        "generated_tokens": [],
+                        "sampling": {"temperature": 0.0,
+                                     "max_tokens": 8}}})
+        rr.poll_outbox()
+        migrated = rr.take_migrated()
+        assert len(migrated) == 1
+        got, ticket = migrated[0]
+        assert got is req and ticket.reason == "handoff"
+
+    def test_probe_updates_cache_and_drain_state(self, fake_worker):
+        rr = make_remote(fake_worker)
+        rr.submit(self.req())
+        rr.probe()
+        assert rr.queue_depth() == 1
+        assert rr.outstanding_tokens() == 17
+        rr.request_drain()
+        assert fake_worker.state == "drained"
+        rr.probe()
+        assert rr.state == "drained"
+        rr.undrain()
+        assert rr.state == "healthy" and fake_worker.state == "healthy"
+
+    def test_role_sync_on_start(self, fake_worker):
+        rr = make_remote(fake_worker, role="decode")
+        rr.start()
+        try:
+            assert fake_worker.role == "decode"
+        finally:
+            rr.stop()
+
+    def test_blackhole_probe_raises_and_partition_heals(self, fake_worker):
+        """A black-holed endpoint fails probes (RemoteUnavailable); a
+        finite black-hole heals and the next probe succeeds."""
+        from distributed_llm_training_and_inference_system_tpu.serve.fleet.remote import (  # noqa: E501
+            RemoteUnavailable)
+        inj = FaultInjector(FaultPlan(rpc_blackhole_replica=1,
+                                      rpc_blackhole_count=2))
+        rr = make_remote(fake_worker, injector=inj)
+        for _ in range(2):
+            with pytest.raises(RemoteUnavailable):
+                rr.probe()
+            time.sleep(0.01)        # let the reconnect gate expire
+        rr.probe()                  # partition healed
+        assert rr.state == "healthy"
+
+    def test_supervisor_tears_down_dead_worker_like_a_crash(
+            self, fake_worker):
+        """Probe misses against a black-holed worker tear it down
+        exactly like an engine-thread crash: its in-flight requests are
+        reset (payload stubs stripped — the bytes died with the worker)
+        and requeued onto survivors."""
+        from distributed_llm_training_and_inference_system_tpu.serve.fleet.router import (  # noqa: E501
+            FleetRouter)
+        from distributed_llm_training_and_inference_system_tpu.serve.fleet.supervisor import (  # noqa: E501
+            ReplicaSupervisor)
+
+        class LocalFake:
+            replica_id = 0
+            role = "mixed"
+            state = "healthy"
+            restarts = 0
+            last_error = None
+            migrations_out = 0
+            migrated_tokens = 0
+            reprefill_avoided_tokens = 0
+            migrations_by_reason: dict = {}
+            migration_pauses_ms: list = []
+
+            def __init__(self):
+                self.queue = []
+
+            def accepting(self):
+                return True
+
+            def submit(self, req):
+                self.queue.append(req)
+                return True
+
+            def queue_depth(self):
+                return len(self.queue)
+
+            def active_count(self):
+                return 0
+
+            def outstanding_tokens(self):
+                return 0
+
+            def take_orphans(self):
+                return []
+
+            def take_migrated(self):
+                return []
+
+            def migrations_in_flight(self):
+                return 0
+
+            def prefix_cache_stats(self):
+                return 0, 0, 0
+
+            def probe(self):
+                return {}
+
+        inj = FaultInjector(FaultPlan(rpc_blackhole_replica=1,
+                                      rpc_blackhole_count=-1))
+        rr = make_remote(fake_worker, injector=inj)
+        local = LocalFake()
+        cfg = FleetConfig(replicas=2, probe_failures=2,
+                          restart_backoff_s=60.0,
+                          affinity_prefix_tokens=0)
+        router = FleetRouter([local, rr], cfg)
+        sup = ReplicaSupervisor([local, rr], router, cfg)
+        req = self.req()
+        # the request is known in flight on the remote replica
+        router._meta[req.request_id] = {"requeues": 0, "replica": 1}
+        rr._inflight[req.request_id] = req
+        req.swapped_kv = ticket_stub("tk-dead", 1)
+        for _ in range(2):
+            sup.poll_once()
+            time.sleep(0.01)
+        assert rr.state == "crashed"
+        # requeued onto the survivor, payload stub stripped -> re-prefill
+        assert local.queue and local.queue[0] is req
+        assert req.swapped_kv is None
+        snap = sup.snapshot()
+        rep = {x["replica"]: x for x in snap["replicas"]}
+        assert rep[1]["remote"] is True
+        assert rep[1]["endpoint"] == "local"   # no endpoint map in cfg
+        assert router.stats()["requeues"] == 1
+
+    def test_submit_rejection_passes_error_through(self, fake_worker):
+        fake_worker.accept = False
+        rr = make_remote(fake_worker)
+        assert rr.submit(self.req()) is False
+        assert not rr._inflight
+
+
+@pytest.mark.socket
+class TestWorkerToWorkerShip:
+    def test_courier_ships_parked_payload_worker_to_worker(self):
+        """The tentpole flow: a payload parked on worker A moves straight
+        to worker B's receiver on a /worker/ship command — the control
+        plane never relays the bytes."""
+        a, b = FakeWorkerServer(), FakeWorkerServer()
+        try:
+            payload = {"pages": {"k": np.arange(64, dtype=np.float32)
+                                 .reshape(1, 1, 1, 8, 8),
+                                 "num_pages": 1},
+                       "positions": 5}
+            a.receiver.put_payload("tk-x", payload)
+            cfg = remote_cfg(fleet_endpoints={0: a.endpoint,
+                                              1: b.endpoint},
+                             remote_replicas="0,1")
+            cfg.remote_replica_ids = lambda: {0, 1}
+            cfg.endpoint_map = lambda: {0: a.endpoint, 1: b.endpoint}
+            courier = KVCourier(cfg)
+            req = SimpleNamespace(request_id="m1",
+                                  swapped_kv=ticket_stub("tk-x", 0))
+            assert courier.ship(req, src=0, dest=1)
+            assert req.swapped_kv["at"] == 1
+            got = b.receiver.take_payload("tk-x")
+            assert got is not None and got["positions"] == 5
+            assert np.array_equal(got["pages"]["k"],
+                                  payload["pages"]["k"])
+            # A no longer holds it (ship pops)
+            assert a.receiver.take_payload("tk-x") is None
+        finally:
+            a.close()
+            b.close()
+
+    def test_ship_of_unknown_ticket_degrades_to_reprefill(self):
+        a, b = FakeWorkerServer(), FakeWorkerServer()
+        try:
+            cfg = remote_cfg()
+            cfg.remote_replica_ids = lambda: {0, 1}
+            cfg.endpoint_map = lambda: {0: a.endpoint, 1: b.endpoint}
+            courier = KVCourier(cfg)
+            req = SimpleNamespace(request_id="m2",
+                                  swapped_kv=ticket_stub("gone", 0))
+            assert courier.ship(req, src=0, dest=1) is False
+            assert req.swapped_kv is None
+            assert courier.snapshot()["per_src"]["0"]["aborts"] == 1
+        finally:
+            a.close()
+            b.close()
+
+    def test_spawned_worker_round_trip(self):
+        """Full-suite merge gate: one REAL `llmctl fleet worker` OS
+        process (gpt-test, deterministic --param-seed), driven by a
+        RemoteReplica over real sockets — greedy output must be
+        token-identical to a local engine built from the same seed.
+        The broader multi-process scenarios (drain migration, SIGKILL,
+        disagg) run in the serve.fleet2+remote dryrun regime."""
+        import os
+        import select
+        import subprocess
+        import sys
+
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        pkg = "distributed_llm_training_and_inference_system_tpu"
+        cmd = [sys.executable, "-m", f"{pkg}.cli.main", "fleet",
+               "worker", "--model", "gpt-test", "--replica-id", "1",
+               "--role", "mixed", "--host", "127.0.0.1", "--port", "0",
+               "--param-seed", "3", "--seed", "1000",
+               "--max-batch-size", "2", "--max-seq-len", "128",
+               "--prefill-chunk", "32", "--kv-block-size", "8",
+               "--dtype", "float32", "--restart-backoff", "0.05"]
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                stderr=subprocess.DEVNULL, env=env,
+                                text=True, start_new_session=True)
+        try:
+            port = None
+            deadline = time.time() + 240
+            while time.time() < deadline:
+                assert proc.poll() is None, "worker died during startup"
+                rd, _, _ = select.select([proc.stdout], [], [], 1.0)
+                if rd:
+                    line = proc.stdout.readline()
+                    if line.startswith("LLMCTL_WORKER_READY"):
+                        port = int(line.strip().split("port=")[1])
+                        break
+            assert port, "worker never became ready"
+
+            from distributed_llm_training_and_inference_system_tpu.serve.fleet.remote import (  # noqa: E501
+                RemoteReplica)
+            done = []
+            rr = RemoteReplica(
+                1, f"http://127.0.0.1:{port}", fleet_cfg=remote_cfg(),
+                on_finish=lambda rid, r: done.append(r))
+            rr.start()
+            try:
+                prompt = [5, 17, 99, 3, 42, 7, 23]
+                req = Request(request_id="spawn-1",
+                              prompt_tokens=list(prompt),
+                              sampling=SamplingParams(temperature=0.0,
+                                                      max_tokens=8))
+                assert rr.submit(req)
+                t0 = time.time()
+                while not done and time.time() - t0 < 120:
+                    time.sleep(0.05)
+                assert done, "remote request never finished"
+                assert req.state is RequestState.FINISHED
+
+                import jax
+                from distributed_llm_training_and_inference_system_tpu.config import (  # noqa: E501
+                    get_model_config)
+                from distributed_llm_training_and_inference_system_tpu.config.schema import (  # noqa: E501
+                    ServeConfig)
+                from distributed_llm_training_and_inference_system_tpu.models import (  # noqa: E501
+                    init as model_init)
+                from distributed_llm_training_and_inference_system_tpu.serve import (  # noqa: E501
+                    InferenceEngine)
+                mc = get_model_config("gpt-test")
+                eng = InferenceEngine(
+                    mc, ServeConfig(model="gpt-test", max_batch_size=2,
+                                    max_seq_len=128, prefill_chunk=32,
+                                    kv_block_size=8, dtype="float32"),
+                    params=model_init(mc, jax.random.PRNGKey(3)),
+                    seed=0)
+                [ref] = eng.generate([prompt], SamplingParams(
+                    temperature=0.0, max_tokens=8))
+                assert req.generated_tokens == ref.generated_tokens, (
+                    "spawned worker diverged from the local engine")
+            finally:
+                rr.stop()
+        finally:
+            # no stray worker processes, even on assertion failure
+            if proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+
+    def test_parent_push_to_remote_dest(self):
+        """Bytes held by the parent push over HTTP to a remote worker's
+        receiver; the request then carries a stub naming that worker."""
+        b = FakeWorkerServer()
+        try:
+            cfg = remote_cfg()
+            cfg.remote_replica_ids = lambda: {1}
+            cfg.endpoint_map = lambda: {1: b.endpoint}
+            courier = KVCourier(cfg)
+            payload = {"positions": 3,
+                       "pages": {"k": np.ones((1, 1, 1, 8, 8),
+                                              np.float32),
+                                 "num_pages": 1}}
+            req = SimpleNamespace(request_id="m3", swapped_kv=payload)
+            assert courier.ship(req, src=None, dest=1)
+            assert is_ticket_stub(req.swapped_kv)
+            assert req.swapped_kv["at"] == 1
+            got = b.receiver.take_payload(
+                req.swapped_kv["courier_ticket"])
+            assert got is not None and got["positions"] == 3
+        finally:
+            b.close()
