@@ -183,11 +183,20 @@ def kernels(op, seq_len, hidden, heads, batch):
                    "and transfer-stall percentiles against 0.0 (clean "
                    "link). Results always carry the courier section "
                    "(transfers/retries/aborts + p50/p99_transfer_ms).")
+@click.option("--serve-hot-prefix", default=0, show_default=True,
+              type=int,
+              help="serve-load fleet: flash-crowd scenario — every "
+                   "prompt shares a hot prefix of this many tokens "
+                   "(tails random), so placements spilling off the "
+                   "affinity owner exercise the fleet-global prefix "
+                   "fetch; compare fleet prefill_tokens and the "
+                   "prefix_fetch section against 0 (all-unique "
+                   "prompts). 0 disables.")
 def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
         requests, rps, concurrency, admission, kv_blocks, device_times,
         preemption, latency_dispatch_steps, artifact, quant, kv_quant,
         slots, pipelined, int8_pallas, serve_max_retries, serve_replicas,
-        serve_disagg, serve_courier_chaos):
+        serve_disagg, serve_courier_chaos, serve_hot_prefix):
     """End-to-end train step throughput / serve TTFT+throughput
     (parity: reference bench.py:35-49). ``serve-load`` runs open-loop
     (Poisson) and closed-loop sweeps with p50/p99 TTFT, per-token latency,
@@ -372,7 +381,8 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
                        if hasattr(target, "router") else [target])
             keys = ("short_dispatches", "decode_steps",
                     "padded_slot_steps", "prefill_tokens", "preemptions",
-                    "requeue_cached_tokens")
+                    "requeue_cached_tokens", "prefix_cached_tokens",
+                    "prefix_fetched_tokens")
             agg = {k: sum(e.stats().get(k) or 0 for e in engines)
                    for k in keys}
             B = engines[0].serve_cfg.max_batch_size
@@ -389,6 +399,7 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
                               num_requests=requests, prompt_len=prompt_len,
                               max_tokens=gen_len, seed=0,
                               max_retries=serve_max_retries,
+                              hot_prefix_len=serve_hot_prefix,
                               device_times=device_times)
             s = out.summary()
             s["engine"] = engine_counters()
@@ -399,6 +410,7 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
                                   prompt_len=prompt_len,
                                   max_tokens=gen_len, seed=0,
                                   max_retries=serve_max_retries,
+                                  hot_prefix_len=serve_hot_prefix,
                                   device_times=device_times)
             s = out.summary()
             s["concurrency"] = c

@@ -63,7 +63,7 @@ def status(url, as_json):
     for col in ("replica", "state", "role", "endpoint", "remote?",
                 "queue", "active", "outstanding tok", "restarts",
                 "migr out", "handoffs", "courier out", "courier aborts",
-                "prefix hit", "last error"):
+                "prefix hit", "pfx fetched", "pfx miss", "last error"):
         table.add_column(col)
     per_src = snap.get("courier", {}).get("per_src", {})
     for r in snap["replicas"]:
@@ -87,6 +87,8 @@ def status(url, as_json):
                       str(src.get("transfers", 0)),
                       str(src.get("aborts", 0)),
                       f"{hit:.0%}" if hit is not None else "-",
+                      str(r.get("prefix_fetch_pages", 0)),
+                      str(r.get("prefix_fetch_misses", 0)),
                       (r.get("last_error") or "")[:48])
     console = Console()
     console.print(table)
@@ -113,6 +115,14 @@ def status(url, as_json):
             f"{ho.get('reroles', 0)} re-roles, "
             f"{ho.get('promotions', 0)} promotions, "
             f"{ho.get('demotions', 0)} demotions)")
+    pf = snap.get("prefix_fetch")
+    if pf and (pf.get("pages") or pf.get("misses") or pf.get("aborts")):
+        console.print(
+            f"prefix fetch: {pf.get('pages', 0)} pages pulled from "
+            f"siblings ({pf.get('bytes', 0)} bytes, "
+            f"{pf.get('fetches', 0)} fetches, "
+            f"{pf.get('misses', 0)} misses, "
+            f"{pf.get('aborts', 0)} aborts)")
     cour = snap.get("courier")
     if cour and (cour.get("transfers") or cour.get("aborts")
                  or cour.get("in_flight") or cour.get("expired")):

@@ -190,6 +190,18 @@ def app(ctx):
                    "worker` processes instead of in-process engines; "
                    "each MUST have a --fleet-endpoint entry (validated "
                    "at startup).")
+@click.option("--fleet-prefix-fetch/--fleet-no-prefix-fetch",
+              "fleet_prefix_fetch", default=True, show_default=True,
+              help="Fleet-global prefix cache: placements that miss the "
+                   "affinity owner FETCH the shared prefix pages from "
+                   "the replica that has them (over the courier) "
+                   "instead of re-prefilling; fetch failures degrade to "
+                   "plain prefill.")
+@click.option("--fleet-prefix-fetch-min-pages", default=1,
+              show_default=True, type=int,
+              help="Skip fetches smaller than this many full pages "
+                   "(raise when computing a page is cheaper than your "
+                   "link).")
 def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
           kv_block_size, kv_hbm_gb, scheduler, dtype, prometheus_port,
           speculative, spec_tokens, prefix_cache, tensor_parallel,
@@ -203,7 +215,8 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
           fleet_courier_transport, fleet_courier_chunk_bytes,
           fleet_courier_retries, fleet_courier_deadline_ms,
           fleet_courier_endpoint, fleet_courier_ticket_ttl_ms,
-          fleet_endpoints, fleet_remote_replicas):
+          fleet_endpoints, fleet_remote_replicas, fleet_prefix_fetch,
+          fleet_prefix_fetch_min_pages):
     """Start the OpenAI-compatible inference server."""
     import jax
 
@@ -252,7 +265,9 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
             courier_endpoint=fleet_courier_endpoint,
             courier_ticket_ttl_ms=fleet_courier_ticket_ttl_ms,
             fleet_endpoints=parse_fleet_endpoints(list(fleet_endpoints)),
-            remote_replicas=fleet_remote_replicas)
+            remote_replicas=fleet_remote_replicas,
+            prefix_fetch=fleet_prefix_fetch,
+            prefix_fetch_min_pages=fleet_prefix_fetch_min_pages)
         fleet_cfg.validate()
 
     observer = None

@@ -895,6 +895,28 @@ class FleetConfig:
     # budget; this bounds the whole RPC so a hung worker can't wedge
     # placement).
     courier_ship_timeout_s: float = 30.0
+    # -- fleet-global prefix cache (Mooncake-style KV reuse) -----------------
+    # A placement that lands off the prefix-affinity owner (load bound,
+    # role filter, drain, requeue) normally re-prefills a prefix whose
+    # KV already exists somewhere in the fleet. With prefix_fetch on,
+    # the router attaches a `prefix_owner` hint (from per-replica
+    # prefix-page inventories) and the destination FETCHES the shared
+    # full pages over the courier instead of recomputing them,
+    # prefilling only the uncovered tail. Every failure mode (owner
+    # evicted the pages, transfer aborted, timeout) degrades to plain
+    # prefill — fetch is an optimization, never a correctness
+    # dependency. Fetched pages credit reprefill_tokens_avoided.
+    prefix_fetch: bool = True
+    # don't bother fetching fewer than this many full pages (a one-page
+    # fetch rarely beats just computing it; raise on slow links)
+    prefix_fetch_min_pages: int = 1
+    # bound on one fetch round trip (owner-side extract waits at most
+    # one engine dispatch; the push inside has its own chunk deadlines)
+    prefix_fetch_timeout_s: float = 5.0
+    # newest prefix-page hashes each replica advertises in its probe /
+    # inventory (bounds probe payloads and router hint work; 0 disables
+    # the inventory and therefore all fetch hints)
+    prefix_inventory_max: int = 512
 
     def role_list(self) -> list[str]:
         """Per-replica role assignment; empty config = all mixed."""
@@ -983,6 +1005,14 @@ class FleetConfig:
         if self.remote_timeout_s <= 0 or self.courier_ship_timeout_s <= 0:
             raise ConfigError(
                 "remote_timeout_s / courier_ship_timeout_s must be > 0")
+        if self.prefix_fetch_min_pages < 1:
+            raise ConfigError("prefix_fetch_min_pages must be >= 1")
+        if self.prefix_fetch_timeout_s <= 0:
+            raise ConfigError("prefix_fetch_timeout_s must be > 0")
+        if self.prefix_inventory_max < 0:
+            raise ConfigError(
+                "prefix_inventory_max must be >= 0 (0 disables the "
+                "inventory and therefore all prefix-fetch hints)")
         endpoints = self.endpoint_map()       # raises on malformed entries
         for rid in endpoints:
             if not 0 <= rid < self.replicas:

@@ -276,6 +276,30 @@ class PrometheusExporter:
             "llmctl_fleet_courier_transfer_ms",
             "End-to-end courier transfer time per payload (ms)",
             buckets=(.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 1000, 5000))
+        # fleet-global prefix cache (serve/fleet/ prefix fetch): pages
+        # pulled from a sibling replica's cache instead of re-prefilled,
+        # plus the attempts that degraded to plain prefill (misses:
+        # owner had nothing; aborts: the transfer failed)
+        self.fleet_prefix_fetch_pages = c(
+            "llmctl_fleet_prefix_fetch_pages",
+            "Prefix pages fetched from another replica's cache instead "
+            "of re-prefilled")
+        self.fleet_prefix_fetch_bytes = c(
+            "llmctl_fleet_prefix_fetch_bytes",
+            "Host bytes of fetched prefix pages moved over the courier")
+        self.fleet_prefix_fetch_misses = c(
+            "llmctl_fleet_prefix_fetch_misses",
+            "Prefix fetches that found nothing at the owner (evicted "
+            "since advertised / stale hint) — degraded to plain prefill")
+        self.fleet_prefix_fetch_aborts = c(
+            "llmctl_fleet_prefix_fetch_aborts",
+            "Prefix fetches whose courier transfer failed — degraded to "
+            "plain prefill")
+        self.fleet_prefix_fetch = h(
+            "llmctl_fleet_prefix_fetch_ms",
+            "End-to-end prefix fetch time per attempt (ms; hint -> "
+            "pages imported or degraded)",
+            buckets=(.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 1000, 5000))
         self._last_totals: dict[str, float] = {}
         self._server_started = False
 
@@ -418,6 +442,27 @@ class PrometheusExporter:
             for t in xfers[-min(new, len(xfers)):]:
                 self.fleet_courier_transfer.observe(t)
         self._last_totals["fleet_cour_transfers"] = count
+        # fleet-global prefix-fetch plane: same delta-on-running-totals
+        # contract; the latency histogram fills from the bounded recent
+        # window gated by the cumulative attempt count
+        pf = snap.get("prefix_fetch", {})
+        for key, counter in (
+                ("pages", self.fleet_prefix_fetch_pages),
+                ("bytes", self.fleet_prefix_fetch_bytes),
+                ("misses", self.fleet_prefix_fetch_misses),
+                ("aborts", self.fleet_prefix_fetch_aborts)):
+            total = pf.get(key, 0)
+            delta = total - self._last_totals.get(f"fleet_pf_{key}", 0)
+            if delta > 0:
+                counter.inc(delta)
+            self._last_totals[f"fleet_pf_{key}"] = total
+        count = pf.get("fetch_count", 0)
+        new = int(count - self._last_totals.get("fleet_pf_fetches", 0))
+        window = pf.get("fetch_ms", [])
+        if new > 0:
+            for t in window[-min(new, len(window)):]:
+                self.fleet_prefix_fetch.observe(t)
+        self._last_totals["fleet_pf_fetches"] = count
 
 
 class OTLPExporter:

@@ -202,6 +202,15 @@ class InferenceEngine:
         # partial swap-in restores (crash-surviving migration pre-copies:
         # covered pages written back, only the tail re-prefilled)
         self.total_partial_restores = 0
+        # fleet-global prefix cache (serve/fleet/): called on the ENGINE
+        # thread right before a prefill with (request, uncovered page
+        # hashes); returns {"hashes": [bytes], "pages": payload} fetched
+        # from the owning replica, or None (miss/abort — plain prefill).
+        # None (the default) disables fetching entirely.
+        self.prefix_fetch_hook: Optional[Callable] = None
+        # context tokens covered by pages FETCHED from another replica's
+        # prefix cache instead of being re-prefilled here
+        self.total_prefix_fetched_tokens = 0
 
         # per-slot host state
         self.last_tokens = np.zeros(S, np.int32)
@@ -608,9 +617,89 @@ class InferenceEngine:
                 extend_chunk, donate_argnums=(4, 5))
         return self._prefill_cache[key_]
 
+    def _maybe_fetch_prefix(self, req: Request) -> None:
+        """Fleet-global prefix fetch (engine thread, called right before
+        a prefill, NO lock held across the network round trip): when the
+        local prefix cache leaves full pages of the context uncovered and
+        the router attached a ``prefix_owner`` hint, fetch those pages
+        from the owner over the courier, import them into the local
+        cache, and pin them for this request — the prefill then computes
+        only the uncovered tail. Every failure (no hook, miss, abort,
+        malformed payload, dry pool) leaves the request exactly as it
+        was: plain prefill, correct tokens, extra compute."""
+        hook = self.prefix_fetch_hook
+        if (hook is None or not self.serve_cfg.prefix_caching
+                or req.swapped_kv is not None
+                or getattr(req, "prefix_owner", None) is None
+                or not req.prefix_hashes):
+            return
+        rid = req.request_id
+        n = len(req.context_tokens)
+        PS = self.kv.page_size
+        # >=1 suffix token stays: the last context token must be
+        # re-processed to produce the next token's logits
+        usable = min(len(req.prefix_hashes), max((n - 1) // PS, 0))
+        if usable == 0:
+            return
+        with self.lock:
+            pins = list(self._prefix_pins.get(rid, ()))
+            # re-check coverage NOW (not at admission): a sibling's fetch
+            # or prefill since then may already have published the pages
+            chain = self.kv.lookup_prefix(req.prefix_hashes[:usable])
+            if len(chain) > len(pins):
+                extra = chain[len(pins):]
+                self.kv.pin_pages(extra)
+                pins += extra
+                self._prefix_pins[rid] = pins
+        uncovered = req.prefix_hashes[len(pins):usable]
+        if not uncovered:
+            return
+        got = hook(req, uncovered)      # network round trip, no lock
+        if not got:
+            return
+        hashes, pages = got.get("hashes") or [], got.get("pages")
+        # chain consistency: the owner must answer with a PREFIX of what
+        # was asked — anything else (stale inventory, hash-collision-
+        # shaped confusion) is discarded rather than imported
+        k = 0
+        while k < min(len(hashes), len(uncovered)) \
+                and hashes[k] == uncovered[k]:
+            k += 1
+        if k == 0 or not isinstance(pages, dict):
+            return
+        with self.lock:
+            try:
+                inserted = self.kv.insert_prefix_pages(uncovered[:k], pages)
+            except (ValueError, KeyError, TypeError) as e:
+                # malformed fetch payload: plain prefill, never garbage KV
+                logger.warning(
+                    "fetched prefix payload for %s rejected (%s); "
+                    "re-prefilling", rid, e)
+                return
+            # pin the now-longer cached chain for this request so nothing
+            # imported can be evicted before its prefill runs (same lock
+            # hold as the insert — the lookup->pin atomicity contract)
+            chain = self.kv.lookup_prefix(req.prefix_hashes[:usable])
+            if len(chain) > len(pins):
+                extra = chain[len(pins):]
+                self.kv.pin_pages(extra)
+                self._prefix_pins[rid] = pins + extra
+            if inserted:
+                tokens = len(inserted) * PS
+                self.total_prefix_fetched_tokens += tokens
+                # prefill FLOPs the FLEET did not respend — feeds the
+                # fleet's reprefill_tokens_avoided metric exactly like
+                # warm-prefix requeues
+                self.total_requeue_cached_tokens += tokens
+                logger.info(
+                    "prefix fetch for %s: imported %d page(s) (%d "
+                    "tokens) from replica %s", rid, len(inserted),
+                    tokens, getattr(req, "prefix_owner", None))
+
     def _start_chunked_prefill(self, req: Request) -> None:
         """Allocate the slot's pages and enqueue the context for chunk-at-a-
         time prefill (one chunk per engine step, interleaved with decode)."""
+        self._maybe_fetch_prefix(req)
         slot = req.slot
         ctx = req.context_tokens
         n = len(ctx)
@@ -720,6 +809,7 @@ class InferenceEngine:
         The first-token fetch is DEFERRED (_finish_prefill) so a burst of
         admitted prompts pays one host round trip total, not one per
         prompt — dispatches pipeline on-device."""
+        self._maybe_fetch_prefix(req)
         slot = req.slot
         ctx = req.context_tokens   # prompt, + generated after a preemption
         n = len(ctx)
@@ -1633,6 +1723,7 @@ class InferenceEngine:
             "prefill_tokens": self.total_prefill_tokens,
             "prefix_cached_tokens": self.total_prefix_cached_tokens,
             "requeue_cached_tokens": self.total_requeue_cached_tokens,
+            "prefix_fetched_tokens": self.total_prefix_fetched_tokens,
             "unexpected_prefills": self.total_unexpected_prefills,
             "partial_restores": self.total_partial_restores,
             "padded_slot_steps": self.total_padded_slot_steps,

@@ -139,13 +139,21 @@ class ServeFleet:
             self.replicas.append(r)
         self.model_cfg = model_cfg
         self._params = params
+        # fleet-global prefix cache: hints need the page size the
+        # engines actually hash with; 0 disables the whole plane
+        page_size = (serve_cfg.kv_block_size
+                     if (serve_cfg.prefix_caching
+                         and self.fleet_cfg.prefix_fetch) else 0)
         self.router = FleetRouter(self.replicas, self.fleet_cfg,
-                                  observer=observer, courier=self.courier)
+                                  observer=observer, courier=self.courier,
+                                  page_size=page_size)
         for r in self.replicas:
             if getattr(r, "remote", False):
                 # a remote prefill worker parks its handoffs under a
                 # ticket and publishes them through its outbox; the
-                # supervisor's migrated-collection places them
+                # supervisor's migrated-collection places them — and it
+                # runs its own prefix fetches (the hint travels on the
+                # submit wire)
                 continue
             # disaggregation wiring: a prefill-role replica asks the
             # router for a decode destination BEFORE extracting (local-
@@ -153,6 +161,12 @@ class ServeFleet:
             # handed-off sequence synchronously from its engine thread
             r.handoff_dest = self.router.handoff_dest
             r.on_handoff = self._place_handoff
+            # prefix-fetch wiring: this replica both serves its cached
+            # pages to the fleet (provider) and fetches missing ones
+            # through the courier's fetch verb
+            self.courier.prefix_providers[r.replica_id] = \
+                r.request_prefix_extract
+            r.prefix_fetcher = self.courier.fetch_prefix
         self.supervisor = ReplicaSupervisor(
             self.replicas, self.router, self.fleet_cfg,
             injector=self.injector, params=params, observer=observer)
@@ -240,3 +254,38 @@ class ServeFleet:
 
     def status(self) -> dict:
         return self.supervisor.snapshot()
+
+    def serve_prefix_fetch(self, body: dict) -> dict:
+        """Owner side of ``POST /fleet/courier/fetch`` when the owning
+        replica is IN-PROC behind this front: extract the cached prefix
+        pages (on that replica's engine thread) and PUSH them, chunked,
+        to the remote fetcher's courier endpoint. Mirrors the worker's
+        handler so remote workers can fetch from in-proc owners."""
+        from .transport import HTTPCourierTransport, TransportError
+        try:
+            owner = int(body.get("replica", -1))
+            hashes = [bytes.fromhex(h) for h in body.get("hashes", [])]
+        except (TypeError, ValueError):
+            return {"ok": False, "error": "malformed replica/hashes"}
+        ticket = str(body.get("ticket") or "")
+        dest_ep = str(body.get("dest_endpoint") or "").rstrip("/")
+        if not hashes or not ticket or not dest_ep:
+            return {"ok": False, "error":
+                    "body must be {replica, hashes, ticket, dest_endpoint}"}
+        provider = self.courier.prefix_providers.get(owner)
+        if provider is None:
+            return {"ok": False,
+                    "error": f"no in-proc replica {owner} here"}
+        payload = provider(hashes, self.fleet_cfg.prefix_fetch_timeout_s)
+        if not payload:
+            return {"ok": False, "error": "prefix pages not cached"}
+        transport = HTTPCourierTransport(
+            self.fleet_cfg, injector=self.injector,
+            stats=self.courier.stats, endpoint=dest_ep)
+        try:
+            transport.transfer(payload, src=owner,
+                               dest=body.get("dest"), ticket=ticket)
+        except TransportError as e:
+            return {"ok": False, "error": str(e)}
+        return {"ok": True, "ticket": ticket,
+                "covered": int(payload["pages"]["num_pages"])}

@@ -25,6 +25,12 @@ operator endpoints:
   locally at submit time. (The old ``/fleet/courier/claim`` loopback,
   which handed the blob back to the *sender*, is gone: transfers are
   destination-terminated.)
+- ``POST /fleet/courier/fetch`` — fleet-global prefix cache, owner
+  side: ``{replica, hashes, ticket, dest, dest_endpoint}`` asks an
+  in-proc replica for the cached prefix pages matching ``hashes``; the
+  extraction runs on that replica's engine thread and the pages are
+  PUSHED (chunked, as above) to ``dest_endpoint``. A miss — evicted
+  since advertised — answers ``ok: false`` and the fetcher re-prefills.
 
 Backpressure contract: when every replica saturates, completions answer
 **429 with a Retry-After header** (seconds) instead of queueing without
@@ -253,6 +259,26 @@ class FleetServer:
         return web.json_response(
             self.fleet.courier_receiver.add_chunk(chunk))
 
+    async def handle_courier_fetch(self, request: web.Request
+                                   ) -> web.Response:
+        """Fleet-global prefix fetch, owner side (in-proc replicas): a
+        remote fetcher asks for cached prefix pages by hash; the owning
+        replica extracts them on its engine thread and this front PUSHES
+        the chunks to the fetcher's courier endpoint. ok=False covers
+        misses (evicted since advertised) — data for the fetcher's
+        degrade path, not an HTTP error."""
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid JSON"},
+                                     status=400)
+        loop = asyncio.get_running_loop()
+        # extract waits on an engine thread + the push retries: off the
+        # event loop so chunk ingestion and probes stay responsive
+        out = await loop.run_in_executor(
+            None, self.fleet.serve_prefix_fetch, body)
+        return web.json_response(out)
+
     async def handle_metrics(self, request: web.Request) -> web.Response:
         try:
             from prometheus_client import generate_latest
@@ -275,6 +301,8 @@ class FleetServer:
         app.router.add_post("/fleet/role", self.handle_fleet_role)
         app.router.add_post("/fleet/courier/chunk",
                             self.handle_courier_chunk)
+        app.router.add_post("/fleet/courier/fetch",
+                            self.handle_courier_fetch)
         return app
 
     # -- lifecycle -----------------------------------------------------------

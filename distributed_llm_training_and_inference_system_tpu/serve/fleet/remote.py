@@ -89,6 +89,12 @@ def request_to_wire(req: Request) -> dict:
         "ticket": ticket,
         "partial": bool(kv.get("partial")) if isinstance(kv, dict)
         else False,
+        # fleet-global prefix cache: the router's placement-time hint
+        # rides the wire so the WORKER can fetch the shared pages
+        # itself (it cannot see the fleet)
+        "prefix_owner": getattr(req, "prefix_owner", None),
+        "prefix_owner_endpoint": getattr(req, "prefix_owner_endpoint",
+                                         None),
     }
 
 
@@ -104,6 +110,8 @@ def request_from_wire(d: dict, receiver=None) -> Request:
     req.assigned_seed = d.get("assigned_seed")
     req.fleet_requeued = bool(d.get("fleet_requeued"))
     req.handoffs = int(d.get("handoffs", 0))
+    req.prefix_owner = d.get("prefix_owner")
+    req.prefix_owner_endpoint = d.get("prefix_owner_endpoint")
     ticket = d.get("ticket")
     if ticket and receiver is not None:
         payload = receiver.take_payload(ticket)
@@ -166,6 +174,9 @@ class RemoteReplica:
         self.handoff_tokens = 0
         self.handoffs_local = 0
         self.handoff_stalls_ms: list = []
+        # fleet-global prefix cache: the worker's advertised page-hash
+        # inventory (bytes) and fetch-side counters, refreshed per probe
+        self._prefix_inv: tuple = ()
         # parent-side load adjustment: the probe cache is only as fresh
         # as the last poll, so submissions between probes would all pile
         # onto the same least-loaded replica. Work submitted since the
@@ -363,6 +374,43 @@ class RemoteReplica:
                 int(self._cache.get("prefix_queries", 0)),
                 int(self._cache.get("requeue_cached_tokens", 0)))
 
+    def prefix_inventory(self) -> list:
+        """The worker's advertised prefix-page hashes, as of the last
+        probe — the router's fetch-hint input. Probe-stale by design: a
+        page evicted since the advertise makes the fetch a counted miss,
+        never wrong tokens."""
+        with self._lock:
+            return list(self._prefix_inv)
+
+    def prefix_fetch_stats(self) -> dict:
+        with self._lock:
+            pf = self._cache.get("prefix_fetch") or {}
+        return {"fetches": int(pf.get("fetches", 0)),
+                "pages": int(pf.get("pages", 0)),
+                "bytes": int(pf.get("bytes", 0)),
+                "misses": int(pf.get("misses", 0)),
+                "aborts": int(pf.get("aborts", 0)),
+                "fetch_ms": list(pf.get("fetch_ms", [])),
+                "fetch_count": int(pf.get("fetch_count", 0))}
+
+    def pool_room_for(self, req: Request) -> bool:
+        """PR-6 gap closed: the ``handoff_dest`` advisory used to ASSUME
+        every remote decode replica had pool room. The probe now carries
+        the worker's real pool facts (free pages net of reserves, page
+        size, decode lookahead) and this consults them. Probe-stale room
+        still races — the destination's own admission is the binding
+        check, and a loser falls back to local decode, counted in
+        ``handoffs_local`` — but a full remote pool no longer attracts
+        every handoff. Optimistic (True) before the first probe."""
+        with self._lock:
+            ps = int(self._cache.get("pool_page_size", 0) or 0)
+            free = int(self._cache.get("pool_free_pages", 0) or 0)
+            look = int(self._cache.get("pool_lookahead", 0) or 0)
+        if ps <= 0:
+            return True
+        need = -(-(len(req.context_tokens) + look) // ps)
+        return need <= free
+
     def migrations_in_flight(self) -> int:
         return int(self._cache.get("migrations_in_flight", 0))
 
@@ -405,6 +453,12 @@ class RemoteReplica:
             if out.get("migrations_by_reason"):
                 self.migrations_by_reason = dict(
                     out["migrations_by_reason"])
+            if "prefix_pages" in out:
+                try:
+                    self._prefix_inv = tuple(
+                        bytes.fromhex(h) for h in out["prefix_pages"])
+                except (TypeError, ValueError):
+                    self._prefix_inv = ()
 
     def poll_outbox(self) -> int:
         """Pull finished results / orphans / migrations from the worker

@@ -83,6 +83,11 @@ def reset_for_requeue(req: Request, keep_kv: bool = False) -> None:
     req.finish_reason = None
     req.cancel_requested = False
     req.fleet_requeued = True
+    # placement-time fetch hints are stale the moment the request leaves
+    # its replica; the router re-attaches fresh ones (or none) at the
+    # next placement
+    req.prefix_owner = None
+    req.prefix_owner_endpoint = None
     if req.generated_tokens:
         req.prefix_hashes = None
     if not keep_kv:
@@ -107,6 +112,32 @@ class EngineReplica:
         self.role = role
         self._migrate_on_drain = bool(fleet_cfg.migrate_on_drain) \
             if fleet_cfg is not None else False
+        # fleet-global prefix cache: the fetch half (this replica is the
+        # cache-cold destination). `prefix_fetcher` is injected by
+        # ServeFleet (KVCourier.fetch_prefix) or FleetWorker (its
+        # socket fetcher); the engine's prefix_fetch_hook calls through
+        # _fetch_prefix, which owns the counters below.
+        self.prefix_fetcher: Optional[Callable] = None
+        self._prefix_fetch = bool(getattr(fleet_cfg, "prefix_fetch",
+                                          False)) \
+            if fleet_cfg is not None else False
+        self._prefix_fetch_min_pages = int(getattr(
+            fleet_cfg, "prefix_fetch_min_pages", 1) or 1)
+        self._prefix_fetch_timeout_s = float(getattr(
+            fleet_cfg, "prefix_fetch_timeout_s", 5.0) or 5.0)
+        self._prefix_inventory_max = int(getattr(
+            fleet_cfg, "prefix_inventory_max", 512) or 0) \
+            if fleet_cfg is not None else 0
+        self.prefix_fetches = 0          # fetches that imported pages
+        self.prefix_fetch_pages = 0      # pages received over the wire
+        self.prefix_fetch_bytes = 0
+        self.prefix_fetch_misses = 0     # owner had nothing / no payload
+        self.prefix_fetch_aborts = 0     # transfer/RPC failed
+        self.prefix_fetch_ms: deque = deque(maxlen=64)
+        # owner half: extract jobs other replicas queued for our prefix
+        # pages; serviced ON the engine thread between steps (the donated
+        # page buffers are only safe to read at a loop boundary)
+        self._prefix_jobs: list[dict] = []
         # single-request migrations (rebalance / operator): ticket state
         # advances ONLY on the engine thread at step boundaries; the dict
         # itself is shared with the supervisor thread (_state_lock)
@@ -164,6 +195,8 @@ class EngineReplica:
         self.engine.on_finish = self._engine_finished
         self.engine.on_prefill_complete = self._on_prefill_complete
         self.engine.expect_pure_decode = (self.role == ROLE_DECODE)
+        self.engine.prefix_fetch_hook = (self._fetch_prefix
+                                         if self._prefix_fetch else None)
 
     def set_role(self, role: str) -> None:
         """Re-role this replica (balancer / operator). Takes effect for
@@ -197,6 +230,12 @@ class EngineReplica:
                 except Exception as e:   # broken engine mid-copy
                     self._crash(e)
                     return
+            if self._prefix_jobs:
+                # owner half of the fleet prefix fetch: extraction runs
+                # here, between steps, where the donated page buffers
+                # are guaranteed live; per-job failures answer a miss
+                # instead of crashing the replica
+                self._service_prefix_extracts()
             with eng.lock:
                 busy = (eng.scheduler.queue_depth > 0
                         or eng.scheduler.active_count > 0)
@@ -244,6 +283,7 @@ class EngineReplica:
                 r.swapped_kv = p
         with self._state_lock:
             self._orphans.extend(orphans)
+        self._fail_prefix_jobs()
 
     def _salvage_precopies(self) -> dict[str, dict]:
         """Partial ``swapped_kv`` payloads from migration tickets whose
@@ -580,11 +620,17 @@ class EngineReplica:
 
     def probe(self) -> dict:
         """Health snapshot for the supervisor. Raises if the engine thread
-        is dead — a crashed replica must not look merely idle."""
+        is dead — a crashed replica must not look merely idle. Carries
+        the KV-pool room facts (free pages net of admission reserves,
+        page size, decode lookahead) so a REMOTE parent's
+        ``handoff_dest`` advisory can consult real room instead of
+        assuming it (the PR-6 known gap)."""
         with self._state_lock:
             state = self.state
         if state == CRASHED:
             raise RuntimeError(self.last_error or "replica crashed")
+        eng = self.engine
+        kv = getattr(eng, "kv", None)
         return {
             "replica": self.replica_id,
             "state": state,
@@ -593,6 +639,11 @@ class EngineReplica:
             "active": self.active_count(),
             "outstanding_tokens": self.outstanding_tokens(),
             "restarts": self.restarts,
+            "pool_free_pages": (int(kv.free_pages - eng._reserved_pages)
+                                if kv is not None else 0),
+            "pool_page_size": int(kv.page_size) if kv is not None else 0,
+            "pool_lookahead": (int(eng._decode_lookahead)
+                               if kv is not None else 0),
         }
 
     def request_drain(self) -> None:
@@ -666,6 +717,167 @@ class EngineReplica:
         return (kv.prefix_hits, kv.prefix_queries,
                 getattr(self.engine, "total_requeue_cached_tokens", 0))
 
+    # -- fleet-global prefix cache -------------------------------------------
+
+    def prefix_inventory(self) -> list:
+        """The prefix-page hashes this replica's cache currently holds —
+        the router's hint input (bounded; advisory, so staleness only
+        costs a missed fetch or a counted miss)."""
+        if self._prefix_inventory_max <= 0:
+            return []
+        kv = getattr(self.engine, "kv", None)
+        if kv is None:
+            return []
+        with self.engine.lock:
+            return kv.prefix_inventory(self._prefix_inventory_max)
+
+    def prefix_fetch_stats(self) -> dict:
+        """Fetch-side counters for the supervisor snapshot / Prometheus
+        (`llmctl_fleet_prefix_fetch_*`). fetch_ms is the bounded recent
+        window of ALL attempts (hits, misses, aborts); fetch_count the
+        cumulative attempt total the histogram pump deltas on."""
+        with self._state_lock:
+            return {
+                "fetches": self.prefix_fetches,
+                "pages": self.prefix_fetch_pages,
+                "bytes": self.prefix_fetch_bytes,
+                "misses": self.prefix_fetch_misses,
+                "aborts": self.prefix_fetch_aborts,
+                "fetch_ms": list(self.prefix_fetch_ms),
+                "fetch_count": (self.prefix_fetches
+                                + self.prefix_fetch_misses
+                                + self.prefix_fetch_aborts),
+            }
+
+    def _fetch_prefix(self, req: Request, hashes: list) -> Optional[dict]:
+        """Engine prefix_fetch_hook: fetch ``hashes``' pages from the
+        request's hinted owner through the injected fetcher (courier /
+        worker sockets). Returns {"hashes": [bytes], "pages": payload}
+        or None; every failure mode is counted and degrades to plain
+        prefill on the caller side."""
+        fetcher = self.prefix_fetcher
+        if (fetcher is None or not self._prefix_fetch
+                or len(hashes) < self._prefix_fetch_min_pages):
+            return None
+        owner = getattr(req, "prefix_owner", None)
+        if owner is None or owner == self.replica_id:
+            return None
+        t0 = time.perf_counter()
+        payload, aborted = None, False
+        try:
+            payload = fetcher(self.replica_id, owner,
+                              getattr(req, "prefix_owner_endpoint", None),
+                              list(hashes))
+        except Exception as e:      # TransferAborted + wire surprises
+            aborted = True
+            logger.warning(
+                "replica %d: prefix fetch from replica %s aborted for "
+                "%s (%s); falling back to plain prefill",
+                self.replica_id, owner, req.request_id, e)
+        out = None
+        if payload is not None and not aborted:
+            hx = payload.get("hashes") or []
+            pages = payload.get("pages")
+            try:
+                hb = [bytes.fromhex(h) if isinstance(h, str) else h
+                      for h in hx]
+            except (ValueError, TypeError):
+                hb, pages = [], None
+            if hb and isinstance(pages, dict):
+                out = {"hashes": hb, "pages": pages}
+        ms = (time.perf_counter() - t0) * 1e3
+        with self._state_lock:
+            self.prefix_fetch_ms.append(float(ms))
+            if aborted:
+                self.prefix_fetch_aborts += 1
+            elif out is None:
+                self.prefix_fetch_misses += 1
+            else:
+                self.prefix_fetches += 1
+                self.prefix_fetch_pages += int(
+                    out["pages"].get("num_pages", 0))
+                self.prefix_fetch_bytes += migration.payload_nbytes(
+                    out["pages"])
+        return out
+
+    def request_prefix_extract(self, hashes: list,
+                               timeout_s: Optional[float] = None
+                               ) -> Optional[dict]:
+        """Owner half of the fleet prefix fetch: extract the cached pages
+        for (a prefix of) ``hashes`` as a courier-encodable payload
+        {"prefix": True, "hashes": [hex], "pages": {...}}. The extraction
+        itself runs ON the engine thread at the next loop boundary — the
+        donated page buffers are only safe to read between dispatches —
+        and this caller waits (bounded). None = nothing cached, replica
+        down, or timeout: the fetcher counts a miss and re-prefills."""
+        if not hashes:
+            return None
+        with self._state_lock:
+            if self.state in (CRASHED, STOPPED):
+                return None
+        if self._thread is None or not self._thread.is_alive():
+            # offline/unit use: no engine thread is dispatching, so the
+            # buffers are stable and direct extraction is safe
+            return self._extract_prefix_payload(hashes)
+        job = {"hashes": list(hashes), "event": threading.Event(),
+               "result": None}
+        with self._state_lock:
+            self._prefix_jobs.append(job)
+        self._wake.set()
+        if not job["event"].wait(
+                timeout=timeout_s or self._prefix_fetch_timeout_s):
+            return None
+        return job["result"]
+
+    def _service_prefix_extracts(self) -> None:
+        """Answer queued prefix-extract jobs (engine thread, between
+        steps). Per-job failures — a deleted-buffer race with an
+        in-flight dispatch, a released engine — answer None (the fetcher
+        re-prefills) instead of killing the replica."""
+        with self._state_lock:
+            jobs, self._prefix_jobs = self._prefix_jobs, []
+        for job in jobs:
+            try:
+                job["result"] = self._extract_prefix_payload(job["hashes"])
+            except Exception:
+                logger.exception(
+                    "replica %d prefix extract failed", self.replica_id)
+                job["result"] = None
+            job["event"].set()
+
+    def _extract_prefix_payload(self, hashes: list) -> Optional[dict]:
+        eng = self.engine
+        kv = getattr(eng, "kv", None)
+        if kv is None:
+            return None
+        try:
+            with eng.lock:
+                pages = kv.lookup_prefix(hashes)
+                if not pages:
+                    return None
+                payload = {
+                    "prefix": True,
+                    # hex: the manifest crosses JSON on the HTTP courier
+                    "hashes": [h.hex() for h in hashes[:len(pages)]],
+                    "pages": kv.extract_pages(pages),
+                }
+            return payload
+        except Exception as e:
+            # deleted donated buffers (a dispatch in flight on another
+            # thread) and friends: a miss, never an error — the fetcher
+            # falls back to prefill
+            logger.warning("replica %d prefix extract degraded to miss "
+                           "(%s)", self.replica_id, e)
+            return None
+
+    def _fail_prefix_jobs(self) -> None:
+        """Release extract waiters when this replica stops/crashes (their
+        fetchers then count a miss instead of blocking to timeout)."""
+        with self._state_lock:
+            jobs, self._prefix_jobs = self._prefix_jobs, []
+        for job in jobs:
+            job["event"].set()
+
     def stop(self, timeout: float = 10.0) -> None:
         self._stop.set()
         self._wake.set()
@@ -675,6 +887,7 @@ class EngineReplica:
         with self._state_lock:
             if self.state != CRASHED:
                 self.state = STOPPED
+        self._fail_prefix_jobs()
 
     def teardown(self) -> list[Request]:
         """Stop the thread and extract whatever was still in flight (used

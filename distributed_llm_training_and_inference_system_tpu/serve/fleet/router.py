@@ -81,7 +81,7 @@ def _needs_prefill(req: Request) -> bool:
 class FleetRouter:
     def __init__(self, replicas: Iterable, cfg: Optional[FleetConfig] = None,
                  observer: Optional[Callable[[str, dict], None]] = None,
-                 courier=None):
+                 courier=None, page_size: int = 0):
         self.cfg = cfg or FleetConfig()
         self.replicas = list(replicas)
         self.by_id = {r.replica_id: r for r in self.replicas}
@@ -90,6 +90,17 @@ class FleetRouter:
         # placement ships the pages through it src->dest before submit.
         # None = legacy direct hand-off (fake-replica unit tests).
         self.courier = courier
+        # fleet-global prefix cache: with page_size > 0 (and
+        # cfg.prefix_fetch), every needs-prefill placement gets a
+        # `prefix_owner` hint — the replica (other than the destination)
+        # whose advertised prefix-page inventory covers the longest
+        # chain prefix of the prompt. 0 disables hints entirely (plain
+        # engines, fake-replica unit tests).
+        self.page_size = int(page_size)
+        try:
+            self._endpoints = self.cfg.endpoint_map()
+        except Exception:
+            self._endpoints = {}
         # _lock guards router bookkeeping ONLY. It is never held across a
         # replica.submit() call: submit takes the engine lock, and the
         # engine thread calls back into on_request_exit under that same
@@ -184,6 +195,73 @@ class FleetRouter:
         return (sum(r.queue_depth() for r in self.replicas)
                 + len(self._parked))
 
+    # -- fleet-global prefix-cache hints -------------------------------------
+
+    def _hints_enabled(self, req: Request) -> bool:
+        return (self.page_size > 0 and self.cfg.prefix_fetch
+                and req.swapped_kv is None)
+
+    def _inventories(self) -> dict:
+        """Per-replica prefix-page hash sets, read fresh at placement
+        time. Crashed/stopped replicas are skipped (their cache died or
+        is dark); DRAINED ones are not — a drained replica's engine is
+        alive and serving its pages is exactly the flash-crowd-spill
+        case this plane exists for."""
+        from .replica import CRASHED, STOPPED
+        out = {}
+        for r in self.replicas:
+            inv = getattr(r, "prefix_inventory", None)
+            if inv is None or getattr(r, "state", None) in (CRASHED,
+                                                            STOPPED):
+                continue
+            try:
+                hashes = inv()
+            except Exception:
+                hashes = ()
+            if hashes:
+                out[r.replica_id] = set(hashes)
+        return out
+
+    def _attach_prefix_hint(self, req: Request, dest_id: int,
+                            invs: dict) -> None:
+        """Stamp ``req.prefix_owner`` (+ courier endpoint) with the
+        replica whose inventory covers the destination's prompt better
+        than the destination itself does — the destination then FETCHES
+        those pages instead of re-prefilling. Advisory only: a stale
+        hint costs one counted miss, never wrong tokens."""
+        req.prefix_owner = None
+        req.prefix_owner_endpoint = None
+        if not invs:
+            return
+        if req.prefix_hashes is None:
+            from ..kv_cache import prefix_page_hashes
+            req.prefix_hashes = prefix_page_hashes(
+                req.context_tokens, self.page_size)
+        hashes = req.prefix_hashes
+        usable = min(len(hashes),
+                     max((len(req.context_tokens) - 1) // self.page_size,
+                         0))
+        if usable == 0:
+            return
+
+        def coverage(inv) -> int:
+            c = 0
+            while c < usable and hashes[c] in inv:
+                c += 1
+            return c
+
+        best, best_cov = None, coverage(invs.get(dest_id, ()))
+        for rid, inv in invs.items():
+            if rid == dest_id:
+                continue
+            c = coverage(inv)
+            if c > best_cov or (c == best_cov and best is not None
+                                and rid < best):
+                best, best_cov = rid, c
+        if best is not None:
+            req.prefix_owner = best
+            req.prefix_owner_endpoint = self._endpoints.get(best)
+
     # -- submission ----------------------------------------------------------
 
     def submit(self, prompt_tokens: Sequence[int],
@@ -211,7 +289,10 @@ class FleetRouter:
             self._meta[req.request_id] = {"requeues": 0, "replica": None}
             if on_complete is not None:
                 self._waiters[req.request_id] = on_complete
+        invs = self._inventories() if self._hints_enabled(req) else {}
         for i, r in enumerate(cands):
+            if invs:
+                self._attach_prefix_hint(req, r.replica_id, invs)
             if r.submit(req):
                 with self._lock:
                     self.total_submitted += 1
@@ -423,7 +504,10 @@ class FleetRouter:
         while True:
             cands, _ = self._candidates(req.prompt_tokens, exclude=exclude,
                                         needs_prefill=_needs_prefill(req))
+            invs = self._inventories() if self._hints_enabled(req) else {}
             for r in cands:
+                if invs:
+                    self._attach_prefix_hint(req, r.replica_id, invs)
                 if not self._ship(req, src, r.replica_id):
                     # courier abort dropped the payload; the candidate
                     # order (decode-first, affinity-skipped) is stale —

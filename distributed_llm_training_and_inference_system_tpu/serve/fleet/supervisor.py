@@ -493,6 +493,11 @@ class ReplicaSupervisor:
         pauses: list[float] = []
         stalls: list[float] = []
         by_reason: dict[str, int] = {}
+        # fleet-global prefix cache: fetch-side aggregates (counters are
+        # running totals; fetch_ms a bounded recent window + cumulative
+        # count — the usual Prometheus delta contract)
+        fetch_agg = {"fetches": 0, "pages": 0, "bytes": 0, "misses": 0,
+                     "aborts": 0, "fetch_ms": [], "fetch_count": 0}
         try:
             endpoints = self.cfg.endpoint_map()
         except Exception:
@@ -504,6 +509,12 @@ class ReplicaSupervisor:
             stalls.extend(getattr(r, "handoff_stalls_ms", ()))
             for reason, n in r.migrations_by_reason.items():
                 by_reason[reason] = by_reason.get(reason, 0) + n
+            pf = (r.prefix_fetch_stats()
+                  if hasattr(r, "prefix_fetch_stats") else {})
+            for key in ("fetches", "pages", "bytes", "misses", "aborts",
+                        "fetch_count"):
+                fetch_agg[key] += int(pf.get(key, 0))
+            fetch_agg["fetch_ms"].extend(pf.get("fetch_ms", ()))
             reps.append({
                 "replica": r.replica_id,
                 "state": r.state,
@@ -526,6 +537,11 @@ class ReplicaSupervisor:
                 "prefix_hits": hits,
                 "prefix_queries": queries,
                 "prefix_hit_rate": round(hits / max(queries, 1), 4),
+                # fleet-global prefix cache: pages this replica pulled
+                # from siblings instead of re-prefilling, and the
+                # attempts that came back empty
+                "prefix_fetch_pages": int(pf.get("pages", 0)),
+                "prefix_fetch_misses": int(pf.get("misses", 0)),
             })
         migration = {
             "migrations": sum(r.migrations_out for r in self.replicas),
@@ -570,6 +586,10 @@ class ReplicaSupervisor:
         return {"replicas": reps, "router": self.router.stats(),
                 "restarts": self.total_restarts, "migration": migration,
                 "handoff": handoff,
+                # fleet-global prefix cache: fetched-instead-of-
+                # recomputed pages/bytes, misses, aborts + the fetch
+                # latency window (feeds llmctl_fleet_prefix_fetch_*)
+                "prefix_fetch": fetch_agg,
                 # per-replica courier endpoint map (string keys: JSON)
                 "endpoints": {str(k): v for k, v in endpoints.items()},
                 "courier": courier.snapshot() if courier else {}}

@@ -744,6 +744,12 @@ class KVCourier:
                            == "http")
         self.ship_timeout_s = float(getattr(cfg, "courier_ship_timeout_s",
                                             30.0))
+        # fleet-global prefix cache: per-replica owner-side extractors
+        # for IN-PROC replicas (replica_id -> request_prefix_extract);
+        # remote owners are reached over /fleet/courier/fetch instead.
+        self.prefix_providers: dict[int, object] = {}
+        self.fetch_timeout_s = float(getattr(
+            cfg, "prefix_fetch_timeout_s", 5.0) or 5.0)
         self.local_transport = InProcTransport(
             cfg, injector=injector, stats=self.stats,
             receiver=self.receiver)
@@ -837,6 +843,73 @@ class KVCourier:
         with self._lock:
             slot["transfers"] += 1
         return True
+
+    # -- fleet-global prefix fetch -------------------------------------------
+
+    def fetch_prefix(self, fetcher_id: int, owner_id,
+                     owner_endpoint: Optional[str],
+                     hashes: list) -> Optional[dict]:
+        """Fetch the prefix pages for ``hashes`` from their owning
+        replica on behalf of in-proc replica ``fetcher_id`` — the fetch
+        verb of the courier. Two physical paths, one contract:
+
+        - owner in-proc: its registered provider extracts on the owner's
+          engine thread, then the payload crosses the SAME chunked
+          frame->verify path every other payload rides (in-proc
+          transport, injector chaos applies) and is claimed from the
+          local ready store;
+        - owner remote: POST ``/fleet/courier/fetch`` commands the owner
+          worker to extract and PUSH the chunks to this process's own
+          courier endpoint (``fleet_endpoints[fetcher_id]`` — an in-proc
+          fetcher must be reachable, same rule as worker-to-worker
+          ships), then the payload is claimed locally by ticket.
+
+        Returns the decoded {"hashes": [hex], "pages": {...}} payload,
+        None on a miss (owner has nothing / no endpoint / expired
+        ticket), and raises TransferAborted when the transfer itself
+        failed — the caller counts it and re-prefills either way."""
+        ticket = f"courier-{uuid.uuid4().hex[:16]}"
+        provider = self.prefix_providers.get(owner_id)
+        if provider is not None:
+            payload = provider(hashes, self.fetch_timeout_s)
+            if not payload:
+                return None
+            self.local_transport.transfer(payload, src=owner_id,
+                                          dest=fetcher_id, ticket=ticket)
+            return self.receiver.take_payload(ticket)
+        ep = (owner_endpoint or self.endpoints.get(owner_id)
+              or "").rstrip("/")
+        dest_ep = self.endpoints.get(fetcher_id)
+        if not ep or not dest_ep:
+            logger.info(
+                "prefix fetch %s -> %s skipped: no courier endpoint "
+                "(owner %r, fetcher %r)", owner_id, fetcher_id,
+                ep or None, dest_ep)
+            return None
+        body = {"replica": owner_id,
+                "hashes": [h.hex() if isinstance(h, bytes) else str(h)
+                           for h in hashes],
+                "ticket": ticket, "dest": fetcher_id,
+                "dest_endpoint": dest_ep}
+        try:
+            if self.injector is not None:
+                self.injector.on_rpc(owner_id)
+            import urllib.request
+            wire = urllib.request.Request(
+                f"{ep}/fleet/courier/fetch",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(
+                    wire, timeout=self.fetch_timeout_s) as resp:
+                out = json.loads(resp.read().decode())
+        except Exception as e:
+            raise TransferAborted(
+                f"prefix fetch command to replica {owner_id} failed: "
+                f"{e}") from e
+        if not out.get("ok"):
+            return None
+        return self.receiver.take_payload(ticket)
 
     def _ship_remote_held(self, req, stub: dict, at: int,
                           dest: int) -> bool:

@@ -87,6 +87,12 @@ class FleetWorker:
         # places + ships it
         self.replica.handoff_dest = lambda req, rid: -1
         self.replica.on_handoff = self._on_handoff
+        # fleet-global prefix cache: this worker fetches missing prefix
+        # pages itself (the owner hint + endpoint ride the submit wire);
+        # set once run_forever knows the bound address — a worker driven
+        # directly in tests can set it by hand
+        self.self_endpoint: Optional[str] = None
+        self.replica.prefix_fetcher = self._fetch_prefix
         if warmup:
             # compile outside the serving path, then zero the prefill
             # counters the fleet's zero-re-prefill assertions read
@@ -259,6 +265,11 @@ class FleetWorker:
             "handoffs_local": r.handoffs_local,
             "prefix_hits": hits, "prefix_queries": queries,
             "requeue_cached_tokens": cached,
+            # fleet-global prefix cache: the compact inventory (hex) the
+            # parent's router turns into fetch hints, plus this
+            # replica's fetch-side counters
+            "prefix_pages": [h.hex() for h in r.prefix_inventory()],
+            "prefix_fetch": r.prefix_fetch_stats(),
             "engine_restarts": self._restarts,
             "total_prefill_tokens": getattr(eng, "total_prefill_tokens",
                                             0),
@@ -303,6 +314,71 @@ class FleetWorker:
         out["courier"] = {**self.courier_stats.snapshot(),
                           **self.receiver.stats()}
         return out
+
+    # -- fleet-global prefix cache -------------------------------------------
+
+    def _fetch_prefix(self, fetcher_id: int, owner,
+                      owner_endpoint: Optional[str],
+                      hashes: list) -> Optional[dict]:
+        """Fetch half, worker flavor: command the OWNER's front (worker
+        or parent fleet server — both serve /fleet/courier/fetch) to
+        extract + push the pages to this worker's own courier endpoint,
+        then claim them locally by ticket. None = miss; raises
+        TransportError-shaped failures as plain exceptions the replica
+        counts as aborts."""
+        ep = (owner_endpoint or "").rstrip("/")
+        me = self.self_endpoint
+        if not ep or not me:
+            return None
+        ticket = f"courier-{uuid.uuid4().hex[:16]}"
+        body = {"replica": owner,
+                "hashes": [h.hex() if isinstance(h, bytes) else str(h)
+                           for h in hashes],
+                "ticket": ticket, "dest": self.replica.replica_id,
+                "dest_endpoint": me}
+        import urllib.request
+        wire = urllib.request.Request(
+            f"{ep}/fleet/courier/fetch",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(
+                wire,
+                timeout=self.fleet_cfg.prefix_fetch_timeout_s) as resp:
+            out = json.loads(resp.read().decode())
+        if not out.get("ok"):
+            return None
+        return self.receiver.take_payload(ticket)
+
+    def prefix_fetch(self, body: dict) -> dict:
+        """Owner side of ``POST /fleet/courier/fetch`` (alias
+        ``/worker/prefix``): extract the requested prefix pages on the
+        engine thread and push them, chunked, to the fetcher's courier
+        endpoint. A miss (nothing cached, evicted since advertised) is
+        an ok=False answer, not an error — the fetcher re-prefills."""
+        try:
+            hashes = [bytes.fromhex(h) for h in body.get("hashes", [])]
+        except (TypeError, ValueError):
+            return {"ok": False, "error": "malformed hashes"}
+        ticket = str(body.get("ticket") or "")
+        dest_ep = str(body.get("dest_endpoint") or "").rstrip("/")
+        if not hashes or not ticket or not dest_ep:
+            return {"ok": False, "error":
+                    "body must be {hashes, ticket, dest_endpoint}"}
+        payload = self.replica.request_prefix_extract(
+            hashes, timeout_s=self.fleet_cfg.prefix_fetch_timeout_s)
+        if not payload:
+            return {"ok": False, "error": "prefix pages not cached"}
+        transport = HTTPCourierTransport(
+            self.fleet_cfg, injector=self.injector,
+            stats=self.courier_stats, endpoint=dest_ep)
+        try:
+            transport.transfer(payload,
+                               src=self.replica.replica_id,
+                               dest=body.get("dest"), ticket=ticket)
+        except TransportError as e:
+            return {"ok": False, "error": str(e)}
+        return {"ok": True, "ticket": ticket,
+                "covered": int(payload["pages"]["num_pages"])}
 
     # -- aiohttp front -------------------------------------------------------
 
@@ -352,6 +428,15 @@ class FleetWorker:
             out = await loop.run_in_executor(None, worker.ship, body)
             return web.json_response(out)
 
+        async def prefix(request, body):
+            # extract waits on the engine thread and the push retries:
+            # both belong off the event loop (inbound chunks from OTHER
+            # transfers must keep landing mid-fetch)
+            loop = asyncio.get_running_loop()
+            out = await loop.run_in_executor(None, worker.prefix_fetch,
+                                             body)
+            return web.json_response(out)
+
         async def drain(request, body):
             worker.replica.request_drain()
             return web.json_response({"ok": True})
@@ -398,6 +483,12 @@ class FleetWorker:
         app.router.add_get("/worker/probe", probe)
         app.router.add_post("/worker/outbox/take", json_body(outbox_take))
         app.router.add_post("/worker/ship", json_body(ship))
+        # fleet-global prefix fetch, owner side: /worker/prefix is the
+        # worker-flavored name, /fleet/courier/fetch the uniform one the
+        # fetchers actually POST (the parent fleet front serves the same
+        # path for its in-proc replicas)
+        app.router.add_post("/worker/prefix", json_body(prefix))
+        app.router.add_post("/fleet/courier/fetch", json_body(prefix))
         app.router.add_post("/worker/drain", json_body(drain))
         app.router.add_post("/worker/undrain", json_body(undrain))
         app.router.add_post("/worker/role", json_body(role))
@@ -420,6 +511,9 @@ class FleetWorker:
             site = web.TCPSite(runner, host, port)
             await site.start()
             bound = runner.addresses[0][1]
+            # our own courier endpoint: prefix fetches ask owners to
+            # push here
+            self.self_endpoint = f"http://{host}:{bound}"
             self.start()
             print(f"LLMCTL_WORKER_READY port={bound}", flush=True)
             logger.info("fleet worker replica %d (%s) serving on %s:%d",

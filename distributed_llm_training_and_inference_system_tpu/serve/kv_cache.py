@@ -261,7 +261,23 @@ class PagedKVCache:
             raise ValueError(
                 f"extract_slot_pages: range [{lo}, {hi}) outside slot "
                 f"{slot}'s chain of {chain} page(s)")
-        pages = self.block_tables[slot, lo:hi].copy()
+        return self._extract_pages_idx(self.block_tables[slot, lo:hi].copy())
+
+    def extract_pages(self, pages: list[int]) -> dict:
+        """Copy arbitrary page ids to host memory — the owner half of the
+        fleet-global prefix fetch (serve/fleet/): the pages come from
+        ``lookup_prefix``, not any slot's chain. Same payload schema as
+        :meth:`extract_slot_pages`. Page ids are bounds-checked (scratch
+        page 0 is never a cache page; an out-of-range id would gather
+        garbage presented as real KV)."""
+        bad = [int(p) for p in pages if not 0 < int(p) < self.num_pages]
+        if bad:
+            raise ValueError(
+                f"extract_pages: page id(s) {bad} outside (0, "
+                f"{self.num_pages})")
+        return self._extract_pages_idx(np.asarray(pages, np.int32))
+
+    def _extract_pages_idx(self, pages: np.ndarray) -> dict:
         idx = jnp.asarray(pages)
 
         def grab(buf):
@@ -271,7 +287,7 @@ class PagedKVCache:
                         "scale": np.asarray(buf.scale[:, idx])}
             return np.asarray(buf[:, idx])
         return {"k": grab(self.k_pages), "v": grab(self.v_pages),
-                "num_pages": int(hi - lo)}
+                "num_pages": int(len(pages))}
 
     def _restore_fn(self, n_bucket: int):
         """Jitted donated page-write for swap-in: out-of-place .at[].set
@@ -315,8 +331,28 @@ class PagedKVCache:
 
     def _validate_payload(self, slot: int, content: dict, lo: int) -> int:
         """Schema + bounds check for a restore payload; returns its page
-        count. Raises ValueError naming exactly what is malformed."""
-        from ..ops.paged_attention import QuantPages
+        count. Raises ValueError naming exactly what is malformed.
+        Bounds before shapes, so a wrong page COUNT names the slot's
+        chain rather than a derived shape mismatch."""
+        n = self._parse_num_pages(content)
+        chain = self._chain_len.get(slot, 0)
+        if lo < 0 or lo + n > chain:
+            raise ValueError(
+                f"restore payload covers chain entries [{lo}, {lo + n}) "
+                f"but slot {slot} owns only {chain} page(s)")
+        self._validate_pages_shapes(content, n)
+        return n
+
+    def _validate_pages_content(self, content: dict) -> int:
+        """Schema/shape validation with no slot bounds — the
+        ``insert_prefix_pages`` flavor, whose fetched pages belong to no
+        slot. Returns the payload's page count."""
+        n = self._parse_num_pages(content)
+        self._validate_pages_shapes(content, n)
+        return n
+
+    @staticmethod
+    def _parse_num_pages(content) -> int:
         if not isinstance(content, dict) or "num_pages" not in content \
                 or "k" not in content or "v" not in content:
             raise ValueError(
@@ -331,11 +367,10 @@ class PagedKVCache:
                 f"{content['num_pages']!r}") from None
         if n < 0:
             raise ValueError(f"restore payload num_pages {n} < 0")
-        chain = self._chain_len.get(slot, 0)
-        if lo < 0 or lo + n > chain:
-            raise ValueError(
-                f"restore payload covers chain entries [{lo}, {lo + n}) "
-                f"but slot {slot} owns only {chain} page(s)")
+        return n
+
+    def _validate_pages_shapes(self, content: dict, n: int) -> None:
+        from ..ops.paged_attention import QuantPages
         cfg = self.cfg
         expect = (cfg.num_layers, n, cfg.num_kv_heads, self.page_size,
                   cfg.head_dim)
@@ -366,7 +401,6 @@ class PagedKVCache:
                     raise ValueError(
                         f"restore payload '{name}' shape {got} != "
                         f"expected {expect}")
-        return n
 
     def write_slot_pages(self, slot: int, content: dict,
                          lo: int = 0) -> None:
@@ -388,11 +422,21 @@ class PagedKVCache:
         n = self._validate_payload(slot, content, lo)
         if n <= 0:
             return
+        self._write_pages_idx(self.block_tables[slot, lo:lo + n],
+                              content["k"], content["v"])
+
+    def _write_pages_idx(self, pages: np.ndarray, kd, vd) -> None:
+        """Write n pages of host K/V content into the given page ids via
+        the jitted donated scatter (power-of-two bucketed; pad entries
+        target scratch page 0)."""
+        n = int(len(pages))
+        if n <= 0:
+            return
         bucket = 1
         while bucket < n:
             bucket <<= 1
         idx = np.zeros(bucket, np.int32)        # pad -> scratch page 0
-        idx[:n] = self.block_tables[slot, lo:lo + n]
+        idx[:n] = pages
 
         def pad(data):
             if isinstance(data, dict):
@@ -401,9 +445,7 @@ class PagedKVCache:
                            data.dtype)
             out[:, :n] = data
             return out
-        kd, vd = pad(content["k"]), pad(content["v"])
-        to_dev = (lambda d: {k: jnp.asarray(v) for k, v in d.items()}
-                  if isinstance(d, dict) else jnp.asarray(d))
+        kd, vd = pad(kd), pad(vd)
         from ..ops.paged_attention import QuantPages
         def as_arg(buf, d):
             if isinstance(buf, QuantPages):
@@ -460,6 +502,67 @@ class PagedKVCache:
             if h not in self._hash_to_page and page not in self._page_to_hash:
                 self._hash_to_page[h] = page
                 self._page_to_hash[page] = h
+
+    def insert_prefix_pages(self, hashes: list[bytes],
+                            content: dict) -> list[int]:
+        """Import FETCHED prefix pages (fleet-global prefix cache): write
+        ``content``'s page columns into freshly-taken free pages and
+        publish them under ``hashes`` (column i <-> hashes[i]).
+
+        First writer wins exactly like :meth:`register_pages`: a hash
+        already cached here (a concurrent fetch or a local prefill raced
+        us) keeps its existing page and the fetched copy for that
+        position is discarded — the chain hash guarantees the content is
+        identical, so either page serves the same K/V. A dry pool stops
+        the insert early (partial import; the uncovered tail re-prefills)
+        rather than evicting pages a resident request may be about to
+        hit. Inserted pages enter the cache EVICTABLE (ref 0) — callers
+        that need them to survive until a prefill must pin them under
+        the same lock (the eviction-between-insert-and-pin race is the
+        same one ``lookup_prefix`` documents).
+
+        Returns the page ids actually claimed (not the skipped
+        duplicates)."""
+        n = self._validate_pages_content(content)
+        if n < len(hashes):
+            raise ValueError(
+                f"insert_prefix_pages: payload carries {n} page(s) for "
+                f"{len(hashes)} hash(es)")
+        take_pos: list[int] = []
+        pages: list[int] = []
+        for i, h in enumerate(hashes):
+            if h in self._hash_to_page:
+                continue                   # duplicate: first writer wins
+            if not self._free and not self._evictable:
+                break                      # pool dry: partial import
+            pages.append(self._take_free_page())
+            take_pos.append(i)
+        if not pages:
+            return []
+
+        def part(data):
+            if isinstance(data, dict):
+                return {k: part(v) for k, v in data.items()}
+            return np.ascontiguousarray(np.asarray(data)[:, take_pos])
+        self._write_pages_idx(np.asarray(pages, np.int32),
+                              part(content["k"]), part(content["v"]))
+        for i, p in zip(take_pos, pages):
+            self._hash_to_page[hashes[i]] = p
+            self._page_to_hash[p] = hashes[i]
+            self._evictable[p] = None      # ref 0 until a request pins it
+        return pages
+
+    def prefix_inventory(self, max_entries: int = 0) -> list[bytes]:
+        """The page hashes currently cached here — the compact inventory
+        a fleet replica advertises so the router can attach
+        prefix-owner hints. ``max_entries > 0`` keeps only the NEWEST
+        that many (dict order is registration order), bounding probe
+        payloads; the hint is advisory, so a truncated inventory only
+        costs missed fetch opportunities."""
+        keys = list(self._hash_to_page.keys())
+        if max_entries > 0:
+            keys = keys[-max_entries:]
+        return keys
 
     def stats(self) -> dict:
         return {

@@ -84,6 +84,11 @@ class LoadResult:
     # percentiles — reported alongside handoff stall so an operator can
     # split "the crossing was slow" from "the link was lossy"
     courier: dict = field(default_factory=dict)
+    # fleet-global prefix cache: pages fetched from sibling replicas
+    # instead of re-prefilled (the --serve-hot-prefix flash-crowd
+    # scenario's payoff readout), with miss/abort counts and fetch
+    # latency percentiles
+    prefix_fetch: dict = field(default_factory=dict)
 
     def percentile(self, xs, q):
         return float(np.percentile(np.asarray(xs), q)) if xs else float("nan")
@@ -124,6 +129,8 @@ class LoadResult:
                 "phases": self.phases}
                if self.phases else {}),
             **({"courier": self.courier} if self.courier else {}),
+            **({"prefix_fetch": self.prefix_fetch}
+               if self.prefix_fetch else {}),
         }
 
 
@@ -277,6 +284,24 @@ def _finalize_fleet(res: LoadResult, reqs: list, fleet,
             "p99_transfer_ms": pct3(xfer, 99),
         }
 
+    # fleet-global prefix cache: fetched-instead-of-recomputed pages —
+    # nonzero whenever admission spilled off a warm owner and the fetch
+    # plane recovered the pages
+    pf = snap.get("prefix_fetch", {})
+    if pf.get("pages", 0) or pf.get("misses", 0) or pf.get("aborts", 0):
+        def pct4(xs, q):
+            return round(res.percentile(xs, q), 2) if xs else None
+        window = pf.get("fetch_ms", [])
+        res.prefix_fetch = {
+            "fetches": pf.get("fetches", 0),
+            "pages": pf.get("pages", 0),
+            "bytes": pf.get("bytes", 0),
+            "misses": pf.get("misses", 0),
+            "aborts": pf.get("aborts", 0),
+            "p50_fetch_ms": pct4(window, 50),
+            "p99_fetch_ms": pct4(window, 99),
+        }
+
     for rid, slot in sorted(by_replica.items()):
         res.per_replica[rid] = {
             "requests": slot["requests"],
@@ -335,9 +360,17 @@ def _drain_retryq(fleet, retryq, max_tokens, reqs, events, res,
                       retryq=retryq, max_retries=max_retries, tries=x[2])
 
 
+def _hot_prefix(rng, hi, prompt_len, hot_prefix_len: int) -> list:
+    """The shared head every flash-crowd prompt starts with (drawn once
+    per run, seeded); clamped to leave at least one distinct tail
+    token so prompts differ."""
+    k = min(max(hot_prefix_len, 0), max(prompt_len - 1, 0))
+    return [int(t) for t in rng.integers(1, hi, size=k)] if k else []
+
+
 def _run_poisson_fleet(fleet, *, offered_rps, num_requests, prompt_len,
                        max_tokens, seed, vocab_hi, prompt_pool,
-                       max_retries=0) -> LoadResult:
+                       max_retries=0, hot_prefix_len=0) -> LoadResult:
     """Open-loop arrivals against a fleet router: replica threads do the
     stepping; the generator only submits on schedule and waits. The
     supervisor is polled inline when no background supervisor runs, so
@@ -346,7 +379,9 @@ def _run_poisson_fleet(fleet, *, offered_rps, num_requests, prompt_len,
     hi = vocab_hi or fleet.model_cfg.vocab_size
     gaps = rng.exponential(1.0 / offered_rps, size=num_requests)
     arrivals = np.cumsum(gaps)
-    pool = [rng.integers(1, hi, size=prompt_len).tolist()
+    hot = _hot_prefix(rng, hi, prompt_len, hot_prefix_len)
+    pool = [hot + rng.integers(1, hi,
+                               size=prompt_len - len(hot)).tolist()
             for _ in range(max(prompt_pool, 1))]
     reqs: list[Request] = []
     events: list = []
@@ -360,7 +395,8 @@ def _run_poisson_fleet(fleet, *, offered_rps, num_requests, prompt_len,
         now = time.monotonic() - t0
         while i < num_requests and arrivals[i] <= now:
             prompt = (pool[int(rng.integers(len(pool)))] if prompt_pool
-                      else rng.integers(1, hi, size=prompt_len).tolist())
+                      else hot + rng.integers(
+                          1, hi, size=prompt_len - len(hot)).tolist())
             _submit_fleet(fleet, prompt, max_tokens, reqs, events, res,
                           retryq=retryq, max_retries=max_retries)
             i += 1
@@ -375,9 +411,10 @@ def _run_poisson_fleet(fleet, *, offered_rps, num_requests, prompt_len,
 
 def _run_closed_loop_fleet(fleet, *, concurrency, num_requests, prompt_len,
                            max_tokens, seed, vocab_hi,
-                           max_retries=0) -> LoadResult:
+                           max_retries=0, hot_prefix_len=0) -> LoadResult:
     rng = np.random.default_rng(seed)
     hi = vocab_hi or fleet.model_cfg.vocab_size
+    hot = _hot_prefix(rng, hi, prompt_len, hot_prefix_len)
     reqs: list[Request] = []
     events: list = []
     retryq: list = []
@@ -390,7 +427,9 @@ def _run_closed_loop_fleet(fleet, *, concurrency, num_requests, prompt_len,
         in_flight = sum(1 for e in events if not e.is_set())
         while submitted < num_requests and in_flight < concurrency:
             _submit_fleet(fleet,
-                          rng.integers(1, hi, size=prompt_len).tolist(),
+                          hot + rng.integers(
+                              1, hi,
+                              size=prompt_len - len(hot)).tolist(),
                           max_tokens, reqs, events, res,
                           retryq=retryq, max_retries=max_retries)
             submitted += 1
@@ -408,6 +447,7 @@ def run_poisson(engine: InferenceEngine, *, offered_rps: float,
                 num_requests: int, prompt_len: int, max_tokens: int,
                 seed: int = 0, vocab_hi: Optional[int] = None,
                 prompt_pool: int = 0, max_retries: int = 0,
+                hot_prefix_len: int = 0,
                 device_times: bool = False) -> LoadResult:
     """Open-loop run: arrivals follow a seeded Poisson process regardless of
     engine progress; steps until everything admitted drains.
@@ -421,18 +461,24 @@ def run_poisson(engine: InferenceEngine, *, offered_rps: float,
     rejections final). Ignored for plain engines (no 429 path).
 
     ``prompt_pool > 0`` draws prompts from that many distinct prompts
-    (prefix-cache-friendly workloads); 0 = every prompt unique."""
+    (prefix-cache-friendly workloads); 0 = every prompt unique.
+    ``hot_prefix_len > 0`` is the flash-crowd scenario: every prompt
+    shares the same seeded hot head with a random tail — on a fleet
+    this is the workload where off-affinity spill exercises the
+    fleet-global prefix fetch (LoadResult.prefix_fetch)."""
     if _is_fleet(engine):
         return _run_poisson_fleet(
             engine, offered_rps=offered_rps, num_requests=num_requests,
             prompt_len=prompt_len, max_tokens=max_tokens, seed=seed,
             vocab_hi=vocab_hi, prompt_pool=prompt_pool,
-            max_retries=max_retries)
+            max_retries=max_retries, hot_prefix_len=hot_prefix_len)
     rng = np.random.default_rng(seed)
     hi = vocab_hi or engine.cfg.vocab_size
     gaps = rng.exponential(1.0 / offered_rps, size=num_requests)
     arrivals = np.cumsum(gaps)
-    pool = [rng.integers(1, hi, size=prompt_len).tolist()
+    hot = _hot_prefix(rng, hi, prompt_len, hot_prefix_len)
+    pool = [hot + rng.integers(1, hi,
+                               size=prompt_len - len(hot)).tolist()
             for _ in range(max(prompt_pool, 1))]
 
     reqs: list[Request] = []
@@ -444,7 +490,8 @@ def run_poisson(engine: InferenceEngine, *, offered_rps: float,
         now = time.monotonic() - t0
         while i < num_requests and arrivals[i] <= now:
             prompt = (pool[int(rng.integers(len(pool)))] if prompt_pool
-                      else rng.integers(1, hi, size=prompt_len).tolist())
+                      else hot + rng.integers(
+                          1, hi, size=prompt_len - len(hot)).tolist())
             r = Request(request_id=f"load-{i}", prompt_tokens=prompt,
                         sampling=SamplingParams(temperature=0.0,
                                                 max_tokens=max_tokens))
@@ -468,19 +515,22 @@ def run_poisson(engine: InferenceEngine, *, offered_rps: float,
 def run_closed_loop(engine: InferenceEngine, *, concurrency: int,
                     num_requests: int, prompt_len: int, max_tokens: int,
                     seed: int = 0, vocab_hi: Optional[int] = None,
-                    max_retries: int = 0,
+                    max_retries: int = 0, hot_prefix_len: int = 0,
                     device_times: bool = False) -> LoadResult:
     """Closed-loop run: keep ``concurrency`` requests in flight (a new one
     arrives the moment one finishes) — the standard saturation probe.
     Fleet targets route through the router like run_poisson; see there for
-    ``max_retries`` (Retry-After honoring)."""
+    ``max_retries`` (Retry-After honoring) and ``hot_prefix_len`` (the
+    flash-crowd shared-prefix scenario)."""
     if _is_fleet(engine):
         return _run_closed_loop_fleet(
             engine, concurrency=concurrency, num_requests=num_requests,
             prompt_len=prompt_len, max_tokens=max_tokens, seed=seed,
-            vocab_hi=vocab_hi, max_retries=max_retries)
+            vocab_hi=vocab_hi, max_retries=max_retries,
+            hot_prefix_len=hot_prefix_len)
     rng = np.random.default_rng(seed)
     hi = vocab_hi or engine.cfg.vocab_size
+    hot = _hot_prefix(rng, hi, prompt_len, hot_prefix_len)
     reqs: list[Request] = []
     res = LoadResult(offered_rps=float("inf"))
     submitted = 0
@@ -489,8 +539,8 @@ def run_closed_loop(engine: InferenceEngine, *, concurrency: int,
     def submit():
         nonlocal submitted
         r = Request(request_id=f"load-{submitted}",
-                    prompt_tokens=rng.integers(
-                        1, hi, size=prompt_len).tolist(),
+                    prompt_tokens=hot + rng.integers(
+                        1, hi, size=prompt_len - len(hot)).tolist(),
                     sampling=SamplingParams(temperature=0.0,
                                             max_tokens=max_tokens))
         submitted += 1

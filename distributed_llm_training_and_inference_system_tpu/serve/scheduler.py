@@ -80,6 +80,14 @@ class Request:
     # key off these.
     handoff_time: Optional[float] = None
     handoffs: int = 0
+    # fleet-global prefix cache (serve/fleet/): the router's placement-
+    # time hint naming which replica's prefix cache already holds this
+    # prompt's full pages (and that replica's courier endpoint, for a
+    # remote owner). The destination engine fetches the uncovered pages
+    # from the owner over the courier instead of re-prefilling them; a
+    # stale or wrong hint degrades to plain prefill.
+    prefix_owner: Optional[int] = None
+    prefix_owner_endpoint: Optional[str] = field(default=None, repr=False)
     arrival_time: float = field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None   # for TTFT
     # when the engine dispatched this request's prefill (host clock, no

@@ -138,6 +138,14 @@ SLOW_TESTS = {
     # engine compile in the child): full-suite merge gate; the fast
     # tier's multi-process coverage is the serve.fleet2+remote dryrun
     "test_spawned_worker_round_trip",
+    # fleet-global prefix fetch: the engine-backed spill scenarios
+    # build a 2-replica fleet each; greedy/degrade variants stay in the
+    # fast tier, the seeded/int8/chaos-retry ones and the 2-process
+    # socket acceptance run full-suite only
+    "test_fetch_spill_seeded_sampling",
+    "test_fetch_spill_int8_kv_pages",
+    "test_chunk_chaos_stays_token_identical",
+    "test_spawned_worker_prefix_fetch",
 }
 
 

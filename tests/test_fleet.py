@@ -994,6 +994,10 @@ class TestFleetMetrics:
                         "in_flight": 0,
                         "transfer_ms": [1.0, 2.0, 3.0, 4.0],
                         "transfer_count": 4},
+            "prefix_fetch": {"fetches": 2, "pages": 8, "bytes": 2048,
+                             "misses": 1, "aborts": 1,
+                             "fetch_ms": [2.0, 3.0, 4.0, 5.0],
+                             "fetch_count": 4},
         }
         exporter.export_fleet(snap)
         samples = {}
@@ -1043,6 +1047,20 @@ class TestFleetMetrics:
             ("llmctl_fleet_courier_transfer_ms_count", None)] == 4
         assert samples[("llmctl_fleet_courier_transfer_ms_sum", None)] \
             == pytest.approx(10.0)
+        # fleet-global prefix-fetch plane (this PR): fetched pages/bytes
+        # + degrade counters and the fetch-latency histogram
+        assert samples[
+            ("llmctl_fleet_prefix_fetch_pages_total", None)] == 8
+        assert samples[
+            ("llmctl_fleet_prefix_fetch_bytes_total", None)] == 2048
+        assert samples[
+            ("llmctl_fleet_prefix_fetch_misses_total", None)] == 1
+        assert samples[
+            ("llmctl_fleet_prefix_fetch_aborts_total", None)] == 1
+        assert samples[
+            ("llmctl_fleet_prefix_fetch_ms_count", None)] == 4
+        assert samples[("llmctl_fleet_prefix_fetch_ms_sum", None)] \
+            == pytest.approx(14.0)
         # counters export deltas: a second identical snapshot must not
         # double-count the running totals (incl. the pause histogram)
         exporter.export_fleet(snap)

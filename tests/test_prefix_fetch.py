@@ -1,0 +1,652 @@
+"""Fleet-global prefix cache: fetch shared prefix pages over the courier
+instead of recomputing them.
+
+Prefix-affinity hashing keeps each replica hot for its slice of the
+prompt population, but any placement off the affinity owner (load bound,
+role filter, drain, requeue) used to re-prefill a prefix whose KV
+already existed in the fleet. These tests hold the feature to its
+contract:
+
+- the kv-cache primitives (arbitrary-page extract, fetched-page import,
+  the bounded inventory) round-trip content exactly, fp and int8;
+- the router's placement-time `prefix_owner` hint picks the replica
+  whose inventory covers the prompt best — and never the destination;
+- engine-backed: a flash crowd spilling off the warm owner fetches the
+  shared pages (greedy AND seeded, fp AND int8-KV pages), with the
+  fetching replica's prefill-token counter reduced by EXACTLY the
+  fetched full-page coverage and the credit flowing into
+  reprefill_tokens_avoided;
+- degrade, never wrong: seeded 100% chunk loss on the fetch path falls
+  back to plain prefill token-identically with zero failed requests;
+- the PR-6 satellite: `RemoteReplica.pool_room_for` consults the pool
+  facts the probe now carries instead of assuming room.
+"""
+
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from distributed_llm_training_and_inference_system_tpu.config import (
+    get_model_config)
+from distributed_llm_training_and_inference_system_tpu.config.schema import (
+    FleetConfig, ServeConfig)
+from distributed_llm_training_and_inference_system_tpu.serve import (
+    InferenceEngine, SamplingParams)
+from distributed_llm_training_and_inference_system_tpu.serve.fleet import (
+    FaultPlan, ServeFleet)
+from distributed_llm_training_and_inference_system_tpu.serve.fleet.router import (  # noqa: E501
+    FleetRouter)
+from distributed_llm_training_and_inference_system_tpu.serve.kv_cache import (
+    PagedKVCache, prefix_page_hashes)
+
+PS = 8                                   # page size everywhere below
+HOT = [7, 3, 9, 1, 4, 8, 2, 6] * 4       # 32 tokens = 4 full pages
+
+
+@pytest.fixture(scope="module")
+def model_cfg():
+    return get_model_config("gpt-test")
+
+
+@pytest.fixture(scope="module")
+def params(model_cfg):
+    import jax
+
+    from distributed_llm_training_and_inference_system_tpu.models import (
+        init as model_init)
+    return model_init(model_cfg, jax.random.PRNGKey(3))
+
+
+def serve_cfg(**overrides) -> ServeConfig:
+    kw = dict(model="gpt-test", max_batch_size=2, max_seq_len=128,
+              prefill_chunk=32, kv_block_size=PS, dtype="float32")
+    kw.update(overrides)
+    return ServeConfig(**kw)
+
+
+# -- kv-cache primitives ------------------------------------------------------
+
+
+def make_kv(model_cfg, num_pages=32, quantized=False) -> PagedKVCache:
+    return PagedKVCache(model_cfg, num_slots=2, max_seq_len=128,
+                        page_size=PS, num_pages=num_pages,
+                        quantized=quantized)
+
+
+class TestPrefixPrimitives:
+    def test_prompt_shorter_than_one_page_has_no_hashes(self):
+        assert prefix_page_hashes(list(range(PS - 1)), PS) == []
+        assert prefix_page_hashes([], PS) == []
+
+    def test_partial_tail_page_never_advertised(self, model_cfg):
+        """Only FULL pages are shareable: a 3-token tail past the last
+        page boundary must appear neither in the hash chain nor in the
+        inventory a replica advertises."""
+        kv = make_kv(model_cfg)
+        ctx = HOT + [1, 2, 3]                       # 35 tokens
+        hashes = prefix_page_hashes(ctx, PS)
+        assert len(hashes) == len(HOT) // PS        # 4 full pages only
+        kv.allocate(0, len(ctx))
+        table = kv.block_tables[0]
+        kv.register_pages([(hashes[i], int(table[i]))
+                           for i in range(len(hashes))])
+        inv = kv.prefix_inventory()
+        assert set(inv) == set(hashes)              # no tail-page entry
+
+    def test_inventory_bound_keeps_newest(self, model_cfg):
+        kv = make_kv(model_cfg)
+        hashes = prefix_page_hashes(list(range(1, 1 + 6 * PS)), PS)
+        kv.allocate(0, 6 * PS)
+        table = kv.block_tables[0]
+        kv.register_pages([(hashes[i], int(table[i])) for i in range(6)])
+        assert kv.prefix_inventory(4) == hashes[2:]
+
+    @pytest.mark.parametrize("quantized", [False, True])
+    def test_extract_insert_round_trip(self, model_cfg, quantized):
+        """Owner extract -> fetcher import must reproduce page content
+        bit-exactly, plain and int8 pools alike."""
+        rng = np.random.default_rng(0)
+        src = make_kv(model_cfg, quantized=quantized)
+        dst = make_kv(model_cfg, quantized=quantized)
+        hashes = prefix_page_hashes(HOT, PS)
+        src.allocate(0, len(HOT))
+
+        # stamp recognizable content through the public write path
+        cfg = model_cfg
+        shape = (cfg.num_layers, 4, cfg.num_kv_heads, PS, cfg.head_dim)
+        if quantized:
+            content = {
+                "k": {"values": rng.integers(-127, 127, shape, np.int8),
+                      "scale": rng.random(shape[:-1], np.float32)},
+                "v": {"values": rng.integers(-127, 127, shape, np.int8),
+                      "scale": rng.random(shape[:-1], np.float32)},
+                "num_pages": 4,
+            }
+        else:
+            content = {"k": rng.random(shape, np.float32),
+                       "v": rng.random(shape, np.float32),
+                       "num_pages": 4}
+        src.write_slot_pages(0, content)
+        table = src.block_tables[0]
+        src.register_pages([(hashes[i], int(table[i])) for i in range(4)])
+
+        payload = src.extract_pages(src.lookup_prefix(hashes))
+        assert payload["num_pages"] == 4
+        inserted = dst.insert_prefix_pages(hashes, payload)
+        assert len(inserted) == 4
+        assert dst.lookup_prefix(hashes) == inserted
+        got = dst.extract_pages(inserted)
+
+        def flat(d):
+            if isinstance(d, dict):
+                return {k: flat(v) for k, v in d.items()
+                        if k != "num_pages"}
+            return np.asarray(d)
+        a, b = flat(payload), flat(got)
+        if quantized:
+            np.testing.assert_array_equal(a["k"]["values"],
+                                          b["k"]["values"])
+            np.testing.assert_allclose(a["k"]["scale"], b["k"]["scale"])
+            np.testing.assert_array_equal(a["v"]["values"],
+                                          b["v"]["values"])
+        else:
+            np.testing.assert_allclose(a["k"], b["k"])
+            np.testing.assert_allclose(a["v"], b["v"])
+
+    def test_duplicate_insert_first_writer_wins(self, model_cfg):
+        """Hash-collision-shaped duplicate imports: a hash already
+        mapped keeps its page; the re-import claims nothing."""
+        src = make_kv(model_cfg)
+        dst = make_kv(model_cfg)
+        hashes = prefix_page_hashes(HOT, PS)
+        src.allocate(0, len(HOT))
+        table = src.block_tables[0]
+        src.register_pages([(hashes[i], int(table[i])) for i in range(4)])
+        payload = src.extract_pages(src.lookup_prefix(hashes))
+        first = dst.insert_prefix_pages(hashes, payload)
+        assert len(first) == 4
+        again = dst.insert_prefix_pages(hashes, payload)
+        assert again == []                          # all duplicates
+        assert dst.lookup_prefix(hashes) == first   # originals kept
+        # a partially-overlapping import claims only the new suffix
+        longer = prefix_page_hashes(HOT + list(range(100, 100 + PS)), PS)
+        assert longer[:4] == hashes
+        src2 = make_kv(model_cfg)
+        src2.allocate(0, 5 * PS)
+        t2 = src2.block_tables[0]
+        src2.register_pages([(longer[i], int(t2[i])) for i in range(5)])
+        pay2 = src2.extract_pages(src2.lookup_prefix(longer))
+        extra = dst.insert_prefix_pages(longer, pay2)
+        assert len(extra) == 1
+        assert dst.lookup_prefix(longer) == first + extra
+
+    def test_pool_dry_partial_insert(self, model_cfg):
+        """A dry pool stops the import early instead of erroring: the
+        chain head lands, the tail re-prefills."""
+        src = make_kv(model_cfg)
+        hashes = prefix_page_hashes(HOT, PS)
+        src.allocate(0, len(HOT))
+        table = src.block_tables[0]
+        src.register_pages([(hashes[i], int(table[i])) for i in range(4)])
+        payload = src.extract_pages(src.lookup_prefix(hashes))
+        # 8-page pool (page 0 scratch): one slot holding 5 pages leaves 2
+        dst = make_kv(model_cfg, num_pages=8)
+        dst.allocate(0, 5 * PS)
+        inserted = dst.insert_prefix_pages(hashes, payload)
+        assert len(inserted) == 2                   # partial, no error
+        assert dst.lookup_prefix(hashes) == inserted
+
+    def test_eviction_between_lookup_and_pin(self, model_cfg):
+        """The lookup->pin atomicity contract: an eviction in between
+        drops the hash mapping, so a RE-lookup (what the engine does
+        under one lock hold) sees the shorter chain instead of pinning
+        a reused page."""
+        kv = make_kv(model_cfg, num_pages=6)        # 5 usable pages
+        hashes = prefix_page_hashes(HOT, PS)
+        kv.allocate(0, len(HOT))
+        table = kv.block_tables[0]
+        kv.register_pages([(hashes[i], int(table[i])) for i in range(4)])
+        kv.release(0)                               # all 4 evictable
+        chain = kv.lookup_prefix(hashes)
+        assert len(chain) == 4
+        # eviction strikes between lookup and pin: a new allocation
+        # reclaims the two LRU cached pages
+        kv.allocate(1, 3 * PS)
+        chain2 = kv.lookup_prefix(hashes)
+        assert len(chain2) < 4                      # mapping dropped
+        kv.pin_pages(chain2)                        # only valid pages
+        assert all(kv._ref[p] == 1 for p in chain2)
+
+    def test_extract_pages_bounds_checked(self, model_cfg):
+        kv = make_kv(model_cfg)
+        with pytest.raises(ValueError):
+            kv.extract_pages([0])                   # scratch page
+        with pytest.raises(ValueError):
+            kv.extract_pages([kv.num_pages])
+
+    def test_insert_rejects_short_payload(self, model_cfg):
+        kv = make_kv(model_cfg)
+        hashes = prefix_page_hashes(HOT, PS)
+        cfg = kv.cfg
+        shape = (cfg.num_layers, 2, cfg.num_kv_heads, PS, cfg.head_dim)
+        bad = {"k": np.zeros(shape, np.float32),
+               "v": np.zeros(shape, np.float32), "num_pages": 2}
+        with pytest.raises(ValueError):
+            kv.insert_prefix_pages(hashes, bad)     # 2 pages, 4 hashes
+
+
+# -- router hints -------------------------------------------------------------
+
+
+class _HintReplica:
+    def __init__(self, rid, inv=(), state="healthy"):
+        self.replica_id = rid
+        self.state = state
+        self._inv = list(inv)
+
+    def accepting(self):
+        return self.state == "healthy"
+
+    def queue_depth(self):
+        return 0
+
+    def outstanding_tokens(self):
+        return 0
+
+    def prefix_inventory(self):
+        return list(self._inv)
+
+    def submit(self, req):
+        return False
+
+
+class TestPrefixHints:
+    def _router(self, reps):
+        return FleetRouter(reps, FleetConfig(replicas=len(reps),
+                                             affinity_prefix_tokens=0),
+                           page_size=PS)
+
+    def _req(self):
+        from distributed_llm_training_and_inference_system_tpu.serve.scheduler import (  # noqa: E501
+            Request)
+        return Request(request_id="h1", prompt_tokens=HOT + [1, 2, 3])
+
+    def test_owner_is_best_coverage_not_dest(self):
+        hashes = prefix_page_hashes(HOT, PS)
+        reps = [_HintReplica(0, hashes),          # full coverage
+                _HintReplica(1, hashes[:2]),      # partial
+                _HintReplica(2)]                  # cold destination
+        router = self._router(reps)
+        req = self._req()
+        router._attach_prefix_hint(req, 2, router._inventories())
+        assert req.prefix_owner == 0
+        # destination already covering best -> no hint
+        req2 = self._req()
+        router._attach_prefix_hint(req2, 0, router._inventories())
+        assert req2.prefix_owner is None
+
+    def test_crashed_owner_excluded(self):
+        hashes = prefix_page_hashes(HOT, PS)
+        reps = [_HintReplica(0, hashes, state="crashed"),
+                _HintReplica(1, hashes[:1]), _HintReplica(2)]
+        router = self._router(reps)
+        invs = router._inventories()
+        assert 0 not in invs
+        req = self._req()
+        router._attach_prefix_hint(req, 2, invs)
+        assert req.prefix_owner == 1               # best LIVE coverage
+
+    def test_short_prompt_gets_no_hint(self):
+        hashes = prefix_page_hashes(HOT, PS)
+        reps = [_HintReplica(0, hashes), _HintReplica(1)]
+        router = self._router(reps)
+        from distributed_llm_training_and_inference_system_tpu.serve.scheduler import (  # noqa: E501
+            Request)
+        req = Request(request_id="short", prompt_tokens=[1, 2, 3])
+        router._attach_prefix_hint(req, 1, router._inventories())
+        assert req.prefix_owner is None
+
+    def test_page_size_zero_disables_hints(self):
+        reps = [_HintReplica(0, prefix_page_hashes(HOT, PS)),
+                _HintReplica(1)]
+        router = FleetRouter(reps, FleetConfig(replicas=2), page_size=0)
+        req = self._req()
+        assert not router._hints_enabled(req)
+
+
+# -- PR-6 satellite: remote pool-room advisory --------------------------------
+
+
+class TestRemotePoolRoom:
+    def _remote(self):
+        from distributed_llm_training_and_inference_system_tpu.serve.fleet.remote import (  # noqa: E501
+            RemoteReplica)
+        return RemoteReplica(1, "http://127.0.0.1:1",
+                             fleet_cfg=FleetConfig(replicas=2))
+
+    def test_consults_probe_pool_facts(self):
+        rr = self._remote()
+        rr._cache.update({"pool_page_size": 8, "pool_free_pages": 3,
+                          "pool_lookahead": 4})
+        fits = SimpleNamespace(context_tokens=list(range(16)))     # 3 pages
+        too_big = SimpleNamespace(context_tokens=list(range(30)))  # 5 pages
+        assert rr.pool_room_for(fits) is True
+        assert rr.pool_room_for(too_big) is False
+
+    def test_optimistic_before_first_probe(self):
+        rr = self._remote()
+        assert rr.pool_room_for(
+            SimpleNamespace(context_tokens=list(range(100)))) is True
+
+    def test_handoff_dest_skips_full_remote(self):
+        """The router advisory now consults the remote's probed room:
+        a full remote decode pool no longer attracts the handoff."""
+        rr = self._remote()
+        rr.role = "decode"
+        rr._cache.update({"pool_page_size": 8, "pool_free_pages": 0,
+                          "pool_lookahead": 4})
+        local = _HintReplica(2)
+        local.role = "mixed"
+        local.pool_room_for = lambda req: True
+        router = FleetRouter([rr, local], FleetConfig(replicas=2))
+        req = SimpleNamespace(context_tokens=list(range(16)))
+        assert router.handoff_dest(req, from_replica=0) == 2
+
+    def test_probe_carries_pool_fields(self, model_cfg, params):
+        from distributed_llm_training_and_inference_system_tpu.serve.fleet.replica import (  # noqa: E501
+            EngineReplica)
+        rep = EngineReplica(0, model_cfg, serve_cfg(), params=params,
+                            fleet_cfg=FleetConfig(replicas=1))
+        try:
+            out = rep.probe()
+            assert out["pool_page_size"] == PS
+            assert out["pool_free_pages"] > 0
+            assert out["pool_lookahead"] >= 1
+        finally:
+            rep.stop()
+            rep.engine.release()
+
+
+# -- engine-backed: the fetch-versus-recompute contract -----------------------
+
+
+def _fleet(model_cfg, params, fault_plan=None, kv_quant="none",
+           **fleet_kw):
+    kw = dict(replicas=2, affinity_prefix_tokens=0,
+              restart_backoff_s=0.05, probe_interval_s=0.05,
+              courier_chunk_bytes=1024)
+    kw.update(fleet_kw)
+    fleet = ServeFleet(model_cfg, serve_cfg(kv_quantization=kv_quant),
+                       FleetConfig(**kw), params=params,
+                       fault_plan=fault_plan, supervise=False, seed=0)
+    for rep in fleet.replicas:
+        rep.engine.generate([[1, 2, 3]],
+                            SamplingParams(temperature=0.0, max_tokens=4))
+        rep.engine.total_prefill_tokens = 0
+    fleet.start()
+    return fleet
+
+
+def _drain_wait(fleet, rid, deadline):
+    assert fleet.drain(rid)
+    while fleet.replicas[rid].state != "drained":
+        fleet.supervisor.poll_once()
+        time.sleep(0.005)
+        assert time.monotonic() < deadline, "drain hung"
+
+
+def _spill_scenario(fleet, prompts, sampling, ref):
+    """Warm replica 0 with prompts[0], spill prompts[1:] onto replica 1,
+    return (spill tokens, fetched tokens, prefill tokens spent on 1)."""
+    deadline = time.monotonic() + 300
+    _drain_wait(fleet, 1, deadline)
+    warm = fleet.generate([prompts[0]], sampling, timeout_s=300)
+    assert warm[0].generated_tokens == ref[0]
+    fleet.undrain(1)
+    _drain_wait(fleet, 0, deadline)
+    pre = fleet.replicas[1].engine.total_prefill_tokens
+    got = fleet.generate(prompts[1:], sampling, timeout_s=300)
+    eng = fleet.replicas[1].engine
+    return ([r.generated_tokens for r in got],
+            eng.total_prefix_fetched_tokens,
+            eng.total_prefill_tokens - pre)
+
+
+def _prompts():
+    return [HOT + [50 + i, 60 + i, 70 + i] for i in range(4)]
+
+
+class TestFetchSpill:
+    def _run(self, model_cfg, params, sampling, kv_quant="none",
+             fault_plan=None, **fleet_kw):
+        prompts = _prompts()
+        ref_eng = InferenceEngine(model_cfg,
+                                  serve_cfg(kv_quantization=kv_quant),
+                                  params=params, seed=0)
+        ref = [r.generated_tokens
+               for r in ref_eng.generate(prompts, sampling)]
+        ref_eng.release()
+        fleet = _fleet(model_cfg, params, fault_plan=fault_plan,
+                       kv_quant=kv_quant, **fleet_kw)
+        try:
+            toks, fetched, spent = _spill_scenario(fleet, prompts,
+                                                   sampling, ref)
+            snap = fleet.status()
+            stats = fleet.router.stats()
+        finally:
+            fleet.shutdown()
+        assert toks == ref[1:], "spill diverged from undisturbed run"
+        assert stats["failed"] == 0 and stats["completed"] == len(prompts)
+        return fetched, spent, snap
+
+    def test_fetch_spill_greedy_fp(self, model_cfg, params):
+        """Off-affinity spill fetches the 4 hot pages ONCE; the fetching
+        replica's prefill counter shrinks by exactly that coverage, and
+        the saving is credited in reprefill_tokens_avoided."""
+        fetched, spent, snap = self._run(
+            model_cfg, params, SamplingParams(temperature=0.0,
+                                              max_tokens=16))
+        assert fetched == len(HOT)
+        tails = sum(len(p) for p in _prompts()[1:]) - 3 * len(HOT)
+        assert spent == tails
+        assert snap["prefix_fetch"]["pages"] == len(HOT) // PS
+        assert snap["prefix_fetch"]["aborts"] == 0
+        assert snap["migration"]["reprefill_tokens_avoided"] >= len(HOT)
+        # per-replica fetch columns surface on the snapshot
+        rep1 = next(r for r in snap["replicas"] if r["replica"] == 1)
+        assert rep1["prefix_fetch_pages"] == len(HOT) // PS
+
+    def test_fetch_spill_seeded_sampling(self, model_cfg, params):
+        fetched, spent, _ = self._run(
+            model_cfg, params,
+            SamplingParams(temperature=0.8, seed=123, max_tokens=16))
+        assert fetched == len(HOT)
+        assert spent == sum(len(p) for p in _prompts()[1:]) - 3 * len(HOT)
+
+    def test_fetch_spill_int8_kv_pages(self, model_cfg, params):
+        fetched, spent, snap = self._run(
+            model_cfg, params,
+            SamplingParams(temperature=0.0, max_tokens=16),
+            kv_quant="int8")
+        assert fetched == len(HOT)
+        assert spent == sum(len(p) for p in _prompts()[1:]) - 3 * len(HOT)
+        assert snap["prefix_fetch"]["bytes"] > 0
+
+    def test_chunk_chaos_stays_token_identical(self, model_cfg, params):
+        """Seeded chunk drop/corrupt/duplicate on the fetch path: the
+        transfer retries through and the output stays token-identical
+        with zero aborts (the chaos-tested courier contract)."""
+        fetched, spent, snap = self._run(
+            model_cfg, params, SamplingParams(temperature=0.0,
+                                              max_tokens=16),
+            fault_plan=FaultPlan(seed=5, chunk_drop_rate=0.2,
+                                 chunk_corrupt_rate=0.15,
+                                 chunk_duplicate_rate=0.1),
+            courier_max_retries=12, courier_retry_backoff_ms=0.2,
+            courier_retry_backoff_max_ms=2.0,
+            courier_chunk_deadline_ms=20.0)
+        assert fetched == len(HOT)
+        assert snap["prefix_fetch"]["aborts"] == 0
+        assert snap["courier"]["retries"] >= 1
+
+    def test_dead_link_degrades_to_plain_prefill(self, model_cfg, params):
+        """100% chunk loss: every fetch aborts, every prompt re-prefills
+        plainly — token-identical, aborts counted, nothing imported,
+        nothing failed."""
+        fetched, spent, snap = self._run(
+            model_cfg, params, SamplingParams(temperature=0.0,
+                                              max_tokens=16),
+            fault_plan=FaultPlan(seed=2, chunk_drop_rate=1.0),
+            courier_max_retries=1, courier_retry_backoff_ms=0.2,
+            courier_retry_backoff_max_ms=1.0,
+            courier_chunk_deadline_ms=20.0)
+        assert fetched == 0
+        assert snap["prefix_fetch"]["aborts"] >= 1
+        # the first spill prompt re-prefilled fully, the rest hit the
+        # pages it published locally
+        assert spent == sum(len(p) for p in _prompts()[1:]) - 2 * len(HOT)
+
+    def test_prefix_fetch_off_recomputes(self, model_cfg, params):
+        """The A/B control: prefix_fetch=False spills re-prefill the hot
+        prefix once (then local hits cover the siblings)."""
+        fetched, spent, snap = self._run(
+            model_cfg, params, SamplingParams(temperature=0.0,
+                                              max_tokens=16),
+            prefix_fetch=False)
+        assert fetched == 0
+        assert snap["prefix_fetch"]["fetches"] == 0
+        assert spent == sum(len(p) for p in _prompts()[1:]) - 2 * len(HOT)
+
+
+# -- real sockets: spawned workers --------------------------------------------
+
+
+@pytest.mark.socket
+class TestRemoteFetch:
+    def test_spawned_worker_prefix_fetch(self, model_cfg):
+        """Acceptance over real sockets: two `llmctl fleet worker`
+        processes; the flash crowd spills off the warm worker and the
+        cold one fetches the shared pages worker-to-worker
+        (/fleet/courier/fetch -> chunk push), token-identical with the
+        prefill reduction visible in /worker/status."""
+        import json
+        import os
+        import select
+        import subprocess
+        import sys
+        import urllib.request
+
+        pkg = "distributed_llm_training_and_inference_system_tpu"
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+
+        def spawn(rid):
+            cmd = [sys.executable, "-m", f"{pkg}.cli.main", "fleet",
+                   "worker", "--model", "gpt-test",
+                   "--replica-id", str(rid), "--role", "mixed",
+                   "--host", "127.0.0.1", "--port", "0",
+                   "--param-seed", "3", "--seed", str(1000 * rid),
+                   "--max-batch-size", "2", "--max-seq-len", "128",
+                   "--prefill-chunk", "32", "--kv-block-size", str(PS),
+                   "--dtype", "float32", "--courier-chunk-bytes", "1024",
+                   "--restart-backoff", "0.05"]
+            return subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                    stderr=subprocess.DEVNULL, env=env,
+                                    text=True, start_new_session=True)
+
+        def wait_ready(proc, deadline):
+            while time.monotonic() < deadline:
+                assert proc.poll() is None, "worker died during startup"
+                rd, _, _ = select.select([proc.stdout], [], [], 1.0)
+                if rd:
+                    line = proc.stdout.readline()
+                    if line.startswith("LLMCTL_WORKER_READY"):
+                        return int(line.strip().split("port=")[1])
+            raise AssertionError("worker never became ready")
+
+        def wstatus(port):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/worker/status",
+                    timeout=5) as resp:
+                return json.loads(resp.read().decode())
+
+        import jax
+
+        from distributed_llm_training_and_inference_system_tpu.models import (  # noqa: E501
+            init as model_init)
+        sparams = model_init(model_cfg, jax.random.PRNGKey(3))
+        prompts = _prompts()
+        greedy = SamplingParams(temperature=0.0, max_tokens=12)
+        ref_eng = InferenceEngine(model_cfg, serve_cfg(), params=sparams,
+                                  seed=0)
+        ref = [r.generated_tokens
+               for r in ref_eng.generate(prompts, greedy)]
+        ref_eng.release()
+
+        workers = []
+        try:
+            deadline = time.monotonic() + 480
+            pa, pb = spawn(0), spawn(1)
+            workers = [pa, pb]
+            porta, portb = (wait_ready(pa, deadline),
+                            wait_ready(pb, deadline))
+            fleet = ServeFleet(
+                model_cfg, serve_cfg(),
+                FleetConfig(replicas=2, remote_replicas="0,1",
+                            fleet_endpoints={
+                                0: f"http://127.0.0.1:{porta}",
+                                1: f"http://127.0.0.1:{portb}"},
+                            affinity_prefix_tokens=0,
+                            probe_interval_s=0.05, probe_failures=2,
+                            restart_backoff_s=0.05,
+                            courier_chunk_bytes=1024),
+                supervise=False)
+            fleet.start()
+            try:
+                def run_batch(ps):
+                    import threading
+                    evs, rs = [], []
+                    for p in ps:
+                        ev = threading.Event()
+                        rs.append(fleet.submit(
+                            p, greedy,
+                            on_complete=lambda _r, ev=ev: ev.set()))
+                        evs.append(ev)
+                    while not all(e.is_set() for e in evs):
+                        fleet.supervisor.poll_once()
+                        time.sleep(0.01)
+                        assert time.monotonic() < deadline, "batch hung"
+                    return [r.generated_tokens for r in rs]
+
+                _drain_wait(fleet, 1, deadline)
+                assert run_batch([prompts[0]]) == [ref[0]]
+                # probe so the parent learns worker 0's inventory
+                fleet.supervisor.poll_once()
+                fleet.undrain(1)
+                _drain_wait(fleet, 0, deadline)
+                base_b = wstatus(portb)
+                assert run_batch(prompts[1:]) == ref[1:], \
+                    "remote spill diverged"
+                sb = wstatus(portb)
+                pf = sb.get("prefix_fetch", {})
+                assert pf.get("pages", 0) >= len(HOT) // PS, pf
+                spent = (sb["total_prefill_tokens"]
+                         - base_b["total_prefill_tokens"])
+                assert spent == sum(len(p) for p in prompts[1:]) \
+                    - 3 * len(HOT), spent
+                st = fleet.router.stats()
+                assert st["failed"] == 0 and st["completed"] == len(
+                    prompts)
+            finally:
+                fleet.shutdown()
+        finally:
+            for proc in workers:
+                if proc.poll() is None:
+                    proc.terminate()
+                    try:
+                        proc.wait(timeout=5)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
+                        proc.wait()
