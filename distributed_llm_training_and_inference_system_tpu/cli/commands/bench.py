@@ -192,11 +192,21 @@ def kernels(op, seq_len, hidden, heads, batch):
                    "fetch; compare fleet prefill_tokens and the "
                    "prefix_fetch section against 0 (all-unique "
                    "prompts). 0 disables.")
+@click.option("--serve-stream/--no-serve-stream", default=False,
+              show_default=True,
+              help="serve-load fleet: streaming client mode — every "
+                   "request is consumed as a live token stream off the "
+                   "fleet stream hub; results gain the stream section "
+                   "(streamed-token identity vs the final completion, "
+                   "zero-gap/zero-dup assertion, per-token delivery-gap "
+                   "percentiles). Combine with fault flags to measure "
+                   "delivery jitter across crashes/migrations.")
 def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
         requests, rps, concurrency, admission, kv_blocks, device_times,
         preemption, latency_dispatch_steps, artifact, quant, kv_quant,
         slots, pipelined, int8_pallas, serve_max_retries, serve_replicas,
-        serve_disagg, serve_courier_chaos, serve_hot_prefix):
+        serve_disagg, serve_courier_chaos, serve_hot_prefix,
+        serve_stream):
     """End-to-end train step throughput / serve TTFT+throughput
     (parity: reference bench.py:35-49). ``serve-load`` runs open-loop
     (Poisson) and closed-loop sweeps with p50/p99 TTFT, per-token latency,
@@ -400,6 +410,7 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
                               max_tokens=gen_len, seed=0,
                               max_retries=serve_max_retries,
                               hot_prefix_len=serve_hot_prefix,
+                              stream=serve_stream,
                               device_times=device_times)
             s = out.summary()
             s["engine"] = engine_counters()
@@ -411,6 +422,7 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
                                   max_tokens=gen_len, seed=0,
                                   max_retries=serve_max_retries,
                                   hot_prefix_len=serve_hot_prefix,
+                                  stream=serve_stream,
                                   device_times=device_times)
             s = out.summary()
             s["concurrency"] = c

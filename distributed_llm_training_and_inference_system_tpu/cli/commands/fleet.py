@@ -62,7 +62,8 @@ def status(url, as_json):
     table = Table(title="Fleet replicas")
     for col in ("replica", "state", "role", "endpoint", "remote?",
                 "queue", "active", "outstanding tok", "restarts",
-                "migr out", "handoffs", "courier out", "courier aborts",
+                "migr out", "handoffs", "streams", "replayed",
+                "courier out", "courier aborts",
                 "prefix hit", "pfx fetched", "pfx miss", "last error"):
         table.add_column(col)
     per_src = snap.get("courier", {}).get("per_src", {})
@@ -84,6 +85,8 @@ def status(url, as_json):
                       str(r["outstanding_tokens"]), str(r["restarts"]),
                       str(r.get("migrations", 0)),
                       str(r.get("handoffs", 0)),
+                      str(r.get("active_streams", 0)),
+                      str(r.get("stream_replayed_tokens", 0)),
                       str(src.get("transfers", 0)),
                       str(src.get("aborts", 0)),
                       f"{hit:.0%}" if hit is not None else "-",
@@ -115,6 +118,17 @@ def status(url, as_json):
             f"{ho.get('reroles', 0)} re-roles, "
             f"{ho.get('promotions', 0)} promotions, "
             f"{ho.get('demotions', 0)} demotions)")
+    st = snap.get("streams")
+    if st and (st.get("opened") or st.get("active")):
+        console.print(
+            f"streams: {st.get('active', 0)} live / "
+            f"{st.get('opened', 0)} opened, "
+            f"{st.get('tokens', 0)} tokens, "
+            f"{st.get('duplicates', 0)} producer dups suppressed, "
+            f"{st.get('reconnects', 0)} reconnects "
+            f"({st.get('replayed', 0)} tokens replayed), "
+            f"{st.get('gaps_healed', 0)} gap-healed, "
+            f"{st.get('identity_mismatches', 0)} identity violations")
     pf = snap.get("prefix_fetch")
     if pf and (pf.get("pages") or pf.get("misses") or pf.get("aborts")):
         console.print(

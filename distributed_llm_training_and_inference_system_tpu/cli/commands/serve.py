@@ -202,6 +202,24 @@ def app(ctx):
               help="Skip fetches smaller than this many full pages "
                    "(raise when computing a page is cheaper than your "
                    "link).")
+@click.option("--fleet-inventory-ttl-ms", default=0.0, show_default=True,
+              type=float,
+              help="Cache the per-replica prefix-page inventory map this "
+                   "long between placements (0 = re-read every "
+                   "placement). Invalidated on replica teardown/drain; "
+                   "within-TTL staleness costs a counted fetch miss, "
+                   "never wrong tokens.")
+@click.option("--fleet-stream-ttl-ms", default=60_000.0,
+              show_default=True, type=float,
+              help="How long a finished SSE stream stays replayable for "
+                   "a Last-Event-ID reconnect at /v1/streams/<id>.")
+@click.option("--stream-abort-on-disconnect/--no-stream-abort-on-disconnect",  # noqa: E501
+              "stream_abort_on_disconnect", default=True,
+              show_default=True,
+              help="Single-server SSE only: abort a request whose client "
+                   "disconnected mid-stream (frees its decode slot + KV "
+                   "pages). The fleet front keeps it running — its "
+                   "stream log supports reconnect instead.")
 def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
           kv_block_size, kv_hbm_gb, scheduler, dtype, prometheus_port,
           speculative, spec_tokens, prefix_cache, tensor_parallel,
@@ -216,7 +234,8 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
           fleet_courier_retries, fleet_courier_deadline_ms,
           fleet_courier_endpoint, fleet_courier_ticket_ttl_ms,
           fleet_endpoints, fleet_remote_replicas, fleet_prefix_fetch,
-          fleet_prefix_fetch_min_pages):
+          fleet_prefix_fetch_min_pages, fleet_inventory_ttl_ms,
+          fleet_stream_ttl_ms, stream_abort_on_disconnect):
     """Start the OpenAI-compatible inference server."""
     import jax
 
@@ -243,7 +262,8 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
         latency_dispatch_steps=latency_dispatch_steps,
         pipelined_decode=pipelined_decode,
         int8_pallas_matmul=int8_pallas,
-        cors_origins=cors_origins)
+        cors_origins=cors_origins,
+        stream_abort_on_disconnect=stream_abort_on_disconnect)
     serve_cfg.validate()
     fleet_cfg = None
     if replicas > 1:
@@ -267,7 +287,9 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
             fleet_endpoints=parse_fleet_endpoints(list(fleet_endpoints)),
             remote_replicas=fleet_remote_replicas,
             prefix_fetch=fleet_prefix_fetch,
-            prefix_fetch_min_pages=fleet_prefix_fetch_min_pages)
+            prefix_fetch_min_pages=fleet_prefix_fetch_min_pages,
+            prefix_inventory_ttl_ms=fleet_inventory_ttl_ms,
+            stream_log_ttl_ms=fleet_stream_ttl_ms)
         fleet_cfg.validate()
 
     observer = None

@@ -666,6 +666,13 @@ class ServeConfig:
     # further evictions fall back to recompute (vLLM's swap_space analog
     # — unbounded host copies would grow with queue depth x context)
     swap_space_gb: float = 4.0
+    # single-server SSE: when the client disconnects mid-stream, abort
+    # the orphaned request (free its slot + KV pages) instead of letting
+    # it decode to max_tokens for nobody. Off = old behavior (the
+    # request runs to completion; only the stream entry is dropped).
+    # The FLEET front never aborts on disconnect — its stream log keeps
+    # the tail replayable for a Last-Event-ID reconnect instead.
+    stream_abort_on_disconnect: bool = True
 
     def validate(self) -> None:
         if self.kv_quantization not in ("none", "int8"):
@@ -917,6 +924,19 @@ class FleetConfig:
     # inventory (bounds probe payloads and router hint work; 0 disables
     # the inventory and therefore all fetch hints)
     prefix_inventory_max: int = 512
+    # TTL on the router's per-placement inventory reads (the PR-7 named
+    # gap: every needs-prefill placement re-read every replica's
+    # inventory). > 0 caches the {replica: hashes} map for that long —
+    # invalidated outright on replica teardown/drain/undrain/restart,
+    # so a dead owner's pages never outlive it in the hint path; a
+    # within-TTL stale entry only costs a counted fetch miss. 0 = read
+    # fresh every placement (exact hints; fine at small fleets).
+    prefix_inventory_ttl_ms: float = 0.0
+    # -- fleet SSE streaming (serve/fleet/streams.py) ------------------------
+    # finished stream logs stay replayable (Last-Event-ID reconnect) for
+    # this long before the hub GCs them; live logs never expire. 0 keeps
+    # finished logs forever (tests only — production would leak).
+    stream_log_ttl_ms: float = 60_000.0
 
     def role_list(self) -> list[str]:
         """Per-replica role assignment; empty config = all mixed."""
@@ -1013,6 +1033,14 @@ class FleetConfig:
             raise ConfigError(
                 "prefix_inventory_max must be >= 0 (0 disables the "
                 "inventory and therefore all prefix-fetch hints)")
+        if self.prefix_inventory_ttl_ms < 0:
+            raise ConfigError(
+                "prefix_inventory_ttl_ms must be >= 0 (0 = read fresh "
+                "per placement)")
+        if self.stream_log_ttl_ms < 0:
+            raise ConfigError(
+                "stream_log_ttl_ms must be >= 0 (0 keeps finished "
+                "stream logs forever)")
         endpoints = self.endpoint_map()       # raises on malformed entries
         for rid in endpoints:
             if not 0 <= rid < self.replicas:

@@ -300,6 +300,49 @@ class PrometheusExporter:
             "End-to-end prefix fetch time per attempt (ms; hint -> "
             "pages imported or degraded)",
             buckets=(.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 1000, 5000))
+        # inventory TTL cache (FleetConfig.prefix_inventory_ttl_ms):
+        # placements served from the cached per-replica inventory map vs
+        # fresh fleet-wide reads
+        self.fleet_inventory_cache_hits = c(
+            "llmctl_fleet_prefix_inventory_cache_hits",
+            "Placements whose prefix-owner hints used the TTL-cached "
+            "inventory map")
+        self.fleet_inventory_cache_misses = c(
+            "llmctl_fleet_prefix_inventory_cache_misses",
+            "Placements that re-read every replica's prefix inventory "
+            "(cache cold, expired, or invalidated)")
+        # fleet SSE streaming (serve/fleet/streams.py): the exactly-once
+        # delivery ledger. Duplicates are producer re-sends suppressed
+        # by sequence number (migration/SIGKILL resume replay — client-
+        # invisible); replayed tokens are the reconnect tails re-sent on
+        # Last-Event-ID resumes; gaps healed count tokens recovered from
+        # the request's own list after an eaten publish callback.
+        self.fleet_stream_active = g(
+            "llmctl_fleet_stream_active",
+            "Live SSE streams fleet-wide")
+        self.fleet_stream_tokens = c(
+            "llmctl_fleet_stream_tokens",
+            "Tokens accepted into fleet stream logs (seq-deduped)")
+        self.fleet_stream_duplicates = c(
+            "llmctl_fleet_stream_duplicates",
+            "Producer token re-sends suppressed by sequence number "
+            "(re-placement resume replay; never client-visible)")
+        self.fleet_stream_replayed = c(
+            "llmctl_fleet_stream_replayed_tokens",
+            "Tokens replayed to reconnecting SSE clients "
+            "(Last-Event-ID tail)")
+        self.fleet_stream_reconnects = c(
+            "llmctl_fleet_stream_reconnects",
+            "SSE reconnects served from the stream log")
+        self.fleet_stream_gaps_healed = c(
+            "llmctl_fleet_stream_gaps_healed",
+            "Stream-log tokens recovered from the request's own token "
+            "list (publish callbacks lost to a crash window)")
+        self.fleet_stream_replay = h(
+            "llmctl_fleet_stream_replay_tokens",
+            "Tokens replayed per SSE reconnect (Last-Event-ID tail "
+            "size)",
+            buckets=(1, 2, 5, 10, 25, 50, 100, 250, 1000))
         self._last_totals: dict[str, float] = {}
         self._server_started = False
 
@@ -377,8 +420,12 @@ class PrometheusExporter:
                     {"mixed": 0, "prefill": 1, "decode": 2}.get(
                         rep["role"], 0))
         router = snap.get("router", {})
-        for key, counter in (("requeues", self.fleet_requeues),
-                             ("rejected", self.fleet_rejected)):
+        for key, counter in (
+                ("requeues", self.fleet_requeues),
+                ("rejected", self.fleet_rejected),
+                ("inventory_cache_hits", self.fleet_inventory_cache_hits),
+                ("inventory_cache_misses",
+                 self.fleet_inventory_cache_misses)):
             total = router.get(key, 0)
             delta = total - self._last_totals.get(f"fleet_{key}", 0)
             if delta > 0:
@@ -463,6 +510,30 @@ class PrometheusExporter:
             for t in window[-min(new, len(window)):]:
                 self.fleet_prefix_fetch.observe(t)
         self._last_totals["fleet_pf_fetches"] = count
+        # fleet SSE streaming plane: counters on running totals; the
+        # replay-size histogram fills from the bounded recent window
+        # gated by the cumulative reconnect count (same delta contract)
+        st = snap.get("streams", {})
+        if st:
+            self.fleet_stream_active.set(st.get("active", 0))
+        for key, counter in (
+                ("tokens", self.fleet_stream_tokens),
+                ("duplicates", self.fleet_stream_duplicates),
+                ("replayed", self.fleet_stream_replayed),
+                ("reconnects", self.fleet_stream_reconnects),
+                ("gaps_healed", self.fleet_stream_gaps_healed)):
+            total = st.get(key, 0)
+            delta = total - self._last_totals.get(f"fleet_st_{key}", 0)
+            if delta > 0:
+                counter.inc(delta)
+            self._last_totals[f"fleet_st_{key}"] = total
+        count = st.get("replay_count", 0)
+        new = int(count - self._last_totals.get("fleet_st_replays", 0))
+        sizes = st.get("replay_sizes", [])
+        if new > 0:
+            for s in sizes[-min(new, len(sizes)):]:
+                self.fleet_stream_replay.observe(s)
+        self._last_totals["fleet_st_replays"] = count
 
 
 class OTLPExporter:

@@ -211,6 +211,9 @@ class InferenceEngine:
         # context tokens covered by pages FETCHED from another replica's
         # prefix cache instead of being re-prefilled here
         self.total_prefix_fetched_tokens = 0
+        # of those, tokens fetched to extend a crash-salvaged PARTIAL
+        # payload's coverage (the tail that would otherwise re-prefill)
+        self.total_salvage_tail_fetched_tokens = 0
 
         # per-slot host state
         self.last_tokens = np.zeros(S, np.int32)
@@ -696,6 +699,66 @@ class InferenceEngine:
                     "tokens) from replica %s", rid, len(inserted),
                     tokens, getattr(req, "prefix_owner", None))
 
+    def _maybe_fetch_salvage_tail(self, req: Request) -> None:
+        """Crash-salvaged PARTIAL payloads (migration pre-copies) used to
+        re-prefill their whole uncovered tail even when a sibling's
+        prefix cache held those very pages. When the router hinted an
+        owner, fetch the chain pages BEYOND the payload's coverage over
+        the courier and splice them onto the payload — the tail prefill
+        then shrinks to what nobody has. Every failure mode (no hook, no
+        hint, miss, abort, schema mismatch) leaves the payload exactly
+        as it was: the plain partial-restore path, correct tokens, extra
+        compute. Engine thread, no lock held across the network."""
+        hook = self.prefix_fetch_hook
+        kvp = req.swapped_kv
+        if (hook is None or not self.serve_cfg.prefix_caching
+                or not isinstance(kvp, dict) or not kvp.get("partial")
+                or getattr(req, "prefix_owner", None) is None
+                or not req.prefix_hashes):
+            return
+        from .kv_cache import concat_page_payloads, slice_page_payload
+        PS = self.kv.page_size
+        n = len(req.context_tokens)
+        covered = int(kvp.get("positions", 0)) // PS
+        pages = kvp.get("pages")
+        if not isinstance(pages, dict) \
+                or int(pages.get("num_pages", -1)) != covered:
+            return       # unexpected payload shape: leave it alone
+        # >=1 suffix token must still be computed (the last context token
+        # produces the next token's logits) — same bound as the plain
+        # prefix-fetch path
+        usable = min(len(req.prefix_hashes), max((n - 1) // PS, 0))
+        if covered >= usable:
+            return
+        missing = req.prefix_hashes[covered:usable]
+        got = hook(req, missing)
+        if not got:
+            return
+        hashes, fetched = got.get("hashes") or [], got.get("pages")
+        # chain consistency: accept only a PREFIX of what was asked
+        k = 0
+        while k < min(len(hashes), len(missing)) \
+                and hashes[k] == missing[k]:
+            k += 1
+        if k == 0 or not isinstance(fetched, dict):
+            return
+        try:
+            merged = concat_page_payloads(pages,
+                                          slice_page_payload(fetched, k))
+        except (ValueError, KeyError, TypeError) as e:
+            logger.warning(
+                "salvage-tail fetch payload for %s rejected (%s); "
+                "re-prefilling the tail", req.request_id, e)
+            return
+        kvp["pages"] = merged
+        kvp["positions"] = (covered + k) * PS
+        self.total_salvage_tail_fetched_tokens += k * PS
+        self.total_prefix_fetched_tokens += k * PS
+        logger.info(
+            "salvage-tail fetch for %s: extended partial coverage "
+            "%d -> %d page(s) from replica %s", req.request_id, covered,
+            covered + k, getattr(req, "prefix_owner", None))
+
     def _start_chunked_prefill(self, req: Request) -> None:
         """Allocate the slot's pages and enqueue the context for chunk-at-a-
         time prefill (one chunk per engine step, interleaved with decode)."""
@@ -822,12 +885,30 @@ class InferenceEngine:
                 "(restore fallback or fleet mis-routing)", rid)
         # crash-salvaged migration pre-copy: the payload's FULL pages are
         # host memory covering a prefix of the context — written back
-        # below, so only the uncovered tail re-prefills
+        # below, so only the uncovered tail re-prefills. When the router
+        # hinted a prefix owner, the tail first routes through the fetch
+        # path and the payload grows by whatever the owner still caches.
+        self._maybe_fetch_salvage_tail(req)
         partial = (req.swapped_kv
                    if req.swapped_kv is not None
                    and req.swapped_kv.get("partial") else None)
         with self.lock:   # page bookkeeping is shared with cancel/release
             pins = self._prefix_pins.get(rid, [])
+            if partial is not None and pins:
+                # a partial payload and local prefix-cache pins both
+                # cover a prefix of the chain — pick ONE source. The
+                # payload is written into the slot's own pages from
+                # chain index 0, so restoring it over pinned SHARED
+                # cache pages would corrupt the cache for every other
+                # holder; and when the cache already covers at least as
+                # much, the payload adds nothing.
+                if len(pins) * PS >= int(partial.get("positions", 0)):
+                    req.swapped_kv = None
+                    partial = None
+                else:
+                    self.kv.unpin_pages(pins)
+                    self._prefix_pins.pop(rid, None)
+                    pins = []
             self.kv.allocate(slot, n + self._admission_tail(req),
                              prefix_pages=pins)
             self._reserved_pages -= self._reserved_by.pop(rid, 0)
@@ -1724,6 +1805,8 @@ class InferenceEngine:
             "prefix_cached_tokens": self.total_prefix_cached_tokens,
             "requeue_cached_tokens": self.total_requeue_cached_tokens,
             "prefix_fetched_tokens": self.total_prefix_fetched_tokens,
+            "salvage_tail_fetched_tokens":
+                self.total_salvage_tail_fetched_tokens,
             "unexpected_prefills": self.total_unexpected_prefills,
             "partial_restores": self.total_partial_restores,
             "padded_slot_steps": self.total_padded_slot_steps,

@@ -41,6 +41,7 @@ from .remote import RemoteReplica, RemoteUnavailable
 from .replica import (ROLE_DECODE, ROLE_MIXED, ROLE_PREFILL, EngineReplica,
                       reset_for_requeue)
 from .router import FleetRouter, FleetSaturated, prefix_digest
+from .streams import FleetStreamHub
 from .supervisor import ReplicaSupervisor
 from .transport import (CourierReceiver, HTTPCourierTransport,
                         InProcTransport, KVCourier, TransferAborted,
@@ -55,6 +56,7 @@ __all__ = [
     "FaultPlan",
     "FleetRouter",
     "FleetSaturated",
+    "FleetStreamHub",
     "HTTPCourierTransport",
     "InProcTransport",
     "InjectedCrash",
@@ -111,6 +113,15 @@ class ServeFleet:
         # destinations use the local receiver; remote destinations are
         # pushed over HTTP per the fleet_endpoints map.
         self.courier = KVCourier(self.fleet_cfg, injector=self.injector)
+        # fleet SSE streaming: the per-request token log + stream hub
+        # (serve/fleet/streams.py). Every replica a streaming request
+        # crosses publishes its token batches here with monotonic
+        # sequence numbers; the hub dedupes by seq, so crash requeue,
+        # drain migration, disagg handoff, and SIGKILL'd workers are
+        # invisible to SSE clients — delivery just resumes from the last
+        # acked token on the new producer.
+        self.streams = FleetStreamHub(
+            ttl_ms=self.fleet_cfg.stream_log_ttl_ms)
         # inbound chunk reassembly for the HTTP front
         # (/fleet/courier/chunk) shares the courier's receiver, so
         # socket-delivered and in-proc transfers attach in one place
@@ -153,8 +164,13 @@ class ServeFleet:
                 # ticket and publishes them through its outbox; the
                 # supervisor's migrated-collection places them — and it
                 # runs its own prefix fetches (the hint travels on the
-                # submit wire)
+                # submit wire). Its token batches arrive cursor-tagged
+                # through the same outbox poll.
+                r.on_tokens = self._on_remote_stream_tokens
                 continue
+            # in-proc streaming: the engine's on_token feeds the hub
+            # directly, with the request object as the gap authority
+            r.on_token = self._on_stream_tokens
             # disaggregation wiring: a prefill-role replica asks the
             # router for a decode destination BEFORE extracting (local-
             # decode fallback when no pool has room), then places the
@@ -169,11 +185,21 @@ class ServeFleet:
             r.prefix_fetcher = self.courier.fetch_prefix
         self.supervisor = ReplicaSupervisor(
             self.replicas, self.router, self.fleet_cfg,
-            injector=self.injector, params=params, observer=observer)
+            injector=self.injector, params=params, observer=observer,
+            streams=self.streams)
         self._supervise = supervise
 
     def _on_request_exit(self, replica_id: int, req: Request) -> None:
         self.router.on_request_exit(replica_id, req)
+
+    def _on_stream_tokens(self, replica_id: int, req: Request,
+                          tokens: list) -> None:
+        self.streams.publish_from_request(req, tokens, replica=replica_id)
+
+    def _on_remote_stream_tokens(self, replica_id: int, request_id: str,
+                                 start: int, tokens: list) -> None:
+        self.streams.publish(request_id, start, tokens,
+                             replica=replica_id)
 
     def _place_handoff(self, replica_id: int, req: Request,
                        dest: Optional[int]) -> None:
@@ -208,6 +234,38 @@ class ServeFleet:
         return self.router.submit(prompt_tokens, sampling,
                                   request_id=request_id,
                                   on_complete=on_complete)
+
+    def submit_streaming(self, prompt_tokens: Sequence[int],
+                         sampling: Optional[SamplingParams] = None,
+                         request_id: Optional[str] = None,
+                         on_complete: Optional[Callable[[Request], None]]
+                         = None) -> Request:
+        """Admit one STREAMING request: its token batches flow through
+        the fleet stream hub (``self.streams``) with monotonic sequence
+        numbers, across every re-placement the fleet performs. The log
+        is opened BEFORE placement so no producer can race the first
+        token past it; a rejected submission tears it down again. The
+        hub finishes (and final-syncs) the log on the request's terminal
+        state — normal completion AND router-side failure — before the
+        caller's ``on_complete`` fires."""
+        import uuid as _uuid
+        rid = request_id or f"fleet-{_uuid.uuid4().hex[:24]}"
+        self.streams.open(rid)
+
+        def _complete(req: Request) -> None:
+            meta = getattr(req, "fleet_meta", {}) or {}
+            self.streams.finish_from_request(req,
+                                             replica=meta.get("replica"))
+            if on_complete is not None:
+                on_complete(req)
+
+        try:
+            return self.router.submit(prompt_tokens, sampling,
+                                      request_id=rid,
+                                      on_complete=_complete, stream=True)
+        except Exception:
+            self.streams.discard(rid)
+            raise
 
     def generate(self, prompts: Sequence[Sequence[int]],
                  sampling: Optional[SamplingParams] = None,

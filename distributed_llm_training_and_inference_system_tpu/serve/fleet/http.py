@@ -35,9 +35,19 @@ operator endpoints:
 Backpressure contract: when every replica saturates, completions answer
 **429 with a Retry-After header** (seconds) instead of queueing without
 bound — the client-visible half of the router's ``max_pending`` admission
-bound. SSE streaming is not offered on the fleet front yet (a stream
-would pin a request to one replica and break crash-requeue transparency);
-``stream: true`` is rejected with 400 rather than silently degraded.
+bound.
+
+SSE streaming (``stream: true``, accepted since PR 8) is served through
+the fleet stream hub (serve/fleet/streams.py): every token carries a
+monotonic sequence number in the SSE ``id:`` field, producers publish
+through the hub which dedupes by seq, and crash requeue / drain
+migration / disagg handoff / SIGKILL'd remote workers are therefore
+client-invisible — delivery resumes from the last delivered token on
+the new replica, gapless and duplicate-free. A dropped HTTP connection
+does NOT abort the request: reconnect at
+``GET /v1/streams/{request_id}`` with the standard ``Last-Event-ID``
+header (or ``?last_event_id=``) and only the unacked tail replays. The
+finished log stays replayable for ``FleetConfig.stream_log_ttl_ms``.
 """
 
 from __future__ import annotations
@@ -89,10 +99,8 @@ class FleetServer:
         except BadRequest as e:
             return web.json_response({"error": str(e)}, status=400)
         if stream:
-            return web.json_response(
-                {"error": "stream=true is not supported on the fleet "
-                          "endpoint (a stream would pin the request to one "
-                          "replica and break crash-requeue)"}, status=400)
+            return await self._stream_completion(request, prompt_tokens,
+                                                 sampling)
 
         loop = asyncio.get_running_loop()
         event = asyncio.Event()
@@ -147,6 +155,129 @@ class FleetServer:
                         "replica": meta.get("replica"),
                         "requeues": meta.get("requeues", 0)},
         })
+
+    # -- SSE streaming -------------------------------------------------------
+
+    async def _stream_completion(self, http_req: web.Request,
+                                 prompt_tokens, sampling):
+        """`stream: true` path: admit through the stream hub and serve
+        the SSE response from seq 0."""
+        try:
+            req = self.fleet.submit_streaming(prompt_tokens, sampling)
+        except FleetSaturated as e:
+            return web.json_response(
+                {"error": str(e)}, status=429,
+                headers={"Retry-After":
+                         str(max(int(e.retry_after_s + 0.5), 1))})
+        except ValueError as e:      # per-replica validation (too long)
+            return web.json_response({"error": str(e)}, status=400)
+        return await self._serve_stream(http_req, req.request_id,
+                                        from_seq=0, resume=False)
+
+    async def handle_stream_resume(self, request: web.Request):
+        """``GET /v1/streams/{request_id}``: reconnect a dropped SSE
+        stream. ``Last-Event-ID`` (header or ``?last_event_id=``) names
+        the last seq the client received; only the unacked tail replays,
+        then delivery continues live. 404 once the log left the replay
+        window (``stream_log_ttl_ms``) or never existed."""
+        rid = request.match_info["request_id"]
+        raw = (request.headers.get("Last-Event-ID")
+               or request.query.get("last_event_id"))
+        try:
+            from_seq = int(raw) + 1 if raw is not None else 0
+        except ValueError:
+            return web.json_response(
+                {"error": f"Last-Event-ID must be an integer seq, "
+                          f"got {raw!r}"}, status=400)
+        if not self.fleet.streams.has(rid):
+            return web.json_response(
+                {"error": f"unknown or expired stream {rid!r}"},
+                status=404)
+        return await self._serve_stream(request, rid,
+                                        from_seq=max(from_seq, 0),
+                                        resume=True)
+
+    def _sse_event(self, rid: str, seq_last: int, token_ids: list,
+                   finish_reason=None) -> bytes:
+        """One SSE frame. ``id:`` carries the seq of the LAST token in
+        the batch — exactly what a reconnect must echo as
+        ``Last-Event-ID`` to resume duplicate-free."""
+        payload = {
+            "id": rid, "object": "text_completion",
+            "model": self.model_cfg.name, "seq": seq_last,
+            "choices": [{"index": 0,
+                         "text": self.tokenizer.decode(token_ids)
+                         if token_ids else "",
+                         "token_ids": token_ids,
+                         "finish_reason": finish_reason}],
+        }
+        return (f"id: {seq_last}\n"
+                f"data: {json.dumps(payload)}\n\n").encode()
+
+    async def _serve_stream(self, http_req: web.Request, rid: str,
+                            from_seq: int, resume: bool):
+        """Serve one SSE connection off the stream hub: atomic
+        (replay-tail, live-subscription) snapshot, then hub events in
+        order until the finish event. A dropped connection only
+        unsubscribes — the request keeps decoding and the log keeps
+        growing, so a reconnect resumes where the client left off."""
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+
+        def on_event(ev):     # hub thread -> event loop, non-blocking
+            loop.call_soon_threadsafe(q.put_nowait, ev)
+
+        sub = self.fleet.streams.subscribe(rid, from_seq, on_event,
+                                           resume=resume)
+        if sub is None:       # raced the replay TTL
+            return web.json_response(
+                {"error": f"unknown or expired stream {rid!r}"},
+                status=404)
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+        })
+        seq_next = sub["start"]
+        try:
+            await resp.prepare(http_req)
+            if sub["tokens"]:
+                seq_next = sub["start"] + len(sub["tokens"])
+                await resp.write(self._sse_event(rid, seq_next - 1,
+                                                 sub["tokens"]))
+            finished = sub["finished"]
+            finish_reason = sub["finish_reason"]
+            while not finished:
+                try:
+                    ev = await asyncio.wait_for(q.get(), timeout=600.0)
+                except asyncio.TimeoutError:
+                    # engine stalled for 10 minutes: free the slot like
+                    # the non-streaming timeout path does
+                    self.fleet.router.cancel(rid)
+                    break
+                if ev[0] == "tokens":
+                    _kind, start, toks = ev
+                    seq_next = start + len(toks)
+                    await resp.write(self._sse_event(rid, seq_next - 1,
+                                                     list(toks)))
+                else:
+                    _kind, finish_reason, _error = ev
+                    finished = True
+            await resp.write(self._sse_event(
+                rid, max(seq_next - 1, 0), [],
+                finish_reason=finish_reason or "error"))
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+        except (ConnectionResetError, asyncio.CancelledError):
+            # client went away. Do NOT cancel the request: the stream
+            # log keeps the tail replayable and the client reconnects
+            # with Last-Event-ID (the single-server front, which has no
+            # reconnect, aborts instead — see serve/server.py)
+            logger.info("stream %s: client disconnected at seq %d "
+                        "(reconnectable)", rid, seq_next - 1)
+            raise
+        finally:
+            self.fleet.streams.unsubscribe(rid, sub["sub"])
+        return resp
 
     async def handle_models(self, request: web.Request) -> web.Response:
         return web.json_response({
@@ -290,6 +421,8 @@ class FleetServer:
     def _build_app(self) -> web.Application:
         app = web.Application()
         app.router.add_post("/v1/completions", self.handle_completions)
+        app.router.add_get("/v1/streams/{request_id}",
+                           self.handle_stream_resume)
         app.router.add_get("/v1/models", self.handle_models)
         app.router.add_get("/v1/stats", self.handle_stats)
         app.router.add_get("/health", self.handle_health)

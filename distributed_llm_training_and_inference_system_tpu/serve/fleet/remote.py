@@ -85,6 +85,9 @@ def request_to_wire(req: Request) -> dict:
         "assigned_seed": req.assigned_seed,
         "fleet_requeued": bool(req.fleet_requeued),
         "handoffs": int(getattr(req, "handoffs", 0)),
+        # fleet SSE streaming: a streaming request's worker publishes
+        # cursor-tagged token batches through its outbox
+        "stream": bool(getattr(req, "stream_requested", False)),
         "sampling": sampling_to_wire(req.sampling),
         "ticket": ticket,
         "partial": bool(kv.get("partial")) if isinstance(kv, dict)
@@ -110,6 +113,7 @@ def request_from_wire(d: dict, receiver=None) -> Request:
     req.assigned_seed = d.get("assigned_seed")
     req.fleet_requeued = bool(d.get("fleet_requeued"))
     req.handoffs = int(d.get("handoffs", 0))
+    req.stream_requested = bool(d.get("stream"))
     req.prefix_owner = d.get("prefix_owner")
     req.prefix_owner_endpoint = d.get("prefix_owner_endpoint")
     ticket = d.get("ticket")
@@ -148,6 +152,12 @@ class RemoteReplica:
         self.cfg = fleet_cfg
         self.injector = injector
         self.on_finish = on_finish
+        # fleet SSE streaming: fired with (replica_id, request_id,
+        # start_seq, tokens) for each cursor-tagged batch the worker
+        # published through its outbox. Set by ServeFleet to feed the
+        # stream hub (which dedupes by seq, so late or re-delivered
+        # batches after a SIGKILL/requeue are harmless).
+        self.on_tokens: Optional[Callable] = None
         self.role = role
         self.poll_interval_s = poll_interval_s
         self.timeout_s = float(getattr(fleet_cfg, "remote_timeout_s", 5.0))
@@ -483,10 +493,46 @@ class RemoteReplica:
                     self._migrated.append((req, MigrationTicket(
                         request_id=req.request_id, dest=e.get("dest"),
                         reason=reason)))
+            elif kind == "stream":
+                self._apply_stream(e)
             else:
                 logger.warning("replica %d: unknown outbox entry %r",
                                self.replica_id, kind)
         return len(entries)
+
+    def _apply_stream(self, e: dict) -> None:
+        """One cursor-tagged token batch from the worker's outbox. The
+        committed tokens fold onto the parent-side Request object (with
+        the worker's assigned_seed), so a later SIGKILL teardown requeues
+        from the last STREAMED token instead of position zero — the
+        survivor re-prefills the streamed context and continues the same
+        PRNG stream, resuming delivery with no client-visible gap. Then
+        the batch is forwarded to the hub, which dedupes by seq (a stale
+        poll or post-requeue regeneration re-sends nothing)."""
+        rid = str(e.get("request_id", ""))
+        try:
+            start = int(e.get("start", 0))
+            toks = [int(t) for t in e.get("tokens", [])]
+        except (TypeError, ValueError):
+            logger.warning("replica %d: malformed stream entry for %s",
+                           self.replica_id, rid)
+            return
+        if not rid or not toks:
+            return
+        with self._lock:
+            req = self._inflight.get(rid)
+            if req is not None:
+                if req.assigned_seed is None \
+                        and e.get("seed") is not None:
+                    req.assigned_seed = int(e["seed"])
+                gen = req.generated_tokens
+                if start <= len(gen) < start + len(toks):
+                    gen.extend(toks[len(gen) - start:])
+                if req.first_token_time is None:
+                    req.first_token_time = time.monotonic()
+        cb = self.on_tokens
+        if cb is not None:
+            cb(self.replica_id, rid, start, toks)
 
     def _resolve(self, e: dict) -> Request:
         d = e["request"]

@@ -166,6 +166,13 @@ class EngineReplica:
         # slot terminally on this replica (finished/cancelled) — the
         # router's completion hook. NOT fired on crash/drain extraction.
         self.on_finish = on_finish
+        # fleet SSE streaming: fired with (replica_id, request, tokens)
+        # for each freshly-accepted token batch of a STREAMING request
+        # (engine on_token, forwarded only when req.stream_requested).
+        # Set by ServeFleet to feed the FleetStreamHub; fires on the
+        # engine thread, sometimes under engine.lock — the hub never
+        # calls back into an engine, so no inversion is possible.
+        self.on_token: Optional[Callable] = None
         # host-local CourierReceiver (set by ServeFleet / FleetWorker):
         # payload-carrying requests arrive holding a ticket STUB; submit
         # attaches the completed payload from this receiver — the
@@ -193,6 +200,7 @@ class EngineReplica:
         """Attach the fleet hooks + role expectations to self.engine (also
         re-run after restart() builds a fresh one)."""
         self.engine.on_finish = self._engine_finished
+        self.engine.on_token = self._engine_tokens
         self.engine.on_prefill_complete = self._on_prefill_complete
         self.engine.expect_pure_decode = (self.role == ROLE_DECODE)
         self.engine.prefix_fetch_hook = (self._fetch_prefix
@@ -414,6 +422,14 @@ class EngineReplica:
     def _engine_finished(self, req: Request) -> None:
         if self.on_finish is not None:
             self.on_finish(self.replica_id, req)
+
+    def _engine_tokens(self, req: Request, tokens: list) -> None:
+        """Engine on_token hook: forward a streaming request's fresh
+        batch to the fleet stream plane. Non-streaming requests (and
+        warmup generates) skip the callback entirely."""
+        cb = self.on_token
+        if cb is not None and getattr(req, "stream_requested", False):
+            cb(self.replica_id, req, tokens)
 
     # -- prefill->decode handoff (engine-thread half) ------------------------
 

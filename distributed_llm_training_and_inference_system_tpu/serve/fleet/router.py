@@ -101,6 +101,18 @@ class FleetRouter:
             self._endpoints = self.cfg.endpoint_map()
         except Exception:
             self._endpoints = {}
+        # inventory TTL cache (PR-7 named gap): > 0 bounds how often the
+        # hint path re-reads every replica's prefix-page inventory.
+        # Invalidated wholesale on replica teardown/drain/undrain/
+        # restart (supervisor calls invalidate_inventories) — a dead
+        # owner's pages must leave the hint path immediately, while
+        # within-TTL staleness only costs a counted fetch miss.
+        self._inv_ttl_s = float(getattr(self.cfg,
+                                        "prefix_inventory_ttl_ms", 0.0)
+                                or 0.0) / 1e3
+        self._inv_cache: Optional[tuple[float, dict]] = None
+        self.inventory_cache_hits = 0
+        self.inventory_cache_misses = 0
         # _lock guards router bookkeeping ONLY. It is never held across a
         # replica.submit() call: submit takes the engine lock, and the
         # engine thread calls back into on_request_exit under that same
@@ -198,15 +210,30 @@ class FleetRouter:
     # -- fleet-global prefix-cache hints -------------------------------------
 
     def _hints_enabled(self, req: Request) -> bool:
-        return (self.page_size > 0 and self.cfg.prefix_fetch
-                and req.swapped_kv is None)
+        """Needs-prefill placements get owner hints. PARTIAL payloads
+        (crash-salvaged pre-copies) count: their uncovered tail is a
+        prefill like any other, and the engine routes it through the
+        prefix-fetch path (``_maybe_fetch_salvage_tail``) when hinted."""
+        if self.page_size <= 0 or not self.cfg.prefix_fetch:
+            return False
+        kv = req.swapped_kv
+        return kv is None or bool(kv.get("partial"))
 
     def _inventories(self) -> dict:
-        """Per-replica prefix-page hash sets, read fresh at placement
-        time. Crashed/stopped replicas are skipped (their cache died or
-        is dark); DRAINED ones are not — a drained replica's engine is
-        alive and serving its pages is exactly the flash-crowd-spill
-        case this plane exists for."""
+        """Per-replica prefix-page hash sets for the hint path. Crashed/
+        stopped replicas are skipped (their cache died or is dark);
+        DRAINED ones are not — a drained replica's engine is alive and
+        serving its pages is exactly the flash-crowd-spill case this
+        plane exists for. With ``prefix_inventory_ttl_ms`` > 0 the map
+        is cached for that long (counted hits/misses) instead of being
+        re-read from every replica on every placement."""
+        if self._inv_ttl_s > 0:
+            now = time.monotonic()
+            with self._lock:
+                if self._inv_cache is not None \
+                        and now < self._inv_cache[0]:
+                    self.inventory_cache_hits += 1
+                    return self._inv_cache[1]
         from .replica import CRASHED, STOPPED
         out = {}
         for r in self.replicas:
@@ -220,7 +247,20 @@ class FleetRouter:
                 hashes = ()
             if hashes:
                 out[r.replica_id] = set(hashes)
+        if self._inv_ttl_s > 0:
+            with self._lock:
+                self.inventory_cache_misses += 1
+                self._inv_cache = (time.monotonic() + self._inv_ttl_s,
+                                   out)
         return out
+
+    def invalidate_inventories(self) -> None:
+        """Drop the TTL inventory cache (replica teardown / drain /
+        undrain / restart: that replica's advertised pages just changed
+        wholesale, and a fetch hint naming a dead owner would burn a
+        timeout per placement until the TTL expired)."""
+        with self._lock:
+            self._inv_cache = None
 
     def _attach_prefix_hint(self, req: Request, dest_id: int,
                             invs: dict) -> None:
@@ -268,15 +308,18 @@ class FleetRouter:
                sampling: Optional[SamplingParams] = None,
                request_id: Optional[str] = None,
                on_complete: Optional[Callable[[Request], None]] = None,
-               ) -> Request:
+               stream: bool = False) -> Request:
         """Admit one request into the fleet. Returns the (QUEUED) Request;
         raises FleetSaturated on backpressure. ``on_complete`` fires (from
         an engine thread) when the request reaches a terminal state, however
-        many replicas it crossed on the way."""
+        many replicas it crossed on the way. ``stream`` marks the request
+        for token streaming: every replica it crosses publishes its token
+        batches to the fleet stream hub (serve/fleet/streams.py)."""
         req = Request(
             request_id=request_id or f"fleet-{uuid.uuid4().hex[:24]}",
             prompt_tokens=list(prompt_tokens),
-            sampling=sampling or SamplingParams())
+            sampling=sampling or SamplingParams(),
+            stream_requested=bool(stream))
         if self.pending_total() >= self.cfg.max_pending:
             with self._lock:
                 self.total_rejected += 1
@@ -587,6 +630,8 @@ class FleetRouter:
                 "handoffs": self.total_handoffs,
                 "parked": len(self._parked),
                 "in_flight": in_flight,
+                "inventory_cache_hits": self.inventory_cache_hits,
+                "inventory_cache_misses": self.inventory_cache_misses,
                 "completed_per_replica": dict(self.completed_per_replica),
                 "routed_per_replica": dict(self.routed_per_replica),
                 "requeues_per_replica": dict(self.requeues_per_replica),

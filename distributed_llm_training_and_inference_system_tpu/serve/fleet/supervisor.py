@@ -38,11 +38,16 @@ class ReplicaSupervisor:
                  cfg: Optional[FleetConfig] = None,
                  injector: Optional[FaultInjector] = None,
                  params=None,
-                 observer: Optional[Callable[[str, dict], None]] = None):
+                 observer: Optional[Callable[[str, dict], None]] = None,
+                 streams=None):
         self.cfg = cfg or FleetConfig()
         self.replicas = replicas
         self.router = router
         self.injector = injector
+        # fleet stream hub (serve/fleet/streams.py): snapshot columns +
+        # replay-window GC ride the supervisor poll. None = no streaming
+        # plane (unit tests on bare routers).
+        self.streams = streams
         self.params = params          # shared weights for engine rebuilds
         self.observer = observer or (lambda event, payload: None)
         self._misses: dict[int, int] = {r.replica_id: 0 for r in replicas}
@@ -100,6 +105,8 @@ class ReplicaSupervisor:
         self._maybe_role_restore()
         self._maybe_role_balance()
         self._maybe_rebalance()
+        if self.streams is not None:
+            self.streams.gc()        # expire finished replay windows
         if recovered or self.router.parked_count():
             self.router.flush_parked()
         snap = self.snapshot()
@@ -363,6 +370,9 @@ class ReplicaSupervisor:
                                "misses", r.replica_id,
                                self._misses[r.replica_id])
                 orphans = r.teardown()
+                # its prefix cache died with it: cached inventories must
+                # not keep hinting fetches at a dead owner
+                self.router.invalidate_inventories()
                 if orphans:
                     self.router.requeue(orphans,
                                         from_replica=r.replica_id)
@@ -388,6 +398,7 @@ class ReplicaSupervisor:
         try:
             r.stop()                    # idempotent; joins a dead thread
             r.restart(params=self.params)
+            self.router.invalidate_inventories()   # fresh (empty) cache
             self.total_restarts += 1
             self._misses[r.replica_id] = 0
             del self._next_restart[r.replica_id]
@@ -415,6 +426,9 @@ class ReplicaSupervisor:
         if r is None:
             return False
         r.request_drain()
+        # drain changes which replica should attract placements AND whose
+        # inventory the spill-off hints should consult — re-read fresh
+        self.router.invalidate_inventories()
         return True
 
     def undrain(self, replica_id: int) -> bool:
@@ -423,6 +437,7 @@ class ReplicaSupervisor:
         if r is None:
             return False
         r.undrain()
+        self.router.invalidate_inventories()
         self.router.flush_parked()
         return True
 
@@ -502,6 +517,8 @@ class ReplicaSupervisor:
             endpoints = self.cfg.endpoint_map()
         except Exception:
             endpoints = {}
+        stream_by_replica = (self.streams.replica_stats()
+                             if self.streams is not None else {})
         for r in self.replicas:
             hits, queries, cached = r.prefix_cache_stats()
             requeue_cached += cached
@@ -542,6 +559,14 @@ class ReplicaSupervisor:
                 # attempts that came back empty
                 "prefix_fetch_pages": int(pf.get("pages", 0)),
                 "prefix_fetch_misses": int(pf.get("misses", 0)),
+                # fleet SSE streaming: live streams this replica is
+                # currently producing, and duplicate tokens it
+                # republished after a re-placement (suppressed by seq —
+                # the migration-resume replay, client-invisible)
+                "active_streams": int(stream_by_replica.get(
+                    r.replica_id, {}).get("active", 0)),
+                "stream_replayed_tokens": int(stream_by_replica.get(
+                    r.replica_id, {}).get("replayed", 0)),
             })
         migration = {
             "migrations": sum(r.migrations_out for r in self.replicas),
@@ -586,6 +611,11 @@ class ReplicaSupervisor:
         return {"replicas": reps, "router": self.router.stats(),
                 "restarts": self.total_restarts, "migration": migration,
                 "handoff": handoff,
+                # fleet SSE streaming: hub counters (running totals +
+                # the bounded replay-size window — the usual Prometheus
+                # delta contract; feeds llmctl_fleet_stream_*)
+                "streams": (self.streams.stats()
+                            if self.streams is not None else {}),
                 # fleet-global prefix cache: fetched-instead-of-
                 # recomputed pages/bytes, misses, aborts + the fetch
                 # latency window (feeds llmctl_fleet_prefix_fetch_*)

@@ -93,6 +93,12 @@ class FleetWorker:
         # directly in tests can set it by hand
         self.self_endpoint: Optional[str] = None
         self.replica.prefix_fetcher = self._fetch_prefix
+        # fleet SSE streaming: a streaming request's token batches ship
+        # to the parent as cursor-tagged outbox entries (tokens are tiny
+        # — no courier involved). The outbox deque preserves order, so a
+        # request's stream entries always precede its own finished /
+        # orphan / migrated entry.
+        self.replica.on_token = self._on_token
         if warmup:
             # compile outside the serving path, then zero the prefill
             # counters the fleet's zero-re-prefill assertions read
@@ -123,6 +129,22 @@ class FleetWorker:
             "error": req.error,
             "ttft_ms": req.ttft_ms,
         }
+        with self._lock:
+            self._outbox.append(entry)
+
+    def _on_token(self, replica_id: int, req: Request,
+                  tokens: list) -> None:
+        """Engine-thread streaming hook: publish one token batch with its
+        sequence cursor. ``start`` is derived from the request's own
+        committed token count, so after any local engine rebuild +
+        re-prefill the cursors stay aligned with the fleet-wide sequence
+        numbering (seq = index into generated_tokens). ``seed`` rides
+        along so the parent can fold streamed tokens into its copy and
+        requeue a SIGKILL'd stream from the last delivered token."""
+        entry = {"kind": "stream", "request_id": req.request_id,
+                 "start": len(req.generated_tokens) - len(tokens),
+                 "tokens": [int(t) for t in tokens],
+                 "seed": req.assigned_seed}
         with self._lock:
             self._outbox.append(entry)
 

@@ -45,6 +45,44 @@ def prefix_page_hashes(tokens, page_size: int) -> list[bytes]:
     return out
 
 
+def slice_page_payload(content: dict, n: int) -> dict:
+    """First ``n`` pages of an ``extract_pages``-schema payload (plain
+    arrays or quantized {values, scale} dicts; page axis is 1)."""
+    total = int(content["num_pages"])
+    if not 0 < n <= total:
+        raise ValueError(
+            f"slice_page_payload: want {n} of {total} page(s)")
+
+    def cut(node):
+        if isinstance(node, dict):
+            return {k: cut(v) for k, v in node.items()}
+        return np.asarray(node)[:, :n]
+    return {"k": cut(content["k"]), "v": cut(content["v"]),
+            "num_pages": n}
+
+
+def concat_page_payloads(a: dict, b: dict) -> dict:
+    """Concatenate two page payloads along the page axis — the
+    salvage-tail splice (serve/engine.py ``_maybe_fetch_salvage_tail``):
+    a crash-salvaged partial payload grows by the chain pages a sibling
+    replica's cache still held. Quantized and plain payloads must not
+    mix (the write path validates shapes again before any scatter)."""
+
+    def cat(x, y):
+        if isinstance(x, dict) != isinstance(y, dict):
+            raise ValueError(
+                "concat_page_payloads: quantized/plain payload mismatch")
+        if isinstance(x, dict):
+            if set(x) != set(y):
+                raise ValueError(
+                    f"concat_page_payloads: quantized parts differ "
+                    f"({sorted(x)} vs {sorted(y)})")
+            return {k: cat(x[k], y[k]) for k in x}
+        return np.concatenate([np.asarray(x), np.asarray(y)], axis=1)
+    return {"k": cat(a["k"], b["k"]), "v": cat(a["v"], b["v"]),
+            "num_pages": int(a["num_pages"]) + int(b["num_pages"])}
+
+
 class PagedKVCache:
     def __init__(
         self,

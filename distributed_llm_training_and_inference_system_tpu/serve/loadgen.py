@@ -89,6 +89,14 @@ class LoadResult:
     # scenario's payoff readout), with miss/abort counts and fetch
     # latency percentiles
     prefix_fetch: dict = field(default_factory=dict)
+    # streaming client mode (fleet targets, stream=True): every request
+    # consumed as a live token stream off the fleet stream hub. Reports
+    # streamed-token identity vs the final completion (the
+    # exactly-once-delivery assertion), client-observed seq gaps/dups
+    # (must be 0 — the hub's ordering contract), suppressed producer
+    # duplicates, and per-token delivery-gap percentiles (jitter: how
+    # bursty delivery got across injected crashes/migrations)
+    stream: dict = field(default_factory=dict)
 
     def percentile(self, xs, q):
         return float(np.percentile(np.asarray(xs), q)) if xs else float("nan")
@@ -131,6 +139,7 @@ class LoadResult:
             **({"courier": self.courier} if self.courier else {}),
             **({"prefix_fetch": self.prefix_fetch}
                if self.prefix_fetch else {}),
+            **({"stream": self.stream} if self.stream else {}),
         }
 
 
@@ -181,8 +190,46 @@ def _is_fleet(target) -> bool:
     return hasattr(target, "router")
 
 
+class _StreamClient:
+    """One streamed request's client-side consumer: subscribes to the
+    fleet stream hub, asserts the per-subscriber ordering contract
+    (contiguous seqs — any gap or duplicate is counted and would fail
+    the run's identity check), and records per-batch delivery times for
+    the jitter percentiles. Callbacks arrive on producer threads under
+    the hub lock, so this only appends."""
+
+    def __init__(self):
+        self.tokens: list[int] = []
+        self.next_seq = 0
+        self.gaps = 0
+        self.dups = 0
+        self.batch_times: list[float] = []   # one stamp per batch burst
+        self.finished = False
+
+    def __call__(self, ev):
+        if ev[0] == "tokens":
+            _kind, start, toks = ev
+            if start > self.next_seq:
+                self.gaps += 1
+            elif start < self.next_seq:
+                self.dups += 1
+            self.tokens.extend(toks)
+            self.next_seq = start + len(toks)
+            self.batch_times.append(time.monotonic())
+        else:
+            self.finished = True
+
+    def delivery_gaps_ms(self) -> list:
+        """Inter-batch delivery gaps — the client-observed inter-token
+        stall profile (a migration/crash resume shows up as one long
+        gap; steady decode as the dispatch cadence)."""
+        return [(b - a) * 1e3 for a, b in
+                zip(self.batch_times, self.batch_times[1:])]
+
+
 def _finalize_fleet(res: LoadResult, reqs: list, fleet,
-                    t0: float) -> LoadResult:
+                    t0: float,
+                    stream_clients: Optional[dict] = None) -> LoadResult:
     """Fleet-side accounting: aggregate latencies like _finalize, then the
     per-replica breakdown (requests, p50/p99 TTFT, requeues) from each
     request's routing metadata + the router ledger."""
@@ -302,6 +349,46 @@ def _finalize_fleet(res: LoadResult, reqs: list, fleet,
             "p99_fetch_ms": pct4(window, 99),
         }
 
+    # streaming client mode: per-token delivery jitter + the
+    # exactly-once ledger. ``identity_ok`` is the headline assertion:
+    # every request's STREAMED token sequence equals its final
+    # completion, with zero client-observed seq gaps or duplicates —
+    # across whatever crashes/migrations the run injected.
+    if stream_clients is not None:
+        by_rid = {r.request_id: r for r in reqs}
+        identity_ok = True
+        streamed_tokens = 0
+        gaps = dups = 0
+        all_gaps_ms: list = []
+        for rid, sc in stream_clients.items():
+            req = by_rid.get(rid)
+            if req is not None and req.state is RequestState.FINISHED \
+                    and sc.tokens != req.generated_tokens:
+                identity_ok = False
+            streamed_tokens += len(sc.tokens)
+            gaps += sc.gaps
+            dups += sc.dups
+            all_gaps_ms.extend(sc.delivery_gaps_ms())
+        hub = fleet.streams.stats()
+
+        def pct5(xs, q):
+            return round(res.percentile(xs, q), 2) if xs else None
+
+        res.stream = {
+            "streams": len(stream_clients),
+            "tokens": streamed_tokens,
+            "identity_ok": identity_ok,
+            "gaps": gaps,
+            "duplicates": dups,
+            # producer-side re-sends the hub absorbed (never delivered)
+            "suppressed_duplicates": hub.get("duplicates", 0),
+            "replayed": hub.get("replayed", 0),
+            "p50_gap_ms": pct5(all_gaps_ms, 50),
+            "p99_gap_ms": pct5(all_gaps_ms, 99),
+            "max_gap_ms": (round(max(all_gaps_ms), 2)
+                           if all_gaps_ms else None),
+        }
+
     for rid, slot in sorted(by_replica.items()):
         res.per_replica[rid] = {
             "requests": slot["requests"],
@@ -321,23 +408,44 @@ def _finalize_fleet(res: LoadResult, reqs: list, fleet,
 
 def _submit_fleet(fleet, prompt, max_tokens, reqs, events, res,
                   retryq: Optional[list] = None, max_retries: int = 0,
-                  tries: int = 0):
+                  tries: int = 0,
+                  stream_clients: Optional[dict] = None):
     """One fleet submission; 429-style rejections are counted, not raised.
 
     With ``max_retries > 0`` a saturated submission honors the server's
     Retry-After hint: it re-enters ``retryq`` as (due_time, prompt, tries)
     and is resubmitted by the drive loop once due — the client half of the
     backpressure contract. Budget exhausted -> counted rejected+failed,
-    exactly like max_retries=0."""
+    exactly like max_retries=0.
+
+    ``stream_clients`` (a dict, streaming mode): submit through the
+    stream hub and attach a :class:`_StreamClient` subscriber — tokens
+    are then consumed as a live stream, not just read off the finished
+    request."""
     import threading
 
     from .fleet.router import FleetSaturated
     ev = threading.Event()
     try:
-        reqs.append(fleet.submit(
-            prompt,
-            SamplingParams(temperature=0.0, max_tokens=max_tokens),
-            on_complete=lambda _r, ev=ev: ev.set()))
+        if stream_clients is not None:
+            req = fleet.submit_streaming(
+                prompt,
+                SamplingParams(temperature=0.0, max_tokens=max_tokens),
+                on_complete=lambda _r, ev=ev: ev.set())
+            sc = _StreamClient()
+            sub = fleet.streams.subscribe(req.request_id, 0, sc)
+            if sub is not None:
+                if sub["tokens"]:
+                    sc(("tokens", sub["start"], sub["tokens"]))
+                if sub["finished"]:
+                    sc(("finish", sub["finish_reason"], sub["error"]))
+            stream_clients[req.request_id] = sc
+            reqs.append(req)
+        else:
+            reqs.append(fleet.submit(
+                prompt,
+                SamplingParams(temperature=0.0, max_tokens=max_tokens),
+                on_complete=lambda _r, ev=ev: ev.set()))
         events.append(ev)
     except FleetSaturated as e:
         if retryq is not None and tries < max_retries:
@@ -350,14 +458,15 @@ def _submit_fleet(fleet, prompt, max_tokens, reqs, events, res,
 
 
 def _drain_retryq(fleet, retryq, max_tokens, reqs, events, res,
-                  max_retries) -> None:
+                  max_retries, stream_clients=None) -> None:
     """Resubmit every due Retry-After entry (oldest first)."""
     now = time.monotonic()
     due = [x for x in retryq if x[0] <= now]
     for x in sorted(due):
         retryq.remove(x)
         _submit_fleet(fleet, x[1], max_tokens, reqs, events, res,
-                      retryq=retryq, max_retries=max_retries, tries=x[2])
+                      retryq=retryq, max_retries=max_retries, tries=x[2],
+                      stream_clients=stream_clients)
 
 
 def _hot_prefix(rng, hi, prompt_len, hot_prefix_len: int) -> list:
@@ -370,7 +479,8 @@ def _hot_prefix(rng, hi, prompt_len, hot_prefix_len: int) -> list:
 
 def _run_poisson_fleet(fleet, *, offered_rps, num_requests, prompt_len,
                        max_tokens, seed, vocab_hi, prompt_pool,
-                       max_retries=0, hot_prefix_len=0) -> LoadResult:
+                       max_retries=0, hot_prefix_len=0,
+                       stream=False) -> LoadResult:
     """Open-loop arrivals against a fleet router: replica threads do the
     stepping; the generator only submits on schedule and waits. The
     supervisor is polled inline when no background supervisor runs, so
@@ -386,6 +496,7 @@ def _run_poisson_fleet(fleet, *, offered_rps, num_requests, prompt_len,
     reqs: list[Request] = []
     events: list = []
     retryq: list = []
+    stream_clients: Optional[dict] = {} if stream else None
     res = LoadResult(offered_rps=offered_rps)
     supervised = fleet.supervisor._thread is not None
     t0 = time.monotonic()
@@ -398,26 +509,30 @@ def _run_poisson_fleet(fleet, *, offered_rps, num_requests, prompt_len,
                       else hot + rng.integers(
                           1, hi, size=prompt_len - len(hot)).tolist())
             _submit_fleet(fleet, prompt, max_tokens, reqs, events, res,
-                          retryq=retryq, max_retries=max_retries)
+                          retryq=retryq, max_retries=max_retries,
+                          stream_clients=stream_clients)
             i += 1
         _drain_retryq(fleet, retryq, max_tokens, reqs, events, res,
-                      max_retries)
+                      max_retries, stream_clients=stream_clients)
         res.queue_peak = max(res.queue_peak, fleet.router.pending_total())
         if not supervised:
             fleet.supervisor.poll_once()
         time.sleep(0.005)
-    return _finalize_fleet(res, reqs, fleet, t0)
+    return _finalize_fleet(res, reqs, fleet, t0,
+                           stream_clients=stream_clients)
 
 
 def _run_closed_loop_fleet(fleet, *, concurrency, num_requests, prompt_len,
                            max_tokens, seed, vocab_hi,
-                           max_retries=0, hot_prefix_len=0) -> LoadResult:
+                           max_retries=0, hot_prefix_len=0,
+                           stream=False) -> LoadResult:
     rng = np.random.default_rng(seed)
     hi = vocab_hi or fleet.model_cfg.vocab_size
     hot = _hot_prefix(rng, hi, prompt_len, hot_prefix_len)
     reqs: list[Request] = []
     events: list = []
     retryq: list = []
+    stream_clients: Optional[dict] = {} if stream else None
     res = LoadResult(offered_rps=float("inf"))
     supervised = fleet.supervisor._thread is not None
     submitted = 0
@@ -431,23 +546,25 @@ def _run_closed_loop_fleet(fleet, *, concurrency, num_requests, prompt_len,
                               1, hi,
                               size=prompt_len - len(hot)).tolist(),
                           max_tokens, reqs, events, res,
-                          retryq=retryq, max_retries=max_retries)
+                          retryq=retryq, max_retries=max_retries,
+                          stream_clients=stream_clients)
             submitted += 1
             in_flight += 1
         _drain_retryq(fleet, retryq, max_tokens, reqs, events, res,
-                      max_retries)
+                      max_retries, stream_clients=stream_clients)
         res.queue_peak = max(res.queue_peak, fleet.router.pending_total())
         if not supervised:
             fleet.supervisor.poll_once()
         time.sleep(0.005)
-    return _finalize_fleet(res, reqs, fleet, t0)
+    return _finalize_fleet(res, reqs, fleet, t0,
+                           stream_clients=stream_clients)
 
 
 def run_poisson(engine: InferenceEngine, *, offered_rps: float,
                 num_requests: int, prompt_len: int, max_tokens: int,
                 seed: int = 0, vocab_hi: Optional[int] = None,
                 prompt_pool: int = 0, max_retries: int = 0,
-                hot_prefix_len: int = 0,
+                hot_prefix_len: int = 0, stream: bool = False,
                 device_times: bool = False) -> LoadResult:
     """Open-loop run: arrivals follow a seeded Poisson process regardless of
     engine progress; steps until everything admitted drains.
@@ -465,13 +582,21 @@ def run_poisson(engine: InferenceEngine, *, offered_rps: float,
     ``hot_prefix_len > 0`` is the flash-crowd scenario: every prompt
     shares the same seeded hot head with a random tail — on a fleet
     this is the workload where off-affinity spill exercises the
-    fleet-global prefix fetch (LoadResult.prefix_fetch)."""
+    fleet-global prefix fetch (LoadResult.prefix_fetch).
+
+    ``stream=True`` (fleet only) drives every request as a live SSE-style
+    token stream off the fleet stream hub: LoadResult.stream reports
+    streamed-token identity vs the final completion, client-observed
+    gaps/duplicates (must be 0), and per-token delivery-gap percentiles
+    — the client-side half of the migration-transparent streaming
+    contract. Ignored for plain engines."""
     if _is_fleet(engine):
         return _run_poisson_fleet(
             engine, offered_rps=offered_rps, num_requests=num_requests,
             prompt_len=prompt_len, max_tokens=max_tokens, seed=seed,
             vocab_hi=vocab_hi, prompt_pool=prompt_pool,
-            max_retries=max_retries, hot_prefix_len=hot_prefix_len)
+            max_retries=max_retries, hot_prefix_len=hot_prefix_len,
+            stream=stream)
     rng = np.random.default_rng(seed)
     hi = vocab_hi or engine.cfg.vocab_size
     gaps = rng.exponential(1.0 / offered_rps, size=num_requests)
@@ -516,18 +641,20 @@ def run_closed_loop(engine: InferenceEngine, *, concurrency: int,
                     num_requests: int, prompt_len: int, max_tokens: int,
                     seed: int = 0, vocab_hi: Optional[int] = None,
                     max_retries: int = 0, hot_prefix_len: int = 0,
+                    stream: bool = False,
                     device_times: bool = False) -> LoadResult:
     """Closed-loop run: keep ``concurrency`` requests in flight (a new one
     arrives the moment one finishes) — the standard saturation probe.
     Fleet targets route through the router like run_poisson; see there for
-    ``max_retries`` (Retry-After honoring) and ``hot_prefix_len`` (the
-    flash-crowd shared-prefix scenario)."""
+    ``max_retries`` (Retry-After honoring), ``hot_prefix_len`` (the
+    flash-crowd shared-prefix scenario), and ``stream`` (the streaming
+    client mode with its identity + jitter readout)."""
     if _is_fleet(engine):
         return _run_closed_loop_fleet(
             engine, concurrency=concurrency, num_requests=num_requests,
             prompt_len=prompt_len, max_tokens=max_tokens, seed=seed,
             vocab_hi=vocab_hi, max_retries=max_retries,
-            hot_prefix_len=hot_prefix_len)
+            hot_prefix_len=hot_prefix_len, stream=stream)
     rng = np.random.default_rng(seed)
     hi = vocab_hi or engine.cfg.vocab_size
     hot = _hot_prefix(rng, hi, prompt_len, hot_prefix_len)

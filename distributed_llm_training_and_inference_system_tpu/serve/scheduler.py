@@ -88,6 +88,11 @@ class Request:
     # stale or wrong hint degrades to plain prefill.
     prefix_owner: Optional[int] = None
     prefix_owner_endpoint: Optional[str] = field(default=None, repr=False)
+    # fleet SSE streaming (serve/fleet/streams.py): the client asked for
+    # a token stream, so every replica this request crosses publishes
+    # its token batches (with sequence cursors) to the fleet stream hub.
+    # Carried on the worker submit wire; survives requeue/migration.
+    stream_requested: bool = False
     arrival_time: float = field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None   # for TTFT
     # when the engine dispatched this request's prefill (host clock, no

@@ -286,16 +286,45 @@ class InferenceServer:
             }) + "\n\n").encode()
 
         try:
+            deadline = time.monotonic() + 600.0
             while True:
                 try:
+                    # short poll instead of one long wait: a client that
+                    # disconnected between tokens used to leave this
+                    # coroutine parked on the queue (and the _streams
+                    # entry + the request's decode slot alive) until the
+                    # request finished on its own — the disconnect only
+                    # surfaced at the next write. Waking every 250 ms
+                    # lets the transport check below catch it promptly.
                     batch = await asyncio.wait_for(token_q.get(),
-                                                   timeout=600.0)
+                                                   timeout=0.25)
                 except asyncio.TimeoutError:
-                    # engine stalled: free the slot + KV pages like the
-                    # non-streaming timeout path does
-                    with self._lock:
-                        self.engine.scheduler.cancel(req.request_id)
-                    break
+                    if time.monotonic() > deadline:
+                        # engine stalled: free the slot + KV pages like
+                        # the non-streaming timeout path does
+                        with self._lock:
+                            self.engine.scheduler.cancel(req.request_id)
+                        break
+                    tr = http_req.transport
+                    if tr is None or tr.is_closing():
+                        # client is gone mid-stream: drop the stream
+                        # entry NOW and (default on) abort the orphaned
+                        # request so it stops burning a decode slot for
+                        # nobody
+                        self._streams.pop(req.request_id, None)
+                        self._waiters.pop(req.request_id, None)
+                        if self.serve_cfg.stream_abort_on_disconnect:
+                            with self._lock:
+                                self.engine.scheduler.cancel(
+                                    req.request_id)
+                        logger.info(
+                            "stream %s: client disconnected; request "
+                            "%s", req.request_id,
+                            "aborted"
+                            if self.serve_cfg.stream_abort_on_disconnect
+                            else "left to finish unobserved")
+                        return resp
+                    continue
                 if batch is None:               # request left its slot
                     break
                 await resp.write(chunk(self.tokenizer.decode(batch)))

@@ -146,6 +146,13 @@ SLOW_TESTS = {
     "test_fetch_spill_int8_kv_pages",
     "test_chunk_chaos_stays_token_identical",
     "test_spawned_worker_prefix_fetch",
+    # fleet SSE streaming: each engine-backed scenario builds a
+    # 2-replica fleet; the greedy crash / reconnect / loadgen variants
+    # stay in the fast tier, the seeded-migration + int8-handoff +
+    # plain-salvage ones run full-suite only
+    "test_stream_through_drain_migration_seeded",
+    "test_stream_through_handoff_int8_kv",
+    "test_salvage_without_hint_stays_plain",
 }
 
 
@@ -157,6 +164,10 @@ def pytest_configure(config):
                    "port 0 — never a fixed port, so tier-1 cannot flake "
                    "on collisions); deselect with -m 'not socket' in "
                    "network-restricted sandboxes")
+    config.addinivalue_line(
+        "markers", "sse: fleet SSE streaming (stream hub, "
+                   "migration-transparent delivery, reconnect replay); "
+                   "select with -m sse to run the streaming plane alone")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -888,10 +888,16 @@ class TestFleetHTTP:
         assert rq.post(f"{base}/fleet/migrate", json={"replica": 1},
                        timeout=10).status_code == 400
 
-        # contract edges: SSE refused, bad body refused
-        assert rq.post(f"{base}/v1/completions",
-                       json={"prompt": [1, 2], "stream": True},
-                       timeout=10).status_code == 400
+        # contract edges: SSE accepted since PR 8 (delivery contract
+        # covered in test_fleet_streams.py), bad body refused
+        r_sse = rq.post(f"{base}/v1/completions",
+                        json={"prompt": [1, 2], "stream": True,
+                              "max_tokens": 4, "temperature": 0.0},
+                        stream=True, timeout=240)
+        assert r_sse.status_code == 200
+        assert r_sse.headers["Content-Type"].startswith(
+            "text/event-stream")
+        r_sse.close()
         assert rq.post(f"{base}/v1/completions",
                        json={"prompt": [1.5]},
                        timeout=10).status_code == 400

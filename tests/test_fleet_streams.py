@@ -1,0 +1,1032 @@
+"""Fleet SSE streaming: migration-transparent, exactly-once token delivery.
+
+The load-bearing assertions mirror the subsystem's acceptance bar:
+
+- the stream hub's per-request token log is gapless and duplicate-free
+  under out-of-order batches, producer re-sends, and reconnects with
+  stale/future ``Last-Event-ID`` (units on fakes);
+- engine-backed streams survive a mid-stream CRASH, a drain MIGRATION,
+  and a prefill->decode HANDOFF with streamed output token-identical to
+  the undisturbed single engine and zero client-observed gaps/dups
+  (greedy and seeded, fp and int8-KV);
+- remote workers ship token batches with cursors through the outbox
+  poll (real ephemeral sockets), folding progress onto the parent's
+  request so a SIGKILL'd stream requeues from the last delivered token;
+- the fleet HTTP front serves ``stream: true`` as SSE (the PR-2 400 is
+  gone — regression-tested) with ``id:`` carrying the seq, and
+  ``GET /v1/streams/{id}`` + ``Last-Event-ID`` replays only the tail;
+- the single-server front drops a disconnected client's stream entry
+  and aborts the orphaned request (the decode-slot leak fix);
+- the PR-7 named gaps: the router's inventory TTL cache (counted
+  hits/misses, invalidation) and the crash-salvage tail fetch.
+"""
+
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from distributed_llm_training_and_inference_system_tpu.config import (
+    get_model_config)
+from distributed_llm_training_and_inference_system_tpu.config.schema import (
+    FleetConfig,
+    ServeConfig,
+)
+from distributed_llm_training_and_inference_system_tpu.serve import (
+    InferenceEngine,
+    SamplingParams,
+)
+from distributed_llm_training_and_inference_system_tpu.serve.fleet import (
+    FaultPlan,
+    FleetStreamHub,
+    ServeFleet,
+)
+from distributed_llm_training_and_inference_system_tpu.serve.scheduler import (
+    Request,
+    RequestState,
+)
+
+pytestmark = pytest.mark.sse
+
+PROMPTS = [[5, 17, 99, 3, 42, 7, 23], [1, 2, 3, 4, 5], [9, 8, 7, 6],
+           [11, 12, 13]]
+
+
+def serve_cfg(**overrides) -> ServeConfig:
+    kw = dict(model="gpt-test", max_batch_size=2, max_seq_len=256,
+              prefill_chunk=32, kv_block_size=8, dtype="float32")
+    kw.update(overrides)
+    return ServeConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def model_cfg():
+    return get_model_config("gpt-test")
+
+
+@pytest.fixture(scope="module")
+def ref_engine(model_cfg):
+    """Single undisturbed engine: the token-identity oracle AND the shared
+    param tree every fleet in this module reuses."""
+    return InferenceEngine(model_cfg, serve_cfg(), seed=0)
+
+
+class Recorder:
+    """Hub subscriber capturing events and asserting the per-subscriber
+    ordering contract (contiguous seqs)."""
+
+    def __init__(self):
+        self.events = []
+        self.tokens = []
+        self.next_seq = 0
+        self.gaps = 0
+        self.dups = 0
+        self.finished = threading.Event()
+
+    def __call__(self, ev):
+        self.events.append(ev)
+        if ev[0] == "tokens":
+            _k, start, toks = ev
+            if start > self.next_seq:
+                self.gaps += 1
+            elif start < self.next_seq:
+                self.dups += 1
+            self.tokens.extend(toks)
+            self.next_seq = start + len(toks)
+        else:
+            self.finished.set()
+
+
+# -- hub units (no engine) ----------------------------------------------------
+
+
+class TestHubUnits:
+    def test_in_order_publish_subscribe_finish(self):
+        hub = FleetStreamHub()
+        assert hub.open("r")
+        assert not hub.open("r")          # idempotent-open refused
+        rec = Recorder()
+        sub = hub.subscribe("r", 0, rec)
+        assert sub["sub"] is not None and sub["tokens"] == []
+        hub.publish("r", 0, [1, 2, 3], replica=0)
+        hub.publish("r", 3, [4], replica=0)
+        hub.finish("r", "stop")
+        assert rec.tokens == [1, 2, 3, 4]
+        assert rec.gaps == 0 and rec.dups == 0
+        assert rec.events[-1] == ("finish", "stop", None)
+        assert hub.stats()["tokens"] == 4
+        assert hub.stats()["active"] == 0
+
+    def test_overlapping_republish_suppressed_and_counted(self):
+        """A re-placed producer regenerating tokens the log already
+        delivered: overlap is absorbed by seq, clients see each token
+        once, and the duplicate count attributes to the replica."""
+        hub = FleetStreamHub()
+        hub.open("r")
+        rec = Recorder()
+        hub.subscribe("r", 0, rec)
+        hub.publish("r", 0, [1, 2, 3], replica=0)
+        # replica 1 resumes from seq 1: re-sends 2,3 then adds 4,5
+        hub.publish("r", 1, [2, 3, 4, 5], replica=1)
+        assert rec.tokens == [1, 2, 3, 4, 5]
+        assert rec.gaps == 0 and rec.dups == 0
+        st = hub.stats()
+        assert st["duplicates"] == 2
+        assert st["identity_mismatches"] == 0
+        assert hub.replica_stats()[1]["replayed"] == 2
+
+    def test_out_of_order_batch_buffered_until_gap_fills(self):
+        hub = FleetStreamHub()
+        hub.open("r")
+        rec = Recorder()
+        hub.subscribe("r", 0, rec)
+        hub.publish("r", 0, [1, 2], replica=0)
+        hub.publish("r", 4, [5, 6], replica=0)    # ahead of the frontier
+        assert rec.tokens == [1, 2]               # held, not delivered
+        assert hub.stats()["out_of_order"] == 1
+        hub.publish("r", 2, [3, 4], replica=0)    # fills the gap
+        assert rec.tokens == [1, 2, 3, 4, 5, 6]
+        assert rec.gaps == 0 and rec.dups == 0
+
+    def test_gap_healed_from_request_authority(self):
+        """A crash can eat on_token callbacks AFTER tokens were recorded
+        on the request; the in-proc publish path heals the hole from
+        req.generated_tokens before the new batch lands."""
+        hub = FleetStreamHub()
+        hub.open("r")
+        rec = Recorder()
+        hub.subscribe("r", 0, rec)
+        req = SimpleNamespace(request_id="r",
+                              generated_tokens=[1, 2, 3, 4, 5])
+        # hub only ever saw seq 0-1; the new batch starts at seq 4
+        hub.publish("r", 0, [1, 2], replica=0)
+        hub.publish_from_request(req, [5], replica=1)
+        assert rec.tokens == [1, 2, 3, 4, 5]
+        assert rec.gaps == 0
+        assert hub.stats()["gaps_healed"] == 2    # 3 and 4 recovered
+
+    def test_sync_appends_missing_tail(self):
+        hub = FleetStreamHub()
+        hub.open("r")
+        hub.publish("r", 0, [1, 2], replica=0)
+        assert hub.sync("r", [1, 2, 3, 4]) == 2
+        assert hub.tokens_of("r") == [1, 2, 3, 4]
+        assert hub.sync("r", [1, 2, 3, 4]) == 0   # idempotent
+
+    def test_reconnect_replays_only_unacked_tail(self):
+        hub = FleetStreamHub()
+        hub.open("r")
+        hub.publish("r", 0, list(range(10)), replica=0)
+        rec = Recorder()
+        # client acked seq 6 (Last-Event-ID=6): replay starts at 7
+        sub = hub.subscribe("r", 7, rec, resume=True)
+        assert sub["tokens"] == [7, 8, 9]
+        st = hub.stats()
+        assert st["reconnects"] == 1 and st["replayed"] == 3
+        assert st["replay_sizes"] == [3]
+        # live continuation follows the replay with no gap or overlap
+        hub.publish("r", 10, [10, 11], replica=0)
+        assert rec.events == [("tokens", 10, [10, 11])]
+
+    def test_stale_last_event_id_full_replay(self):
+        hub = FleetStreamHub()
+        hub.open("r")
+        hub.publish("r", 0, [1, 2, 3], replica=0)
+        hub.finish("r", "stop")
+        sub = hub.subscribe("r", 0, Recorder(), resume=True)
+        assert sub["tokens"] == [1, 2, 3]
+        assert sub["finished"] and sub["finish_reason"] == "stop"
+        assert sub["sub"] is None          # finished: no live sub
+
+    def test_future_last_event_id_clamps_to_frontier(self):
+        hub = FleetStreamHub()
+        hub.open("r")
+        hub.publish("r", 0, [1, 2], replica=0)
+        rec = Recorder()
+        sub = hub.subscribe("r", 999, rec)
+        assert sub["tokens"] == []         # clamped, not wedged
+        hub.publish("r", 2, [3], replica=0)
+        assert rec.events == [("tokens", 2, [3])]
+
+    def test_finish_during_replay_window(self):
+        """Subscribe on a live log, finish immediately after: the finish
+        event arrives after the snapshot, never instead of it."""
+        hub = FleetStreamHub()
+        hub.open("r")
+        hub.publish("r", 0, [1, 2], replica=0)
+        rec = Recorder()
+        sub = hub.subscribe("r", 0, rec)
+        assert sub["tokens"] == [1, 2] and not sub["finished"]
+        hub.finish("r", "length")
+        assert rec.events == [("finish", "length", None)]
+
+    def test_unknown_stream_and_discard(self):
+        hub = FleetStreamHub()
+        assert hub.subscribe("nope", 0, Recorder()) is None
+        assert hub.publish("nope", 0, [1]) == 0
+        hub.open("r")
+        rec = Recorder()
+        hub.subscribe("r", 0, rec)
+        hub.discard("r")                   # submit failed after open
+        assert rec.finished.is_set()
+        assert not hub.has("r")
+
+    def test_ttl_gc_drops_finished_logs_only(self):
+        hub = FleetStreamHub(ttl_ms=1.0)
+        hub.open("done")
+        hub.open("live")
+        hub.publish("live", 0, [1], replica=0)
+        hub.finish("done", "stop")
+        time.sleep(0.01)
+        assert hub.gc() == 1
+        assert not hub.has("done") and hub.has("live")
+
+    def test_identity_mismatch_counted_never_redelivered(self):
+        hub = FleetStreamHub()
+        hub.open("r")
+        rec = Recorder()
+        hub.subscribe("r", 0, rec)
+        hub.publish("r", 0, [1, 2], replica=0)
+        hub.publish("r", 0, [1, 99], replica=1)   # broken producer
+        assert hub.stats()["identity_mismatches"] == 1
+        assert rec.tokens == [1, 2]               # log wins, no re-send
+
+    def test_replica_stats_active_streams(self):
+        hub = FleetStreamHub()
+        hub.open("a")
+        hub.open("b")
+        hub.publish("a", 0, [1], replica=0)
+        hub.publish("b", 0, [1], replica=0)
+        hub.finish("b", "stop")
+        rs = hub.replica_stats()
+        assert rs[0]["active"] == 1
+
+
+# -- router satellite units (fakes) -------------------------------------------
+
+
+class FakeInvReplica:
+    def __init__(self, rid, hashes):
+        self.replica_id = rid
+        self.state = "healthy"
+        self.role = "mixed"
+        self._hashes = hashes
+        self.inventory_reads = 0
+
+    def accepting(self):
+        return True
+
+    def queue_depth(self):
+        return 0
+
+    def outstanding_tokens(self):
+        return 0
+
+    def prefix_inventory(self):
+        self.inventory_reads += 1
+        return list(self._hashes)
+
+
+class TestInventoryTTLCache:
+    def make_router(self, ttl_ms):
+        from distributed_llm_training_and_inference_system_tpu.serve.fleet.router import (  # noqa: E501
+            FleetRouter)
+        reps = [FakeInvReplica(0, [b"h0"]), FakeInvReplica(1, [b"h1"])]
+        cfg = FleetConfig(replicas=2, prefix_fetch=True,
+                          prefix_inventory_ttl_ms=ttl_ms)
+        return FleetRouter(reps, cfg, page_size=8), reps
+
+    def test_ttl_cache_hits_counted_and_invalidated(self):
+        router, reps = self.make_router(ttl_ms=60_000.0)
+        inv1 = router._inventories()
+        inv2 = router._inventories()
+        assert inv1 is inv2                       # served from the cache
+        assert all(r.inventory_reads == 1 for r in reps)
+        st = router.stats()
+        assert st["inventory_cache_hits"] == 1
+        assert st["inventory_cache_misses"] == 1
+        router.invalidate_inventories()
+        router._inventories()
+        assert all(r.inventory_reads == 2 for r in reps)
+        assert router.stats()["inventory_cache_misses"] == 2
+
+    def test_ttl_expiry_rereads(self):
+        router, reps = self.make_router(ttl_ms=1.0)
+        router._inventories()
+        time.sleep(0.01)
+        router._inventories()
+        assert all(r.inventory_reads == 2 for r in reps)
+
+    def test_ttl_zero_reads_fresh_every_placement(self):
+        router, reps = self.make_router(ttl_ms=0.0)
+        router._inventories()
+        router._inventories()
+        assert all(r.inventory_reads == 2 for r in reps)
+        st = router.stats()
+        assert st["inventory_cache_hits"] == 0
+        assert st["inventory_cache_misses"] == 0
+
+    def test_hints_enabled_for_partial_payloads(self):
+        router, _ = self.make_router(ttl_ms=0.0)
+        req = Request(request_id="x", prompt_tokens=[1, 2, 3])
+        assert router._hints_enabled(req)
+        req.swapped_kv = {"pages": {}, "positions": 8, "partial": True}
+        assert router._hints_enabled(req)          # the PR-7 named gap
+        req.swapped_kv = {"pages": {}, "positions": 8}
+        assert not router._hints_enabled(req)      # full payload: restore
+
+
+# -- payload splice helpers (salvage-tail fetch) ------------------------------
+
+
+class TestPagePayloadHelpers:
+    def plain(self, n, fill=0.0):
+        import numpy as np
+        return {"k": np.full((2, n, 2, 8, 4), fill, np.float32),
+                "v": np.full((2, n, 2, 8, 4), fill, np.float32),
+                "num_pages": n}
+
+    def quant(self, n):
+        import numpy as np
+        part = {"values": np.zeros((2, n, 2, 8, 4), np.int8),
+                "scale": np.zeros((2, n, 2, 8), np.float32)}
+        return {"k": dict(part), "v": dict(part), "num_pages": n}
+
+    def test_slice_and_concat_plain(self):
+        from distributed_llm_training_and_inference_system_tpu.serve.kv_cache import (  # noqa: E501
+            concat_page_payloads, slice_page_payload)
+        a, b = self.plain(2, 1.0), self.plain(3, 2.0)
+        cut = slice_page_payload(b, 2)
+        assert cut["num_pages"] == 2 and cut["k"].shape[1] == 2
+        merged = concat_page_payloads(a, cut)
+        assert merged["num_pages"] == 4
+        assert merged["k"].shape[1] == 4
+        assert float(merged["k"][0, 0, 0, 0, 0]) == 1.0
+        assert float(merged["k"][0, 2, 0, 0, 0]) == 2.0
+
+    def test_slice_and_concat_quant(self):
+        from distributed_llm_training_and_inference_system_tpu.serve.kv_cache import (  # noqa: E501
+            concat_page_payloads, slice_page_payload)
+        merged = concat_page_payloads(self.quant(1),
+                                      slice_page_payload(self.quant(2), 1))
+        assert merged["num_pages"] == 2
+        assert merged["k"]["values"].shape[1] == 2
+        assert merged["k"]["scale"].shape[1] == 2
+
+    def test_mixed_payloads_refused(self):
+        from distributed_llm_training_and_inference_system_tpu.serve.kv_cache import (  # noqa: E501
+            concat_page_payloads, slice_page_payload)
+        with pytest.raises(ValueError, match="mismatch"):
+            concat_page_payloads(self.plain(1), self.quant(1))
+        with pytest.raises(ValueError):
+            slice_page_payload(self.plain(2), 3)
+        with pytest.raises(ValueError):
+            slice_page_payload(self.plain(2), 0)
+
+
+# -- engine-backed streaming --------------------------------------------------
+
+
+def make_fleet(model_cfg, params, *, replicas=2, plan=None, fleet_kw=None,
+               serve_kw=None, warm=False) -> ServeFleet:
+    fc_kw = dict(replicas=replicas, affinity_prefix_tokens=0,
+                 restart_backoff_s=0.05, probe_interval_s=0.05)
+    fc_kw.update(fleet_kw or {})
+    fleet = ServeFleet(model_cfg, serve_cfg(**(serve_kw or {})),
+                       FleetConfig(**fc_kw), params=params,
+                       fault_plan=plan, supervise=False, seed=0)
+    if warm:
+        for r in fleet.replicas:
+            r.engine.generate([[1, 2, 3]],
+                              SamplingParams(temperature=0.0, max_tokens=4))
+    fleet.start()
+    return fleet
+
+
+def stream_batch(fleet, prompts, sampling, timeout_s=240.0,
+                 mid_decode_hook=None):
+    """Submit every prompt as a stream with a Recorder subscriber; drive
+    the supervisor until completion. Returns (requests, recorders)."""
+    evs, reqs, recs = [], [], []
+    for p in prompts:
+        ev = threading.Event()
+        req = fleet.submit_streaming(
+            p, sampling, on_complete=lambda _r, ev=ev: ev.set())
+        rec = Recorder()
+        sub = fleet.streams.subscribe(req.request_id, 0, rec)
+        assert sub is not None
+        if sub["tokens"]:
+            rec(("tokens", sub["start"], sub["tokens"]))
+        if sub["finished"]:
+            rec.finished.set()
+        evs.append(ev)
+        reqs.append(req)
+        recs.append(rec)
+    deadline = time.monotonic() + timeout_s
+    if mid_decode_hook is not None:
+        while not all(len(r.generated_tokens) >= 2 for r in reqs):
+            time.sleep(0.002)
+            assert time.monotonic() < deadline, "stream decode hung"
+        mid_decode_hook()
+    while not (all(e.is_set() for e in evs)
+               and all(r.finished.is_set() for r in recs)):
+        fleet.supervisor.poll_once()
+        time.sleep(0.005)
+        assert time.monotonic() < deadline, "stream batch hung"
+    return reqs, recs
+
+
+def assert_streams(recs, ref):
+    assert [r.tokens for r in recs] == ref
+    assert all(r.gaps == 0 for r in recs)
+    assert all(r.dups == 0 for r in recs)
+
+
+class TestEngineStreams:
+    def test_stream_through_crash_token_identical(self, model_cfg,
+                                                  ref_engine):
+        """Mid-decode crash: the requeued stream resumes on the survivor
+        with no client-visible gap or duplicate — streamed output equals
+        the undisturbed single-engine run exactly."""
+        greedy = SamplingParams(temperature=0.0, max_tokens=24)
+        ref = [r.generated_tokens
+               for r in ref_engine.generate(PROMPTS, greedy)]
+        fleet = make_fleet(model_cfg, ref_engine.params,
+                           plan=FaultPlan(crash_replica=0,
+                                          crash_after_steps=2))
+        try:
+            reqs, recs = stream_batch(fleet, PROMPTS, greedy)
+            assert_streams(recs, ref)
+            # the hub log and the final completion agree token for token
+            for req, rec in zip(reqs, recs):
+                assert rec.tokens == req.generated_tokens
+            st = fleet.router.stats()
+            assert st["requeues"] >= 1
+            assert st["completed"] + st["failed"] + st["rejected"] \
+                == st["submitted"]
+            hub = fleet.streams.stats()
+            assert hub["identity_mismatches"] == 0
+        finally:
+            fleet.shutdown()
+
+    def test_stream_through_drain_migration_seeded(self, model_cfg,
+                                                   ref_engine):
+        """Seeded sampling + drain-with-migration mid-stream: the
+        sequence moves WITH its KV and the stream stays seq-contiguous
+        and bit-identical to the undisturbed PRNG stream."""
+        seeded = SamplingParams(temperature=0.8, seed=123, max_tokens=32)
+        ref = [r.generated_tokens
+               for r in ref_engine.generate(PROMPTS, seeded)]
+        fleet = make_fleet(model_cfg, ref_engine.params,
+                           plan=FaultPlan(slow_replica=0, slow_ms=3.0),
+                           fleet_kw={"migrate_on_drain": True}, warm=True)
+        try:
+            _reqs, recs = stream_batch(
+                fleet, PROMPTS, seeded,
+                mid_decode_hook=lambda: fleet.drain(0))
+            assert_streams(recs, ref)
+            snap = fleet.status()
+            assert snap["migration"]["migrations"] >= 1
+            assert snap["streams"]["identity_mismatches"] == 0
+            # per-replica stream columns exist in the snapshot
+            for rep in snap["replicas"]:
+                assert "active_streams" in rep
+                assert "stream_replayed_tokens" in rep
+        finally:
+            fleet.shutdown()
+
+    def test_stream_through_handoff_int8_kv(self, model_cfg, ref_engine):
+        """Disaggregated prefill->decode handoff mid-stream on int8-KV
+        pages: the first token streams from the prefill replica, the
+        rest from the decode replica, one contiguous sequence."""
+        greedy = SamplingParams(temperature=0.0, max_tokens=20)
+        ref_q8 = InferenceEngine(model_cfg,
+                                 serve_cfg(kv_quantization="int8"), seed=0,
+                                 params=ref_engine.params)
+        ref = [r.generated_tokens
+               for r in ref_q8.generate(PROMPTS, greedy)]
+        fleet = make_fleet(model_cfg, ref_engine.params,
+                           fleet_kw={"roles": "prefill,decode"},
+                           serve_kw={"kv_quantization": "int8"})
+        try:
+            _reqs, recs = stream_batch(fleet, PROMPTS, greedy)
+            assert_streams(recs, ref)
+            snap = fleet.status()
+            assert snap["handoff"]["handoffs"] == len(PROMPTS)
+        finally:
+            fleet.shutdown()
+            ref_q8.release()
+
+    def test_reconnect_replay_after_finish(self, model_cfg, ref_engine):
+        """Last-Event-ID reconnect on a finished stream: exactly the
+        unacked tail replays, counted in the hub's replay ledger."""
+        greedy = SamplingParams(temperature=0.0, max_tokens=16)
+        ref = [r.generated_tokens
+               for r in ref_engine.generate(PROMPTS[:1], greedy)]
+        fleet = make_fleet(model_cfg, ref_engine.params)
+        try:
+            reqs, recs = stream_batch(fleet, PROMPTS[:1], greedy)
+            assert_streams(recs, ref)
+            acked = len(ref[0]) // 2
+            sub = fleet.streams.subscribe(reqs[0].request_id, acked,
+                                          Recorder(), resume=True)
+            assert sub["finished"]
+            assert sub["tokens"] == ref[0][acked:]
+            hub = fleet.streams.stats()
+            assert hub["reconnects"] == 1
+            assert hub["replayed"] == len(ref[0]) - acked
+        finally:
+            fleet.shutdown()
+
+
+class TestLoadgenStreaming:
+    def test_streaming_mode_identity_and_jitter_under_crash(
+            self, model_cfg, ref_engine):
+        """Loadgen's streaming client mode: every request consumed as a
+        live stream through an injected crash — identity holds, zero
+        gaps/dups, per-token delivery-gap percentiles reported, ledger
+        balanced."""
+        from distributed_llm_training_and_inference_system_tpu.serve.loadgen import (  # noqa: E501
+            run_closed_loop)
+        fleet = make_fleet(model_cfg, ref_engine.params,
+                           plan=FaultPlan(crash_replica=1,
+                                          crash_after_steps=3))
+        try:
+            res = run_closed_loop(fleet, concurrency=3, num_requests=6,
+                                  prompt_len=8, max_tokens=16, seed=0,
+                                  stream=True)
+            assert res.failed == 0
+            assert res.stream["streams"] == 6
+            assert res.stream["identity_ok"]
+            assert res.stream["gaps"] == 0
+            assert res.stream["duplicates"] == 0
+            assert res.stream["p50_gap_ms"] is not None
+            assert res.stream["p99_gap_ms"] is not None
+            assert "stream" in res.summary()
+        finally:
+            fleet.shutdown()
+
+
+# -- crash-salvage tail fetch (PR-7 named gap) --------------------------------
+
+
+class TestSalvageTailFetch:
+    def test_partial_payload_tail_routes_through_prefix_fetch(
+            self, model_cfg, ref_engine):
+        """A crash-salvaged partial payload covering only page 0 of a
+        5-page context, requeued onto a cold replica while a warm owner
+        caches the whole chain: the missing tail is FETCHED over the
+        courier (counted) and only the sub-page remainder re-prefills —
+        token-identically."""
+        from distributed_llm_training_and_inference_system_tpu.serve.kv_cache import (  # noqa: E501
+            prefix_page_hashes)
+        PS = 8
+        prompt = [(i * 7 + 3) % 50 + 1 for i in range(4 * PS + 3)]  # 35 tok
+        greedy = SamplingParams(temperature=0.0, max_tokens=12)
+        [ref] = ref_engine.generate([prompt], greedy)
+        fleet = make_fleet(model_cfg, ref_engine.params,
+                           fleet_kw={"prefix_fetch": True,
+                                     "prefix_fetch_min_pages": 1})
+        try:
+            deadline = time.monotonic() + 240
+            # warm replica 0 with the full prompt (replica 1 drained)
+            fleet.drain(1)
+            while fleet.replicas[1].state != "drained":
+                fleet.supervisor.poll_once()
+                time.sleep(0.005)
+                assert time.monotonic() < deadline
+            [warm] = fleet.generate([prompt], greedy, timeout_s=240)
+            assert warm.generated_tokens == ref.generated_tokens
+            fleet.undrain(1)
+
+            hashes = prefix_page_hashes(prompt, PS)
+            # page 0's content, extracted as a real payload off the owner
+            owner_payload = fleet.replicas[0].request_prefix_extract(
+                hashes[:1], timeout_s=5.0)
+            assert owner_payload is not None
+            # a crash-salvaged partial: page 0 only, tail missing
+            req = Request(request_id="salvage-1",
+                          prompt_tokens=list(prompt), sampling=greedy)
+            req.swapped_kv = {"pages": owner_payload["pages"],
+                              "positions": PS, "partial": True}
+            req.prefix_hashes = list(hashes)
+            req.prefix_owner = 0
+            req.fleet_requeued = True
+            eng1 = fleet.replicas[1].engine
+            pre_prefill = eng1.total_prefill_tokens
+            assert fleet.replicas[1].submit(req)
+            while req.state is not RequestState.FINISHED:
+                time.sleep(0.005)
+                assert time.monotonic() < deadline, "salvage run hung"
+            assert req.generated_tokens == ref.generated_tokens
+            # usable chain = 4 full pages; payload covered 1; 3 fetched
+            assert eng1.total_salvage_tail_fetched_tokens == 3 * PS
+            assert eng1.total_prefix_fetched_tokens >= 3 * PS
+            # prefill computed only the sub-page remainder (35 - 32)
+            assert eng1.total_prefill_tokens - pre_prefill \
+                == len(prompt) - 4 * PS
+            assert "salvage_tail_fetched_tokens" in eng1.stats()
+        finally:
+            fleet.shutdown()
+
+    def test_salvage_without_hint_stays_plain(self, model_cfg,
+                                              ref_engine):
+        """No owner hint -> the partial payload restores what it has and
+        plainly re-prefills the tail (the PR-4 path, untouched)."""
+        PS = 8
+        prompt = [(i * 5 + 2) % 50 + 1 for i in range(2 * PS + 3)]
+        greedy = SamplingParams(temperature=0.0, max_tokens=8)
+        [ref] = ref_engine.generate([prompt], greedy)
+        fleet = make_fleet(model_cfg, ref_engine.params,
+                           fleet_kw={"prefix_fetch": True})
+        try:
+            deadline = time.monotonic() + 240
+            fleet.drain(1)
+            while fleet.replicas[1].state != "drained":
+                fleet.supervisor.poll_once()
+                time.sleep(0.005)
+                assert time.monotonic() < deadline
+            [warm] = fleet.generate([prompt], greedy, timeout_s=240)
+            fleet.undrain(1)
+            from distributed_llm_training_and_inference_system_tpu.serve.kv_cache import (  # noqa: E501
+                prefix_page_hashes)
+            hashes = prefix_page_hashes(prompt, PS)
+            payload = fleet.replicas[0].request_prefix_extract(
+                hashes[:1], timeout_s=5.0)
+            req = Request(request_id="salvage-2",
+                          prompt_tokens=list(prompt), sampling=greedy)
+            req.swapped_kv = {"pages": payload["pages"],
+                              "positions": PS, "partial": True}
+            # no prefix_owner hint, no hashes: must not fetch
+            eng1 = fleet.replicas[1].engine
+            assert fleet.replicas[1].submit(req)
+            while req.state is not RequestState.FINISHED:
+                time.sleep(0.005)
+                assert time.monotonic() < deadline
+            assert req.generated_tokens == ref.generated_tokens
+            assert eng1.total_salvage_tail_fetched_tokens == 0
+        finally:
+            fleet.shutdown()
+
+
+# -- remote worker cursor poll (real sockets) ---------------------------------
+
+
+@pytest.mark.socket
+class TestRemoteStreamCursors:
+    def make_fake_worker(self):
+        import json
+        from http.server import (BaseHTTPRequestHandler,
+                                 ThreadingHTTPServer)
+
+        class Fake:
+            pass
+        fake = Fake()
+        fake.submitted = []
+        fake.outbox = []
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, body, status=200):
+                data = json.dumps(body).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                self._reply({"state": "healthy", "role": "mixed",
+                             "queue_depth": 0, "active": 0,
+                             "outstanding_tokens": 0})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n) or b"{}")
+                if self.path == "/worker/submit":
+                    fake.submitted.append(body)
+                    self._reply({"ok": True})
+                elif self.path == "/worker/outbox/take":
+                    entries, fake.outbox = fake.outbox, []
+                    self._reply({"entries": entries})
+                else:
+                    self._reply({"ok": True})
+
+        server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        fake.endpoint = f"http://127.0.0.1:{server.server_address[1]}"
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        fake.close = lambda: (server.shutdown(), server.server_close())
+        return fake
+
+    def test_cursor_entries_fold_and_forward(self):
+        from distributed_llm_training_and_inference_system_tpu.serve.fleet.remote import (  # noqa: E501
+            RemoteReplica)
+        fake = self.make_fake_worker()
+        try:
+            rr = RemoteReplica(
+                1, fake.endpoint,
+                fleet_cfg=SimpleNamespace(
+                    remote_timeout_s=2.0,
+                    remote_reconnect_backoff_s=0.001))
+            forwarded = []
+            rr.on_tokens = lambda rid, req_id, start, toks: \
+                forwarded.append((rid, req_id, start, list(toks)))
+            req = Request(request_id="s1", prompt_tokens=[1, 2, 3],
+                          sampling=SamplingParams(temperature=0.0,
+                                                  max_tokens=8),
+                          stream_requested=True)
+            assert rr.submit(req)
+            # the stream flag rides the submit wire
+            assert fake.submitted[0]["stream"] is True
+            fake.outbox.extend([
+                {"kind": "stream", "request_id": "s1", "start": 0,
+                 "tokens": [7, 8], "seed": 42},
+                {"kind": "stream", "request_id": "s1", "start": 2,
+                 "tokens": [9], "seed": 42},
+            ])
+            assert rr.poll_outbox() == 2
+            # worker progress folded onto the PARENT's object: a SIGKILL
+            # teardown now requeues from the last streamed token
+            assert req.generated_tokens == [7, 8, 9]
+            assert req.assigned_seed == 42
+            assert req.first_token_time is not None
+            assert forwarded == [(1, "s1", 0, [7, 8]),
+                                 (1, "s1", 2, [9])]
+            # a late/duplicate re-poll entry folds to a no-op and is
+            # still forwarded (the hub dedupes by seq)
+            fake.outbox.append({"kind": "stream", "request_id": "s1",
+                                "start": 0, "tokens": [7, 8]})
+            rr.poll_outbox()
+            assert req.generated_tokens == [7, 8, 9]
+            assert forwarded[-1] == (1, "s1", 0, [7, 8])
+            # malformed entry: logged, skipped, never raises
+            fake.outbox.append({"kind": "stream", "request_id": "s1",
+                                "start": "x", "tokens": [1]})
+            rr.poll_outbox()
+        finally:
+            fake.close()
+
+    def test_wire_round_trip_carries_stream_flag(self):
+        from distributed_llm_training_and_inference_system_tpu.serve.fleet.remote import (  # noqa: E501
+            request_from_wire, request_to_wire)
+        req = Request(request_id="w1", prompt_tokens=[1, 2],
+                      sampling=SamplingParams(max_tokens=4),
+                      stream_requested=True)
+        back = request_from_wire(request_to_wire(req))
+        assert back.stream_requested is True
+        req.stream_requested = False
+        assert request_from_wire(request_to_wire(req)) \
+            .stream_requested is False
+
+
+# -- fleet HTTP front: SSE over real sockets ----------------------------------
+
+
+def _parse_sse(resp):
+    """Collect (id, data-dict) SSE frames from a requests stream until
+    [DONE]."""
+    import json
+    frames, cur_id = [], None
+    for raw in resp.iter_lines():
+        line = raw.decode() if isinstance(raw, bytes) else raw
+        if line.startswith("id: "):
+            cur_id = int(line[4:])
+        elif line.startswith("data: "):
+            body = line[6:]
+            if body == "[DONE]":
+                break
+            frames.append((cur_id, json.loads(body)))
+    return frames
+
+
+@pytest.mark.socket
+class TestFleetHTTPStreaming:
+    @pytest.fixture()
+    def server(self, model_cfg, ref_engine):
+        import asyncio
+
+        from distributed_llm_training_and_inference_system_tpu.serve.fleet.http import (  # noqa: E501
+            FleetServer)
+        srv = FleetServer(
+            model_cfg,
+            serve_cfg(host="127.0.0.1", port=0),
+            FleetConfig(replicas=2, probe_interval_s=0.05,
+                        restart_backoff_s=0.05),
+            params=ref_engine.params)
+        loop = asyncio.new_event_loop()
+        started = threading.Event()
+        state = {}
+
+        def run():
+            asyncio.set_event_loop(loop)
+
+            async def main():
+                runner = await srv.start_async()
+                state["port"] = runner.addresses[0][1]
+                started.set()
+
+            loop.run_until_complete(main())
+            loop.run_forever()
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        assert started.wait(timeout=60)
+        yield srv, state["port"]
+        loop.call_soon_threadsafe(loop.stop)
+        t.join(timeout=5)
+        srv.fleet.shutdown()
+
+    def test_stream_true_serves_sse_with_seq_ids(self, server,
+                                                 ref_engine):
+        """Regression: stream=true answered 400 on the fleet front from
+        PR 2 through PR 7. It now serves SSE whose id: carries the seq
+        and whose tokens equal the non-streamed completion; a reconnect
+        with Last-Event-ID replays only the tail."""
+        import requests as rq
+        srv, port = server
+        base = f"http://127.0.0.1:{port}"
+        greedy = SamplingParams(temperature=0.0, max_tokens=10)
+        [ref] = ref_engine.generate([PROMPTS[0]], greedy)
+
+        r = rq.post(f"{base}/v1/completions",
+                    json={"prompt": PROMPTS[0], "max_tokens": 10,
+                          "temperature": 0.0, "stream": True},
+                    stream=True, timeout=240)
+        assert r.status_code == 200                       # not 400
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        frames = _parse_sse(r)
+        assert frames, "no SSE frames delivered"
+        rid = frames[0][1]["id"]
+        tokens = [t for _sid, f in frames
+                  for t in f["choices"][0]["token_ids"]]
+        assert tokens == ref.generated_tokens
+        # id: is the seq of the batch's LAST token — strictly increasing,
+        # final id == last seq
+        ids = [sid for sid, _f in frames if _f["choices"][0]["token_ids"]]
+        assert ids == sorted(ids)
+        assert ids[-1] == len(ref.generated_tokens) - 1
+        assert frames[-1][1]["choices"][0]["finish_reason"] is not None
+
+        # reconnect with Last-Event-ID: replay ONLY the unacked tail
+        acked = len(ref.generated_tokens) // 2 - 1
+        r2 = rq.get(f"{base}/v1/streams/{rid}",
+                    headers={"Last-Event-ID": str(acked)},
+                    stream=True, timeout=60)
+        assert r2.status_code == 200
+        frames2 = _parse_sse(r2)
+        tail = [t for _sid, f in frames2
+                for t in f["choices"][0]["token_ids"]]
+        assert tail == ref.generated_tokens[acked + 1:]
+
+        # contract edges: unknown stream 404, malformed Last-Event-ID 400
+        assert rq.get(f"{base}/v1/streams/nope",
+                      timeout=10).status_code == 404
+        assert rq.get(f"{base}/v1/streams/{rid}",
+                      headers={"Last-Event-ID": "banana"},
+                      timeout=10).status_code == 400
+
+        # the snapshot surfaces the hub ledger + per-replica columns
+        snap = rq.get(f"{base}/fleet/status", timeout=10).json()
+        assert snap["streams"]["opened"] >= 1
+        assert snap["streams"]["reconnects"] >= 1
+        for rep in snap["replicas"]:
+            assert "active_streams" in rep
+
+
+# -- single-server disconnect leak fix ----------------------------------------
+
+
+@pytest.mark.socket
+class TestSingleServerDisconnect:
+    @pytest.fixture()
+    def server(self, model_cfg, ref_engine):
+        import asyncio
+
+        from distributed_llm_training_and_inference_system_tpu.serve.server import (  # noqa: E501
+            InferenceServer)
+        srv = InferenceServer(model_cfg,
+                              serve_cfg(host="127.0.0.1", port=0),
+                              params=ref_engine.params)
+        loop = asyncio.new_event_loop()
+        started = threading.Event()
+        state = {}
+
+        def run():
+            asyncio.set_event_loop(loop)
+
+            async def main():
+                runner = await srv.start_async()
+                state["port"] = runner.addresses[0][1]
+                started.set()
+
+            loop.run_until_complete(main())
+            loop.run_forever()
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        assert started.wait(timeout=60)
+        yield srv, state["port"]
+        loop.call_soon_threadsafe(loop.stop)
+        t.join(timeout=5)
+        srv.stop_engine()
+
+    def test_disconnect_mid_stream_aborts_orphaned_request(self, server):
+        """Satellite: a client disconnect mid-stream used to leave the
+        _streams entry and the request alive to max_tokens. Now the
+        stream entry drops promptly and (flag default on) the orphaned
+        request is cancelled, freeing its decode slot + pages."""
+        import json
+        import socket as sock
+        srv, port = server
+        cancelled = []
+        orig_cancel = srv.engine.scheduler.cancel
+
+        def spy_cancel(rid):
+            cancelled.append(rid)
+            return orig_cancel(rid)
+        srv.engine.scheduler.cancel = spy_cancel
+        try:
+            body = json.dumps({"prompt": [1, 2, 3, 4], "temperature": 0.0,
+                               "max_tokens": 200, "stream": True})
+            s = sock.create_connection(("127.0.0.1", port), timeout=30)
+            s.sendall((f"POST /v1/completions HTTP/1.1\r\n"
+                       f"Host: 127.0.0.1:{port}\r\n"
+                       f"Content-Type: application/json\r\n"
+                       f"Content-Length: {len(body)}\r\n\r\n"
+                       f"{body}").encode())
+            # wait for the first SSE bytes so the request is mid-stream
+            got = b""
+            while b"data: " not in got:
+                chunk = s.recv(4096)
+                assert chunk, "server closed before first token"
+                got += chunk
+            # abrupt client disconnect
+            s.setsockopt(sock.SOL_SOCKET, sock.SO_LINGER,
+                         __import__("struct").pack("ii", 1, 0))
+            s.close()
+            deadline = time.monotonic() + 30
+            while not cancelled or srv._streams:
+                time.sleep(0.05)
+                assert time.monotonic() < deadline, (
+                    f"disconnect never detected (cancelled={cancelled}, "
+                    f"streams={list(srv._streams)})")
+            assert cancelled[0].startswith("cmpl-")
+            assert srv._streams == {}
+        finally:
+            srv.engine.scheduler.cancel = orig_cancel
+
+
+# -- metric names -------------------------------------------------------------
+
+
+class TestStreamMetrics:
+    def test_stream_metric_names(self):
+        """The llmctl_fleet_stream_* counters + the replay histogram and
+        the inventory-cache counters exist under their documented names
+        (dashboards alarm on these)."""
+        prometheus_client = pytest.importorskip("prometheus_client")
+        from distributed_llm_training_and_inference_system_tpu.metrics.observability import (  # noqa: E501
+            PrometheusExporter)
+        try:
+            exporter = PrometheusExporter(port=0)
+        except ValueError:
+            pytest.skip("prometheus registry already populated "
+                        "(another exporter instance in this process)")
+        snap = {
+            "replicas": [],
+            "router": {"requeues": 0, "rejected": 0,
+                       "inventory_cache_hits": 7,
+                       "inventory_cache_misses": 3},
+            "streams": {"active": 2, "opened": 5, "finished": 3,
+                        "tokens": 100, "duplicates": 4, "replayed": 9,
+                        "reconnects": 2, "gaps_healed": 1,
+                        "replay_sizes": [4, 5], "replay_count": 2},
+        }
+        exporter.export_fleet(snap)
+        samples = {}
+        for metric in prometheus_client.REGISTRY.collect():
+            for s in metric.samples:
+                samples[(s.name, s.labels.get("replica"))] = s.value
+        assert samples[("llmctl_fleet_stream_active", None)] == 2
+        assert samples[("llmctl_fleet_stream_tokens_total", None)] == 100
+        assert samples[
+            ("llmctl_fleet_stream_duplicates_total", None)] == 4
+        assert samples[
+            ("llmctl_fleet_stream_replayed_tokens_total", None)] == 9
+        assert samples[
+            ("llmctl_fleet_stream_reconnects_total", None)] == 2
+        assert samples[
+            ("llmctl_fleet_stream_gaps_healed_total", None)] == 1
+        assert samples[
+            ("llmctl_fleet_stream_replay_tokens_count", None)] == 2
+        assert samples[("llmctl_fleet_stream_replay_tokens_sum", None)] \
+            == pytest.approx(9.0)
+        assert samples[
+            ("llmctl_fleet_prefix_inventory_cache_hits_total", None)] == 7
+        assert samples[
+            ("llmctl_fleet_prefix_inventory_cache_misses_total",
+             None)] == 3

@@ -504,9 +504,13 @@ class TestFetchSpill:
             courier_chunk_deadline_ms=20.0)
         assert fetched == 0
         assert snap["prefix_fetch"]["aborts"] >= 1
-        # the first spill prompt re-prefilled fully, the rest hit the
-        # pages it published locally
-        assert spent == sum(len(p) for p in _prompts()[1:]) - 2 * len(HOT)
+        # the first spill prompt re-prefilled fully, later ones hit the
+        # pages it published locally. Local publish lands only when a
+        # prefill COMPLETES, so a second spill admitted while the first
+        # is still chunking re-prefills the hot head too — legitimate
+        # concurrency, not a fetch: accept one or two full re-prefills
+        total = sum(len(p) for p in _prompts()[1:])
+        assert spent in (total - 2 * len(HOT), total - len(HOT))
 
     def test_prefix_fetch_off_recomputes(self, model_cfg, params):
         """The A/B control: prefix_fetch=False spills re-prefill the hot
@@ -517,7 +521,11 @@ class TestFetchSpill:
             prefix_fetch=False)
         assert fetched == 0
         assert snap["prefix_fetch"]["fetches"] == 0
-        assert spent == sum(len(p) for p in _prompts()[1:]) - 2 * len(HOT)
+        # same admission-concurrency tolerance as the dead-link control:
+        # a spill admitted before the first one finishes its chunked
+        # prefill re-prefills the hot head locally too
+        total = sum(len(p) for p in _prompts()[1:])
+        assert spent in (total - 2 * len(HOT), total - len(HOT))
 
 
 # -- real sockets: spawned workers --------------------------------------------
