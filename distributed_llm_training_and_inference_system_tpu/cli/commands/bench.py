@@ -140,9 +140,13 @@ def kernels(op, seq_len, hidden, heads, batch):
 @click.option("--quant", default="none", show_default=True,
               type=click.Choice(["none", "int8", "int4", "int4-awq"]),
               help="serve-load: weight quantization.")
-@click.option("--kv-quant", default="none", show_default=True,
-              type=click.Choice(["none", "int8"]),
-              help="serve-load: KV page quantization.")
+@click.option("--kv-quant", "--serve-kv-quant", "kv_quant",
+              default="none", show_default=True,
+              type=click.Choice(["none", "fp", "int8", "int4"]),
+              help="serve-load: KV page quantization ('fp' is an alias "
+                   "for none — the A/B arm naming bench scripts use). "
+                   "int4 packs two page slots per byte: 2x decode slots "
+                   "per HBM byte over int8, 4x over bf16.")
 @click.option("--slots", default=0, show_default=True, type=int,
               help="serve-load: decode slot count (max_batch_size); "
                    "0 = auto from --requests (capped at 16).")
@@ -297,7 +301,7 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
                 pipelined_decode=pipelined,
                 int8_pallas_matmul=int8_pallas,
                 artifact=artifact, quantization=quant,
-                kv_quantization=kv_quant,
+                kv_quantization="none" if kv_quant == "fp" else kv_quant,
                 dtype="bfloat16" if on_tpu else "float32")
 
         def fresh_engine():
@@ -455,18 +459,22 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
                    "quantize-on-write for int8) vs per-row scatter.")
 def kv_decode(slots, kv_heads, head_dim, q_heads, page_size, context,
               layers, steps, write_mode):
-    """int8-KV decode A/B: one layer's paged attention + KV append per
-    step, bf16 pages vs int8 QuantPages, same shapes — the
-    round-5-named 7B 16-slot wall (BASELINE.md:205-218). Reports
-    ms/step for each mode plus an HBM-traffic ledger (bytes the decode
-    step must stream per token) so a chip run can certify whether a
-    remaining gap is physical or software."""
+    """Quantized-KV decode A/B: one layer's paged attention + KV append
+    per step over bf16 pages, int8 QuantPages, and packed-int4 Int4Pages
+    — same shapes (the round-5-named 7B 16-slot wall,
+    BASELINE.md:205-218, plus the round-14 int4 capacity arm). Reports
+    ms/step per mode, an HBM-traffic ledger (bytes the decode step must
+    stream per token), and a CAPACITY ledger (bytes/slot at this
+    context, slots/GB) — the Mooncake-style fleet-economics number:
+    decode replicas needed scale with bytes per resident slot, and int4
+    must show >= 1.9x decode slots per HBM byte over int8."""
     import jax
     import jax.numpy as jnp
 
     from ...ops.paged_attention import (
-        QuantPages, paged_attention, quantize_kv_token,
+        Int4Pages, QuantPages, paged_attention, quantize_kv_token,
         write_token_to_pages, write_window_to_pages)
+    from ...ops.quantization import pack_int4_rows, quantize_int4_rows
 
     q_heads = q_heads or kv_heads
     B, Nkv, Nq, D, PS = slots, kv_heads, q_heads, head_dim, page_size
@@ -481,10 +489,13 @@ def kv_decode(slots, kv_heads, head_dim, q_heads, page_size, context,
     q = jax.random.normal(key, (B, Nq, D), dtype)
     new_kv = jax.random.normal(key, (B, 1, Nkv, D), dtype)
 
-    def build(quant):
-        if quant:
+    def build(kind):
+        if kind == "int8":
             qv, sc = quantize_kv_token(kf)
             return QuantPages(qv, sc)
+        if kind == "int4":
+            qv, sc = quantize_int4_rows(kf)
+            return Int4Pages(pack_int4_rows(qv, axis=-2), sc)
         return jnp.array(kf)     # copy: the step donates its page buffer
 
     def step(pages, q, new_kv):
@@ -497,9 +508,17 @@ def kv_decode(slots, kv_heads, head_dim, q_heads, page_size, context,
         out = paged_attention(q, pages, pages, tables, lengths)
         return pages, out
 
+    # bytes one K-or-V token row costs in HBM per mode (scales included:
+    # fp32 per-(token, kv-head) for both quantized modes — the int4 win
+    # is the D/2 packed nibbles)
+    row_bytes = {
+        "bf16": Nkv * D * jnp.dtype(dtype).itemsize,
+        "int8": Nkv * (D + 4),
+        "int4": Nkv * (D // 2 + 4),
+    }
     results = {}
-    for name, quant in (("bf16", False), ("int8", True)):
-        pages = build(quant)
+    for name in ("bf16", "int8", "int4"):
+        pages = build(name)
         fn = jax.jit(step, donate_argnums=(0,))
         pages, out = jax.block_until_ready(fn(pages, q, new_kv))  # compile
         t0 = time.perf_counter()
@@ -510,13 +529,16 @@ def kv_decode(slots, kv_heads, head_dim, q_heads, page_size, context,
         # per-token HBM ledger at this shape, whole model (layers x):
         # attention must stream every live K/V row once; the append
         # writes (and, page-granular, re-reads) whole pages
-        kv_bytes = (1 if quant else jnp.dtype(dtype).itemsize)
-        row = Nkv * D * kv_bytes + (Nkv * 4 if quant else 0)  # + scales
+        row = row_bytes[name]
         read_attn = 2 * B * context * row
         if write_mode == "paged":
             write_rw = 2 * B * 2 * PS * row        # K+V staging gather+scatter
         else:
             write_rw = 2 * B * row                 # K+V row scatter (ideal)
+        # capacity ledger: a resident decode slot at this context costs
+        # K+V x layers x context rows — the fleet sizes decode replica
+        # counts off slots/GB (Mooncake: serving is KV-capacity-bound)
+        slot_bytes = 2 * layers * context * row
         results[name] = {
             "ms_per_layer_step": round(sec * 1e3, 3),
             "est_model_decode_ms": round(sec * 1e3 * layers, 1),
@@ -524,10 +546,25 @@ def kv_decode(slots, kv_heads, head_dim, q_heads, page_size, context,
                 "attn_kv_read": round(layers * read_attn / 1e6, 4),
                 "kv_append_rw": round(layers * write_rw / 1e6, 4),
             },
+            "capacity": {
+                "bytes_per_slot": slot_bytes,
+                "mb_per_slot": round(slot_bytes / 1e6, 3),
+                "slots_per_gb": round(1e9 / slot_bytes, 2),
+            },
         }
     b, i8 = (results["bf16"]["ms_per_layer_step"],
              results["int8"]["ms_per_layer_step"])
     results["int8_vs_bf16_speedup"] = round(b / i8, 3) if i8 else None
+    i4 = results["int4"]["ms_per_layer_step"]
+    results["int4_vs_bf16_speedup"] = round(b / i4, 3) if i4 else None
+    # the acceptance number: decode slots per HBM byte, int4 over int8
+    # (pure layout arithmetic at this shape — row bytes, not wall time)
+    results["int4_vs_int8_slots_per_hbm_byte"] = round(
+        results["int8"]["capacity"]["bytes_per_slot"]
+        / results["int4"]["capacity"]["bytes_per_slot"], 3)
+    results["int4_vs_bf16_slots_per_hbm_byte"] = round(
+        results["bf16"]["capacity"]["bytes_per_slot"]
+        / results["int4"]["capacity"]["bytes_per_slot"], 3)
     results["write_mode"] = write_mode
     results["backend"] = jax.default_backend()
     click.echo(json.dumps(results, indent=2))

@@ -64,7 +64,8 @@ def status(url, as_json):
                 "queue", "active", "outstanding tok", "restarts",
                 "migr out", "handoffs", "streams", "replayed",
                 "courier out", "courier aborts",
-                "prefix hit", "pfx fetched", "pfx miss", "last error"):
+                "prefix hit", "pfx fetched", "pfx miss",
+                "spec acc", "last error"):
         table.add_column(col)
     per_src = snap.get("courier", {}).get("per_src", {})
     for r in snap["replicas"]:
@@ -76,6 +77,15 @@ def status(url, as_json):
             # crash-promoted; auto-demotes once the lost class returns
             role = f"{role} (was {r['promoted_from']})"
         src = per_src.get(str(r["replica"]), {})
+        # speculative acceptance: drafts accepted / proposed on this
+        # replica, "+N res" when sequences arrived with a migrated
+        # SpecState (courier-aware speculation)
+        if r.get("spec_drafts"):
+            spec = f"{r.get('spec_acceptance', 0.0):.0%}"
+            if r.get("spec_resumes"):
+                spec += f" +{r['spec_resumes']}res"
+        else:
+            spec = "-"
         table.add_row(str(r["replica"]),
                       f"[{color}]{r['state']}[/{color}]",
                       role,
@@ -92,6 +102,7 @@ def status(url, as_json):
                       f"{hit:.0%}" if hit is not None else "-",
                       str(r.get("prefix_fetch_pages", 0)),
                       str(r.get("prefix_fetch_misses", 0)),
+                      spec,
                       (r.get("last_error") or "")[:48])
     console = Console()
     console.print(table)
@@ -129,6 +140,13 @@ def status(url, as_json):
             f"({st.get('replayed', 0)} tokens replayed), "
             f"{st.get('gaps_healed', 0)} gap-healed, "
             f"{st.get('identity_mismatches', 0)} identity violations")
+    sp = snap.get("spec")
+    if sp and sp.get("dispatches"):
+        console.print(
+            f"speculative: {sp.get('accepted', 0)}/{sp.get('drafts', 0)} "
+            f"drafts accepted ({sp.get('acceptance', 0.0):.0%} over "
+            f"{sp.get('dispatches', 0)} dispatches, "
+            f"{sp.get('resumes', 0)} migrated-state resumes)")
     pf = snap.get("prefix_fetch")
     if pf and (pf.get("pages") or pf.get("misses") or pf.get("aborts")):
         console.print(
@@ -236,7 +254,7 @@ def migrate(request_id, replica, url):
 @click.option("--dtype", default=None,
               type=click.Choice(["bfloat16", "float32"]))
 @click.option("--kv-quantization", default="none", show_default=True,
-              type=click.Choice(["none", "int8"]))
+              type=click.Choice(["none", "int8", "int4"]))
 @click.option("--seed", default=0, show_default=True, type=int,
               help="Engine sampling seed base.")
 @click.option("--param-seed", default=-1, show_default=True, type=int,

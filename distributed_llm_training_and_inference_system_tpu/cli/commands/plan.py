@@ -290,7 +290,7 @@ def verify(model, hardware, batch, seq_len, steps, save_calib,
               type=click.Choice(["none", "int8", "int4"]),
               help="Single-config mode: fix weight quantization.")
 @click.option("--kv-quant", default=None,
-              type=click.Choice(["none", "int8"]))
+              type=click.Choice(["none", "int8", "int4"]))
 @click.option("--tensor-parallel", "-tp", default=1, show_default=True)
 @click.option("--candidates", default=6, show_default=True)
 @click.option("--calibrate", is_flag=True,

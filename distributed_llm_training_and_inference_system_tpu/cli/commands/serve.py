@@ -62,9 +62,13 @@ def app(ctx):
               help="Prefill prompts longer than this in chunks of this "
                    "many tokens, interleaved with decode (0 = off).")
 @click.option("--kv-quantization", default="none", show_default=True,
-              type=click.Choice(["none", "int8"]),
-              help="int8 KV pages (+per-token scales): 2x KV capacity, "
-                   "half the decode KV streaming.")
+              type=click.Choice(["none", "int8", "int4"]),
+              help="Quantized KV pages (+per-token scales): int8 = 2x KV "
+                   "capacity and half the decode KV streaming; int4 packs "
+                   "two page slots per byte = 4x capacity / quarter the "
+                   "streaming (2x decode slots per HBM byte over int8) at "
+                   "a larger quality cost — see USER_GUIDE 'KV "
+                   "quantization: int8 vs int4'.")
 @click.option("--admission", default="ondemand", show_default=True,
               type=click.Choice(["ondemand", "reserve"]),
               help="KV admission: ondemand grows page chains as decode "

@@ -636,11 +636,15 @@ class ServeConfig:
     # default on. Single-device only (Pallas is opaque to GSPMD — the
     # tp>1 engine forces the dequant path like it does for attention).
     int8_pallas_matmul: bool = False
-    # int8 KV cache: pages stored int8 with per-token absmax scales (~3%
-    # overhead at D=128) — 2x KV capacity per HBM byte and half the
-    # decode-attention KV streaming. Dequant happens in VMEM inside the
-    # paged-attention kernels.
-    kv_quantization: str = "none"   # none | int8
+    # quantized KV cache: "int8" stores pages int8 with per-token absmax
+    # scales (~3% overhead at D=128) — 2x KV capacity per HBM byte and
+    # half the decode-attention KV streaming; "int4" packs two page
+    # slots per byte along the slot axis with the SAME per-token scale
+    # tile — 4x capacity / quarter the streaming (2x decode slots per
+    # HBM byte over int8), at a larger quality cost (see USER_GUIDE "KV
+    # quantization: int8 vs int4"). Dequant happens in VMEM inside the
+    # paged-attention kernels. int4 needs an even kv_block_size.
+    kv_quantization: str = "none"   # none | int8 | int4
     # KV admission policy:
     #   ondemand — reserve only the prompt (+ one dispatch of decode
     #     lookahead) at admission; grow the page chain as decode advances
@@ -675,8 +679,12 @@ class ServeConfig:
     stream_abort_on_disconnect: bool = True
 
     def validate(self) -> None:
-        if self.kv_quantization not in ("none", "int8"):
-            raise ConfigError("kv_quantization must be none|int8")
+        if self.kv_quantization not in ("none", "int8", "int4"):
+            raise ConfigError("kv_quantization must be none|int8|int4")
+        if self.kv_quantization == "int4" and self.kv_block_size % 2:
+            raise ConfigError(
+                f"kv_quantization=int4 packs two page slots per byte; "
+                f"kv_block_size {self.kv_block_size} must be even")
         if self.tensor_parallel < 1:
             raise ConfigError("tensor_parallel must be >= 1")
         if self.quantization not in ("none", "int8", "int4", "int4-awq"):

@@ -343,6 +343,25 @@ class PrometheusExporter:
             "Tokens replayed per SSE reconnect (Last-Event-ID tail "
             "size)",
             buckets=(1, 2, 5, 10, 25, 50, 100, 250, 1000))
+        # speculative decode plane (serve/speculative.py SpecState):
+        # fleet-wide acceptance economics. Dispatches/drafts/accepted
+        # give the acceptance rate the adaptive window tunes against;
+        # resumes count sequences re-placed WITH a migrated SpecState
+        # (courier-aware speculation — a handed-off sequence keeps its
+        # tuned window instead of cold-starting the proposer).
+        self.fleet_spec_dispatches = c(
+            "llmctl_fleet_spec_dispatches",
+            "Fused speculative verify+decode dispatches fleet-wide")
+        self.fleet_spec_drafts = c(
+            "llmctl_fleet_spec_drafts",
+            "Draft tokens proposed within adaptive windows fleet-wide")
+        self.fleet_spec_accepted = c(
+            "llmctl_fleet_spec_accepted",
+            "Draft tokens verified/accepted by the device fleet-wide")
+        self.fleet_spec_resumes = c(
+            "llmctl_fleet_spec_resumes",
+            "Slots armed from a MIGRATED SpecState (tuned window kept "
+            "across migration / prefill->decode handoff)")
         self._last_totals: dict[str, float] = {}
         self._server_started = False
 
@@ -510,6 +529,20 @@ class PrometheusExporter:
             for t in window[-min(new, len(window)):]:
                 self.fleet_prefix_fetch.observe(t)
         self._last_totals["fleet_pf_fetches"] = count
+        # speculative-decode plane: per-replica counters arrive fleet-
+        # aggregated as running totals (supervisor snapshot "spec"
+        # section); the pump deltas them like every other fleet counter
+        sp = snap.get("spec", {})
+        for key, counter in (
+                ("dispatches", self.fleet_spec_dispatches),
+                ("drafts", self.fleet_spec_drafts),
+                ("accepted", self.fleet_spec_accepted),
+                ("resumes", self.fleet_spec_resumes)):
+            total = sp.get(key, 0)
+            delta = total - self._last_totals.get(f"fleet_sp_{key}", 0)
+            if delta > 0:
+                counter.inc(delta)
+            self._last_totals[f"fleet_sp_{key}"] = total
         # fleet SSE streaming plane: counters on running totals; the
         # replay-size histogram fills from the bounded recent window
         # gated by the cumulative reconnect count (same delta contract)

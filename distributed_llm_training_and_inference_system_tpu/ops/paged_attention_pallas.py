@@ -47,7 +47,7 @@ from ..models.layers import NEG_INF
 def _extend_kernel(tables_ref, starts_ref,        # scalar prefetch
                    *refs,                          # see unpack below
                    page_size: int, scale: float, groups: int,
-                   window: int, num_kv: int, kv_quant: bool):
+                   window: int, num_kv: int, kv_quant: str):
     """Multi-query variant: ``window`` consecutive query tokens per slot
     (speculative verify / cached-prefix suffix prefill). Each page is
     DMA'd ONCE per slot and scored against all T queries of ALL kv heads —
@@ -68,11 +68,14 @@ def _extend_kernel(tables_ref, starts_ref,        # scalar prefetch
     trivia next to per-grid-step overhead (16 GFLOPs/step at gpt-1b B=8
     vs a ~100 us MXU budget).
 
-    ``kv_quant``: pages are int8 with a per-page [Nkv, PS] scale tile
-    (one row scale per token — QuantPages layout) — dequant happens in
-    VMEM right before the fp32 dot, so HBM page traffic is halved (the
-    whole point of the int8 KV cache)."""
-    if kv_quant:
+    ``kv_quant``: "int8" pages carry a per-page [Nkv, PS] scale tile
+    (one row scale per token — QuantPages layout); "int4" pages pack two
+    page slots per byte along the slot axis ([Nkv, PS/2, D] uint8 tile,
+    Int4Pages) with the SAME scale tile. Either way dequant happens in
+    VMEM right before the fp32 dot, so HBM page traffic is halved
+    (int8) or quartered (int4) — the whole point of the quantized KV
+    cache."""
+    if kv_quant != "none":
         (q_ref, k_ref, ks_ref, v_ref, vs_ref,
          o_ref, acc_ref, m_ref, l_ref) = refs
     else:
@@ -94,7 +97,14 @@ def _extend_kernel(tables_ref, starts_ref,        # scalar prefetch
     @pl.when(p * page_size < max_len)
     def _body():
         q = q_ref[...].astype(jnp.float32).reshape(num_kv * tg, d)
-        if kv_quant:
+        if kv_quant == "int4":
+            # shared nibble math (ops.quantization): unpack is a sublane
+            # relabel of the [Nkv, PS/2, D] byte tile, then the same
+            # row-scale multiply as int8
+            from .quantization import dequantize_int4_rows
+            k = dequantize_int4_rows(k_ref[...], ks_ref[...], jnp.float32)
+            v = dequantize_int4_rows(v_ref[...], vs_ref[...], jnp.float32)
+        elif kv_quant == "int8":
             # shared absmax math (ops.quantization): pure jnp, safe in a
             # Pallas body — page scales are the [Nkv, PS] per-page tile
             from .quantization import dequantize_int8_rows
@@ -143,8 +153,9 @@ def paged_attention_pallas_multi(
 ) -> jax.Array:
     """Returns [B, T, Nq, D]; query j attends over [0, start+j] via pages
     (the window's own K/V must already be written to the pages)."""
-    from .paged_attention import QuantPages
-    kv_quant = isinstance(k_pages, QuantPages)
+    from .paged_attention import Int4Pages, QuantPages
+    kv_quant = ("int4" if isinstance(k_pages, Int4Pages)
+                else "int8" if isinstance(k_pages, QuantPages) else "none")
     B, T, Nq, D = q.shape
     NP, Nkv, PS, _ = k_pages.shape
     maxP = block_tables.shape[1]
@@ -165,15 +176,17 @@ def paged_attention_pallas_multi(
     # head-folded grid (B, maxP): one whole page (all kv heads) per step.
     # The scale tile [Nkv, PS] rides the SAME clamped block-table index
     # map as its page, so Pallas elides its re-fetch together with the
-    # page's on consecutive identical indices.
-    page_spec = pl.BlockSpec((None, Nkv, PS, D),
+    # page's on consecutive identical indices. int4 pages DMA the packed
+    # [Nkv, PS/2, D] byte tile — half the int8 bytes per page.
+    page_rows = PS // 2 if kv_quant == "int4" else PS
+    page_spec = pl.BlockSpec((None, Nkv, page_rows, D),
                              lambda b, p, t, u: (t[b, p], 0, 0, 0))
     scale_spec = pl.BlockSpec((None, Nkv, PS),
                               lambda b, p, t, u: (t[b, p], 0, 0))
     in_specs = [pl.BlockSpec((None, Nkv, T * groups, D),
                              lambda b, p, t, u: (b, 0, 0, 0))]      # q
     inputs = [qg]
-    if kv_quant:
+    if kv_quant != "none":
         in_specs += [page_spec, scale_spec, page_spec, scale_spec]
         inputs += [k_pages.values, k_pages.scale,
                    v_pages.values, v_pages.scale]

@@ -82,6 +82,76 @@ def _unnibble(v: jax.Array) -> jax.Array:
     return jnp.where(v >= 8, v - 16, v)
 
 
+# -- int4 KV rows (Int4Pages — ops/paged_attention.py) ------------------------
+#
+# The KV-cache flavor of int4: per-(token, kv-head) absmax over head_dim
+# (same row granularity as the int8 quantize_int8_rows path, so the
+# per-page scale tile keeps the kernel-friendly [.., Nkv, PS] layout from
+# round 6), with nibbles packed pairwise along the PAGE-SLOT axis — two
+# consecutive tokens share one byte. Packing along PS (not D) keeps
+# head_dim on the minor axis, so the Pallas page tile stays a full
+# 128-lane vector and unpack is a sublane relabel, exactly the lesson the
+# weight-side [.., in/2, out] layout already paid for (the round-3
+# transpose-in-the-scan disaster documented on quantize_int4_groupwise).
+
+
+def quantize_int4_rows(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Symmetric absmax int4 over the LAST axis: (values int8 in [-7, 7]
+    [..., D], scale fp32 [...]). The int4 sibling of quantize_int8_rows —
+    pure jnp, safe both traced and inside Pallas kernel bodies. Values
+    stay UNPACKED int8 here; pack_int4_rows pairs them along a chosen
+    axis (the write path packs along the page-slot axis)."""
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(absmax / 7.0, 1e-12)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
+                 -7, 7).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def pack_int4_rows(q: jax.Array, axis: int = -2) -> jax.Array:
+    """Pack int4-valued int8 rows pairwise along ``axis``: element 2i ->
+    low nibble, 2i+1 -> high nibble of byte i. An ODD count along the
+    axis pads one zero row (the unpacked tail reads back as 0; callers
+    slicing with ``unpack_int4_rows(..., n=odd)`` never see it)."""
+    axis = axis % q.ndim
+    n = q.shape[axis]
+    if n % 2:
+        pad = [(0, 0)] * q.ndim
+        pad[axis] = (0, 1)
+        q = jnp.pad(q, pad)
+    lo = (jax.lax.slice_in_dim(q, 0, None, 2, axis) & 0xF).astype(jnp.uint8)
+    hi = (jax.lax.slice_in_dim(q, 1, None, 2, axis) & 0xF).astype(jnp.uint8)
+    return (lo | (hi << 4)).astype(jnp.uint8)
+
+
+def unpack_int4_rows(packed: jax.Array, axis: int = -2,
+                     n: int | None = None) -> jax.Array:
+    """Inverse of pack_int4_rows: uint8 bytes -> sign-extended int8 rows
+    interleaved along ``axis`` (count doubles; ``n`` trims a padded odd
+    tail). stack+reshape is a free row-major relabel along the packed
+    axis — no transpose, fusable into the consuming dequant."""
+    axis = axis % packed.ndim
+    lo = _unnibble(packed & 0xF)
+    hi = _unnibble(packed >> 4)
+    q = jnp.stack([lo, hi], axis=axis + 1)
+    shape = (*packed.shape[:axis], packed.shape[axis] * 2,
+             *packed.shape[axis + 1:])
+    q = q.reshape(shape)
+    if n is not None and n < shape[axis]:
+        q = jax.lax.slice_in_dim(q, 0, n, 1, axis)
+    return q
+
+
+def dequantize_int4_rows(packed: jax.Array, scale: jax.Array,
+                         dtype=jnp.float32) -> jax.Array:
+    """Inverse of quantize_int4_rows+pack_int4_rows for the KV layout:
+    packed [..., PS/2, D] uint8 * row scales [..., PS] -> [..., PS, D].
+    Shared by the write-path round-trip checks and the Pallas kernel
+    body (one definition of the nibble math, like the int8 pair)."""
+    q = unpack_int4_rows(packed, axis=-2, n=scale.shape[-1])
+    return (q.astype(jnp.float32) * scale[..., :, None]).astype(dtype)
+
+
 def dequantize_int4_blockwise(packed: jax.Array, scale: jax.Array,
                               block: int = 32, dtype=jnp.bfloat16) -> jax.Array:
     lo = _unnibble(packed & 0xF)

@@ -706,6 +706,12 @@ class ServePlanner:
         if kv_quant == "int8":
             return 2 * m.num_layers * page_size * m.num_kv_heads \
                 * (m.head_dim + 4)
+        if kv_quant == "int4":
+            # two page slots per byte + the same fp32 per-row scale
+            # (Int4Pages): the Mooncake capacity lever — ~2x int8's
+            # slots per HBM byte at D=128
+            return 2 * m.num_layers * page_size * m.num_kv_heads \
+                * (m.head_dim / 2 + 4)
         return 2 * m.num_layers * page_size * m.num_kv_heads \
             * m.head_dim * BYTES_BF16
 
@@ -737,7 +743,7 @@ class ServePlanner:
         kv_read = batch * context_len * (pb / max(page_size, 1))
         bw = hw.hbm_bw_gbps * 1e9 * self.decode_efficiency
         decode_s = (wb + kv_read) / max(bw, 1.0)
-        if kv_quant == "int8":
+        if kv_quant in ("int8", "int4"):
             # int8 KV pages switch the page writes to the per-row scatter
             # path and add in-kernel dequant — a program-structure cost,
             # not a bytes cost, so the byte model alone predicts int8 KV
@@ -753,7 +759,10 @@ class ServePlanner:
             # model, it exists to rank configs, and without it the
             # ranking steered 7B/MHA users into the measured 40% loss.
             # At long contexts the halved KV traffic can still net a
-            # win — the capacity regime the feature exists for.
+            # win — the capacity regime the feature exists for. int4
+            # reuses the int8 anchors (same dequant/program structure;
+            # the nibble unpack is a relabel, not extra traffic) until
+            # a chip battery measures its own points.
             nkv_chip = m.num_kv_heads / tp
             overhead = max(1.0, 1.18 + 0.45 * (nkv_chip - 16) / 16)
             decode_s *= overhead
@@ -778,7 +787,7 @@ class ServePlanner:
     def sweep(self, *, context_len: int = 1024, prompt_len: int = 512,
               page_size: int = 64, tensor_parallel: int = 1,
               quants: tuple = ("none", "int8", "int4"),
-              kv_quants: tuple = ("none", "int8"),
+              kv_quants: tuple = ("none", "int8", "int4"),
               batches: tuple = (4, 8, 16, 32)) -> list[dict]:
         """Grid over the serving knobs; rows sorted by decode throughput
         among configs that fit (oversubscription is rejected inside

@@ -157,7 +157,7 @@ class InferenceEngine:
             num_pages=serve_cfg.kv_num_blocks,
             hbm_budget_gb=serve_cfg.kv_hbm_budget_gb, dtype=dtype,
             page_sharding=page_sharding,
-            quantized=serve_cfg.kv_quantization == "int8")
+            quantized=serve_cfg.kv_quantization)
 
         self._req_slot: dict[str, int] = {}
         # pages promised to admitted-but-not-yet-prefilled requests; without
@@ -304,6 +304,15 @@ class InferenceEngine:
         self.total_spec_dispatches = 0
         self.total_spec_drafts = 0
         self.total_spec_accepted = 0
+        # per-slot courier-migratable speculative state (SpecState:
+        # acceptance EWMA, adaptive window, proposer warmup) — armed
+        # with the request, extracted into migration/handoff payloads,
+        # restored on the destination so a re-placed sequence keeps its
+        # tuned window instead of cold-starting the proposer
+        self._spec_state: list = [None] * S
+        # slots armed FROM a migrated SpecState (vs a cold proposer) —
+        # the fleet-disagg resume assertion reads this
+        self.total_spec_resumes = 0
 
     # -- setup ---------------------------------------------------------------
 
@@ -419,10 +428,23 @@ class InferenceEngine:
     @property
     def _decode_lookahead(self) -> int:
         """Tokens one device dispatch may write per slot: the page-growth
-        horizon for on-demand admission."""
+        horizon for on-demand admission.
+
+        The fused speculative dispatch writes the whole verify window
+        (T rows from the root position) AND its decode scan (K-1 steps
+        from root + n_emit, n_emit <= T), so its worst-case span is
+        T + K - 1 tokens — NOT max(T, K). Under-reserving here silently
+        redirected the overflow rows to scratch page 0 (the block-table
+        padding entry) where concurrent slots' overflow interleaves, and
+        the next capacity pass then grew the chain over those positions
+        with FRESH (zero) pages: quality rot in every deep-acceptance
+        dispatch, and byte divergence the moment a migration misaligned
+        a co-resident's overflow pattern (caught by the int4+spec
+        migration identity tests)."""
         k = self._decode_units * self._decode_unit_len
         if self.serve_cfg.speculative == "ngram":
-            k = max(k, self.serve_cfg.speculative_tokens)
+            K = max(self.serve_cfg.decode_steps_per_dispatch, 1)
+            k = max(k, self.serve_cfg.speculative_tokens + K - 1)
         return k
 
     def _admission_tail(self, req: Request) -> int:
@@ -544,8 +566,19 @@ class InferenceEngine:
                     cfg.num_kv_heads, cfg.head_dim).transpose(0, 1, 3, 2, 4)
 
                 def scatter(pages, dense):
-                    from ..ops.paged_attention import (QuantPages,
-                                                       quantize_kv_token)
+                    from ..ops.paged_attention import (
+                        Int4Pages, QuantPages, quantize_kv_token,
+                        quantize_kv_token_int4)
+                    if isinstance(pages, Int4Pages):
+                        # same per-token absmax granularity as int8,
+                        # then the whole-page pack along the slot axis
+                        # ([L, nP, Nkv, PS, D] -> [.., PS/2, D] bytes)
+                        from ..ops.quantization import pack_int4_rows
+                        qv, sc = quantize_kv_token_int4(dense)
+                        return Int4Pages(
+                            pages.values.at[:, entries].set(
+                                pack_int4_rows(qv, axis=-2)),
+                            pages.scale.at[:, entries].set(sc))
                     if isinstance(pages, QuantPages):
                         # dense [L, nP, Nkv, PS, D]: absmax over D gives
                         # the per-token scale [L, nP, Nkv, PS] — exactly
@@ -1023,6 +1056,23 @@ class InferenceEngine:
         self.temperature[slot] = s.temperature
         self.top_k[slot] = s.top_k
         self.top_p[slot] = s.top_p
+        # speculative state: resume from a migrated SpecState when the
+        # request carries one (handoff / drain migration / preemption
+        # resume — the payload's copy lands on req.spec_state before
+        # this runs), else start cold at the full configured window
+        if self.serve_cfg.speculative == "ngram":
+            from .speculative import SpecState
+            T = max(self.serve_cfg.speculative_tokens, 2)
+            carried = getattr(req, "spec_state", None)
+            if isinstance(carried, dict):
+                self._spec_state[slot] = SpecState.from_dict(
+                    carried, max_window=T)
+                if self._spec_jit is not None:
+                    self.total_spec_resumes += 1
+            else:
+                self._spec_state[slot] = SpecState(window=T)
+        else:
+            self._spec_state[slot] = None
 
     def _finish_prefill(self, req: Request, token) -> None:
         """Resolve a dispatched prefill: fetch its first token and make the
@@ -1185,6 +1235,17 @@ class InferenceEngine:
 
     # -- speculative decode --------------------------------------------------
 
+    def spec_state_of(self, slot: int) -> Optional[dict]:
+        """The slot's SpecState as a plain-scalar dict (rides the
+        migration/handoff payload manifest and the worker wire) — None
+        when speculation is off or the slot carries no state. Callers:
+        migration.stop_and_copy (payload "spec" entry) and _preempt
+        (request-side fallback for payload-less requeues)."""
+        if not 0 <= slot < len(self._spec_state):
+            return None
+        st = self._spec_state[slot]
+        return st.to_dict() if st is not None else None
+
     def _spec_impl(self, params, k_pages, v_pages, tokens, positions,
                    tables, stops, slot_keys, temp, top_k, top_p):
         from .speculative import verify_and_decode
@@ -1223,10 +1284,17 @@ class InferenceEngine:
             if req is None or not self.active[slot] \
                     or self.temperature[slot] > 0:
                 continue
-            # every greedy row verifies T-1 drafts (ngram or the repeat
-            # fallback) — counting only ngram rows would let fallback
-            # acceptances push spec_acceptance above 1.0
-            n_drafted += T - 1
+            # per-slot ADAPTIVE window (SpecState): only w-1 drafts are
+            # proposed and counted for this row; positions [w, T) keep
+            # the repeat-last fallback (the compiled program's T is
+            # static — the window bounds proposal work and the
+            # acceptance statistics, not the dispatch shape). Every
+            # greedy row counts its window's drafts (ngram or the
+            # repeat fallback) — counting only ngram rows would let
+            # fallback acceptances push spec_acceptance above 1.0.
+            st = self._spec_state[slot]
+            w = min(st.window, T) if st is not None else T
+            n_drafted += w - 1
             # bounded lookback keeps proposal O(window), not O(context)
             ctx = self._ctx[slot, max(self._ctx_len[slot] - 1024, 0):
                             self._ctx_len[slot]]
@@ -1235,13 +1303,13 @@ class InferenceEngine:
             # production default is the prompt-lookup proposer
             draft_fn = getattr(self, "draft_fn", None)
             if draft_fn is not None:
-                draft = draft_fn(ctx, T - 1,
+                draft = draft_fn(ctx, w - 1,
                                  self.serve_cfg.speculative_ngram)
             else:
                 draft = propose_ngram_draft(
-                    ctx, T - 1, self.serve_cfg.speculative_ngram)
+                    ctx, w - 1, self.serve_cfg.speculative_ngram)
             if draft is not None:
-                tokens[slot, 1:] = draft
+                tokens[slot, 1:w] = draft
         emitted, n_emit, decode_seq, self.kv.k_pages, self.kv.v_pages = \
             self._spec_jit(
                 self.params, self.kv.k_pages, self.kv.v_pages,
@@ -1286,8 +1354,19 @@ class InferenceEngine:
             if self.temperature[slot] <= 0:
                 # device-side acceptance (n_emit - 1 drafts verified), not
                 # recorded count: a stop condition can truncate recording
-                # after the device already verified the draft
-                self.total_spec_accepted += max(int(n_emit[slot]) - 1, 0)
+                # after the device already verified the draft. Capped at
+                # the slot's PROPOSED window — repeat-fallback positions
+                # beyond it can still verify (correct greedy output), but
+                # crediting them would push acceptance above 1.0.
+                st = self._spec_state[slot]
+                T = max(self.serve_cfg.speculative_tokens, 2)
+                w = min(st.window, T) if st is not None else T
+                acc = min(max(int(n_emit[slot]) - 1, 0), w - 1)
+                self.total_spec_accepted += acc
+                if st is not None:
+                    # EWMA + adaptive window (SpecState.observe) — the
+                    # state that migrates with the sequence
+                    st.observe(acc, w - 1, max_window=T)
             if accepted and self.on_token is not None:
                 self.on_token(req, accepted)
 
@@ -1402,6 +1481,12 @@ class InferenceEngine:
         self._slot_seq[slot] = self._admitted_counter
         slot_key = jax.random.PRNGKey(req.assigned_seed)
         self._slot_keys[slot] = np.asarray(jax.random.key_data(slot_key))
+        # migrated speculative state rides the payload manifest (the
+        # courier-aware half: a handed-off/migrated sequence resumes
+        # with its tuned window, not a cold proposer); _arm_slot reads
+        # it off the request
+        if isinstance(saved.get("spec"), dict):
+            req.spec_state = saved["spec"]
         self._arm_slot(req, saved["last_token"], saved["positions"],
                        req.context_tokens)
         req.swapped_kv = None
@@ -1432,6 +1517,9 @@ class InferenceEngine:
                 "positions": written,
                 "last_token": int(self.last_tokens[slot]),
             }
+            spec = self.spec_state_of(slot)
+            if spec is not None:
+                req.swapped_kv["spec"] = spec
         if self.serve_cfg.prefix_caching:
             from .kv_cache import prefix_page_hashes
             ctx = req.context_tokens
@@ -1443,6 +1531,12 @@ class InferenceEngine:
             # stay evictable (content kept) instead of returning to _free
             self.kv.register_pages(
                 [(hashes[j], int(table[j])) for j in range(full)])
+        # carry the tuned speculative state with the request: the resume
+        # (local readmission, drain migration, handoff — all funnel
+        # through here) re-arms from it instead of a cold proposer
+        spec = self.spec_state_of(slot)
+        if spec is not None:
+            req.spec_state = spec
         pins = self._prefix_pins.pop(rid, None)
         self.kv.release(slot)
         if pins:
@@ -1452,6 +1546,7 @@ class InferenceEngine:
         self.positions[slot] = 0
         self.stop_positions[slot] = 0
         self._ctx_len[slot] = 0
+        self._spec_state[slot] = None
         self.scheduler.preempt_slot(slot)
         self.total_preemptions += 1
         logger.info("preempted %s (slot %d, %d tokens generated) to free "
@@ -1507,6 +1602,7 @@ class InferenceEngine:
             self.active[slot] = False
             self.positions[slot] = 0
             self.stop_positions[slot] = 0
+            self._spec_state[slot] = None
         if self.on_finish is not None:
             self.on_finish(req)
 
@@ -1816,6 +1912,7 @@ class InferenceEngine:
             "spec_dispatches": self.total_spec_dispatches,
             "spec_drafts": self.total_spec_drafts,
             "spec_accepted": self.total_spec_accepted,
+            "spec_resumes": self.total_spec_resumes,
             "spec_acceptance": round(
                 self.total_spec_accepted / max(self.total_spec_drafts, 1), 4),
             "compiled_programs": self.compiled_programs(),

@@ -198,16 +198,19 @@ class FleetServer:
                                         resume=True)
 
     def _sse_event(self, rid: str, seq_last: int, token_ids: list,
-                   finish_reason=None) -> bytes:
+                   text: str = "", finish_reason=None) -> bytes:
         """One SSE frame. ``id:`` carries the seq of the LAST token in
         the batch — exactly what a reconnect must echo as
-        ``Last-Event-ID`` to resume duplicate-free."""
+        ``Last-Event-ID`` to resume duplicate-free. ``text`` is the
+        caller's INCREMENTAL suffix delta (IncrementalDecoder): batches
+        are never decoded independently — a merge-sensitive tokenizer
+        (byte-level UTF-8, BPE joiners) would render batch seams
+        differently than the final full-sequence decode."""
         payload = {
             "id": rid, "object": "text_completion",
             "model": self.model_cfg.name, "seq": seq_last,
             "choices": [{"index": 0,
-                         "text": self.tokenizer.decode(token_ids)
-                         if token_ids else "",
+                         "text": text,
                          "token_ids": token_ids,
                          "finish_reason": finish_reason}],
         }
@@ -238,12 +241,21 @@ class FleetServer:
             "Cache-Control": "no-cache",
         })
         seq_next = sub["start"]
+        # incremental text decode against the ACCUMULATED token list
+        # (PR-8 known gap closed): seed with the log prefix the client
+        # already holds, so a reconnect's replay decodes in context and
+        # the concatenated text deltas equal the final full-sequence
+        # decode even when a batch seam splits a multi-byte character
+        from ..tokenizer import IncrementalDecoder
+        prefix = (self.fleet.streams.tokens_of(rid) or [])[:sub["start"]]
+        decoder = IncrementalDecoder(self.tokenizer, prefix)
         try:
             await resp.prepare(http_req)
             if sub["tokens"]:
                 seq_next = sub["start"] + len(sub["tokens"])
-                await resp.write(self._sse_event(rid, seq_next - 1,
-                                                 sub["tokens"]))
+                await resp.write(self._sse_event(
+                    rid, seq_next - 1, sub["tokens"],
+                    text=decoder.feed(sub["tokens"])))
             finished = sub["finished"]
             finish_reason = sub["finish_reason"]
             while not finished:
@@ -257,13 +269,16 @@ class FleetServer:
                 if ev[0] == "tokens":
                     _kind, start, toks = ev
                     seq_next = start + len(toks)
-                    await resp.write(self._sse_event(rid, seq_next - 1,
-                                                     list(toks)))
+                    await resp.write(self._sse_event(
+                        rid, seq_next - 1, list(toks),
+                        text=decoder.feed(toks)))
                 else:
                     _kind, finish_reason, _error = ev
                     finished = True
+            # the finish frame flushes any withheld tail (a trailing
+            # incomplete character really is a replacement char now)
             await resp.write(self._sse_event(
-                rid, max(seq_next - 1, 0), [],
+                rid, max(seq_next - 1, 0), [], text=decoder.finish(),
                 finish_reason=finish_reason or "error"))
             await resp.write(b"data: [DONE]\n\n")
             await resp.write_eof()

@@ -54,7 +54,9 @@ class MigrationTicket:
 
 def _concat_pages(a, b):
     """Concatenate two extract payload buffers along the page axis (1);
-    handles both plain arrays and int8 QuantPages {values, scale} dicts."""
+    handles plain arrays and quantized {values, scale} dicts (int8
+    QuantPages and packed-int4 Int4Pages alike — the page axis is 1 in
+    both leaves)."""
     if isinstance(a, dict):
         return {k: np.concatenate([a[k], b[k]], axis=1) for k in a}
     return np.concatenate([a, b], axis=1)
@@ -125,6 +127,14 @@ def stop_and_copy(engine, slot: int, pre: dict) -> tuple[dict, dict]:
         "positions": pos,
         "last_token": int(engine.last_tokens[slot]),
     }
+    # courier-aware speculation: the slot's SpecState (acceptance EWMA,
+    # adaptive window, proposer warmup) rides the payload MANIFEST as
+    # plain scalars — tiny, CRC-covered, and restored by the destination
+    # engine's swap-in path so the sequence resumes speculating at its
+    # tuned window instead of cold-starting the proposer
+    spec = getattr(engine, "spec_state_of", lambda s: None)(slot)
+    if spec is not None:
+        payload["spec"] = spec
     pause_ms = (time.perf_counter() - t0) * 1e3
     detail = {
         "pause_ms": pause_ms,

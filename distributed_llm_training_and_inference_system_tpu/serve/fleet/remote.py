@@ -98,6 +98,9 @@ def request_to_wire(req: Request) -> dict:
         "prefix_owner": getattr(req, "prefix_owner", None),
         "prefix_owner_endpoint": getattr(req, "prefix_owner_endpoint",
                                          None),
+        # courier-aware speculation: the sequence's SpecState dict (tiny,
+        # plain scalars) so a remote worker arms the tuned window
+        "spec_state": getattr(req, "spec_state", None),
     }
 
 
@@ -116,6 +119,9 @@ def request_from_wire(d: dict, receiver=None) -> Request:
     req.stream_requested = bool(d.get("stream"))
     req.prefix_owner = d.get("prefix_owner")
     req.prefix_owner_endpoint = d.get("prefix_owner_endpoint")
+    spec = d.get("spec_state")
+    if isinstance(spec, dict):
+        req.spec_state = spec
     ticket = d.get("ticket")
     if ticket and receiver is not None:
         payload = receiver.take_payload(ticket)
@@ -133,6 +139,10 @@ def apply_wire(req: Request, d: dict) -> None:
     if d.get("assigned_seed") is not None:
         req.assigned_seed = d["assigned_seed"]
     req.handoffs = int(d.get("handoffs", req.handoffs))
+    if isinstance(d.get("spec_state"), dict):
+        # the worker's copy is fresher: it observed the dispatches this
+        # parent never saw — the next placement resumes from it
+        req.spec_state = d["spec_state"]
 
 
 class RemoteReplica:
@@ -402,6 +412,16 @@ class RemoteReplica:
                 "aborts": int(pf.get("aborts", 0)),
                 "fetch_ms": list(pf.get("fetch_ms", [])),
                 "fetch_count": int(pf.get("fetch_count", 0))}
+
+    def spec_stats(self) -> dict:
+        """The worker's speculative-decode counters, as of the last
+        probe (probe-stale like every other mirrored counter)."""
+        with self._lock:
+            sp = self._cache.get("spec") or {}
+        return {"dispatches": int(sp.get("dispatches", 0)),
+                "drafts": int(sp.get("drafts", 0)),
+                "accepted": int(sp.get("accepted", 0)),
+                "resumes": int(sp.get("resumes", 0))}
 
     def pool_room_for(self, req: Request) -> bool:
         """PR-6 gap closed: the ``handoff_dest`` advisory used to ASSUME

@@ -350,12 +350,25 @@ class EngineReplica:
                         if r is not None and r.state is RequestState.RUNNING:
                             tickets.append(
                                 (r, migration.precopy_slot(eng, slot)))
-                if tickets and any(eng.active):
+                interleave = not (eng._spec_jit is not None
+                                  and eng.kv.quantized)
+                if tickets and any(eng.active) and interleave:
                     # phase 1 done: let decode advance one dispatch while
                     # the full pages are already on host — the stop phase
                     # then covers only the tail written since. Decode-only
                     # (not eng.step()): a drain must not START a queued
                     # request's prefill just to evict it again.
+                    #
+                    # SKIPPED under speculation + quantized KV: committed
+                    # quantized K/V bytes depend on dispatch grouping
+                    # (the dequant multiply fuses into different program
+                    # contexts for the verify window vs the decode scan),
+                    # so a decode-only dispatch where the undisturbed
+                    # engine would have speculated forks the byte stream
+                    # — the destination could then diverge token-wise.
+                    # Going straight to stop-and-copy keeps the dispatch
+                    # schedule identical; the pause only grows by the
+                    # tail the skipped dispatch would have absorbed.
                     with eng.lock:
                         eng._ensure_decode_capacity()
                     if any(eng.active):
@@ -732,6 +745,19 @@ class EngineReplica:
             return 0, 0, 0
         return (kv.prefix_hits, kv.prefix_queries,
                 getattr(self.engine, "total_requeue_cached_tokens", 0))
+
+    def spec_stats(self) -> dict:
+        """Per-replica speculative-decode counters (running totals) for
+        the supervisor snapshot / `llmctl_fleet_spec_*` Prometheus
+        export. ``resumes`` counts slots armed from a MIGRATED SpecState
+        — the courier-aware-speculation payoff signal."""
+        eng = self.engine
+        return {
+            "dispatches": int(getattr(eng, "total_spec_dispatches", 0)),
+            "drafts": int(getattr(eng, "total_spec_drafts", 0)),
+            "accepted": int(getattr(eng, "total_spec_accepted", 0)),
+            "resumes": int(getattr(eng, "total_spec_resumes", 0)),
+        }
 
     # -- fleet-global prefix cache -------------------------------------------
 

@@ -513,6 +513,12 @@ class ReplicaSupervisor:
         # count — the usual Prometheus delta contract)
         fetch_agg = {"fetches": 0, "pages": 0, "bytes": 0, "misses": 0,
                      "aborts": 0, "fetch_ms": [], "fetch_count": 0}
+        # courier-aware speculation: per-replica acceptance counters,
+        # fleet-aggregated (running totals — the llmctl_fleet_spec_*
+        # Prometheus pump deltas them). `resumes` counts sequences that
+        # arrived WITH a migrated SpecState and kept their tuned window.
+        spec_agg = {"dispatches": 0, "drafts": 0, "accepted": 0,
+                    "resumes": 0}
         try:
             endpoints = self.cfg.endpoint_map()
         except Exception:
@@ -532,6 +538,9 @@ class ReplicaSupervisor:
                         "fetch_count"):
                 fetch_agg[key] += int(pf.get(key, 0))
             fetch_agg["fetch_ms"].extend(pf.get("fetch_ms", ()))
+            sp = r.spec_stats() if hasattr(r, "spec_stats") else {}
+            for key in spec_agg:
+                spec_agg[key] += int(sp.get(key, 0))
             reps.append({
                 "replica": r.replica_id,
                 "state": r.state,
@@ -567,6 +576,16 @@ class ReplicaSupervisor:
                     r.replica_id, {}).get("active", 0)),
                 "stream_replayed_tokens": int(stream_by_replica.get(
                     r.replica_id, {}).get("replayed", 0)),
+                # speculative decode per replica: the acceptance rate is
+                # the `fleet status` column; resumes are migrated-state
+                # arms (courier-aware speculation)
+                "spec_dispatches": int(sp.get("dispatches", 0)),
+                "spec_drafts": int(sp.get("drafts", 0)),
+                "spec_accepted": int(sp.get("accepted", 0)),
+                "spec_resumes": int(sp.get("resumes", 0)),
+                "spec_acceptance": round(
+                    int(sp.get("accepted", 0))
+                    / max(int(sp.get("drafts", 0)), 1), 4),
             })
         migration = {
             "migrations": sum(r.migrations_out for r in self.replicas),
@@ -620,6 +639,12 @@ class ReplicaSupervisor:
                 # recomputed pages/bytes, misses, aborts + the fetch
                 # latency window (feeds llmctl_fleet_prefix_fetch_*)
                 "prefix_fetch": fetch_agg,
+                # courier-aware speculation: fleet-wide acceptance
+                # counters (feeds llmctl_fleet_spec_*) + the aggregate
+                # acceptance rate the operator eyeballs
+                "spec": {**spec_agg, "acceptance": round(
+                    spec_agg["accepted"] / max(spec_agg["drafts"], 1),
+                    4)},
                 # per-replica courier endpoint map (string keys: JSON)
                 "endpoints": {str(k): v for k, v in endpoints.items()},
                 "courier": courier.snapshot() if courier else {}}

@@ -8,9 +8,10 @@ and duplicate data, and disaggregation only pays off when that transfer
 is reliable with bounded tail latency. This module is that link layer:
 
 - ``encode_payload``/``decode_payload`` — flatten a ``swapped_kv``-shaped
-  payload (fp pages, int8 QuantPages dicts, partial crash-salvage
-  payloads) into one byte blob plus a JSON-able manifest; decode is the
-  exact inverse (byte-for-byte round trip, property-tested).
+  payload (fp pages, int8 QuantPages / packed-int4 Int4Pages dicts,
+  partial crash-salvage payloads, SpecState scalars) into one byte blob
+  plus a JSON-able manifest; decode is the exact inverse (byte-for-byte
+  round trip, property-tested).
 - ``CourierChunk`` — a bounded-size frame carrying (ticket, seq, total,
   CRC32, bytes); chunk 0 additionally carries the manifest.
 - ``CourierReceiver`` — destination half: per-ticket reassembly that is
@@ -83,10 +84,14 @@ class TransferAborted(TransportError):
 # -- payload <-> (manifest, blob) -------------------------------------------
 #
 # A courier payload is the ``Request.swapped_kv`` schema: scalars
-# (positions, last_token, partial) plus a ``pages`` dict whose "k"/"v"
-# entries are either plain ndarrays [L, NP, Nkv, PS, D] or int8 QuantPages
-# dicts {"values": int8 [L,NP,Nkv,PS,D], "scale": fp32 [L,NP,Nkv,PS]}.
-# Arrays are walked in sorted-key order so encode is deterministic.
+# (positions, last_token, partial, the SpecState "spec" sub-dict) plus a
+# ``pages`` dict whose "k"/"v" entries are plain ndarrays
+# [L, NP, Nkv, PS, D], int8 QuantPages dicts {"values": int8
+# [L,NP,Nkv,PS,D], "scale": fp32 [L,NP,Nkv,PS]}, or packed-int4
+# Int4Pages dicts (values uint8 with the page-slot axis halved, same
+# scale tile). Arrays are walked in sorted-key order so encode is
+# deterministic; dtypes ride the manifest, so uint8 nibbles round-trip
+# bit-exactly with no int4-specific code here.
 
 
 def _walk_arrays(node, prefix, out):

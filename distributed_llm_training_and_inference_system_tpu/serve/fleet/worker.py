@@ -292,6 +292,10 @@ class FleetWorker:
             # replica's fetch-side counters
             "prefix_pages": [h.hex() for h in r.prefix_inventory()],
             "prefix_fetch": r.prefix_fetch_stats(),
+            # courier-aware speculation: per-replica acceptance counters
+            # (running totals; the parent's supervisor snapshot and the
+            # llmctl_fleet_spec_* Prometheus pump delta them)
+            "spec": r.spec_stats(),
             "engine_restarts": self._restarts,
             "total_prefill_tokens": getattr(eng, "total_prefill_tokens",
                                             0),

@@ -95,16 +95,37 @@ class PagedKVCache:
         dtype=jnp.bfloat16,
         page_sharding=None,     # NamedSharding over the kv-head axis for
                                 # tensor-parallel serving (None = one device)
-        quantized: bool = False,  # int8 pages + per-token scales
+        quantized=False,        # False|"none" | True|"int8" | "int4"
     ):
         self.cfg = cfg
         self.num_slots = num_slots
         self.max_seq_len = max_seq_len
         self.page_size = page_size
         self.max_pages_per_slot = math.ceil(max_seq_len / page_size)
-        self.quantized = quantized
+        # normalize the quantization kind: legacy bool callers mean int8
+        if quantized is True:
+            kind = "int8"
+        elif not quantized or quantized == "none":
+            kind = "none"
+        else:
+            kind = str(quantized)
+        if kind not in ("none", "int8", "int4"):
+            raise ValueError(f"unknown KV quantization {quantized!r} "
+                             "(none|int8|int4)")
+        if kind == "int4" and page_size % 2:
+            raise ValueError(
+                f"int4 KV pages pack two page slots per byte; page_size "
+                f"{page_size} must be even")
+        self.quant_kind = kind
+        self.quantized = kind != "none"
         if num_pages <= 0:
-            if quantized:
+            if kind == "int4":
+                # packed nibbles (D/2 bytes) + fp32 per-(token, kv-head)
+                # scale, K and V — the 2x-over-int8 capacity claim
+                bytes_per_page = (2 * cfg.num_layers * page_size
+                                  * cfg.num_kv_heads
+                                  * (cfg.head_dim // 2 + 4))
+            elif kind == "int8":
                 # int8 values + fp32 per-(token, kv-head) scale, K and V
                 bytes_per_page = (2 * cfg.num_layers * page_size
                                   * cfg.num_kv_heads * (cfg.head_dim + 4))
@@ -145,23 +166,35 @@ class PagedKVCache:
         self.prefix_queries = 0       # full pages looked up
 
     def _new_pages(self, shape, dtype):
-        """Allocate a (possibly int8-quantized, possibly tensor-parallel-
-        sharded) page buffer."""
+        """Allocate a (possibly int8/int4-quantized, possibly tensor-
+        parallel-sharded) page buffer. ``shape`` is always the LOGICAL
+        [L, NP, Nkv, PS, D] geometry; the int4 buffer packs the page-slot
+        axis to PS/2 bytes internally (Int4Pages.shape reports logical)."""
         import jax
         if self.quantized:
-            from ..ops.paged_attention import QuantPages
-            # scale layout is the kernel-friendly per-page tensor
-            # [L, NP, Nkv, PS] (no trailing singleton — QuantPages doc)
-            buf = QuantPages(jnp.zeros(shape, jnp.int8),
-                             jnp.zeros(shape[:-1], jnp.float32))
+            from ..ops.paged_attention import Int4Pages, QuantPages
+            if self.quant_kind == "int4":
+                # two page slots per byte along the slot axis; the scale
+                # keeps the full per-slot [L, NP, Nkv, PS] tile
+                buf = Int4Pages(
+                    jnp.zeros((*shape[:-2], shape[-2] // 2, shape[-1]),
+                              jnp.uint8),
+                    jnp.zeros(shape[:-1], jnp.float32))
+            else:
+                # scale layout is the kernel-friendly per-page tensor
+                # [L, NP, Nkv, PS] (no trailing singleton — QuantPages doc)
+                buf = QuantPages(jnp.zeros(shape, jnp.int8),
+                                 jnp.zeros(shape[:-1], jnp.float32))
             if self.page_sharding is not None:
                 from jax.sharding import NamedSharding, PartitionSpec
-                # the scale leaf is one rank lower than the values leaf:
-                # trim the head-dim entry off the values spec
+                # rank-aware: the VALUES leaf keeps the full 5-entry spec
+                # (int4 packing shrinks the slot axis but not the rank —
+                # the kv-head shard axis is untouched); the scale leaf is
+                # one rank lower, so trim the head-dim entry off the spec
                 ps = self.page_sharding
                 scale_sharding = NamedSharding(
                     ps.mesh, PartitionSpec(*tuple(ps.spec)[:len(shape) - 1]))
-                return QuantPages(
+                return type(buf)(
                     jax.device_put(buf.values, ps),
                     jax.device_put(buf.scale, scale_sharding))
             return buf
@@ -343,7 +376,9 @@ class PagedKVCache:
 
                 def put(buf, data):
                     if isinstance(buf, QuantPages):
-                        return QuantPages(
+                        # type(buf): Int4Pages payloads (packed uint8
+                        # values) restore through the same scatter
+                        return type(buf)(
                             buf.values.at[:, idx].set(data["values"]),
                             buf.scale.at[:, idx].set(data["scale"]))
                     return buf.at[:, idx].set(data.astype(buf.dtype))
@@ -408,7 +443,7 @@ class PagedKVCache:
         return n
 
     def _validate_pages_shapes(self, content: dict, n: int) -> None:
-        from ..ops.paged_attention import QuantPages
+        from ..ops.paged_attention import Int4Pages, QuantPages
         cfg = self.cfg
         expect = (cfg.num_layers, n, cfg.num_kv_heads, self.page_size,
                   cfg.head_dim)
@@ -419,21 +454,36 @@ class PagedKVCache:
                         or "scale" not in data:
                     raise ValueError(
                         f"restore payload '{name}' must be a quantized "
-                        "{values, scale} dict for an int8-KV pool; got "
+                        "{values, scale} dict for a "
+                        f"{self.quant_kind}-KV pool; got "
                         f"{type(data).__name__}")
-                shapes = {"values": expect, "scale": expect[:-1]}
+                vexpect = expect
+                if isinstance(buf, Int4Pages):
+                    # packed layout: PS/2 bytes along the page-slot axis
+                    vexpect = (*expect[:-2], expect[-2] // 2, expect[-1])
+                shapes = {"values": vexpect, "scale": expect[:-1]}
                 for part, want in shapes.items():
                     got = tuple(np.shape(data[part]))
                     if got != want:
                         raise ValueError(
                             f"restore payload '{name}.{part}' shape "
                             f"{got} != expected {want}")
+                # dtype guards the int8-vs-int4 seam the shape check
+                # can't always see (a wrong-width payload scattered into
+                # the pool would serve garbage KV, not error)
+                want_dtype = np.dtype(buf.values.dtype)
+                got_dtype = np.asarray(data["values"]).dtype
+                if got_dtype != want_dtype:
+                    raise ValueError(
+                        f"restore payload '{name}.values' dtype "
+                        f"{got_dtype} != pool dtype {want_dtype} "
+                        f"({self.quant_kind}-KV pool)")
             else:
                 if isinstance(data, dict):
                     raise ValueError(
                         f"restore payload '{name}' is quantized but the "
-                        "pool holds plain pages — int8-KV payloads only "
-                        "restore into int8-KV engines")
+                        "pool holds plain pages — quantized-KV payloads "
+                        "only restore into same-kind quantized engines")
                 got = tuple(np.shape(data))
                 if got != expect:
                     raise ValueError(
@@ -607,6 +657,7 @@ class PagedKVCache:
             "num_pages": self.num_pages,
             "free_pages": self.free_pages,
             "page_size": self.page_size,
+            "kv_quantization": self.quant_kind,
             "hbm_bytes": self.hbm_bytes(),
             "slots_resident": len(self._owned),
             "prefix_cached_pages": len(self._hash_to_page),

@@ -93,6 +93,15 @@ class Request:
     # its token batches (with sequence cursors) to the fleet stream hub.
     # Carried on the worker submit wire; survives requeue/migration.
     stream_requested: bool = False
+    # courier-aware speculation (serve/speculative.py SpecState): the
+    # sequence's acceptance EWMA / adaptive window / proposer warmup as
+    # a plain-scalar dict. Stamped at every slot extraction (preempt,
+    # drain migration, handoff), carried on the migration payload
+    # manifest AND the worker submit wire, and consumed by _arm_slot on
+    # the destination — a re-placed sequence resumes speculating at its
+    # tuned window. NOT replica-local (it digests sequence content), so
+    # requeue paths preserve it.
+    spec_state: Optional[dict] = field(default=None, repr=False)
     arrival_time: float = field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None   # for TTFT
     # when the engine dispatched this request's prefill (host clock, no

@@ -285,6 +285,11 @@ class InferenceServer:
                              "finish_reason": finish_reason}],
             }) + "\n\n").encode()
 
+        # incremental decode against the accumulated token list: batch-
+        # independent decode renders merge-sensitive seams (split UTF-8
+        # chars, BPE joins) differently than the final full decode
+        from .tokenizer import IncrementalDecoder
+        decoder = IncrementalDecoder(self.tokenizer)
         try:
             deadline = time.monotonic() + 600.0
             while True:
@@ -327,8 +332,8 @@ class InferenceServer:
                     continue
                 if batch is None:               # request left its slot
                     break
-                await resp.write(chunk(self.tokenizer.decode(batch)))
-            final = chunk("", req.finish_reason or "error")
+                await resp.write(chunk(decoder.feed(batch)))
+            final = chunk(decoder.finish(), req.finish_reason or "error")
             await resp.write(final)
             await resp.write(b"data: [DONE]\n\n")
         except (ConnectionResetError, asyncio.CancelledError):

@@ -31,6 +31,7 @@ advances.
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from typing import Any, Optional
 
 import jax
@@ -40,6 +41,99 @@ import numpy as np
 from ..config.schema import ModelConfig
 from .decode import extend_step_forward
 from .sampling import sample_tokens
+
+# SpecState tuning constants — deterministic, test-pinned. The EWMA
+# weights recent dispatches (a sequence's acceptance drifts as it moves
+# from grounded prompt-copying into free generation); the warmup floor
+# keeps one lucky/unlucky first window from whipsawing the window; the
+# grow/shrink thresholds bracket the ~50% acceptance break-even the
+# verify window's ~9-decode-step cost implies (BASELINE.md round 2).
+SPEC_EWMA_ALPHA = 0.25
+SPEC_WARMUP_DISPATCHES = 4
+SPEC_GROW_AT = 0.5
+SPEC_SHRINK_AT = 0.15
+SPEC_MIN_WINDOW = 2
+
+
+@dataclass
+class SpecState:
+    """Per-SEQUENCE speculative-decode state: the tuned part of a
+    sequence's speed that used to die at every migration / prefill->
+    decode handoff boundary (the engine's counters are engine-global;
+    a re-placed sequence cold-started its proposer and its window).
+
+    This is a courier citizen: ``to_dict``/``from_dict`` round-trip
+    through the migration payload manifest (plain scalars — they ride
+    the existing chunked/CRC transport for free) and through the remote
+    worker submit wire, so a disaggregated decode replica resumes
+    speculating at the source's tuned window instead of re-learning it.
+
+    Fields:
+    - ``window``: current adaptive verify window (first position is the
+      root token, so ``window - 1`` drafts are proposed per dispatch);
+      clamped to [SPEC_MIN_WINDOW, ServeConfig.speculative_tokens].
+    - ``ewma``: recent draft-acceptance EWMA driving the adaptation.
+    - ``warmup``: spec dispatches observed — the n-gram proposer warmup
+      (the window doesn't move until the EWMA has seen a few windows).
+    - ``drafts``/``accepted``: lifetime per-sequence acceptance totals
+      (migrate with the sequence; the per-replica counters stay local).
+    """
+    window: int
+    ewma: float = 0.0
+    warmup: int = 0
+    drafts: int = 0
+    accepted: int = 0
+
+    def observe(self, accepted: int, drafted: int,
+                max_window: int) -> None:
+        """Fold one dispatch's acceptance into the EWMA and adapt the
+        window (deterministic: same observations -> same window, on any
+        replica)."""
+        drafted = max(int(drafted), 1)
+        accepted = min(max(int(accepted), 0), drafted)
+        self.drafts += drafted
+        self.accepted += accepted
+        rate = accepted / drafted
+        if self.warmup == 0:
+            self.ewma = rate
+        else:
+            self.ewma = ((1.0 - SPEC_EWMA_ALPHA) * self.ewma
+                         + SPEC_EWMA_ALPHA * rate)
+        self.warmup += 1
+        if self.warmup >= SPEC_WARMUP_DISPATCHES:
+            if self.ewma >= SPEC_GROW_AT:
+                self.window = min(self.window + 1, max_window)
+            elif self.ewma <= SPEC_SHRINK_AT:
+                self.window = max(self.window - 1, SPEC_MIN_WINDOW)
+
+    def to_dict(self) -> dict:
+        return {"window": int(self.window), "ewma": float(self.ewma),
+                "warmup": int(self.warmup), "drafts": int(self.drafts),
+                "accepted": int(self.accepted)}
+
+    @classmethod
+    def from_dict(cls, d: dict, max_window: int) -> "SpecState":
+        """Rebuild from a migrated dict; malformed/foreign values clamp
+        into range rather than poisoning the destination's dispatch
+        shapes (the window bounds tokens[] writes)."""
+        try:
+            window = int(d.get("window", max_window))
+        except (TypeError, ValueError):
+            window = max_window
+        window = max(SPEC_MIN_WINDOW, min(window, max_window))
+        try:
+            ewma = float(d.get("ewma", 0.0))
+        except (TypeError, ValueError):
+            ewma = 0.0
+
+        def _i(key):
+            try:
+                return max(int(d.get(key, 0)), 0)
+            except (TypeError, ValueError):
+                return 0
+        return cls(window=window, ewma=min(max(ewma, 0.0), 1.0),
+                   warmup=_i("warmup"), drafts=_i("drafts"),
+                   accepted=_i("accepted"))
 
 
 def propose_ngram_draft(

@@ -53,6 +53,71 @@ class HFTokenizerAdapter:
         return self._tok.decode(list(ids), skip_special_tokens=True)
 
 
+class IncrementalDecoder:
+    """Streaming text decode that matches full-sequence decode.
+
+    Decoding each SSE token batch independently is WRONG for any
+    merge-sensitive tokenizer: the byte tokenizer splits multi-byte
+    UTF-8 characters across batches (each half decodes to U+FFFD, while
+    the full sequence decodes the real character), and BPE tokenizers
+    join pieces differently at batch seams. This decoder keeps the
+    ACCUMULATED token list, decodes the whole thing each feed, and emits
+    only the suffix beyond what it already emitted — so the
+    concatenation of emitted deltas equals one full-sequence decode.
+
+    A trailing run of U+FFFD is withheld (it may be the head of a
+    multi-byte character the next batch completes); ``finish()`` flushes
+    whatever is still held once no more tokens can arrive. Token ids
+    remain the identity contract on the wire — the text field is the
+    human-readable rendering this makes consistent with the final
+    completion's ``decode(generated_tokens)``.
+
+    ``prefix`` seeds the context WITHOUT emitting it: an SSE reconnect
+    resumes mid-stream, and its replay batch must decode against the
+    tokens the client already holds, not from a cold start.
+    """
+
+    def __init__(self, tokenizer, prefix: Optional[Sequence[int]] = None):
+        self._tok = tokenizer
+        self._ids: list[int] = [int(t) for t in (prefix or ())]
+        # chars of decode(self._ids) already emitted. Only the STABLE
+        # part of the seeded prefix counts: the previous connection's
+        # decoder withheld an incomplete trailing character, so the
+        # client never received it — the first replay batch re-derives
+        # and emits it in context.
+        self._emitted = self._stable_len(tokenizer.decode(self._ids)) \
+            if self._ids else 0
+
+    @staticmethod
+    def _stable_len(text: str) -> int:
+        """Chars safe to emit: everything but a trailing U+FFFD run
+        (a possibly-incomplete multi-byte sequence)."""
+        n = len(text)
+        while n > 0 and text[n - 1] == "�":
+            n -= 1
+        return n
+
+    def feed(self, tokens: Sequence[int]) -> str:
+        """Accumulate one batch; return the new stable suffix ('' when
+        the batch only extended an incomplete character)."""
+        self._ids.extend(int(t) for t in tokens)
+        full = self._tok.decode(self._ids)
+        stable = self._stable_len(full)
+        if stable <= self._emitted:
+            return ""
+        delta = full[self._emitted:stable]
+        self._emitted = stable
+        return delta
+
+    def finish(self) -> str:
+        """Flush the withheld tail (the stream is over — a dangling
+        U+FFFD really is a replacement char now)."""
+        full = self._tok.decode(self._ids)
+        delta = full[self._emitted:]
+        self._emitted = len(full)
+        return delta
+
+
 def load_tokenizer(artifact_dir: Optional[str | Path], vocab_size: int):
     """HF tokenizer from the artifact dir when present, else byte-level."""
     if artifact_dir:

@@ -62,6 +62,23 @@ def int8_payload(pages=3):
     }
 
 
+def int4_payload(pages=3):
+    """Packed-int4 pages (Int4Pages schema: uint8 values with the
+    page-slot axis halved, full per-slot scale tile) + the SpecState
+    scalars that ride the same manifest (courier-aware speculation)."""
+    def q():
+        return {"values": RNG.integers(0, 256, (2, pages, 2, 4, 16))
+                .astype(np.uint8),
+                "scale": RNG.random((2, pages, 2, 8)).astype(np.float32)}
+    return {
+        "pages": {"k": q(), "v": q(), "num_pages": pages},
+        "positions": pages * 8,
+        "last_token": 9,
+        "spec": {"window": 5, "ewma": 0.625, "warmup": 6,
+                 "drafts": 24, "accepted": 15},
+    }
+
+
 def partial_payload(pages=2):
     p = fp_payload(pages)
     return {"pages": p["pages"], "positions": pages * 8, "partial": True}
@@ -86,12 +103,13 @@ def cfg(**kw):
     return SimpleNamespace(**base)
 
 
-PAYLOAD_MAKERS = [fp_payload, int8_payload, partial_payload]
+PAYLOAD_MAKERS = [fp_payload, int8_payload, int4_payload,
+                  partial_payload]
 
 
 class TestFraming:
     @pytest.mark.parametrize("make", PAYLOAD_MAKERS,
-                             ids=["fp", "int8", "partial"])
+                             ids=["fp", "int8", "int4", "partial"])
     def test_encode_decode_identity(self, make):
         p = make()
         manifest, blob = encode_payload(p)
@@ -104,7 +122,7 @@ class TestFraming:
         (k["values"] if isinstance(k, dict) else k)[0] = 0  # must not raise
 
     @pytest.mark.parametrize("make", PAYLOAD_MAKERS,
-                             ids=["fp", "int8", "partial"])
+                             ids=["fp", "int8", "int4", "partial"])
     def test_chunk_reassemble_identity(self, make):
         p = make()
         manifest, blob = encode_payload(p)
@@ -245,7 +263,7 @@ def pushed(t, p, **kw):
 
 class TestInProcTransport:
     @pytest.mark.parametrize("make", PAYLOAD_MAKERS,
-                             ids=["fp", "int8", "partial"])
+                             ids=["fp", "int8", "int4", "partial"])
     def test_clean_transfer_identity(self, make):
         p = make()
         t = InProcTransport(cfg())

@@ -1004,6 +1004,8 @@ class TestFleetMetrics:
                              "misses": 1, "aborts": 1,
                              "fetch_ms": [2.0, 3.0, 4.0, 5.0],
                              "fetch_count": 4},
+            "spec": {"dispatches": 10, "drafts": 70, "accepted": 35,
+                     "resumes": 2, "acceptance": 0.5},
         }
         exporter.export_fleet(snap)
         samples = {}
@@ -1067,6 +1069,12 @@ class TestFleetMetrics:
             ("llmctl_fleet_prefix_fetch_ms_count", None)] == 4
         assert samples[("llmctl_fleet_prefix_fetch_ms_sum", None)] \
             == pytest.approx(14.0)
+        # speculative-decode plane (round 14): fleet-wide acceptance
+        # counters + migrated-SpecState resumes (courier-aware spec)
+        assert samples[("llmctl_fleet_spec_dispatches_total", None)] == 10
+        assert samples[("llmctl_fleet_spec_drafts_total", None)] == 70
+        assert samples[("llmctl_fleet_spec_accepted_total", None)] == 35
+        assert samples[("llmctl_fleet_spec_resumes_total", None)] == 2
         # counters export deltas: a second identical snapshot must not
         # double-count the running totals (incl. the pause histogram)
         exporter.export_fleet(snap)
@@ -1076,13 +1084,15 @@ class TestFleetMetrics:
                               "llmctl_fleet_migrations_total",
                               "llmctl_fleet_handoffs_total",
                               "llmctl_fleet_courier_retries_total",
-                              "llmctl_fleet_courier_aborts_total"):
+                              "llmctl_fleet_courier_aborts_total",
+                              "llmctl_fleet_spec_drafts_total"):
                     assert s.value == {
                         "llmctl_fleet_requeues_total": 5,
                         "llmctl_fleet_migrations_total": 2,
                         "llmctl_fleet_handoffs_total": 3,
                         "llmctl_fleet_courier_retries_total": 6,
-                        "llmctl_fleet_courier_aborts_total": 1}[s.name]
+                        "llmctl_fleet_courier_aborts_total": 1,
+                        "llmctl_fleet_spec_drafts_total": 70}[s.name]
                 if s.name in ("llmctl_fleet_migration_pause_ms_count",
                               "llmctl_fleet_handoff_stall_ms_count"):
                     assert s.value == {

@@ -1030,3 +1030,68 @@ class TestStreamMetrics:
         assert samples[
             ("llmctl_fleet_prefix_inventory_cache_misses_total",
              None)] == 3
+
+
+class TestIncrementalDecoder:
+    """PR-8 known gap closed: the SSE ``text`` field must be decoded
+    against the ACCUMULATED token list — batch-independent decode
+    renders merge-sensitive seams (split multi-byte UTF-8 characters)
+    differently than the final full-sequence decode."""
+
+    def _tok(self):
+        from distributed_llm_training_and_inference_system_tpu.serve.tokenizer import (  # noqa: E501
+            ByteTokenizer)
+        return ByteTokenizer(vocab_size=512)
+
+    def _decoder(self, prefix=None):
+        from distributed_llm_training_and_inference_system_tpu.serve.tokenizer import (  # noqa: E501
+            IncrementalDecoder)
+        return IncrementalDecoder(self._tok(), prefix)
+
+    def test_split_utf8_char_joins_correctly(self):
+        tok = self._tok()
+        ids = tok.encode("héllo ≈ wörld")      # multi-byte chars inside
+        for cut in range(1, len(ids)):
+            a, b = ids[:cut], ids[cut:]
+            # the OLD behaviour: independent decode mangles the seam
+            naive = tok.decode(a) + tok.decode(b)
+            dec = self._decoder()
+            streamed = dec.feed(a) + dec.feed(b) + dec.finish()
+            assert streamed == tok.decode(ids)
+            if "�" in naive:
+                assert naive != streamed       # the gap was real here
+
+    def test_deltas_concatenate_to_full_decode(self):
+        tok = self._tok()
+        ids = tok.encode("abc déf ghî")
+        dec = self._decoder()
+        out = "".join(dec.feed([t]) for t in ids) + dec.finish()
+        assert out == tok.decode(ids)
+
+    def test_incomplete_tail_withheld_until_finish(self):
+        dec = self._decoder()
+        # first byte of a 2-byte char: nothing stable to emit yet
+        assert dec.feed([0xC3]) == ""
+        assert dec.feed([0xA9]) == "é"         # completed
+        # a dangling lead byte at end-of-stream flushes as U+FFFD
+        dec2 = self._decoder()
+        assert dec2.feed([0xC3]) == ""
+        assert dec2.finish() == "�"
+
+    def test_reconnect_prefix_seeds_context_without_emitting(self):
+        tok = self._tok()
+        ids = tok.encode("héllo wörld")
+        for cut in range(1, len(ids)):
+            # the client holds exactly what a feed()-driven stream had
+            # emitted through `cut` tokens (incomplete tail withheld)
+            pre = self._decoder()
+            held = pre.feed(ids[:cut])
+            dec = self._decoder(prefix=ids[:cut])
+            replay = dec.feed(ids[cut:]) + dec.finish()
+            assert held + replay == tok.decode(ids), f"cut={cut}"
+
+    def test_plain_ascii_passthrough(self):
+        dec = self._decoder()
+        assert dec.feed([104, 105]) == "hi"
+        assert dec.feed([33]) == "!"
+        assert dec.finish() == ""

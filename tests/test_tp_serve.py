@@ -133,3 +133,23 @@ class TestTensorParallelServe:
         [got] = tp2.generate([prompt], SamplingParams(
             temperature=0.0, max_tokens=8))
         assert got.generated_tokens == want.generated_tokens
+
+    def test_tp2_int4_kv_matches_single_device(self, model_cfg, params):
+        """Packed-int4 KV pages under tensor-parallel (round 14): the
+        rank-aware page sharding keeps the full 5-entry values spec (the
+        packed slot axis shrinks but the kv-head shard axis is
+        untouched); tp=2 greedy output must equal the single-device
+        int4-KV engine's bit for bit."""
+        prompt = [5, 17, 99, 3, 42, 7, 11, 23]
+        single = make_engine(model_cfg, params, kv_quantization="int4")
+        [want] = single.generate([prompt], SamplingParams(
+            temperature=0.0, max_tokens=8))
+        tp2 = make_engine(model_cfg, params, tp=2, kv_quantization="int4")
+        from distributed_llm_training_and_inference_system_tpu.ops.paged_attention import (  # noqa: E501
+            Int4Pages)
+        assert isinstance(tp2.kv.k_pages, Int4Pages)
+        assert len(tp2.kv.k_pages.values.sharding.device_set) == 2
+        assert len(tp2.kv.k_pages.scale.sharding.device_set) == 2
+        [got] = tp2.generate([prompt], SamplingParams(
+            temperature=0.0, max_tokens=8))
+        assert got.generated_tokens == want.generated_tokens
