@@ -187,6 +187,16 @@ def kernels(op, seq_len, hidden, heads, batch):
                    "and transfer-stall percentiles against 0.0 (clean "
                    "link). Results always carry the courier section "
                    "(transfers/retries/aborts + p50/p99_transfer_ms).")
+@click.option("--serve-courier-codec", default="none", show_default=True,
+              type=click.Choice(["none", "zlib", "delta-zlib"]),
+              help="serve-load fleet: courier wire codec A/B arm — "
+                   "delta-zlib delta-encodes quantized KV page planes "
+                   "then deflates per chunk (pipelined behind the "
+                   "wire). Compare the courier section's bytes_wire / "
+                   "bytes_raw / compression_ratio and transfer-ms "
+                   "percentiles against none; combine with "
+                   "--serve-disagg (handoff stall) or "
+                   "--serve-hot-prefix (prefix-fetch latency).")
 @click.option("--serve-hot-prefix", default=0, show_default=True,
               type=int,
               help="serve-load fleet: flash-crowd scenario — every "
@@ -209,8 +219,8 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
         requests, rps, concurrency, admission, kv_blocks, device_times,
         preemption, latency_dispatch_steps, artifact, quant, kv_quant,
         slots, pipelined, int8_pallas, serve_max_retries, serve_replicas,
-        serve_disagg, serve_courier_chaos, serve_hot_prefix,
-        serve_stream):
+        serve_disagg, serve_courier_chaos, serve_courier_codec,
+        serve_hot_prefix, serve_stream):
     """End-to-end train step throughput / serve TTFT+throughput
     (parity: reference bench.py:35-49). ``serve-load`` runs open-loop
     (Poisson) and closed-loop sweeps with p50/p99 TTFT, per-token latency,
@@ -330,7 +340,8 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
                 last_engine.pop().shutdown()
                 gc.collect()
                 jax.clear_caches()
-            fc_kw = dict(replicas=serve_replicas)
+            fc_kw = dict(replicas=serve_replicas,
+                         courier_codec=serve_courier_codec)
             if serve_disagg and serve_replicas >= 2:
                 n_pre = max(serve_replicas // 2, 1)
                 fc_kw["roles"] = ",".join(
@@ -565,6 +576,89 @@ def kv_decode(slots, kv_heads, head_dim, q_heads, page_size, context,
     results["int4_vs_bf16_slots_per_hbm_byte"] = round(
         results["bf16"]["capacity"]["bytes_per_slot"]
         / results["int4"]["capacity"]["bytes_per_slot"], 3)
+
+    # courier wire-codec A/B (serve/fleet/transport.py): what one
+    # extracted page payload of each KV kind costs ON THE WIRE under
+    # none / zlib / delta-zlib, plus host encode+frame and
+    # decompress+decode time. Pages here are ACTIVATION-SHAPED (channel-
+    # static structure + a few massive stable outlier channels + AR(1)
+    # per-token drift — the correlation CacheGen exploits), not iid
+    # noise, which would make every codec look useless.
+    import numpy as np
+
+    from ...serve.fleet.transport import (ChunkReassembler, encode_payload,
+                                          make_chunks)
+    rng = np.random.default_rng(0)
+    n_pages = min(maxP, 8)
+    *lead, _PS, _D = shp = (2, n_pages, max(Nkv // 8, 1), PS, D)
+
+    def activation_planes():
+        base = rng.standard_normal((*lead, 1, _D)).astype(np.float32)
+        hot = rng.choice(_D, size=max(_D // 16, 1), replace=False)
+        base[..., hot] *= 10.0
+        drift = np.zeros(shp, np.float32)
+        drift[..., 0, :] = 0.1 * rng.standard_normal((*lead, _D))
+        for t in range(1, _PS):
+            drift[..., t, :] = (0.99 * drift[..., t - 1, :]
+                                + 0.1 * rng.standard_normal((*lead, _D)))
+        return base + drift
+
+    def extract_payload(kind):
+        k, v = activation_planes(), activation_planes()
+
+        def quant(x, levels):
+            scale = np.abs(x).max(-1) / levels + 1e-9
+            return (np.clip(np.round(x / scale[..., None]), -levels,
+                            levels).astype(np.int8), scale)
+        if kind == "bf16":
+            pages = {"k": k, "v": v}
+        elif kind == "int8":
+            pages = {}
+            for name, x in (("k", k), ("v", v)):
+                q8, sc = quant(x, 127)
+                pages[name] = {"values": q8,
+                               "scale": sc.astype(np.float32)}
+        else:                                  # packed int4
+            pages = {}
+            for name, x in (("k", k), ("v", v)):
+                q4, sc = quant(x, 7)
+                packed = ((q4[..., 0::2, :] & 0xF)
+                          | ((q4[..., 1::2, :] & 0xF) << 4)).astype(
+                              np.uint8)
+                pages[name] = {"values": packed,
+                               "scale": sc.astype(np.float32)}
+        return {"pages": {**pages, "num_pages": n_pages},
+                "positions": n_pages * PS, "last_token": 1}
+
+    codec_ab: dict = {}
+    for kind in ("bf16", "int8", "int4"):
+        payload = extract_payload(kind)
+        arms = {}
+        for codec in ("none", "zlib", "delta-zlib"):
+            t0 = time.perf_counter()
+            manifest, blob = encode_payload(payload, codec=codec)
+            chunks = make_chunks("bench", manifest, blob, 256 * 1024)
+            enc_ms = (time.perf_counter() - t0) * 1e3
+            wire = sum(len(c.data) for c in chunks)
+            t0 = time.perf_counter()
+            r = ChunkReassembler(len(chunks))
+            for c in chunks:
+                r.add(c)
+            r.payload()
+            dec_ms = (time.perf_counter() - t0) * 1e3
+            arms[codec] = {
+                "bytes_raw": manifest["nbytes"],
+                "bytes_wire": wire,
+                "compression_ratio": round(manifest["nbytes"]
+                                           / max(wire, 1), 3),
+                "encode_ms": round(enc_ms, 3),
+                "decode_ms": round(dec_ms, 3),
+            }
+        codec_ab[kind] = arms
+    results["courier_codec_ab"] = codec_ab
+    results["delta_zlib_vs_none_int8_wire"] = round(
+        codec_ab["int8"]["none"]["bytes_wire"]
+        / max(codec_ab["int8"]["delta-zlib"]["bytes_wire"], 1), 3)
     results["write_mode"] = write_mode
     results["backend"] = jax.default_backend()
     click.echo(json.dumps(results, indent=2))

@@ -139,6 +139,7 @@ def status(url, as_json):
             f"{st.get('reconnects', 0)} reconnects "
             f"({st.get('replayed', 0)} tokens replayed), "
             f"{st.get('gaps_healed', 0)} gap-healed, "
+            f"{st.get('backpressure_drops', 0)} backpressure drops, "
             f"{st.get('identity_mismatches', 0)} identity violations")
     sp = snap.get("spec")
     if sp and sp.get("dispatches"):
@@ -161,7 +162,10 @@ def status(url, as_json):
         console.print(
             f"courier: {cour.get('in_flight', 0)} in flight, "
             f"{cour.get('transfers', 0)} transfers "
-            f"({cour.get('bytes_moved', 0)} bytes, "
+            f"({cour.get('bytes_wire', cour.get('bytes_moved', 0))} "
+            f"wire / {cour.get('bytes_raw', cour.get('bytes_moved', 0))} "
+            f"raw bytes, {cour.get('compression_ratio', 1.0):.2f}x "
+            f"compression, "
             f"{cour.get('chunks', 0)} chunks, "
             f"{cour.get('retries', 0)} retries, "
             f"{cour.get('corruptions', 0)} corruptions, "
@@ -255,6 +259,18 @@ def migrate(request_id, replica, url):
               type=click.Choice(["bfloat16", "float32"]))
 @click.option("--kv-quantization", default="none", show_default=True,
               type=click.Choice(["none", "int8", "int4"]))
+@click.option("--speculative", default="off", show_default=True,
+              type=click.Choice(["off", "ngram"]),
+              help="Speculative decoding on this worker's engine (ngram "
+                   "= host prompt-lookup drafts, device verification; "
+                   "greedy output unchanged). Per-sequence SpecState "
+                   "rides migration/handoff manifests and the submit "
+                   "wire, so re-placed sequences resume at their tuned "
+                   "window; acceptance counters surface through "
+                   "/worker/probe into the parent's RemoteReplica "
+                   "mirror and llmctl_fleet_spec_*.")
+@click.option("--spec-tokens", default=8, show_default=True, type=int,
+              help="Speculative verify window (drafts per dispatch + 1).")
 @click.option("--seed", default=0, show_default=True, type=int,
               help="Engine sampling seed base.")
 @click.option("--param-seed", default=-1, show_default=True, type=int,
@@ -263,6 +279,13 @@ def migrate(request_id, replica, url):
                    "tests/dryrun; every worker and the reference must "
                    "use the same value). -1 = normal artifact/init "
                    "path.")
+@click.option("--courier-codec", default="none", show_default=True,
+              type=click.Choice(["none", "zlib", "delta-zlib"]),
+              help="Wire codec this worker's OUTBOUND courier pushes "
+                   "use (worker-to-worker ships, prefix-fetch serves); "
+                   "inbound transfers accept any known codec. "
+                   "delta-zlib delta-encodes quantized KV planes then "
+                   "deflates per chunk — 2-4x fewer wire bytes.")
 @click.option("--courier-chunk-bytes", default=256 * 1024,
               show_default=True, type=int)
 @click.option("--courier-retries", default=4, show_default=True,
@@ -287,7 +310,8 @@ def migrate(request_id, replica, url):
                    "e.g. '{\"seed\": 5, \"chunk_drop_rate\": 0.2}'.")
 def worker(model_name, artifact, replica_id, role, host, port,
            max_batch_size, max_seq_len, prefill_chunk, kv_block_size,
-           dtype, kv_quantization, seed, param_seed, courier_chunk_bytes,
+           dtype, kv_quantization, speculative, spec_tokens, seed,
+           param_seed, courier_codec, courier_chunk_bytes,
            courier_retries, courier_deadline_ms, courier_backoff_ms,
            courier_backoff_max_ms, ticket_ttl_ms, restart_backoff,
            migrate_on_drain, fault_plan):
@@ -317,7 +341,8 @@ def worker(model_name, artifact, replica_id, role, host, port,
         max_batch_size=max_batch_size,
         max_seq_len=min(max_seq_len, model_cfg.max_position_embeddings),
         kv_block_size=kv_block_size, dtype=dtype,
-        kv_quantization=kv_quantization)
+        kv_quantization=kv_quantization,
+        speculative=speculative, speculative_tokens=spec_tokens)
     if prefill_chunk > 0:
         serve_kw["prefill_chunk"] = prefill_chunk
     serve_cfg = ServeConfig(**serve_kw)
@@ -325,6 +350,7 @@ def worker(model_name, artifact, replica_id, role, host, port,
     fleet_cfg = FleetConfig(
         replicas=1, migrate_on_drain=migrate_on_drain,
         restart_backoff_s=restart_backoff,
+        courier_codec=courier_codec,
         courier_chunk_bytes=courier_chunk_bytes,
         courier_max_retries=courier_retries,
         courier_chunk_deadline_ms=courier_deadline_ms,

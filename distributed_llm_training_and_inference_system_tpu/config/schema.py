@@ -874,6 +874,19 @@ class FleetConfig:
     # replicas, byte-for-byte what PR-3/4 shipped); "http" POSTs chunks
     # to courier_endpoint's /fleet/courier/chunk (cross-host movement).
     courier_transport: str = "inproc"
+    # wire codec for courier payloads (CacheGen-style, PAPERS.md):
+    # "none" ships raw bytes (wire-compatible with prior PRs); "zlib"
+    # deflates each chunk; "delta-zlib" additionally delta-encodes
+    # quantized KV page planes along the token axis before deflate
+    # (adjacent tokens' int8/int4 values are strongly correlated, so
+    # deltas compress 2-4x where raw pages barely deflate). Compression
+    # is per-chunk and pipelined (chunk k+1 deflates while k is on the
+    # wire), decode-side CRCs verify the compressed frame AND the raw
+    # payload, and a receiver that does not speak the declared codec
+    # rejects the transfer loudly — a codec bug degrades to re-prefill,
+    # never wrong KV. Fewer wire bytes directly shrink migration pause,
+    # handoff stall, and prefix-fetch latency (Mooncake economics).
+    courier_codec: str = "none"
     courier_chunk_bytes: int = 256 * 1024
     courier_max_retries: int = 4
     courier_retry_backoff_ms: float = 2.0
@@ -945,6 +958,14 @@ class FleetConfig:
     # this long before the hub GCs them; live logs never expire. 0 keeps
     # finished logs forever (tests only — production would leak).
     stream_log_ttl_ms: float = 60_000.0
+    # per-subscriber backpressure bound: a subscriber holding more than
+    # this many delivered-but-unconsumed token batches (a slow SSE
+    # client buffering in its response queue) is DISCONNECTED by the hub
+    # (counted in llmctl_fleet_stream_backpressure_drops_total) instead
+    # of buffering without bound — the log keeps growing, so the client
+    # reconnects with Last-Event-ID and replays exactly the unacked
+    # tail. 0 disables the cap (PR-8 behavior).
+    stream_max_buffered_batches: int = 256
 
     def role_list(self) -> list[str]:
         """Per-replica role assignment; empty config = all mixed."""
@@ -1025,6 +1046,10 @@ class FleetConfig:
             raise ConfigError(
                 "courier_transport=http needs courier_endpoint (the "
                 "destination fleet front's base URL)")
+        if self.courier_codec not in ("none", "zlib", "delta-zlib"):
+            raise ConfigError(
+                f"unknown courier_codec {self.courier_codec!r} "
+                f"(none|zlib|delta-zlib)")
         if self.courier_chunk_bytes < 1024:
             raise ConfigError("courier_chunk_bytes must be >= 1024")
         if self.courier_ticket_ttl_ms < 0:
@@ -1049,6 +1074,10 @@ class FleetConfig:
             raise ConfigError(
                 "stream_log_ttl_ms must be >= 0 (0 keeps finished "
                 "stream logs forever)")
+        if self.stream_max_buffered_batches < 0:
+            raise ConfigError(
+                "stream_max_buffered_batches must be >= 0 (0 disables "
+                "the per-subscriber backpressure cap)")
         endpoints = self.endpoint_map()       # raises on malformed entries
         for rid in endpoints:
             if not 0 <= rid < self.replicas:

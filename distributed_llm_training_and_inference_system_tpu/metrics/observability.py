@@ -268,6 +268,14 @@ class PrometheusExporter:
             "llmctl_fleet_courier_aborts",
             "Courier transfers that exhausted their retry budget "
             "(payload dropped; destination re-prefilled)")
+        self.fleet_courier_wire_bytes = c(
+            "llmctl_fleet_courier_wire_bytes",
+            "Courier bytes actually sent on the wire (post-codec, "
+            "retransmits included)")
+        self.fleet_courier_raw_bytes = c(
+            "llmctl_fleet_courier_raw_bytes",
+            "Raw payload bytes the sent courier chunks covered "
+            "(pre-codec; raw/wire = effective compression ratio)")
         self.fleet_courier_expired = c(
             "llmctl_fleet_courier_expired",
             "Courier tickets evicted by TTL before being claimed "
@@ -338,6 +346,12 @@ class PrometheusExporter:
             "llmctl_fleet_stream_gaps_healed",
             "Stream-log tokens recovered from the request's own token "
             "list (publish callbacks lost to a crash window)")
+        self.fleet_stream_backpressure_drops = c(
+            "llmctl_fleet_stream_backpressure_drops",
+            "SSE subscribers disconnected for exceeding the "
+            "per-subscriber buffered-batch cap "
+            "(stream_max_buffered_batches); the client replays via "
+            "Last-Event-ID")
         self.fleet_stream_replay = h(
             "llmctl_fleet_stream_replay_tokens",
             "Tokens replayed per SSE reconnect (Last-Event-ID tail "
@@ -495,6 +509,8 @@ class PrometheusExporter:
                 ("corruptions", self.fleet_courier_corruptions),
                 ("resumes", self.fleet_courier_resumes),
                 ("aborts", self.fleet_courier_aborts),
+                ("bytes_wire", self.fleet_courier_wire_bytes),
+                ("bytes_raw", self.fleet_courier_raw_bytes),
                 ("expired", self.fleet_courier_expired)):
             total = cour.get(key, 0)
             delta = total - self._last_totals.get(f"fleet_cour_{key}", 0)
@@ -554,7 +570,9 @@ class PrometheusExporter:
                 ("duplicates", self.fleet_stream_duplicates),
                 ("replayed", self.fleet_stream_replayed),
                 ("reconnects", self.fleet_stream_reconnects),
-                ("gaps_healed", self.fleet_stream_gaps_healed)):
+                ("gaps_healed", self.fleet_stream_gaps_healed),
+                ("backpressure_drops",
+                 self.fleet_stream_backpressure_drops)):
             total = st.get(key, 0)
             delta = total - self._last_totals.get(f"fleet_st_{key}", 0)
             if delta > 0:

@@ -163,6 +163,104 @@ def dequantize_int4_blockwise(packed: jax.Array, scale: jax.Array,
     return out.reshape(*q.shape[:-1], n).astype(dtype)
 
 
+# -- courier codec helpers (numpy, host-side) ---------------------------------
+#
+# The fleet courier's ``delta-zlib`` wire codec (serve/fleet/transport.py)
+# delta-encodes quantized KV page planes before per-chunk zlib: adjacent
+# page slots hold KV for adjacent tokens, whose quantized values are
+# strongly correlated (CacheGen, PAPERS.md), so per-plane deltas along
+# the page-slot axis concentrate near zero and the byte stream becomes
+# highly compressible. These are the NUMPY twins of the jnp nibble
+# helpers above — ONE definition of the nibble/byte layout (element 2i =
+# low nibble, 2i+1 = high nibble, packed along the page-slot axis, D
+# minor) shared by the write path, the gather fallback, and the wire
+# codec; tests pin the np pack/unpack against the jnp pair so the codec
+# can never disagree with the cache about where a token's bytes live.
+# All four transforms are size-preserving bijections in modular
+# arithmetic (mod-256 bytes for int8 values, mod-16 nibbles for packed
+# int4), so the codec applies them blindly and the courier's end-to-end
+# CRC over the RAW bytes still proves correctness after the inverse.
+
+
+def delta_encode_planes_np(a: np.ndarray, axis: int = -2) -> np.ndarray:
+    """Mod-256 first-difference along ``axis`` (the page-slot axis of an
+    int8 KV plane [..., PS, D]): row i becomes row_i - row_{i-1}, row 0
+    is kept. Byte-wraparound arithmetic makes this a bijection for any
+    1-byte dtype; the inverse is :func:`delta_decode_planes_np`."""
+    u = np.ascontiguousarray(a).view(np.uint8)
+    out = u.copy()
+    axis = axis % u.ndim
+    hi = [slice(None)] * u.ndim
+    lo = [slice(None)] * u.ndim
+    hi[axis] = slice(1, None)
+    lo[axis] = slice(None, -1)
+    out[tuple(hi)] = u[tuple(hi)] - u[tuple(lo)]     # wraps mod 256
+    return out.view(a.dtype)
+
+
+def delta_decode_planes_np(a: np.ndarray, axis: int = -2) -> np.ndarray:
+    """Inverse of :func:`delta_encode_planes_np`: mod-256 prefix sum."""
+    u = np.ascontiguousarray(a).view(np.uint8)
+    out = np.add.accumulate(u, axis=axis % u.ndim, dtype=np.uint8)
+    return out.view(a.dtype)
+
+
+def unpack_nibbles_np(packed: np.ndarray, axis: int = -2) -> np.ndarray:
+    """uint8 bytes -> RAW nibbles (0..15, NO sign extension) interleaved
+    along ``axis`` (count doubles) — the same 2i=low/2i+1=high layout as
+    :func:`unpack_int4_rows`, kept unsigned so modular nibble arithmetic
+    stays trivially bijective."""
+    axis = axis % packed.ndim
+    lo = (packed & 0xF).astype(np.uint8)
+    hi = (packed >> 4).astype(np.uint8)
+    q = np.stack([lo, hi], axis=axis + 1)
+    shape = (*packed.shape[:axis], packed.shape[axis] * 2,
+             *packed.shape[axis + 1:])
+    return q.reshape(shape)
+
+
+def pack_nibbles_np(q: np.ndarray, axis: int = -2) -> np.ndarray:
+    """Inverse of :func:`unpack_nibbles_np` (element 2i -> low nibble,
+    2i+1 -> high nibble of byte i; the :func:`pack_int4_rows` layout)."""
+    axis = axis % q.ndim
+    even = [slice(None)] * q.ndim
+    odd = [slice(None)] * q.ndim
+    even[axis] = slice(0, None, 2)
+    odd[axis] = slice(1, None, 2)
+    lo = (q[tuple(even)] & 0xF).astype(np.uint8)
+    hi = (q[tuple(odd)] & 0xF).astype(np.uint8)
+    return (lo | (hi << 4)).astype(np.uint8)
+
+
+def nibble_delta_encode_np(packed: np.ndarray,
+                           axis: int = -2) -> np.ndarray:
+    """Mod-16 first-difference over the UNPACKED nibble stream of a
+    packed-int4 plane ([..., PS/2, D] -> nibbles along the page-slot
+    axis -> deltas -> repacked). Size-preserving and bijective; adjacent
+    tokens' int4 values differ by small amounts, so the delta nibbles
+    cluster around 0/15 and zlib bites."""
+    axis = axis % packed.ndim
+    q = unpack_nibbles_np(packed, axis)
+    out = q.copy()
+    hi = [slice(None)] * q.ndim
+    lo = [slice(None)] * q.ndim
+    hi[axis] = slice(1, None)
+    lo[axis] = slice(None, -1)
+    out[tuple(hi)] = (q[tuple(hi)] - q[tuple(lo)]) & 0xF
+    return pack_nibbles_np(out, axis)
+
+
+def nibble_delta_decode_np(packed: np.ndarray,
+                           axis: int = -2) -> np.ndarray:
+    """Inverse of :func:`nibble_delta_encode_np`: mod-16 prefix sum over
+    the nibble stream (mod-256 accumulate & 0xF — 16 divides 256, so the
+    residues agree), then repack."""
+    axis = axis % packed.ndim
+    q = unpack_nibbles_np(packed, axis)
+    out = np.add.accumulate(q, axis=axis, dtype=np.uint8) & 0xF
+    return pack_nibbles_np(out, axis)
+
+
 def quantize_int4_groupwise(
     w: jax.Array,            # [..., in, out] kernel(s)
     group: int = 128,

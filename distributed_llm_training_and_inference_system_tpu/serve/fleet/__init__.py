@@ -121,7 +121,9 @@ class ServeFleet:
         # invisible to SSE clients — delivery just resumes from the last
         # acked token on the new producer.
         self.streams = FleetStreamHub(
-            ttl_ms=self.fleet_cfg.stream_log_ttl_ms)
+            ttl_ms=self.fleet_cfg.stream_log_ttl_ms,
+            max_buffered_batches=self.fleet_cfg
+            .stream_max_buffered_batches)
         # inbound chunk reassembly for the HTTP front
         # (/fleet/courier/chunk) shares the courier's receiver, so
         # socket-delivered and in-proc transfers attach in one place

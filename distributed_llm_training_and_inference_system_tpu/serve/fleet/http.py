@@ -272,6 +272,22 @@ class FleetServer:
                     await resp.write(self._sse_event(
                         rid, seq_next - 1, list(toks),
                         text=decoder.feed(toks)))
+                    # backpressure ack: the event reached the socket
+                    # write buffer, so the hub-side budget drains. A
+                    # client too slow to let these writes complete
+                    # stops acking and the hub disconnects it below.
+                    self.fleet.streams.ack(rid, sub["sub"])
+                elif ev[0] == "drop":
+                    # the hub disconnected US for backpressure: end the
+                    # response abruptly (no finish frame, no [DONE]) so
+                    # the client knows to reconnect with Last-Event-ID
+                    # — the log is intact and replays the unacked tail
+                    logger.warning(
+                        "stream %s: subscriber dropped for "
+                        "backpressure at seq %d (reconnectable)",
+                        rid, seq_next - 1)
+                    await resp.write_eof()
+                    return resp
                 else:
                     _kind, finish_reason, _error = ev
                     finished = True

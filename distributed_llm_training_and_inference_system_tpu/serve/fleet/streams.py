@@ -48,14 +48,24 @@ logger = logging.getLogger("llmctl.serve.fleet.streams")
 # subscriber event shapes (delivered in order, finish always last):
 #   ("tokens", start_seq, [tok, ...])
 #   ("finish", finish_reason, error)
+#   ("drop", None, None)   — backpressure disconnect: the subscriber
+#                            exceeded max_buffered_batches without
+#                            acking; it must close its connection and
+#                            reconnect with Last-Event-ID (the log is
+#                            intact — only THIS subscription died)
 
 
 class _Subscriber:
-    __slots__ = ("cb", "next_seq")
+    __slots__ = ("cb", "next_seq", "buffered")
 
     def __init__(self, cb: Callable, next_seq: int):
         self.cb = cb
         self.next_seq = next_seq
+        # delivered-but-unacked batches: incremented per cb delivery,
+        # decremented by FleetStreamHub.ack once the consumer actually
+        # wrote the event to its client. The gap between the two IS the
+        # per-subscriber buffer a slow client grows.
+        self.buffered = 0
 
 
 class _StreamLog:
@@ -86,11 +96,15 @@ class FleetStreamHub:
     """All live + recently-finished stream logs, with the counters the
     supervisor snapshot / Prometheus pump read."""
 
-    def __init__(self, ttl_ms: float = 60_000.0):
+    def __init__(self, ttl_ms: float = 60_000.0,
+                 max_buffered_batches: int = 0):
         self._lock = threading.RLock()
         self._logs: dict[str, _StreamLog] = {}
         self._sub_seq = 0
         self._ttl_s = max(float(ttl_ms), 0.0) / 1e3
+        # per-subscriber backpressure cap
+        # (FleetConfig.stream_max_buffered_batches; 0 = unbounded)
+        self._max_buffered = max(int(max_buffered_batches), 0)
         # counters (running totals — the Prometheus pump deltas them)
         self.total_opened = 0
         self.total_finished = 0
@@ -101,6 +115,7 @@ class FleetStreamHub:
         self.total_gaps_healed = 0       # tokens recovered from the request
         self.total_out_of_order = 0      # batches buffered ahead of a gap
         self.total_identity_mismatches = 0
+        self.total_backpressure_drops = 0   # slow subscribers disconnected
         self.replay_sizes: deque = deque(maxlen=64)   # per-reconnect burst
         self._dups_by_replica: dict[int, int] = {}
 
@@ -239,12 +254,45 @@ class FleetStreamHub:
     def _deliver_locked(self, log: _StreamLog, start: int,
                         tokens: list) -> None:
         end = start + len(tokens)
-        for sub in log.subs.values():
+        dropped: list = []
+        for sub_id, sub in log.subs.items():
             if sub.next_seq >= end:
                 continue
+            if self._max_buffered and sub.buffered >= self._max_buffered:
+                # backpressure: this subscriber's consumer stopped
+                # draining (slow SSE client). Disconnect IT — the log
+                # keeps growing and a Last-Event-ID reconnect replays
+                # exactly the unacked tail — rather than buffering the
+                # fleet's memory behind one stalled socket.
+                dropped.append(sub_id)
+                continue
             lo = max(sub.next_seq - start, 0)
+            sub.buffered += 1
             sub.cb(("tokens", start + lo, tokens[lo:]))
             sub.next_seq = end
+        for sub_id in dropped:
+            sub = log.subs.pop(sub_id)
+            self.total_backpressure_drops += 1
+            logger.warning(
+                "stream subscriber %s dropped: %d delivered batches "
+                "never consumed (stream_max_buffered_batches=%d); "
+                "client can replay via Last-Event-ID", sub_id,
+                sub.buffered, self._max_buffered)
+            sub.cb(("drop", None, None))
+
+    def ack(self, request_id: str, sub_id, batches: int = 1) -> None:
+        """Consumer-side acknowledgement: the subscriber wrote
+        ``batches`` delivered events to its client, so that much of its
+        buffer drained. The SSE writer calls this after every write;
+        without acks a subscriber hits the backpressure cap and is
+        disconnected."""
+        if sub_id is None:
+            return
+        with self._lock:
+            log = self._logs.get(request_id)
+            sub = log.subs.get(sub_id) if log is not None else None
+            if sub is not None:
+                sub.buffered = max(sub.buffered - batches, 0)
 
     # -- finishing -----------------------------------------------------------
 
@@ -378,6 +426,7 @@ class FleetStreamHub:
                 "gaps_healed": self.total_gaps_healed,
                 "out_of_order": self.total_out_of_order,
                 "identity_mismatches": self.total_identity_mismatches,
+                "backpressure_drops": self.total_backpressure_drops,
                 # bounded recent replay bursts + the cumulative count the
                 # Prometheus pump deltas on (same contract as migration
                 # pauses)

@@ -205,6 +205,11 @@ class _StreamClient:
         self.dups = 0
         self.batch_times: list[float] = []   # one stamp per batch burst
         self.finished = False
+        # set right after subscribe: consumes are instantaneous here, so
+        # every delivered batch acks immediately (the backpressure cap
+        # is for stalled SSE sockets, not in-process consumers). Safe
+        # under the hub lock — it is re-entrant.
+        self.acker = None
 
     def __call__(self, ev):
         if ev[0] == "tokens":
@@ -216,6 +221,8 @@ class _StreamClient:
             self.tokens.extend(toks)
             self.next_seq = start + len(toks)
             self.batch_times.append(time.monotonic())
+            if self.acker is not None:
+                self.acker()
         else:
             self.finished = True
 
@@ -327,6 +334,12 @@ def _finalize_fleet(res: LoadResult, reqs: list, fleet,
             "corruptions": cour.get("corruptions", 0),
             "resumes": cour.get("resumes", 0),
             "aborts": cour.get("aborts", 0),
+            # wire codec ledger: bytes that actually traveled vs the raw
+            # payload bytes they covered (the A/B signal for
+            # --serve-courier-codec)
+            "bytes_wire": cour.get("bytes_wire", 0),
+            "bytes_raw": cour.get("bytes_raw", 0),
+            "compression_ratio": cour.get("compression_ratio", 1.0),
             "p50_transfer_ms": pct3(xfer, 50),
             "p99_transfer_ms": pct3(xfer, 99),
         }
@@ -435,6 +448,9 @@ def _submit_fleet(fleet, prompt, max_tokens, reqs, events, res,
             sc = _StreamClient()
             sub = fleet.streams.subscribe(req.request_id, 0, sc)
             if sub is not None:
+                sc.acker = (lambda rid=req.request_id,
+                            sid=sub["sub"]:
+                            fleet.streams.ack(rid, sid))
                 if sub["tokens"]:
                     sc(("tokens", sub["start"], sub["tokens"]))
                 if sub["finished"]:

@@ -84,6 +84,42 @@ def partial_payload(pages=2):
     return {"pages": p["pages"], "positions": pages * 8, "partial": True}
 
 
+def spec_payload(pages=0):
+    """Scalars-only payload (spec state riding a requeue, no arrays):
+    the degenerate blob the codec layer must still frame correctly."""
+    return {"positions": 11, "last_token": 3, "partial": False,
+            "spec": {"window": 6, "ewma": 0.5, "warmup": 4,
+                     "drafts": 10, "accepted": 4}}
+
+
+def correlated_int8_payload(pages=4, ps=16, d=64, seed=0):
+    """Int8 KV pages with the correlation structure real K/V activations
+    have — channel-static components, a few massive stable outlier
+    channels pinning the per-token absmax, and slow AR(1) per-token
+    drift (exactly what CacheGen's delta coding exploits; iid-random
+    int8, by contrast, is incompressible by construction)."""
+    rng = np.random.default_rng(seed)
+
+    def planes():
+        lead = (2, pages, 2)
+        base = rng.standard_normal((*lead, 1, d)).astype(np.float32)
+        hot = rng.choice(d, size=max(d // 16, 1), replace=False)
+        base[..., hot] *= 10.0
+        x = np.zeros((*lead, ps, d), np.float32)
+        x[..., 0, :] = 0.1 * rng.standard_normal((*lead, d))
+        for t in range(1, ps):
+            x[..., t, :] = (0.99 * x[..., t - 1, :]
+                            + 0.1 * rng.standard_normal((*lead, d)))
+        x = base + x
+        scale = np.abs(x).max(-1) / 127.0 + 1e-9
+        q = np.clip(np.round(x / scale[..., None]), -127,
+                    127).astype(np.int8)
+        return {"values": q, "scale": scale.astype(np.float32)}
+
+    return {"pages": {"k": planes(), "v": planes(), "num_pages": pages},
+            "positions": pages * ps, "last_token": 5}
+
+
 def payloads_equal(a, b):
     if isinstance(a, dict):
         return (isinstance(b, dict) and set(a) == set(b)
@@ -105,6 +141,10 @@ def cfg(**kw):
 
 PAYLOAD_MAKERS = [fp_payload, int8_payload, int4_payload,
                   partial_payload]
+
+CODECS = ["none", "zlib", "delta-zlib"]
+CODEC_MAKERS = PAYLOAD_MAKERS + [spec_payload, correlated_int8_payload]
+CODEC_IDS = ["fp", "int8", "int4", "partial", "spec", "int8corr"]
 
 
 class TestFraming:
@@ -188,6 +228,207 @@ class TestFraming:
         r = ChunkReassembler(1)
         r.add(chunks[0])
         assert payloads_equal(r.payload(), p)
+
+
+class TestWireCodecs:
+    """CacheGen-style wire codecs (this PR's tentpole): every payload
+    kind round-trips BYTE-IDENTICALLY under every codec, compressed
+    frames keep the full chaos semantics (per-frame CRC on the wire
+    bytes, whole-payload CRC on the raw bytes), undeclared codecs are
+    rejected loudly at every layer, and delta-zlib actually compresses
+    realistic int8 KV pages >= 2x (the acceptance bar)."""
+
+    @pytest.mark.parametrize("codec", CODECS)
+    @pytest.mark.parametrize("make", CODEC_MAKERS, ids=CODEC_IDS)
+    def test_encode_decode_identity_all_codecs(self, make, codec):
+        from distributed_llm_training_and_inference_system_tpu.serve.fleet.transport import (  # noqa: E501
+            make_chunks as mk)
+        p = make()
+        manifest, blob = encode_payload(p, codec=codec)
+        assert manifest["codec"] == codec
+        assert manifest["nbytes"] == len(blob)
+        # straight decode (the in-memory path)
+        assert payloads_equal(decode_payload(manifest, blob), p)
+        # and through chunk framing + reassembly (the wire path)
+        chunks = mk("t", manifest, blob, 512)
+        r = ChunkReassembler(len(chunks))
+        for c in reversed(chunks):      # order must not matter
+            r.add(c)
+        out = r.payload()
+        assert payloads_equal(out, p)
+        # decoded arrays own their memory under every codec
+        pages = out.get("pages")
+        if pages:
+            k = pages["k"]
+            (k["values"] if isinstance(k, dict) else k)[0] = 0
+
+    @pytest.mark.parametrize("codec", CODECS)
+    @pytest.mark.parametrize("make", CODEC_MAKERS, ids=CODEC_IDS)
+    def test_transfer_identity_all_codecs(self, make, codec):
+        p = make()
+        t = InProcTransport(cfg(courier_codec=codec))
+        assert payloads_equal(pushed(t, p, src=0, dest=1), p)
+        s = t.stats.snapshot()
+        assert s["transfers"] == 1 and s["aborts"] == 0
+        # the ledger always fills (zero only for the scalars-only
+        # payload's empty blob); raw == wire iff no codec ran — an
+        # incompressible payload may legitimately EXPAND under deflate,
+        # correctness never depends on the ratio
+        manifest, _ = encode_payload(p)
+        if manifest["nbytes"]:
+            assert s["bytes_raw"] > 0 and s["bytes_wire"] > 0
+        if codec == "none":
+            assert s["bytes_raw"] == s["bytes_wire"]
+
+    def test_delta_zlib_hits_2x_on_int8_kv_pages(self):
+        """The acceptance criterion: >= 2x compression on realistic
+        int8 KV page payloads (values delta-encoded along the token
+        axis; fp32 scales ride plain zlib)."""
+        p = correlated_int8_payload()
+        t = InProcTransport(cfg(courier_codec="delta-zlib"))
+        assert payloads_equal(pushed(t, p, src=0, dest=1), p)
+        s = t.stats.snapshot()
+        assert s["compression_ratio"] >= 2.0, s
+        assert s["bytes_wire"] < s["bytes_raw"]
+        # and the delta filter beats codec-less deflate on the same
+        # payload (raw int8 barely deflates; deltas are the win)
+        tz = InProcTransport(cfg(courier_codec="zlib"))
+        pushed(tz, p, src=0, dest=1)
+        assert s["bytes_wire"] < tz.stats.snapshot()["bytes_wire"]
+
+    def test_delta_zlib_compresses_packed_int4(self):
+        """Nibble deltas (shared ops/quantization.py layout) compress
+        packed-int4 planes too — wire bytes strictly under raw."""
+        base = correlated_int8_payload()
+
+        def pack4(q8):
+            q4 = np.clip(np.round(q8.astype(np.float32) / 127.0 * 7),
+                         -7, 7).astype(np.int8)
+            return ((q4[..., 0::2, :] & 0xF)
+                    | ((q4[..., 1::2, :] & 0xF) << 4)).astype(np.uint8)
+        for name in ("k", "v"):
+            e = base["pages"][name]
+            e["values"] = pack4(e["values"])
+        t = InProcTransport(cfg(courier_codec="delta-zlib"))
+        assert payloads_equal(pushed(t, base, src=0, dest=1), base)
+        s = t.stats.snapshot()
+        assert s["bytes_wire"] < s["bytes_raw"], s
+        assert s["compression_ratio"] > 1.5, s
+
+    @pytest.mark.parametrize("codec", ["zlib", "delta-zlib"])
+    def test_corrupt_compressed_chunk_detected_and_retransmitted(
+            self, codec):
+        """Chaos semantics are unchanged under compression: the frame
+        CRC covers the COMPRESSED bytes, so a flipped wire byte is
+        rejected exactly like before and the clean retransmit lands."""
+        from distributed_llm_training_and_inference_system_tpu.serve.fleet.transport import (  # noqa: E501
+            make_chunks as mk)
+        p = fp_payload()
+        manifest, blob = encode_payload(p, codec=codec)
+        chunks = mk("t", manifest, blob, 256)
+        assert len(chunks) >= 2
+        bad = chunks[1]
+        flipped = bytes([bad.data[0] ^ 0x01]) + bad.data[1:]
+        rx = CourierReceiver()
+        ack = rx.add_chunk(CourierChunk(bad.ticket, bad.seq, bad.total,
+                                        bad.crc32, flipped))
+        assert not ack["ok"] and not ack.get("fatal")
+        for c in chunks:                 # clean retransmit completes
+            ack = rx.add_chunk(c)
+        assert ack["complete"]
+        assert payloads_equal(rx.take_payload("t"), p)
+
+    @pytest.mark.parametrize("codec", CODECS)
+    def test_chaos_resend_only_missing_all_codecs(self, codec):
+        """Seeded drop+corrupt+duplicate chaos over compressed frames:
+        identity holds, retries/corruptions counted, zero aborts —
+        chunks are opaque to the failure matrix."""
+        inj = FaultInjector(FaultPlan(
+            seed=3, chunk_drop_rate=0.2, chunk_corrupt_rate=0.15,
+            chunk_duplicate_rate=0.1))
+        t = InProcTransport(cfg(courier_codec=codec), injector=inj)
+        p = correlated_int8_payload()
+        for _ in range(3):
+            assert payloads_equal(pushed(t, p, src=0, dest=1), p)
+        s = t.stats.snapshot()
+        assert s["transfers"] == 3 and s["aborts"] == 0
+        assert s["retries"] > 0 and s["resumes"] > 0
+
+    def test_unknown_codec_rejected_everywhere(self):
+        """Build-time: transport init and FleetConfig refuse unknown
+        codecs; wire-time: a receiver acks fatal on an undeclared
+        manifest codec so the sender aborts instead of pushing on."""
+        from distributed_llm_training_and_inference_system_tpu.config.schema import (  # noqa: E501
+            ConfigError,
+            FleetConfig,
+        )
+        with pytest.raises(ValueError, match="codec"):
+            InProcTransport(cfg(courier_codec="brotli"))
+        with pytest.raises(ValueError, match="codec"):
+            encode_payload(fp_payload(), codec="brotli")
+        with pytest.raises(ConfigError, match="courier_codec"):
+            FleetConfig(replicas=1, courier_codec="brotli").validate()
+        # wire-time: hand-craft a manifest declaring a codec this
+        # receiver does not speak
+        manifest, blob = encode_payload(fp_payload(1))
+        manifest["codec"] = "brotli"
+        chunks = make_chunks("t", manifest, blob, 1 << 20)
+        rx = CourierReceiver()
+        ack = rx.add_chunk(chunks[0])
+        assert ack["ok"] is False and ack["fatal"] is True
+        assert "brotli" in ack["error"]
+        assert rx.take_payload("t") is None
+        # a narrowed accept-set rejects even known codecs (negotiation)
+        rx2 = CourierReceiver(codecs=("none",))
+        manifest2, blob2 = encode_payload(fp_payload(1), codec="zlib")
+        ack2 = rx2.add_chunk(make_chunks("t2", manifest2, blob2,
+                                         1 << 20)[0])
+        assert ack2["ok"] is False and ack2.get("fatal") is True
+
+    def test_frame_pipeline_matches_eager_chunks(self):
+        """The two-slot compress-ahead pipeline emits byte-identical
+        frames to the eager framer, in any access pattern (including
+        resend-round reuse)."""
+        from distributed_llm_training_and_inference_system_tpu.serve.fleet.transport import (  # noqa: E501
+            FramePipeline,
+        )
+        manifest, blob = encode_payload(correlated_int8_payload(),
+                                        codec="delta-zlib")
+        eager = make_chunks("t", manifest, blob, 1024)
+        pipe = FramePipeline("t", manifest, blob, 1024, "delta-zlib")
+        assert pipe.total == len(eager)
+        seqs = list(range(pipe.total))
+        for i, seq in enumerate(seqs):
+            nxt = seqs[i + 1] if i + 1 < len(seqs) else None
+            got = pipe.frame(seq, prefetch=nxt)
+            assert (got.seq, got.crc32, got.data) == (
+                eager[seq].seq, eager[seq].crc32, eager[seq].data)
+        # resend round: cached frames, same bytes, raw_len ledger sane
+        for seq in (0, len(eager) - 1):
+            assert pipe.frame(seq).data == eager[seq].data
+        assert sum(pipe.raw_len(s) for s in seqs) == len(blob)
+
+    def test_np_jnp_nibble_layout_agreement(self):
+        """The codec's numpy nibble helpers and the cache's jnp pair
+        share ONE layout: unpacking with either (mod the sign
+        convention) yields the same nibble stream, so the wire codec
+        can never disagree with the write path about where a token's
+        bytes live."""
+        import jax.numpy as jnp
+
+        from distributed_llm_training_and_inference_system_tpu.ops.quantization import (  # noqa: E501
+            pack_nibbles_np,
+            unpack_int4_rows,
+            unpack_nibbles_np,
+        )
+        p = RNG.integers(0, 256, (2, 3, 6, 8)).astype(np.uint8)
+        nib = unpack_nibbles_np(p, axis=-2)
+        assert np.array_equal(pack_nibbles_np(nib, axis=-2), p)
+        signed = np.where(nib >= 8, nib.astype(np.int16) - 16,
+                          nib).astype(np.int8)
+        assert np.array_equal(
+            signed, np.asarray(unpack_int4_rows(jnp.asarray(p),
+                                                axis=-2)))
 
 
 class TestReceiver:

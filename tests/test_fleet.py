@@ -630,6 +630,124 @@ class TestCourierChaos:
             fleet.shutdown()
 
 
+class TestCourierCompressed:
+    """Compressed courier (this PR's tentpole, engine-backed): with
+    ``courier_codec="delta-zlib"`` every migration / handoff payload is
+    delta-filtered + per-chunk deflated on the wire, under the same
+    seeded chunk chaos as TestCourierChaos — token identity, zero
+    re-prefill, and the wire/raw ledger must all hold. A codec bug can
+    only surface as a counted abort (re-prefill), never wrong bytes —
+    these tests prove the good path stays bit-exact."""
+
+    _submit = TestMigration._submit
+    _await_all = TestMigration._await_all
+    _wait_decoding = TestMigration._wait_decoding
+
+    COMP_KW = dict(TestCourierChaos.CHAOS_KW, courier_codec="delta-zlib")
+
+    def test_compressed_drain_migration_chaos_greedy(
+            self, model_cfg, ref_engine):
+        """fp32 payloads under chaos + compression: drain migration
+        lands token-identically with zero re-prefill; the corrupt
+        fault flips COMPRESSED frame bytes and the frame CRC still
+        catches every one (corruptions counted, aborts zero)."""
+        greedy = SamplingParams(temperature=0.0, max_tokens=48)
+        ref = [r.generated_tokens
+               for r in ref_engine.generate(PROMPTS[:4], greedy)]
+        fleet = make_fleet(model_cfg, ref_engine.params, warm=True,
+                           plan=FaultPlan(**TestCourierChaos.CHAOS_PLAN),
+                           fleet_kw=dict(self.COMP_KW))
+        try:
+            reqs, events = self._submit(fleet, PROMPTS[:4], greedy)
+            self._wait_decoding(reqs, events)
+            pre = sum(rep.engine.total_prefill_tokens
+                      for rep in fleet.replicas)
+            assert fleet.drain(0)
+            self._await_all(fleet, events)
+            post = sum(rep.engine.total_prefill_tokens
+                       for rep in fleet.replicas)
+            assert [r.generated_tokens for r in reqs] == ref, (
+                "compressed drain migration diverged")
+            assert post == pre
+            cour = fleet.status()["courier"]
+            assert cour["transfers"] >= 1 and cour["aborts"] == 0
+            assert cour["retries"] >= 1, cour
+            assert cour["bytes_wire"] > 0 and cour["bytes_raw"] > 0
+            st = fleet.router.stats()
+            assert st["completed"] == 4
+            assert st["completed"] + st["failed"] + st["rejected"] \
+                == st["submitted"]
+        finally:
+            fleet.shutdown()
+
+    def test_compressed_int8_drain_seeded_chaos(
+            self, model_cfg, ref_engine):
+        """int8-KV payloads + seeded sampling + chaos + compression:
+        bit-identical resume with the wire/raw ledger populated. (The
+        >= 2x ratio bar lives in test_courier_transport.py on
+        realistically-correlated pages — gpt-test's random-init
+        activations are near-incompressible by construction, which is
+        itself worth pinning: the codec must never NEED compressibility
+        for correctness.)"""
+        from distributed_llm_training_and_inference_system_tpu.serve import (
+            InferenceEngine)
+        sampled = SamplingParams(temperature=0.9, top_k=16,
+                                 max_tokens=32, seed=97)
+        q8_ref = InferenceEngine(model_cfg,
+                                 serve_cfg(kv_quantization="int8"),
+                                 params=ref_engine.params, seed=0)
+        ref = [r.generated_tokens
+               for r in q8_ref.generate([PROMPTS[0]], sampled)]
+        fleet = make_fleet(model_cfg, ref_engine.params, warm=True,
+                           plan=FaultPlan(**TestCourierChaos.CHAOS_PLAN),
+                           serve_kw={"kv_quantization": "int8"},
+                           fleet_kw=dict(self.COMP_KW))
+        try:
+            reqs, events = self._submit(fleet, [PROMPTS[0]], sampled)
+            self._wait_decoding(reqs, events, n_tokens=4)
+            src = fleet.router.replica_of(reqs[0].request_id)
+            assert fleet.drain(src)
+            self._await_all(fleet, events)
+            assert reqs[0].generated_tokens == ref[0], (
+                "compressed int8 seeded migration diverged")
+            cour = fleet.status()["courier"]
+            assert cour["aborts"] == 0, cour
+            assert cour["bytes_wire"] > 0 and cour["bytes_raw"] > 0, cour
+            assert cour["compression_ratio"] > 0.9, cour
+        finally:
+            fleet.shutdown()
+
+    def test_compressed_disagg_handoff_chaos(self, model_cfg,
+                                             ref_engine):
+        """Prefill->decode handoffs ride the compressed lossy courier:
+        token identity and zero decode-side prefill hold."""
+        greedy = SamplingParams(temperature=0.0, max_tokens=20)
+        ref = [r.generated_tokens
+               for r in ref_engine.generate(PROMPTS[:4], greedy)]
+        fleet = make_fleet(
+            model_cfg, ref_engine.params, warm=True,
+            plan=FaultPlan(**TestCourierChaos.CHAOS_PLAN),
+            fleet_kw=dict(self.COMP_KW, roles="prefill,decode"))
+        for rep in fleet.replicas:
+            rep.engine.total_prefill_tokens = 0      # warmup prefilled
+            rep.engine.total_unexpected_prefills = 0
+        try:
+            reqs, events = self._submit(fleet, PROMPTS[:4], greedy)
+            self._await_all(fleet, events)
+            assert [r.generated_tokens for r in reqs] == ref, (
+                "compressed disagg handoff diverged")
+            snap = fleet.status()
+            assert snap["handoff"]["handoffs"] == 4
+            assert snap["courier"]["transfers"] >= 4
+            assert snap["courier"]["aborts"] == 0
+            assert fleet.replicas[1].engine.total_prefill_tokens == 0
+            total = sum(rep.engine.total_prefill_tokens
+                        for rep in fleet.replicas)
+            assert total == sum(len(p) for p in PROMPTS[:4])
+        finally:
+            fleet.shutdown()
+
+
 class TestRoleAutoDemotion:
     """Satellite (PR-4 known gap): crash-promoted mixed replicas demote
     back to their provisioned role once the crashed class is healthy for
@@ -997,6 +1115,8 @@ class TestFleetMetrics:
                         "duplicates": 1, "resumes": 3, "aborts": 1,
                         "expired": 2,
                         "transfers": 4, "bytes_moved": 4096,
+                        "bytes_wire": 1024, "bytes_raw": 4096,
+                        "compression_ratio": 4.0,
                         "in_flight": 0,
                         "transfer_ms": [1.0, 2.0, 3.0, 4.0],
                         "transfer_count": 4},
@@ -1051,6 +1171,12 @@ class TestFleetMetrics:
         assert samples[("llmctl_fleet_courier_resumes_total", None)] == 3
         assert samples[("llmctl_fleet_courier_aborts_total", None)] == 1
         assert samples[("llmctl_fleet_courier_expired_total", None)] == 2
+        # wire codec plane (this PR): bytes on the wire vs the raw
+        # payload bytes they covered — the compression-ratio ledger
+        assert samples[
+            ("llmctl_fleet_courier_wire_bytes_total", None)] == 1024
+        assert samples[
+            ("llmctl_fleet_courier_raw_bytes_total", None)] == 4096
         assert samples[
             ("llmctl_fleet_courier_transfer_ms_count", None)] == 4
         assert samples[("llmctl_fleet_courier_transfer_ms_sum", None)] \

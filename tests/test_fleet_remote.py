@@ -332,6 +332,22 @@ class TestRemoteReplica:
         finally:
             rr.stop()
 
+    def test_spec_counters_mirror_through_probe(self, fake_worker):
+        """PR-9 gap closed: a remote worker running the speculative
+        decoder (`llmctl fleet worker --speculative ngram`) surfaces its
+        acceptance counters through /worker/probe, and the parent-side
+        RemoteReplica mirror exposes them exactly like an in-proc
+        replica's spec_stats() — the supervisor snapshot and the
+        llmctl_fleet_spec_* pump read both through one interface."""
+        rr = make_remote(fake_worker)
+        assert rr.spec_stats() == {"dispatches": 0, "drafts": 0,
+                                   "accepted": 0, "resumes": 0}
+        fake_worker.probe_extra = {"spec": {"dispatches": 7, "drafts": 21,
+                                            "accepted": 13, "resumes": 2}}
+        rr.probe()
+        assert rr.spec_stats() == {"dispatches": 7, "drafts": 21,
+                                   "accepted": 13, "resumes": 2}
+
     def test_blackhole_probe_raises_and_partition_heals(self, fake_worker):
         """A black-holed endpoint fails probes (RemoteUnavailable); a
         finite black-hole heals and the next probe succeeds."""
@@ -508,7 +524,12 @@ class TestWorkerToWorkerShip:
                "--param-seed", "3", "--seed", "1000",
                "--max-batch-size", "2", "--max-seq-len", "128",
                "--prefill-chunk", "32", "--kv-block-size", "8",
-               "--dtype", "float32", "--restart-backoff", "0.05"]
+               "--dtype", "float32", "--restart-backoff", "0.05",
+               # PR-9 gap closed: remote workers can run the speculative
+               # decoder (greedy output unchanged by design) and ship
+               # compressed courier payloads
+               "--speculative", "ngram", "--spec-tokens", "4",
+               "--courier-codec", "delta-zlib"]
         proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
                                 stderr=subprocess.DEVNULL, env=env,
                                 text=True, start_new_session=True)
@@ -565,6 +586,12 @@ class TestWorkerToWorkerShip:
                     temperature=0.0, max_tokens=8))
                 assert req.generated_tokens == ref.generated_tokens, (
                     "spawned worker diverged from the local engine")
+                # --speculative reached the worker's engine: its spec
+                # dispatch counters flow through /worker/probe into the
+                # RemoteReplica mirror (every decode dispatch is a
+                # fused spec dispatch once the proposer is armed)
+                rr.probe()
+                assert rr.spec_stats()["dispatches"] >= 1, rr.spec_stats()
             finally:
                 rr.stop()
         finally:

@@ -252,6 +252,60 @@ class TestHubUnits:
         assert hub.stats()["identity_mismatches"] == 1
         assert rec.tokens == [1, 2]               # log wins, no re-send
 
+    def test_backpressure_drops_slow_subscriber_replayable(self):
+        """PR-8 known gap closed: a subscriber that stops consuming
+        (never acks) is disconnected once it holds
+        stream_max_buffered_batches delivered batches — counted, given
+        one ("drop", ...) event — while fast subscribers and the log
+        itself are untouched; a reconnect at the dropped client's last
+        seq replays exactly the tail it missed."""
+        hub = FleetStreamHub(max_buffered_batches=3)
+        hub.open("r")
+        slow, fast = Recorder(), Recorder()
+        s_slow = hub.subscribe("r", 0, slow)
+        s_fast = hub.subscribe("r", 0, fast)
+        for i in range(6):
+            hub.publish("r", i, [i], replica=0)
+            # the fast consumer drains; the slow one never does
+            hub.ack("r", s_fast["sub"])
+        # slow got the cap's worth of batches, then the drop event
+        assert slow.events[-1] == ("drop", None, None)
+        assert slow.tokens == [0, 1, 2]
+        assert fast.tokens == [0, 1, 2, 3, 4, 5]
+        st = hub.stats()
+        assert st["backpressure_drops"] == 1
+        # the log is intact: reconnect replays the unacked tail
+        re = hub.subscribe("r", len(slow.tokens), Recorder(), resume=True)
+        assert re["tokens"] == [3, 4, 5]
+        # further publishes no longer reach the dropped subscriber
+        n_events = len(slow.events)
+        hub.publish("r", 6, [6], replica=0)
+        assert len(slow.events) == n_events
+        assert hub.stats()["backpressure_drops"] == 1
+
+    def test_backpressure_ack_keeps_subscriber_alive(self):
+        """Acked batches drain the budget: a consumer that keeps up is
+        never dropped no matter how long the stream runs; cap 0
+        disables the bound entirely."""
+        hub = FleetStreamHub(max_buffered_batches=2)
+        hub.open("r")
+        rec = Recorder()
+        sub = hub.subscribe("r", 0, rec)
+        for i in range(50):
+            hub.publish("r", i, [i], replica=0)
+            hub.ack("r", sub["sub"])
+        assert rec.tokens == list(range(50))
+        assert hub.stats()["backpressure_drops"] == 0
+        # unbounded hub: no acks, no drops (PR-8 behavior)
+        hub0 = FleetStreamHub(max_buffered_batches=0)
+        hub0.open("r")
+        rec0 = Recorder()
+        hub0.subscribe("r", 0, rec0)
+        for i in range(50):
+            hub0.publish("r", i, [i], replica=0)
+        assert rec0.tokens == list(range(50))
+        assert hub0.stats()["backpressure_drops"] == 0
+
     def test_replica_stats_active_streams(self):
         hub = FleetStreamHub()
         hub.open("a")
@@ -1004,6 +1058,7 @@ class TestStreamMetrics:
             "streams": {"active": 2, "opened": 5, "finished": 3,
                         "tokens": 100, "duplicates": 4, "replayed": 9,
                         "reconnects": 2, "gaps_healed": 1,
+                        "backpressure_drops": 3,
                         "replay_sizes": [4, 5], "replay_count": 2},
         }
         exporter.export_fleet(snap)
@@ -1021,6 +1076,8 @@ class TestStreamMetrics:
             ("llmctl_fleet_stream_reconnects_total", None)] == 2
         assert samples[
             ("llmctl_fleet_stream_gaps_healed_total", None)] == 1
+        assert samples[
+            ("llmctl_fleet_stream_backpressure_drops_total", None)] == 3
         assert samples[
             ("llmctl_fleet_stream_replay_tokens_count", None)] == 2
         assert samples[("llmctl_fleet_stream_replay_tokens_sum", None)] \

@@ -491,6 +491,27 @@ class TestFetchSpill:
         assert snap["prefix_fetch"]["aborts"] == 0
         assert snap["courier"]["retries"] >= 1
 
+    def test_compressed_fetch_under_chunk_chaos(self, model_cfg, params):
+        """Compressed courier (delta-zlib) on the prefix-fetch path
+        under seeded chunk chaos: fetched pages import bit-exactly (the
+        whole-payload CRC covers the codec inverse), accounting stays
+        exact, zero aborts, and the wire/raw ledger fills."""
+        fetched, spent, snap = self._run(
+            model_cfg, params, SamplingParams(temperature=0.0,
+                                              max_tokens=16),
+            fault_plan=FaultPlan(seed=5, chunk_drop_rate=0.2,
+                                 chunk_corrupt_rate=0.15,
+                                 chunk_duplicate_rate=0.1),
+            courier_codec="delta-zlib",
+            courier_max_retries=12, courier_retry_backoff_ms=0.2,
+            courier_retry_backoff_max_ms=2.0,
+            courier_chunk_deadline_ms=20.0)
+        assert fetched == len(HOT)
+        assert spent == sum(len(p) for p in _prompts()[1:]) - 3 * len(HOT)
+        assert snap["prefix_fetch"]["aborts"] == 0
+        cour = snap["courier"]
+        assert cour["bytes_wire"] > 0 and cour["bytes_raw"] > 0
+
     def test_dead_link_degrades_to_plain_prefill(self, model_cfg, params):
         """100% chunk loss: every fetch aborts, every prompt re-prefills
         plainly — token-identical, aborts counted, nothing imported,
