@@ -1,7 +1,10 @@
-"""`llmctl admin` — checkpoint GC, tensor inspection, dataset indexing.
+"""`llmctl admin` — checkpoint GC, tensor inspection, dataset indexing,
+and static checks.
 
 Un-stubs the reference's admin command (reference cli/commands/admin.py:9-29,
-SURVEY §2 row 22).
+SURVEY §2 row 22). ``llmctl admin lint`` runs graftlint (analysis/): the
+AST invariant checker for thread-context, lock-discipline,
+counter-wiring, config-wiring, and np/jnp-parity contracts.
 """
 
 from __future__ import annotations
@@ -18,6 +21,63 @@ def app(ctx):
     """Maintenance utilities."""
     if ctx.invoked_subcommand is None:
         click.echo(ctx.get_help())
+
+
+@app.command()
+@click.option("--format", "fmt", default="text", show_default=True,
+              type=click.Choice(["text", "json"]),
+              help="Diagnostic output format.")
+@click.option("--rules", default="", show_default=False,
+              help="Comma-separated pass ids to run (default: all of "
+                   "thread-context, lock-discipline, counter-wiring, "
+                   "config-wiring, np-jnp-parity).")
+@click.option("--baseline", "baseline_path", default=None,
+              type=click.Path(dir_okay=False),
+              help="Baseline file of grandfathered findings "
+                   "[default: analysis/baseline.json].")
+@click.option("--write-baseline", is_flag=True,
+              help="Grandfather every currently-unsuppressed finding "
+                   "into the baseline file and exit 0. Review the "
+                   "diff: baselining is for DELIBERATE findings only.")
+@click.option("--all", "show_all", is_flag=True,
+              help="List suppressed/baselined findings too (text "
+                   "format; json always carries everything).")
+def lint(fmt, rules, baseline_path, write_baseline, show_all):
+    """Run graftlint: the AST invariant checker for the serve fleet's
+    concurrency, wiring, and parity contracts (see USER_GUIDE "Static
+    checks"). Exits nonzero on unsuppressed findings — suppress one
+    with `# graftlint: ignore[rule-id]` on the offending line, or
+    grandfather deliberate findings in the baseline with a note."""
+    import json as _json
+
+    from ...analysis import run_lint, write_baseline as _wb
+
+    rule_list = [r.strip() for r in rules.split(",") if r.strip()] or None
+    try:
+        result = run_lint(rules=rule_list, baseline_path=baseline_path)
+    except ValueError as e:
+        raise click.ClickException(str(e))
+    if write_baseline:
+        path = _wb(result.findings, path=baseline_path)
+        click.echo(f"baseline updated: {path} "
+                   f"({len(result.unsuppressed)} finding(s) "
+                   f"grandfathered)")
+        return
+    if fmt == "json":
+        click.echo(_json.dumps(result.to_dict(), indent=2))
+    else:
+        shown = (result.findings if show_all else result.unsuppressed)
+        for f in sorted(shown, key=lambda x: (x.rule, x.file, x.line)):
+            tag = ("suppressed" if f.suppressed
+                   else "baselined" if f.baselined else "FAIL")
+            click.echo(f"[{f.rule}] {f.file}:{f.line} {tag}: "
+                       f"{f.message}")
+        click.echo(
+            f"graftlint: {len(result.findings)} finding(s), "
+            f"{len(result.unsuppressed)} unsuppressed across "
+            f"{len(result.rules_run)} pass(es)")
+    if not result.ok:
+        raise SystemExit(1)
 
 
 @app.command()

@@ -117,7 +117,8 @@ def status(url, as_json):
             f"migration: {mig['migrations']} moved "
             f"({mig['migrated_tokens']} KV tokens, "
             f"{mig['reprefill_tokens_avoided']} re-prefill tokens "
-            f"avoided, {mig['in_flight']} in flight)")
+            f"avoided, {mig.get('rebalance_migrations', 0)} "
+            f"rebalancer-ordered, {mig['in_flight']} in flight)")
     ho = snap.get("handoff")
     if ho and (ho.get("handoffs") or ho.get("local_fallbacks")
                or ho.get("reroles") or ho.get("promotions")

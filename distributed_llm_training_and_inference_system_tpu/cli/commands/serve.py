@@ -61,6 +61,29 @@ def app(ctx):
 @click.option("--chunked-prefill", default=0, show_default=True, type=int,
               help="Prefill prompts longer than this in chunks of this "
                    "many tokens, interleaved with decode (0 = off).")
+@click.option("--prefill-budget-tokens", default=2048, show_default=True,
+              type=int,
+              help="Max prompt tokens prefetched between two decode "
+                   "steps (bounds the inter-token stall resident "
+                   "streams see during a long-prompt burst).")
+@click.option("--decode-steps", default=8, show_default=True, type=int,
+              help="Decode iterations fused into one device dispatch "
+                   "(each dispatch pays one host round trip for K "
+                   "tokens; K also bounds admission latency).")
+@click.option("--max-queue", default=256, show_default=True, type=int,
+              help="Per-engine queued-request bound; beyond it "
+                   "submissions are rejected.")
+@click.option("--swap-space-gb", default=4.0, show_default=True,
+              type=float,
+              help="Host-memory budget for swapped-out KV "
+                   "(--preemption swap); above it evictions fall back "
+                   "to recompute.")
+@click.option("--spec-ngram", default=3, show_default=True, type=int,
+              help="Longest n-gram the speculative proposer tries.")
+@click.option("--spec-min-acceptance", default=0.05, show_default=True,
+              type=float,
+              help="Adaptive kill switch: fall back to plain decode "
+                   "when measured draft acceptance stays below this.")
 @click.option("--kv-quantization", default="none", show_default=True,
               type=click.Choice(["none", "int8", "int4"]),
               help="Quantized KV pages (+per-token scales): int8 = 2x KV "
@@ -113,10 +136,28 @@ def app(ctx):
                    "requests get 429 + Retry-After.")
 @click.option("--fleet-probe-interval", default=0.5, show_default=True,
               type=float, help="Supervisor health-probe cadence (s).")
+@click.option("--fleet-probe-failures", default=3, show_default=True,
+              type=int,
+              help="Consecutive probe misses before a replica is "
+                   "declared dead and torn down like a crash.")
 @click.option("--fleet-restart-backoff", default=0.5, show_default=True,
               type=float,
               help="First replica-restart delay; doubles per consecutive "
                    "restart.")
+@click.option("--fleet-max-restarts", default=0, show_default=True,
+              type=int,
+              help="Give up restarting a replica after this many "
+                   "attempts (0 = unlimited).")
+@click.option("--fleet-max-requeues", default=3, show_default=True,
+              type=int,
+              help="Per-request crash/drain requeue budget; above it "
+                   "the request fails loudly instead of ping-ponging "
+                   "between dying replicas.")
+@click.option("--fleet-prefix-inventory-max", default=512,
+              show_default=True, type=int,
+              help="Newest prefix-page hashes each replica advertises "
+                   "for fleet-global prefix-fetch hints (bounds probe "
+                   "payloads; 0 disables the inventory).")
 @click.option("--fleet-affinity-tokens", default=64, show_default=True,
               type=int,
               help="Prompt-prefix length hashed for replica affinity "
@@ -246,10 +287,14 @@ def app(ctx):
 def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
           kv_block_size, kv_hbm_gb, scheduler, dtype, prometheus_port,
           speculative, spec_tokens, prefix_cache, tensor_parallel,
-          quantization, chunked_prefill, kv_quantization, admission,
+          quantization, chunked_prefill, prefill_budget_tokens,
+          decode_steps, max_queue, swap_space_gb, spec_ngram,
+          spec_min_acceptance, kv_quantization, admission,
           preemption, latency_dispatch_steps, pipelined_decode,
           int8_pallas, cors_origins, replicas, fleet_max_pending,
-          fleet_probe_interval, fleet_restart_backoff,
+          fleet_probe_interval, fleet_probe_failures,
+          fleet_restart_backoff, fleet_max_restarts, fleet_max_requeues,
+          fleet_prefix_inventory_max,
           fleet_affinity_tokens, fleet_migrate_on_drain,
           fleet_rebalance_ratio, fleet_rebalance_hysteresis,
           fleet_max_migrations, fleet_roles, fleet_role_balance_ratio,
@@ -280,8 +325,13 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
         kv_block_size=kv_block_size, kv_hbm_budget_gb=kv_hbm_gb,
         scheduler=scheduler, dtype=dtype, speculative=speculative,
         speculative_tokens=spec_tokens, prefix_caching=prefix_cache,
+        speculative_ngram=spec_ngram,
+        speculative_min_acceptance=spec_min_acceptance,
         tensor_parallel=tensor_parallel, quantization=quantization,
         chunked_prefill_tokens=chunked_prefill,
+        prefill_budget_tokens=prefill_budget_tokens,
+        decode_steps_per_dispatch=decode_steps, max_queue=max_queue,
+        swap_space_gb=swap_space_gb,
         kv_quantization=kv_quantization, admission=admission,
         preemption=preemption,
         latency_dispatch_steps=latency_dispatch_steps,
@@ -295,7 +345,11 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
         fleet_cfg = FleetConfig(
             replicas=replicas, max_pending=fleet_max_pending,
             probe_interval_s=fleet_probe_interval,
+            probe_failures=fleet_probe_failures,
             restart_backoff_s=fleet_restart_backoff,
+            max_restarts=fleet_max_restarts,
+            max_requeues=fleet_max_requeues,
+            prefix_inventory_max=fleet_prefix_inventory_max,
             affinity_prefix_tokens=fleet_affinity_tokens,
             migrate_on_drain=fleet_migrate_on_drain,
             rebalance_imbalance_ratio=fleet_rebalance_ratio,

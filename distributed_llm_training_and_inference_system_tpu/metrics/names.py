@@ -1,0 +1,293 @@
+"""The Prometheus metric-name registry: ONE source of truth.
+
+Before this module, every ``llmctl_*`` name lived in three places that
+could silently drift: the exporter's constructor literals
+(``metrics/observability.py``), the dashboard-pin assertions in
+``tests/test_fleet*.py``, and — implicitly — the operator dashboards
+scraping them. A rename in one place broke the others at runtime, not
+at review time.
+
+Now:
+
+- :data:`METRICS` declares every exported metric (kind, help, labels,
+  histogram buckets). ``PrometheusExporter`` CONSTRUCTS from it, the
+  name-tests read expected names from it, and graftlint's
+  counter-wiring pass cross-checks that every name literal in the
+  package is registered and every registered name is constructed.
+- :data:`COUNTER_FLOW` declares how each ``total_*`` running counter
+  flows from its owning class into snapshot/stats keys and (optionally)
+  a registered Prometheus name. The counter-wiring pass walks the AST
+  and fails if a counter is defined but unregistered, registered but
+  missing from the snapshot code, or mapped to an unknown metric —
+  adding a counter without wiring it end-to-end is now a lint error,
+  not a silent observability gap.
+
+``prometheus_client`` appends ``_total`` to counters at scrape time;
+:func:`scraped_name` gives the wire name tests and dashboards see.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+GAUGE = "gauge"
+COUNTER = "counter"
+HISTOGRAM = "histogram"
+
+
+class MetricSpec(NamedTuple):
+    kind: str
+    help: str
+    labels: tuple = ()
+    buckets: Optional[tuple] = None
+
+
+_LAT_BUCKETS = (.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 1000)
+_XFER_BUCKETS = (.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 1000, 5000)
+
+METRICS: dict[str, MetricSpec] = {
+    # -- training / system -------------------------------------------------
+    "llmctl_train_loss": MetricSpec(GAUGE, "Training loss"),
+    "llmctl_train_mfu": MetricSpec(GAUGE, "Model FLOPs utilisation"),
+    "llmctl_train_tokens_per_sec": MetricSpec(GAUGE, "Global tokens/s"),
+    "llmctl_train_tokens_per_sec_per_chip": MetricSpec(
+        GAUGE, "Tokens/s per chip"),
+    "llmctl_train_grad_norm": MetricSpec(GAUGE, "Gradient global norm"),
+    "llmctl_train_lr": MetricSpec(GAUGE, "Learning rate"),
+    "llmctl_train_step": MetricSpec(GAUGE, "Current optimizer step"),
+    "llmctl_eval_loss": MetricSpec(GAUGE, "Eval loss"),
+    "llmctl_hbm_used_gb": MetricSpec(GAUGE, "HBM in use", ("device",)),
+    "llmctl_cpu_percent": MetricSpec(GAUGE, "Host CPU percent"),
+    "llmctl_mem_percent": MetricSpec(GAUGE, "Host memory percent"),
+    # -- single-server inference ------------------------------------------
+    "llmctl_inference_requests_total": MetricSpec(
+        COUNTER, "Completed inference requests"),
+    "llmctl_inference_latency_seconds": MetricSpec(
+        HISTOGRAM, "Request latency",
+        buckets=(.01, .025, .05, .1, .2, .5, 1, 2, 5, 10)),
+    "llmctl_inference_ttft_seconds": MetricSpec(
+        HISTOGRAM, "Time to first token",
+        buckets=(.01, .025, .05, .1, .15, .2, .3, .5, 1, 2)),
+    "llmctl_inference_queue_depth": MetricSpec(GAUGE, "Queued requests"),
+    "llmctl_decode_tokens_per_sec": MetricSpec(
+        GAUGE, "Decode throughput"),
+    "llmctl_inference_preemptions": MetricSpec(COUNTER, "KV preemptions"),
+    "llmctl_inference_swap_ins": MetricSpec(COUNTER, "Swap-in restores"),
+    "llmctl_inference_swapped_host_bytes": MetricSpec(
+        GAUGE, "Host bytes held by swapped-out KV"),
+    # -- fleet control plane ----------------------------------------------
+    "llmctl_fleet_replica_queue_depth": MetricSpec(
+        GAUGE, "Queued requests per replica", ("replica",)),
+    "llmctl_fleet_replica_outstanding_tokens": MetricSpec(
+        GAUGE, "Tokens of work owed per replica (routing load signal)",
+        ("replica",)),
+    "llmctl_fleet_replica_active": MetricSpec(
+        GAUGE, "Resident (decoding) requests per replica", ("replica",)),
+    "llmctl_fleet_replica_healthy": MetricSpec(
+        GAUGE, "1 while the replica accepts traffic", ("replica",)),
+    "llmctl_fleet_replica_restarts": MetricSpec(
+        COUNTER, "Supervisor restarts per replica", ("replica",)),
+    "llmctl_fleet_requeues": MetricSpec(
+        COUNTER, "Requests rerouted off a crashed or drained replica"),
+    "llmctl_fleet_rejected": MetricSpec(
+        COUNTER, "Requests refused with 429 + Retry-After"),
+    # -- KV migration plane -----------------------------------------------
+    "llmctl_fleet_migrations": MetricSpec(
+        COUNTER, "Sequences moved between replicas with their KV pages"),
+    "llmctl_fleet_migrated_tokens": MetricSpec(
+        COUNTER, "KV entries (tokens) moved by cross-replica migration"),
+    "llmctl_fleet_reprefill_tokens_avoided": MetricSpec(
+        COUNTER, "Prefill tokens NOT recomputed thanks to KV migration "
+                 "and warm-prefix orphan requeue"),
+    "llmctl_fleet_migration_pause_ms": MetricSpec(
+        HISTOGRAM, "Stop-and-copy pause per migration (ms; the "
+                   "two-phase copy's stop phase only)",
+        buckets=_LAT_BUCKETS),
+    "llmctl_fleet_replica_prefix_hit_rate": MetricSpec(
+        GAUGE, "Prefix-cache page hit rate per replica (affinity-ring "
+               "payoff)", ("replica",)),
+    # -- disaggregated prefill/decode plane -------------------------------
+    "llmctl_fleet_handoffs": MetricSpec(
+        COUNTER, "Prefill->decode KV handoffs (disaggregated serving)"),
+    "llmctl_fleet_handoff_stall_ms": MetricSpec(
+        HISTOGRAM, "Per-handoff stall (one-phase KV extract + "
+                   "placement, ms)", buckets=_LAT_BUCKETS),
+    "llmctl_fleet_replica_role": MetricSpec(
+        GAUGE, "Replica role (0=mixed, 1=prefill, 2=decode)",
+        ("replica",)),
+    # -- courier transport plane ------------------------------------------
+    "llmctl_fleet_courier_chunks": MetricSpec(
+        COUNTER, "Courier chunk send attempts (incl. retransmissions)"),
+    "llmctl_fleet_courier_retries": MetricSpec(
+        COUNTER, "Courier chunk retransmissions (lost, late, or "
+                 "corrupt)"),
+    "llmctl_fleet_courier_corruptions": MetricSpec(
+        COUNTER, "Courier chunks rejected by CRC32 at the receiver"),
+    "llmctl_fleet_courier_resumes": MetricSpec(
+        COUNTER, "Courier resend rounds (only missing chunks resent)"),
+    "llmctl_fleet_courier_aborts": MetricSpec(
+        COUNTER, "Courier transfers that exhausted their retry budget "
+                 "(payload dropped; destination re-prefilled)"),
+    "llmctl_fleet_courier_wire_bytes": MetricSpec(
+        COUNTER, "Courier bytes actually sent on the wire (post-codec, "
+                 "retransmits included)"),
+    "llmctl_fleet_courier_raw_bytes": MetricSpec(
+        COUNTER, "Raw payload bytes the sent courier chunks covered "
+                 "(pre-codec; raw/wire = effective compression ratio)"),
+    "llmctl_fleet_courier_expired": MetricSpec(
+        COUNTER, "Courier tickets evicted by TTL before being claimed "
+                 "(abandoned reassembly buffers and unattached "
+                 "payloads)"),
+    "llmctl_fleet_courier_transfer_ms": MetricSpec(
+        HISTOGRAM, "End-to-end courier transfer time per payload (ms)",
+        buckets=_XFER_BUCKETS),
+    # -- fleet-global prefix cache ----------------------------------------
+    "llmctl_fleet_prefix_fetch_pages": MetricSpec(
+        COUNTER, "Prefix pages fetched from another replica's cache "
+                 "instead of re-prefilled"),
+    "llmctl_fleet_prefix_fetch_bytes": MetricSpec(
+        COUNTER, "Host bytes of fetched prefix pages moved over the "
+                 "courier"),
+    "llmctl_fleet_prefix_fetch_misses": MetricSpec(
+        COUNTER, "Prefix fetches that found nothing at the owner "
+                 "(evicted since advertised / stale hint) — degraded "
+                 "to plain prefill"),
+    "llmctl_fleet_prefix_fetch_aborts": MetricSpec(
+        COUNTER, "Prefix fetches whose courier transfer failed — "
+                 "degraded to plain prefill"),
+    "llmctl_fleet_prefix_fetch_ms": MetricSpec(
+        HISTOGRAM, "End-to-end prefix fetch time per attempt (ms; hint "
+                   "-> pages imported or degraded)",
+        buckets=_XFER_BUCKETS),
+    "llmctl_fleet_prefix_inventory_cache_hits": MetricSpec(
+        COUNTER, "Placements whose prefix-owner hints used the "
+                 "TTL-cached inventory map"),
+    "llmctl_fleet_prefix_inventory_cache_misses": MetricSpec(
+        COUNTER, "Placements that re-read every replica's prefix "
+                 "inventory (cache cold, expired, or invalidated)"),
+    # -- fleet SSE streaming plane ----------------------------------------
+    "llmctl_fleet_stream_active": MetricSpec(
+        GAUGE, "Live SSE streams fleet-wide"),
+    "llmctl_fleet_stream_tokens": MetricSpec(
+        COUNTER, "Tokens accepted into fleet stream logs (seq-deduped)"),
+    "llmctl_fleet_stream_duplicates": MetricSpec(
+        COUNTER, "Producer token re-sends suppressed by sequence number "
+                 "(re-placement resume replay; never client-visible)"),
+    "llmctl_fleet_stream_replayed_tokens": MetricSpec(
+        COUNTER, "Tokens replayed to reconnecting SSE clients "
+                 "(Last-Event-ID tail)"),
+    "llmctl_fleet_stream_reconnects": MetricSpec(
+        COUNTER, "SSE reconnects served from the stream log"),
+    "llmctl_fleet_stream_gaps_healed": MetricSpec(
+        COUNTER, "Stream-log tokens recovered from the request's own "
+                 "token list (publish callbacks lost to a crash "
+                 "window)"),
+    "llmctl_fleet_stream_backpressure_drops": MetricSpec(
+        COUNTER, "SSE subscribers disconnected for exceeding the "
+                 "per-subscriber buffered-batch cap "
+                 "(stream_max_buffered_batches); the client replays "
+                 "via Last-Event-ID"),
+    "llmctl_fleet_stream_replay_tokens": MetricSpec(
+        HISTOGRAM, "Tokens replayed per SSE reconnect (Last-Event-ID "
+                   "tail size)",
+        buckets=(1, 2, 5, 10, 25, 50, 100, 250, 1000)),
+    # -- speculative decode plane -----------------------------------------
+    "llmctl_fleet_spec_dispatches": MetricSpec(
+        COUNTER, "Fused speculative verify+decode dispatches "
+                 "fleet-wide"),
+    "llmctl_fleet_spec_drafts": MetricSpec(
+        COUNTER, "Draft tokens proposed within adaptive windows "
+                 "fleet-wide"),
+    "llmctl_fleet_spec_accepted": MetricSpec(
+        COUNTER, "Draft tokens verified/accepted by the device "
+                 "fleet-wide"),
+    "llmctl_fleet_spec_resumes": MetricSpec(
+        COUNTER, "Slots armed from a MIGRATED SpecState (tuned window "
+                 "kept across migration / prefill->decode handoff)"),
+}
+
+
+def scraped_name(name: str) -> str:
+    """The sample base name Prometheus scrapes expose: counters gain a
+    ``_total`` suffix (prometheus_client strips any declared one first,
+    so registry names may or may not carry it)."""
+    spec = METRICS[name]
+    if spec.kind == COUNTER:
+        base = name[:-len("_total")] if name.endswith("_total") else name
+        return base + "_total"
+    return name
+
+
+def fleet_metric_names() -> list[str]:
+    return [n for n in METRICS if n.startswith("llmctl_fleet_")]
+
+
+class CounterFlow(NamedTuple):
+    """One running counter's declared wiring: the attribute on its
+    owning class, the key it must appear under in that class's
+    snapshot/stats source, and the registered Prometheus name it
+    ultimately feeds (None = deliberately process-local: exposed via
+    /v1/stats, bench ledgers, and dryrun assertions but not scraped)."""
+    owner: str           # class name ("InferenceEngine", ...)
+    attr: str            # "total_*" attribute
+    snapshot_key: str    # string key in the owner's snapshot function
+    metric: Optional[str]
+
+
+# Snapshot functions per owner (the counter-wiring pass scans these):
+#   InferenceEngine.stats            (serve/engine.py)
+#   ReplicaSupervisor.snapshot       (serve/fleet/supervisor.py)
+COUNTER_SNAPSHOT_FN = {
+    "InferenceEngine": ("serve/engine.py", "stats"),
+    "ReplicaSupervisor": ("serve/fleet/supervisor.py", "snapshot"),
+}
+
+COUNTER_FLOW: tuple[CounterFlow, ...] = (
+    # engine counters -> InferenceEngine.stats() keys
+    CounterFlow("InferenceEngine", "total_preemptions", "preemptions",
+                "llmctl_inference_preemptions"),
+    CounterFlow("InferenceEngine", "total_swap_ins", "swap_ins",
+                "llmctl_inference_swap_ins"),
+    CounterFlow("InferenceEngine", "total_decode_steps", "decode_steps",
+                None),
+    CounterFlow("InferenceEngine", "total_short_dispatches",
+                "short_dispatches", None),
+    CounterFlow("InferenceEngine", "total_prefill_tokens",
+                "prefill_tokens", None),
+    CounterFlow("InferenceEngine", "total_prefix_cached_tokens",
+                "prefix_cached_tokens", None),
+    # feeds reprefill_tokens_avoided through the supervisor snapshot's
+    # migration section (replica.prefix_cache_stats -> requeue_cached)
+    CounterFlow("InferenceEngine", "total_requeue_cached_tokens",
+                "requeue_cached_tokens",
+                "llmctl_fleet_reprefill_tokens_avoided"),
+    CounterFlow("InferenceEngine", "total_prefix_fetched_tokens",
+                "prefix_fetched_tokens", None),
+    CounterFlow("InferenceEngine", "total_salvage_tail_fetched_tokens",
+                "salvage_tail_fetched_tokens", None),
+    CounterFlow("InferenceEngine", "total_unexpected_prefills",
+                "unexpected_prefills", None),
+    CounterFlow("InferenceEngine", "total_partial_restores",
+                "partial_restores", None),
+    CounterFlow("InferenceEngine", "total_padded_slot_steps",
+                "padded_slot_steps", None),
+    CounterFlow("InferenceEngine", "total_spec_dispatches",
+                "spec_dispatches", "llmctl_fleet_spec_dispatches"),
+    CounterFlow("InferenceEngine", "total_spec_drafts", "spec_drafts",
+                "llmctl_fleet_spec_drafts"),
+    CounterFlow("InferenceEngine", "total_spec_accepted",
+                "spec_accepted", "llmctl_fleet_spec_accepted"),
+    CounterFlow("InferenceEngine", "total_spec_resumes", "spec_resumes",
+                "llmctl_fleet_spec_resumes"),
+    # supervisor counters -> ReplicaSupervisor.snapshot() keys
+    # (per-replica restarts ride llmctl_fleet_replica_restarts; the
+    # fleet-wide totals below are status-surface only)
+    CounterFlow("ReplicaSupervisor", "total_restarts", "restarts", None),
+    CounterFlow("ReplicaSupervisor", "total_rebalance_migrations",
+                "rebalance_migrations", None),
+    CounterFlow("ReplicaSupervisor", "total_reroles", "reroles", None),
+    CounterFlow("ReplicaSupervisor", "total_role_promotions",
+                "promotions", None),
+    CounterFlow("ReplicaSupervisor", "total_role_demotions", "demotions",
+                None),
+)

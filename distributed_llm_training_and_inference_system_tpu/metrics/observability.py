@@ -151,231 +151,122 @@ class PrometheusExporter:
     def __init__(self, port: int = 9100):
         from prometheus_client import (Counter, Gauge, Histogram,
                                        start_http_server)
+
+        from .names import COUNTER, GAUGE, HISTOGRAM, METRICS
         self.port = port
         self._start_http_server = start_http_server
-        g, c, h = Gauge, Counter, Histogram
-        self.train_loss = g("llmctl_train_loss", "Training loss")
-        self.train_mfu = g("llmctl_train_mfu", "Model FLOPs utilisation")
-        self.tokens_per_sec = g("llmctl_train_tokens_per_sec", "Global tokens/s")
-        self.tokens_per_sec_chip = g("llmctl_train_tokens_per_sec_per_chip",
-                                     "Tokens/s per chip")
-        self.grad_norm = g("llmctl_train_grad_norm", "Gradient global norm")
-        self.lr = g("llmctl_train_lr", "Learning rate")
-        self.steps = g("llmctl_train_step", "Current optimizer step")
-        self.eval_loss = g("llmctl_eval_loss", "Eval loss")
-        self.hbm_used = g("llmctl_hbm_used_gb", "HBM in use", ["device"])
-        self.cpu = g("llmctl_cpu_percent", "Host CPU percent")
-        self.mem = g("llmctl_mem_percent", "Host memory percent")
-        self.infer_requests = c("llmctl_inference_requests_total",
-                                "Completed inference requests")
-        self.infer_latency = h("llmctl_inference_latency_seconds",
-                               "Request latency",
-                               buckets=(.01, .025, .05, .1, .2, .5, 1, 2, 5, 10))
-        self.infer_ttft = h("llmctl_inference_ttft_seconds",
-                            "Time to first token",
-                            buckets=(.01, .025, .05, .1, .15, .2, .3, .5, 1, 2))
-        self.infer_queue = g("llmctl_inference_queue_depth", "Queued requests")
-        self.decode_tokens_per_sec = g("llmctl_decode_tokens_per_sec",
-                                       "Decode throughput")
+        classes = {GAUGE: Gauge, COUNTER: Counter, HISTOGRAM: Histogram}
+
+        def mk(name: str):
+            # every metric is DECLARED in metrics/names.py (kind, help,
+            # labels, buckets) and CONSTRUCTED here by name — graftlint's
+            # counter-wiring pass cross-checks both directions, so a
+            # registry entry without a constructor line (or vice versa)
+            # fails lint instead of silently dropping a scrape series
+            spec = METRICS[name]
+            kwargs = {"labelnames": list(spec.labels)}
+            if spec.buckets is not None:
+                kwargs["buckets"] = spec.buckets
+            return classes[spec.kind](name, spec.help, **kwargs)
+
+        self.train_loss = mk("llmctl_train_loss")
+        self.train_mfu = mk("llmctl_train_mfu")
+        self.tokens_per_sec = mk("llmctl_train_tokens_per_sec")
+        self.tokens_per_sec_chip = mk("llmctl_train_tokens_per_sec_per_chip")
+        self.grad_norm = mk("llmctl_train_grad_norm")
+        self.lr = mk("llmctl_train_lr")
+        self.steps = mk("llmctl_train_step")
+        self.eval_loss = mk("llmctl_eval_loss")
+        self.hbm_used = mk("llmctl_hbm_used_gb")
+        self.cpu = mk("llmctl_cpu_percent")
+        self.mem = mk("llmctl_mem_percent")
+        self.infer_requests = mk("llmctl_inference_requests_total")
+        self.infer_latency = mk("llmctl_inference_latency_seconds")
+        self.infer_ttft = mk("llmctl_inference_ttft_seconds")
+        self.infer_queue = mk("llmctl_inference_queue_depth")
+        self.decode_tokens_per_sec = mk("llmctl_decode_tokens_per_sec")
         # on-demand admission telemetry (round 3): preemption pressure and
         # swap-in counts are the KV-capacity health signals. Cumulative
         # counts are COUNTERS (prometheus appends _total; rate() works);
         # the engine reports running totals, so export_inference incs the
         # delta since the last report
-        self.infer_preemptions = c("llmctl_inference_preemptions",
-                                   "KV preemptions")
-        self.infer_swap_ins = c("llmctl_inference_swap_ins",
-                                "Swap-in restores")
-        self.infer_swapped_bytes = g("llmctl_inference_swapped_host_bytes",
-                                     "Host bytes held by swapped-out KV")
+        self.infer_preemptions = mk("llmctl_inference_preemptions")
+        self.infer_swap_ins = mk("llmctl_inference_swap_ins")
+        self.infer_swapped_bytes = mk("llmctl_inference_swapped_host_bytes")
         # serve-fleet control plane (serve/fleet/): per-replica health the
         # operator alarms on. Queue depth + outstanding tokens are the
         # routing signals themselves; restarts/requeues/rejections are the
         # failure-path counters the fault-injection tests exercise.
-        self.fleet_queue_depth = g("llmctl_fleet_replica_queue_depth",
-                                   "Queued requests per replica",
-                                   ["replica"])
-        self.fleet_outstanding = g(
-            "llmctl_fleet_replica_outstanding_tokens",
-            "Tokens of work owed per replica (routing load signal)",
-            ["replica"])
-        self.fleet_active = g("llmctl_fleet_replica_active",
-                              "Resident (decoding) requests per replica",
-                              ["replica"])
-        self.fleet_healthy = g("llmctl_fleet_replica_healthy",
-                               "1 while the replica accepts traffic",
-                               ["replica"])
-        self.fleet_restarts = c("llmctl_fleet_replica_restarts",
-                                "Supervisor restarts per replica",
-                                ["replica"])
-        self.fleet_requeues = c("llmctl_fleet_requeues",
-                                "Requests rerouted off a crashed or "
-                                "drained replica")
-        self.fleet_rejected = c("llmctl_fleet_rejected",
-                                "Requests refused with 429 + Retry-After")
+        self.fleet_queue_depth = mk("llmctl_fleet_replica_queue_depth")
+        self.fleet_outstanding = mk(
+            "llmctl_fleet_replica_outstanding_tokens")
+        self.fleet_active = mk("llmctl_fleet_replica_active")
+        self.fleet_healthy = mk("llmctl_fleet_replica_healthy")
+        self.fleet_restarts = mk("llmctl_fleet_replica_restarts")
+        self.fleet_requeues = mk("llmctl_fleet_requeues")
+        self.fleet_rejected = mk("llmctl_fleet_rejected")
         # KV migration plane (serve/fleet/migration.py): how much work
         # moved between replicas and what it saved vs re-prefill
-        self.fleet_migrations = c(
-            "llmctl_fleet_migrations",
-            "Sequences moved between replicas with their KV pages")
-        self.fleet_migrated_tokens = c(
-            "llmctl_fleet_migrated_tokens",
-            "KV entries (tokens) moved by cross-replica migration")
-        self.fleet_reprefill_avoided = c(
-            "llmctl_fleet_reprefill_tokens_avoided",
-            "Prefill tokens NOT recomputed thanks to KV migration and "
-            "warm-prefix orphan requeue")
-        self.fleet_migration_pause = h(
-            "llmctl_fleet_migration_pause_ms",
-            "Stop-and-copy pause per migration (ms; the two-phase copy's "
-            "stop phase only)",
-            buckets=(.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 1000))
-        self.fleet_prefix_hit_rate = g(
-            "llmctl_fleet_replica_prefix_hit_rate",
-            "Prefix-cache page hit rate per replica (affinity-ring payoff)",
-            ["replica"])
-        # disaggregated prefill/decode plane (serve/fleet/ roles): how
-        # many sequences crossed the prefill->decode seam, what each
-        # crossing stalled the stream, and which role every replica
-        # currently plays (the balancer / promotion moves show up here)
-        self.fleet_handoffs = c(
-            "llmctl_fleet_handoffs",
-            "Prefill->decode KV handoffs (disaggregated serving)")
-        self.fleet_handoff_stall = h(
-            "llmctl_fleet_handoff_stall_ms",
-            "Per-handoff stall (one-phase KV extract + placement, ms)",
-            buckets=(.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 1000))
-        self.fleet_replica_role = g(
-            "llmctl_fleet_replica_role",
-            "Replica role (0=mixed, 1=prefill, 2=decode)", ["replica"])
-        # courier transport plane (serve/fleet/transport.py): how hard
-        # the KV link is working and how often it fails. Retries /
-        # corruptions / resumes are the lossy-link health signals;
-        # aborts count transfers that degraded to re-prefill.
-        self.fleet_courier_chunks = c(
-            "llmctl_fleet_courier_chunks",
-            "Courier chunk send attempts (incl. retransmissions)")
-        self.fleet_courier_retries = c(
-            "llmctl_fleet_courier_retries",
-            "Courier chunk retransmissions (lost, late, or corrupt)")
-        self.fleet_courier_corruptions = c(
-            "llmctl_fleet_courier_corruptions",
-            "Courier chunks rejected by CRC32 at the receiver")
-        self.fleet_courier_resumes = c(
-            "llmctl_fleet_courier_resumes",
-            "Courier resend rounds (only missing chunks resent)")
-        self.fleet_courier_aborts = c(
-            "llmctl_fleet_courier_aborts",
-            "Courier transfers that exhausted their retry budget "
-            "(payload dropped; destination re-prefilled)")
-        self.fleet_courier_wire_bytes = c(
-            "llmctl_fleet_courier_wire_bytes",
-            "Courier bytes actually sent on the wire (post-codec, "
-            "retransmits included)")
-        self.fleet_courier_raw_bytes = c(
-            "llmctl_fleet_courier_raw_bytes",
-            "Raw payload bytes the sent courier chunks covered "
-            "(pre-codec; raw/wire = effective compression ratio)")
-        self.fleet_courier_expired = c(
-            "llmctl_fleet_courier_expired",
-            "Courier tickets evicted by TTL before being claimed "
-            "(abandoned reassembly buffers and unattached payloads)")
-        self.fleet_courier_transfer = h(
-            "llmctl_fleet_courier_transfer_ms",
-            "End-to-end courier transfer time per payload (ms)",
-            buckets=(.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 1000, 5000))
-        # fleet-global prefix cache (serve/fleet/ prefix fetch): pages
-        # pulled from a sibling replica's cache instead of re-prefilled,
-        # plus the attempts that degraded to plain prefill (misses:
-        # owner had nothing; aborts: the transfer failed)
-        self.fleet_prefix_fetch_pages = c(
-            "llmctl_fleet_prefix_fetch_pages",
-            "Prefix pages fetched from another replica's cache instead "
-            "of re-prefilled")
-        self.fleet_prefix_fetch_bytes = c(
-            "llmctl_fleet_prefix_fetch_bytes",
-            "Host bytes of fetched prefix pages moved over the courier")
-        self.fleet_prefix_fetch_misses = c(
-            "llmctl_fleet_prefix_fetch_misses",
-            "Prefix fetches that found nothing at the owner (evicted "
-            "since advertised / stale hint) — degraded to plain prefill")
-        self.fleet_prefix_fetch_aborts = c(
-            "llmctl_fleet_prefix_fetch_aborts",
-            "Prefix fetches whose courier transfer failed — degraded to "
-            "plain prefill")
-        self.fleet_prefix_fetch = h(
-            "llmctl_fleet_prefix_fetch_ms",
-            "End-to-end prefix fetch time per attempt (ms; hint -> "
-            "pages imported or degraded)",
-            buckets=(.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 1000, 5000))
-        # inventory TTL cache (FleetConfig.prefix_inventory_ttl_ms):
-        # placements served from the cached per-replica inventory map vs
-        # fresh fleet-wide reads
-        self.fleet_inventory_cache_hits = c(
-            "llmctl_fleet_prefix_inventory_cache_hits",
-            "Placements whose prefix-owner hints used the TTL-cached "
-            "inventory map")
-        self.fleet_inventory_cache_misses = c(
-            "llmctl_fleet_prefix_inventory_cache_misses",
-            "Placements that re-read every replica's prefix inventory "
-            "(cache cold, expired, or invalidated)")
+        self.fleet_migrations = mk("llmctl_fleet_migrations")
+        self.fleet_migrated_tokens = mk("llmctl_fleet_migrated_tokens")
+        self.fleet_reprefill_avoided = mk(
+            "llmctl_fleet_reprefill_tokens_avoided")
+        self.fleet_migration_pause = mk("llmctl_fleet_migration_pause_ms")
+        self.fleet_prefix_hit_rate = mk(
+            "llmctl_fleet_replica_prefix_hit_rate")
+        # disaggregated prefill/decode plane (serve/fleet/ roles)
+        self.fleet_handoffs = mk("llmctl_fleet_handoffs")
+        self.fleet_handoff_stall = mk("llmctl_fleet_handoff_stall_ms")
+        self.fleet_replica_role = mk("llmctl_fleet_replica_role")
+        # courier transport plane (serve/fleet/transport.py)
+        self.fleet_courier_chunks = mk("llmctl_fleet_courier_chunks")
+        self.fleet_courier_retries = mk("llmctl_fleet_courier_retries")
+        self.fleet_courier_corruptions = mk(
+            "llmctl_fleet_courier_corruptions")
+        self.fleet_courier_resumes = mk("llmctl_fleet_courier_resumes")
+        self.fleet_courier_aborts = mk("llmctl_fleet_courier_aborts")
+        self.fleet_courier_wire_bytes = mk(
+            "llmctl_fleet_courier_wire_bytes")
+        self.fleet_courier_raw_bytes = mk(
+            "llmctl_fleet_courier_raw_bytes")
+        self.fleet_courier_expired = mk("llmctl_fleet_courier_expired")
+        self.fleet_courier_transfer = mk(
+            "llmctl_fleet_courier_transfer_ms")
+        # fleet-global prefix cache (serve/fleet/ prefix fetch)
+        self.fleet_prefix_fetch_pages = mk(
+            "llmctl_fleet_prefix_fetch_pages")
+        self.fleet_prefix_fetch_bytes = mk(
+            "llmctl_fleet_prefix_fetch_bytes")
+        self.fleet_prefix_fetch_misses = mk(
+            "llmctl_fleet_prefix_fetch_misses")
+        self.fleet_prefix_fetch_aborts = mk(
+            "llmctl_fleet_prefix_fetch_aborts")
+        self.fleet_prefix_fetch = mk("llmctl_fleet_prefix_fetch_ms")
+        # inventory TTL cache (FleetConfig.prefix_inventory_ttl_ms)
+        self.fleet_inventory_cache_hits = mk(
+            "llmctl_fleet_prefix_inventory_cache_hits")
+        self.fleet_inventory_cache_misses = mk(
+            "llmctl_fleet_prefix_inventory_cache_misses")
         # fleet SSE streaming (serve/fleet/streams.py): the exactly-once
-        # delivery ledger. Duplicates are producer re-sends suppressed
-        # by sequence number (migration/SIGKILL resume replay — client-
-        # invisible); replayed tokens are the reconnect tails re-sent on
-        # Last-Event-ID resumes; gaps healed count tokens recovered from
-        # the request's own list after an eaten publish callback.
-        self.fleet_stream_active = g(
-            "llmctl_fleet_stream_active",
-            "Live SSE streams fleet-wide")
-        self.fleet_stream_tokens = c(
-            "llmctl_fleet_stream_tokens",
-            "Tokens accepted into fleet stream logs (seq-deduped)")
-        self.fleet_stream_duplicates = c(
-            "llmctl_fleet_stream_duplicates",
-            "Producer token re-sends suppressed by sequence number "
-            "(re-placement resume replay; never client-visible)")
-        self.fleet_stream_replayed = c(
-            "llmctl_fleet_stream_replayed_tokens",
-            "Tokens replayed to reconnecting SSE clients "
-            "(Last-Event-ID tail)")
-        self.fleet_stream_reconnects = c(
-            "llmctl_fleet_stream_reconnects",
-            "SSE reconnects served from the stream log")
-        self.fleet_stream_gaps_healed = c(
-            "llmctl_fleet_stream_gaps_healed",
-            "Stream-log tokens recovered from the request's own token "
-            "list (publish callbacks lost to a crash window)")
-        self.fleet_stream_backpressure_drops = c(
-            "llmctl_fleet_stream_backpressure_drops",
-            "SSE subscribers disconnected for exceeding the "
-            "per-subscriber buffered-batch cap "
-            "(stream_max_buffered_batches); the client replays via "
-            "Last-Event-ID")
-        self.fleet_stream_replay = h(
-            "llmctl_fleet_stream_replay_tokens",
-            "Tokens replayed per SSE reconnect (Last-Event-ID tail "
-            "size)",
-            buckets=(1, 2, 5, 10, 25, 50, 100, 250, 1000))
-        # speculative decode plane (serve/speculative.py SpecState):
-        # fleet-wide acceptance economics. Dispatches/drafts/accepted
-        # give the acceptance rate the adaptive window tunes against;
-        # resumes count sequences re-placed WITH a migrated SpecState
-        # (courier-aware speculation — a handed-off sequence keeps its
-        # tuned window instead of cold-starting the proposer).
-        self.fleet_spec_dispatches = c(
-            "llmctl_fleet_spec_dispatches",
-            "Fused speculative verify+decode dispatches fleet-wide")
-        self.fleet_spec_drafts = c(
-            "llmctl_fleet_spec_drafts",
-            "Draft tokens proposed within adaptive windows fleet-wide")
-        self.fleet_spec_accepted = c(
-            "llmctl_fleet_spec_accepted",
-            "Draft tokens verified/accepted by the device fleet-wide")
-        self.fleet_spec_resumes = c(
-            "llmctl_fleet_spec_resumes",
-            "Slots armed from a MIGRATED SpecState (tuned window kept "
-            "across migration / prefill->decode handoff)")
+        # delivery ledger
+        self.fleet_stream_active = mk("llmctl_fleet_stream_active")
+        self.fleet_stream_tokens = mk("llmctl_fleet_stream_tokens")
+        self.fleet_stream_duplicates = mk(
+            "llmctl_fleet_stream_duplicates")
+        self.fleet_stream_replayed = mk(
+            "llmctl_fleet_stream_replayed_tokens")
+        self.fleet_stream_reconnects = mk(
+            "llmctl_fleet_stream_reconnects")
+        self.fleet_stream_gaps_healed = mk(
+            "llmctl_fleet_stream_gaps_healed")
+        self.fleet_stream_backpressure_drops = mk(
+            "llmctl_fleet_stream_backpressure_drops")
+        self.fleet_stream_replay = mk("llmctl_fleet_stream_replay_tokens")
+        # speculative decode plane (serve/speculative.py SpecState)
+        self.fleet_spec_dispatches = mk("llmctl_fleet_spec_dispatches")
+        self.fleet_spec_drafts = mk("llmctl_fleet_spec_drafts")
+        self.fleet_spec_accepted = mk("llmctl_fleet_spec_accepted")
+        self.fleet_spec_resumes = mk("llmctl_fleet_spec_resumes")
         self._last_totals: dict[str, float] = {}
         self._server_started = False
 

@@ -14,6 +14,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis.annotations import np_host_only, np_twin_of
+
 
 def quantize_int8_rows(x: jax.Array) -> tuple[jax.Array, jax.Array]:
     """Symmetric absmax int8 over the LAST axis, scale WITHOUT keepdims:
@@ -182,6 +184,9 @@ def dequantize_int4_blockwise(packed: jax.Array, scale: jax.Array,
 # CRC over the RAW bytes still proves correctness after the inverse.
 
 
+@np_host_only("token-axis delta filter exists only in the courier wire "
+              "codec (host-side); the device never sees delta-coded "
+              "planes")
 def delta_encode_planes_np(a: np.ndarray, axis: int = -2) -> np.ndarray:
     """Mod-256 first-difference along ``axis`` (the page-slot axis of an
     int8 KV plane [..., PS, D]): row i becomes row_i - row_{i-1}, row 0
@@ -198,6 +203,7 @@ def delta_encode_planes_np(a: np.ndarray, axis: int = -2) -> np.ndarray:
     return out.view(a.dtype)
 
 
+@np_host_only("inverse of the host-side courier delta filter")
 def delta_decode_planes_np(a: np.ndarray, axis: int = -2) -> np.ndarray:
     """Inverse of :func:`delta_encode_planes_np`: mod-256 prefix sum."""
     u = np.ascontiguousarray(a).view(np.uint8)
@@ -205,6 +211,7 @@ def delta_decode_planes_np(a: np.ndarray, axis: int = -2) -> np.ndarray:
     return out.view(a.dtype)
 
 
+@np_twin_of("unpack_int4_rows")
 def unpack_nibbles_np(packed: np.ndarray, axis: int = -2) -> np.ndarray:
     """uint8 bytes -> RAW nibbles (0..15, NO sign extension) interleaved
     along ``axis`` (count doubles) — the same 2i=low/2i+1=high layout as
@@ -219,6 +226,7 @@ def unpack_nibbles_np(packed: np.ndarray, axis: int = -2) -> np.ndarray:
     return q.reshape(shape)
 
 
+@np_twin_of("pack_int4_rows")
 def pack_nibbles_np(q: np.ndarray, axis: int = -2) -> np.ndarray:
     """Inverse of :func:`unpack_nibbles_np` (element 2i -> low nibble,
     2i+1 -> high nibble of byte i; the :func:`pack_int4_rows` layout)."""
@@ -232,6 +240,8 @@ def pack_nibbles_np(q: np.ndarray, axis: int = -2) -> np.ndarray:
     return (lo | (hi << 4)).astype(np.uint8)
 
 
+@np_host_only("mod-16 nibble delta filter exists only in the courier "
+              "wire codec (host-side)")
 def nibble_delta_encode_np(packed: np.ndarray,
                            axis: int = -2) -> np.ndarray:
     """Mod-16 first-difference over the UNPACKED nibble stream of a
@@ -250,6 +260,7 @@ def nibble_delta_encode_np(packed: np.ndarray,
     return pack_nibbles_np(out, axis)
 
 
+@np_host_only("inverse of the host-side mod-16 nibble delta filter")
 def nibble_delta_decode_np(packed: np.ndarray,
                            axis: int = -2) -> np.ndarray:
     """Inverse of :func:`nibble_delta_encode_np`: mod-16 prefix sum over

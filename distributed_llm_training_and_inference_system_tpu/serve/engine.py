@@ -43,6 +43,7 @@ from .kv_cache import PagedKVCache
 from .sampling import sample_tokens
 from .scheduler import (ContinuousBatchingScheduler, Request, RequestState,
                         SamplingParams)
+from ..analysis.annotations import engine_thread_only
 
 logger = logging.getLogger("llmctl.serve.engine")
 
@@ -653,6 +654,7 @@ class InferenceEngine:
                 extend_chunk, donate_argnums=(4, 5))
         return self._prefill_cache[key_]
 
+    @engine_thread_only
     def _maybe_fetch_prefix(self, req: Request) -> None:
         """Fleet-global prefix fetch (engine thread, called right before
         a prefill, NO lock held across the network round trip): when the
@@ -732,6 +734,7 @@ class InferenceEngine:
                     "tokens) from replica %s", rid, len(inserted),
                     tokens, getattr(req, "prefix_owner", None))
 
+    @engine_thread_only
     def _maybe_fetch_salvage_tail(self, req: Request) -> None:
         """Crash-salvaged PARTIAL payloads (migration pre-copies) used to
         re-prefill their whole uncovered tail even when a sibling's
@@ -792,6 +795,7 @@ class InferenceEngine:
             "%d -> %d page(s) from replica %s", req.request_id, covered,
             covered + k, getattr(req, "prefix_owner", None))
 
+    @engine_thread_only
     def _start_chunked_prefill(self, req: Request) -> None:
         """Allocate the slot's pages and enqueue the context for chunk-at-a-
         time prefill (one chunk per engine step, interleaved with decode)."""
@@ -828,6 +832,7 @@ class InferenceEngine:
             "req": req, "ctx": ctx, "done": cached, "pins": len(pins),
             "table_row": table_row, "slot_key": slot_key}
 
+    @engine_thread_only
     def _advance_chunked_prefills(self) -> list:
         """Advance in-flight chunked prefills, at most ``prefill_budget_
         tokens`` of prompt per engine step TOTAL (at least one chunk so a
@@ -899,6 +904,7 @@ class InferenceEngine:
             self.total_prefill_tokens += this
         return completed
 
+    @engine_thread_only
     def _prefill(self, req: Request):
         """Dispatch one prompt's prefill; returns (req, device token).
 
@@ -1031,6 +1037,7 @@ class InferenceEngine:
         self.total_prefill_tokens += computed
         return req, token
 
+    @engine_thread_only
     def _arm_slot(self, req: Request, last_token: int, n_written: int,
                   ctx: list) -> None:
         """Make a slot live for decode — the ONE place the per-slot decode
@@ -1074,6 +1081,7 @@ class InferenceEngine:
         else:
             self._spec_state[slot] = None
 
+    @engine_thread_only
     def _finish_prefill(self, req: Request, token) -> None:
         """Resolve a dispatched prefill: fetch its first token and make the
         slot live for decode."""
@@ -1129,6 +1137,7 @@ class InferenceEngine:
             len(head.context_tokens) + self._admission_tail(head))
         return need <= self.kv.free_pages - self._reserved_pages
 
+    @engine_thread_only
     def _decode_device(self, use_short: bool = False) -> np.ndarray:
         """Dispatch one decode GROUP and fetch its tokens.
 
@@ -1155,6 +1164,7 @@ class InferenceEngine:
                 jnp.asarray(self._slot_keys), jnp.asarray(self.temperature),
                 jnp.asarray(self.top_k), jnp.asarray(self.top_p))
 
+    @engine_thread_only
     def _submit_decode(self, chain_from=None, shared=None) -> dict:
         """Dispatch ONE decode unit WITHOUT fetching results.
 
@@ -1187,6 +1197,7 @@ class InferenceEngine:
             "active": self.active.copy(),
         }
 
+    @engine_thread_only
     def _submit_group(self, n_units: int, chain_from=None) -> dict:
         """Chain ``n_units`` unit dispatches; return a group record.
 
@@ -1208,6 +1219,7 @@ class InferenceEngine:
             "active": units[0]["active"],
         }
 
+    @engine_thread_only
     def _fetch_group(self, group: dict) -> np.ndarray:
         """One batched device->host fetch of a group's sampled tokens:
         [n_units * unit_len, B]. jax.device_get issues the per-unit
@@ -1220,6 +1232,7 @@ class InferenceEngine:
             self.serve_cfg.max_batch_size - group["active"].sum())
         return out
 
+    @engine_thread_only
     def _drain_pending(self) -> None:
         """Fetch + apply the in-flight pipelined dispatch group (if any)
         so the engine's host state catches up with the device before a
@@ -1235,6 +1248,7 @@ class InferenceEngine:
 
     # -- speculative decode --------------------------------------------------
 
+    @engine_thread_only
     def spec_state_of(self, slot: int) -> Optional[dict]:
         """The slot's SpecState as a plain-scalar dict (rides the
         migration/handoff payload manifest and the worker wire) — None
@@ -1265,6 +1279,7 @@ class InferenceEngine:
             w4_kernel_ok=self._w4_kernel_ok,
             w8_kernel_ok=self._w8_kernel_ok)
 
+    @engine_thread_only
     def _spec_device(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
         """One fused speculative dispatch: propose drafts on host (prompt-
         lookup over each slot's prompt+generated context), then verify +
@@ -1327,6 +1342,7 @@ class InferenceEngine:
             B - self.active.sum())
         return emitted, n_emit, decode_seq
 
+    @engine_thread_only
     def _apply_speculative(self, emitted: np.ndarray, n_emit: np.ndarray,
                            decode_seq: np.ndarray) -> None:
         """Host bookkeeping for one fused dispatch (under self.lock):
@@ -1370,6 +1386,7 @@ class InferenceEngine:
             if accepted and self.on_token is not None:
                 self.on_token(req, accepted)
 
+    @engine_thread_only
     def _apply_decode(self, sampled_seq: np.ndarray,
                       snapshot: Optional[dict] = None) -> None:
         """Host bookkeeping for K decode steps (called under self.lock).
@@ -1453,6 +1470,7 @@ class InferenceEngine:
                         total += part.nbytes
         return total
 
+    @engine_thread_only
     def _restore_swapped(self, req: Request) -> bool:
         """Swap-in (preemption=swap readmission): allocate pages, write the
         saved K/V back, and make the slot live for decode — NO prefill
@@ -1493,6 +1511,7 @@ class InferenceEngine:
         self.total_swap_ins += 1
         return True
 
+    @engine_thread_only
     def _preempt(self, slot: int) -> None:
         """Evict ``slot``'s RUNNING request (newest-first victim policy) so
         an older stream can grow its page chain. Recompute-style: the
@@ -1552,6 +1571,7 @@ class InferenceEngine:
         logger.info("preempted %s (slot %d, %d tokens generated) to free "
                     "KV pages", rid, slot, len(req.generated_tokens))
 
+    @engine_thread_only
     def _ensure_decode_capacity(self) -> None:
         """Grow every active slot's page chain to cover the next dispatch's
         writes (on-demand admission). Oldest slots grow first; when the
@@ -1606,6 +1626,7 @@ class InferenceEngine:
         if self.on_finish is not None:
             self.on_finish(req)
 
+    @engine_thread_only
     def step(self) -> int:
         """One engine iteration: admit+prefill, then one decode step for all
         running slots. Returns the number of active requests.

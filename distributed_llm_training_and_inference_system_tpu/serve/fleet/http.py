@@ -67,6 +67,7 @@ from ..tokenizer import load_tokenizer
 from . import ServeFleet
 from .faults import FaultPlan
 from .router import FleetSaturated
+from ...analysis.annotations import aiohttp_handler
 
 logger = logging.getLogger("llmctl.serve.fleet.http")
 
@@ -88,6 +89,7 @@ class FleetServer:
 
     # -- handlers ------------------------------------------------------------
 
+    @aiohttp_handler
     async def handle_completions(self, request: web.Request) -> web.Response:
         try:
             body = await request.json()
@@ -158,6 +160,7 @@ class FleetServer:
 
     # -- SSE streaming -------------------------------------------------------
 
+    @aiohttp_handler
     async def _stream_completion(self, http_req: web.Request,
                                  prompt_tokens, sampling):
         """`stream: true` path: admit through the stream hub and serve
@@ -174,6 +177,7 @@ class FleetServer:
         return await self._serve_stream(http_req, req.request_id,
                                         from_seq=0, resume=False)
 
+    @aiohttp_handler
     async def handle_stream_resume(self, request: web.Request):
         """``GET /v1/streams/{request_id}``: reconnect a dropped SSE
         stream. ``Last-Event-ID`` (header or ``?last_event_id=``) names
@@ -217,6 +221,7 @@ class FleetServer:
         return (f"id: {seq_last}\n"
                 f"data: {json.dumps(payload)}\n\n").encode()
 
+    @aiohttp_handler
     async def _serve_stream(self, http_req: web.Request, rid: str,
                             from_seq: int, resume: bool):
         """Serve one SSE connection off the stream hub: atomic
@@ -310,6 +315,7 @@ class FleetServer:
             self.fleet.streams.unsubscribe(rid, sub["sub"])
         return resp
 
+    @aiohttp_handler
     async def handle_models(self, request: web.Request) -> web.Response:
         return web.json_response({
             "object": "list",
@@ -318,6 +324,7 @@ class FleetServer:
                       "max_model_len": self.serve_cfg.max_seq_len}],
         })
 
+    @aiohttp_handler
     async def handle_health(self, request: web.Request) -> web.Response:
         snap = self.fleet.status()
         healthy = [r for r in snap["replicas"] if r["state"] == "healthy"]
@@ -333,19 +340,24 @@ class FleetServer:
              "router": snap["router"]},
             status=200 if healthy else 503)
 
+    @aiohttp_handler
     async def handle_stats(self, request: web.Request) -> web.Response:
         return web.json_response(self.fleet.status())
 
+    @aiohttp_handler
     async def handle_fleet_status(self, request: web.Request) -> web.Response:
         return web.json_response(self.fleet.status())
 
+    @aiohttp_handler
     async def handle_fleet_drain(self, request: web.Request) -> web.Response:
         return await self._drain_action(request, drain=True)
 
+    @aiohttp_handler
     async def handle_fleet_undrain(self, request: web.Request
                                    ) -> web.Response:
         return await self._drain_action(request, drain=False)
 
+    @aiohttp_handler
     async def _drain_action(self, request: web.Request,
                             drain: bool) -> web.Response:
         try:
@@ -363,6 +375,7 @@ class FleetServer:
                                   "action": "drain" if drain
                                   else "undrain"})
 
+    @aiohttp_handler
     async def handle_fleet_migrate(self, request: web.Request
                                    ) -> web.Response:
         try:
@@ -385,6 +398,7 @@ class FleetServer:
                                   "replica": replica,
                                   "action": "migrate"})
 
+    @aiohttp_handler
     async def handle_fleet_role(self, request: web.Request) -> web.Response:
         try:
             body = await request.json()
@@ -404,6 +418,7 @@ class FleetServer:
         return web.json_response({"ok": True, "replica": replica,
                                   "role": role, "action": "role"})
 
+    @aiohttp_handler
     async def handle_courier_chunk(self, request: web.Request
                                    ) -> web.Response:
         """One courier frame in; the reassembly ack out. Always HTTP 200
@@ -421,6 +436,7 @@ class FleetServer:
         return web.json_response(
             self.fleet.courier_receiver.add_chunk(chunk))
 
+    @aiohttp_handler
     async def handle_courier_fetch(self, request: web.Request
                                    ) -> web.Response:
         """Fleet-global prefix fetch, owner side (in-proc replicas): a
@@ -441,6 +457,7 @@ class FleetServer:
             None, self.fleet.serve_prefix_fetch, body)
         return web.json_response(out)
 
+    @aiohttp_handler
     async def handle_metrics(self, request: web.Request) -> web.Response:
         try:
             from prometheus_client import generate_latest

@@ -38,6 +38,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 import numpy as np
+from ...analysis.annotations import engine_thread_only
 
 
 @dataclass
@@ -76,6 +77,7 @@ def payload_nbytes(payload: Optional[dict]) -> int:
     return walk(payload)
 
 
+@engine_thread_only
 def handoff_slot(engine, slot: int) -> tuple[dict, dict]:
     """Post-prefill prefill->decode handoff: the degenerate ONE-phase
     migration. At prefill completion every written page is full and
@@ -89,6 +91,7 @@ def handoff_slot(engine, slot: int) -> tuple[dict, dict]:
                          {"pages": None, "full_pages": 0, "positions": pos})
 
 
+@engine_thread_only
 def precopy_slot(engine, slot: int) -> dict:
     """Phase 1: copy the slot's FULL pages to host. Caller is the engine
     thread at a step boundary (pipelined dispatch drained), holding
@@ -103,6 +106,7 @@ def precopy_slot(engine, slot: int) -> dict:
     }
 
 
+@engine_thread_only
 def stop_and_copy(engine, slot: int, pre: dict) -> tuple[dict, dict]:
     """Phase 2: freeze the sequence and copy only what phase 1 could not —
     pages [full_pages, pages(written)) — then merge into one

@@ -39,6 +39,7 @@ from .migration import MigrationTicket
 logger = logging.getLogger("llmctl.serve.fleet.replica")
 
 # replica lifecycle states
+from ...analysis.annotations import (engine_thread_only, thread_seam)
 STARTING = "starting"
 HEALTHY = "healthy"
 DRAINING = "draining"     # drain requested; engine thread not yet at boundary
@@ -206,6 +207,7 @@ class EngineReplica:
         self.engine.prefix_fetch_hook = (self._fetch_prefix
                                          if self._prefix_fetch else None)
 
+    @thread_seam
     def set_role(self, role: str) -> None:
         """Re-role this replica (balancer / operator). Takes effect for
         requests admitted from now on; residents finish where they are."""
@@ -224,6 +226,7 @@ class EngineReplica:
                 name=f"llmctl-fleet-replica-{self.replica_id}")
             self._thread.start()
 
+    @engine_thread_only
     def _loop(self) -> None:
         logger.info("replica %d engine thread started", self.replica_id)
         eng = self.engine
@@ -263,6 +266,7 @@ class EngineReplica:
                 return                      # thread dies, like a process
         logger.info("replica %d engine thread stopped", self.replica_id)
 
+    @engine_thread_only
     def _crash(self, exc: Exception) -> None:
         """Engine-thread death: stash every in-flight request as an orphan
         for the supervisor to reroute. No KV bookkeeping — this engine is
@@ -328,6 +332,7 @@ class EngineReplica:
             reset_for_requeue(r)
         return victims
 
+    @engine_thread_only
     def _drain_on_thread(self) -> None:
         """Graceful eviction, executed BY the engine thread between steps:
         catch up the pipelined dispatch, preempt every resident request
@@ -432,10 +437,12 @@ class EngineReplica:
         except Exception as e:           # drain hit a broken engine
             self._crash(e)
 
+    @engine_thread_only
     def _engine_finished(self, req: Request) -> None:
         if self.on_finish is not None:
             self.on_finish(self.replica_id, req)
 
+    @engine_thread_only
     def _engine_tokens(self, req: Request, tokens: list) -> None:
         """Engine on_token hook: forward a streaming request's fresh
         batch to the fleet stream plane. Non-streaming requests (and
@@ -446,6 +453,7 @@ class EngineReplica:
 
     # -- prefill->decode handoff (engine-thread half) ------------------------
 
+    @engine_thread_only
     def _on_prefill_complete(self, req: Request) -> None:
         """Engine prefill-complete hook (engine thread, no locks held):
         on a prefill-role replica the freshly-prefilled sequence leaves
@@ -489,6 +497,7 @@ class EngineReplica:
         stall_ms = (time.perf_counter() - t0) * 1e3
         self._note_handoff(req, payload, detail, stall_ms, dest)
 
+    @engine_thread_only
     def _note_handoff(self, req: Request, payload: dict, detail: dict,
                       stall_ms: float, dest: Optional[int]) -> None:
         self.handoffs_out += 1
@@ -505,6 +514,7 @@ class EngineReplica:
 
     # -- KV migration (engine-thread half) -----------------------------------
 
+    @engine_thread_only
     def _note_migration(self, req: Request, payload: dict, detail: dict,
                         reason: str) -> None:
         self.migrations_out += 1
@@ -528,6 +538,7 @@ class EngineReplica:
             detail["precopy_pages"], detail["stop_pages"],
             detail["pause_ms"])
 
+    @engine_thread_only
     def _service_migrations(self) -> None:
         """Advance in-flight single-request migrations (rebalance /
         operator) at a step boundary, ON the engine thread. One phase per
@@ -581,10 +592,12 @@ class EngineReplica:
 
     # -- fleet-facing API ----------------------------------------------------
 
+    @thread_seam
     def accepting(self) -> bool:
         with self._state_lock:
             return self.state == HEALTHY
 
+    @thread_seam
     def submit(self, req: Request) -> bool:
         if not self.accepting():
             return False
@@ -609,16 +622,20 @@ class EngineReplica:
             self._wake.set()
         return ok
 
+    @thread_seam
     def cancel(self, request_id: str) -> bool:
         with self.engine.lock:
             return self.engine.scheduler.cancel(request_id)
 
+    @thread_seam
     def queue_depth(self) -> int:
         return self.engine.scheduler.queue_depth
 
+    @thread_seam
     def active_count(self) -> int:
         return self.engine.scheduler.active_count
 
+    @thread_seam
     def outstanding_tokens(self) -> int:
         """Routing load signal: tokens of work still owed — un-prefilled
         context plus undecoded budget for queued requests, remaining decode
@@ -632,6 +649,7 @@ class EngineReplica:
                 total += max(r.remaining_tokens, 0)
         return total
 
+    @thread_seam
     def pool_room_for(self, req: Request) -> bool:
         """Advisory handoff-destination check: could this replica restore
         ``req``'s context pages plus one dispatch of decode growth right
@@ -647,6 +665,7 @@ class EngineReplica:
                                + eng._decode_lookahead)
         return need <= kv.free_pages - eng._reserved_pages
 
+    @thread_seam
     def probe(self) -> dict:
         """Health snapshot for the supervisor. Raises if the engine thread
         is dead — a crashed replica must not look merely idle. Carries
@@ -675,6 +694,7 @@ class EngineReplica:
                                if kv is not None else 0),
         }
 
+    @thread_seam
     def request_drain(self) -> None:
         with self._state_lock:
             if self.state not in (HEALTHY, DRAINING):
@@ -683,11 +703,13 @@ class EngineReplica:
         self._drain_requested.set()
         self._wake.set()
 
+    @thread_seam
     def undrain(self) -> None:
         with self._state_lock:
             if self.state == DRAINED:
                 self.state = HEALTHY
 
+    @thread_seam
     def take_orphans(self) -> list[Request]:
         """Hand the stashed crash/drain victims to the caller. The
         supervisor collects on every poll (remote workers surface
@@ -697,6 +719,7 @@ class EngineReplica:
             out, self._orphans = self._orphans, []
         return out
 
+    @thread_seam
     def request_migrate(self, request_id: str, dest: Optional[int] = None,
                         reason: str = "operator") -> bool:
         """Ask the engine thread to migrate one RESIDENT request out with
@@ -715,10 +738,12 @@ class EngineReplica:
         self._wake.set()
         return True
 
+    @thread_seam
     def migrations_in_flight(self) -> int:
         with self._state_lock:
             return len(self._migrations)
 
+    @thread_seam
     def take_migrated(self) -> list[tuple[Request, MigrationTicket]]:
         """Hand completed migrations (request + ticket with dest hint) to
         the supervisor for placement. Survives a crash: payloads are host
@@ -727,6 +752,7 @@ class EngineReplica:
             out, self._migrated = self._migrated, []
         return out
 
+    @thread_seam
     def resident_requests(self) -> list[tuple[str, int]]:
         """(request_id, remaining_tokens) of RUNNING requests — the
         rebalancer's victim-selection input."""
@@ -737,6 +763,7 @@ class EngineReplica:
                     out.append((r.request_id, r.remaining_tokens))
         return out
 
+    @thread_seam
     def prefix_cache_stats(self) -> tuple[int, int, int]:
         """(prefix_hits, prefix_queries, requeue_cached_tokens) from the
         engine — per-replica cache observability (hit-rate gauge)."""
@@ -746,6 +773,7 @@ class EngineReplica:
         return (kv.prefix_hits, kv.prefix_queries,
                 getattr(self.engine, "total_requeue_cached_tokens", 0))
 
+    @thread_seam
     def spec_stats(self) -> dict:
         """Per-replica speculative-decode counters (running totals) for
         the supervisor snapshot / `llmctl_fleet_spec_*` Prometheus
@@ -761,6 +789,7 @@ class EngineReplica:
 
     # -- fleet-global prefix cache -------------------------------------------
 
+    @thread_seam
     def prefix_inventory(self) -> list:
         """The prefix-page hashes this replica's cache currently holds —
         the router's hint input (bounded; advisory, so staleness only
@@ -773,6 +802,7 @@ class EngineReplica:
         with self.engine.lock:
             return kv.prefix_inventory(self._prefix_inventory_max)
 
+    @thread_seam
     def prefix_fetch_stats(self) -> dict:
         """Fetch-side counters for the supervisor snapshot / Prometheus
         (`llmctl_fleet_prefix_fetch_*`). fetch_ms is the bounded recent
@@ -791,6 +821,7 @@ class EngineReplica:
                                 + self.prefix_fetch_aborts),
             }
 
+    @engine_thread_only
     def _fetch_prefix(self, req: Request, hashes: list) -> Optional[dict]:
         """Engine prefix_fetch_hook: fetch ``hashes``' pages from the
         request's hinted owner through the injected fetcher (courier /
@@ -842,6 +873,7 @@ class EngineReplica:
                     out["pages"])
         return out
 
+    @thread_seam
     def request_prefix_extract(self, hashes: list,
                                timeout_s: Optional[float] = None
                                ) -> Optional[dict]:
@@ -871,6 +903,7 @@ class EngineReplica:
             return None
         return job["result"]
 
+    @engine_thread_only
     def _service_prefix_extracts(self) -> None:
         """Answer queued prefix-extract jobs (engine thread, between
         steps). Per-job failures — a deleted-buffer race with an
@@ -887,6 +920,7 @@ class EngineReplica:
                 job["result"] = None
             job["event"].set()
 
+    @engine_thread_only
     def _extract_prefix_payload(self, hashes: list) -> Optional[dict]:
         eng = self.engine
         kv = getattr(eng, "kv", None)
@@ -912,6 +946,7 @@ class EngineReplica:
                            "(%s)", self.replica_id, e)
             return None
 
+    @thread_seam
     def _fail_prefix_jobs(self) -> None:
         """Release extract waiters when this replica stops/crashes (their
         fetchers then count a miss instead of blocking to timeout)."""
@@ -920,6 +955,7 @@ class EngineReplica:
         for job in jobs:
             job["event"].set()
 
+    @thread_seam
     def stop(self, timeout: float = 10.0) -> None:
         self._stop.set()
         self._wake.set()
@@ -931,6 +967,7 @@ class EngineReplica:
                 self.state = STOPPED
         self._fail_prefix_jobs()
 
+    @thread_seam
     def teardown(self) -> list[Request]:
         """Stop the thread and extract whatever was still in flight (used
         when a replica is declared dead by probes: the engine may be fine,
@@ -951,6 +988,7 @@ class EngineReplica:
                              self.replica_id)
         return orphans
 
+    @thread_seam
     def restart(self, params=None) -> None:
         """Build a fresh engine (fresh KV pool, fresh compiled programs) and
         resume stepping. Caller (supervisor) owns backoff/limits."""

@@ -29,6 +29,7 @@ from . import replica as replica_mod
 from .faults import FaultInjector
 from .replica import (ROLE_DECODE, ROLE_MIXED, ROLE_PREFILL, EngineReplica)
 from .router import FleetRouter
+from ...analysis.annotations import (supervisor_thread, thread_seam)
 
 logger = logging.getLogger("llmctl.serve.fleet.supervisor")
 
@@ -80,6 +81,7 @@ class ReplicaSupervisor:
 
     # -- one supervision pass ------------------------------------------------
 
+    @supervisor_thread
     def poll_once(self, now: Optional[float] = None) -> dict:
         """One probe/requeue/restart pass; returns the fleet snapshot it
         acted on. Deterministic: tests drive this directly."""
@@ -113,6 +115,7 @@ class ReplicaSupervisor:
         self.observer("fleet", snap)
         return snap
 
+    @supervisor_thread
     def _collect_migrated(self) -> None:
         for r in self.replicas:
             for req, ticket in r.take_migrated():
@@ -124,6 +127,7 @@ class ReplicaSupervisor:
                 self.router.place_migrated(req, from_replica=r.replica_id,
                                            dest=ticket.dest, kind=kind)
 
+    @supervisor_thread
     def _maybe_rebalance(self) -> None:
         """Migration-driven load rebalancing: when the outstanding-token
         spread between the hottest and coldest healthy replica exceeds
@@ -180,6 +184,7 @@ class ReplicaSupervisor:
     def _role(r) -> str:
         return getattr(r, "role", ROLE_MIXED)
 
+    @supervisor_thread
     def _ensure_role_coverage(self) -> None:
         """Role-aware health: if every prefill-capable replica is down,
         new requests have nowhere to go (and payload-less orphans park
@@ -235,6 +240,7 @@ class ReplicaSupervisor:
             promote([r for r in healthy
                      if self._role(r) == ROLE_PREFILL], ROLE_DECODE)
 
+    @supervisor_thread
     def _maybe_role_restore(self) -> None:
         """Auto-demotion (PR-4 known gap): a replica that role-aware
         health promoted to MIXED returns to its provisioned role once the
@@ -278,6 +284,7 @@ class ReplicaSupervisor:
             self._restore_streak.pop(rid, None)
             self.router.flush_parked()
 
+    @supervisor_thread
     def _maybe_role_balance(self) -> None:
         """Re-role replicas from observed phase pressure. Prefill pressure
         is the queue of un-prefilled prompts on prefill-role replicas;
@@ -345,6 +352,7 @@ class ReplicaSupervisor:
             donor.replica_id, self._role(donor), want, p, d)
         donor.request_drain()
 
+    @supervisor_thread
     def _requeue_orphans(self, r: EngineReplica) -> None:
         orphans = r.take_orphans()
         if orphans:
@@ -352,6 +360,7 @@ class ReplicaSupervisor:
                         len(orphans), r.replica_id)
             self.router.requeue(orphans, from_replica=r.replica_id)
 
+    @supervisor_thread
     def _probe(self, r: EngineReplica) -> None:
         try:
             if self.injector is not None:
@@ -380,6 +389,7 @@ class ReplicaSupervisor:
             return
         self._misses[r.replica_id] = 0
 
+    @supervisor_thread
     def _schedule_restart(self, r: EngineReplica, now: float) -> None:
         if r.replica_id not in self._next_restart:
             backoff = self._backoff.get(r.replica_id,
@@ -389,6 +399,7 @@ class ReplicaSupervisor:
             self._backoff[r.replica_id] = min(
                 max(backoff, 1e-3) * 2, self.cfg.restart_backoff_max_s)
 
+    @supervisor_thread
     def _maybe_restart(self, r: EngineReplica, now: float) -> bool:
         if self.cfg.max_restarts and r.restarts >= self.cfg.max_restarts:
             return False               # permanently failed; stays dead
@@ -413,6 +424,7 @@ class ReplicaSupervisor:
             self._schedule_restart(r, time.monotonic())
             return False
 
+    @thread_seam
     def current_backoff_s(self, replica_id: int) -> float:
         """The delay the NEXT restart of this replica will wait (test +
         status surface for the exponential schedule)."""
@@ -420,6 +432,7 @@ class ReplicaSupervisor:
 
     # -- operator actions ----------------------------------------------------
 
+    @thread_seam
     def drain(self, replica_id: int) -> bool:
         r = next((x for x in self.replicas if x.replica_id == replica_id),
                  None)
@@ -431,6 +444,7 @@ class ReplicaSupervisor:
         self.router.invalidate_inventories()
         return True
 
+    @thread_seam
     def undrain(self, replica_id: int) -> bool:
         r = next((x for x in self.replicas if x.replica_id == replica_id),
                  None)
@@ -441,6 +455,7 @@ class ReplicaSupervisor:
         self.router.flush_parked()
         return True
 
+    @thread_seam
     def set_role(self, replica_id: int, role: str) -> bool:
         """Operator action (`llmctl fleet role` / POST /fleet/role):
         manually re-role one replica. Immediate — the operator drains
@@ -457,6 +472,7 @@ class ReplicaSupervisor:
         self.router.flush_parked()
         return True
 
+    @thread_seam
     def migrate(self, request_id: str, dest_replica: int) -> bool:
         """Operator action (`llmctl fleet migrate`): move one in-flight
         request to ``dest_replica`` with its KV. Returns False when the
@@ -500,6 +516,7 @@ class ReplicaSupervisor:
 
     # -- introspection -------------------------------------------------------
 
+    @supervisor_thread
     def snapshot(self) -> dict:
         """Fleet-wide status: per-replica health + router ledger. Feeds
         /fleet/status, `llmctl fleet status`, and the Prometheus pump."""
@@ -589,6 +606,11 @@ class ReplicaSupervisor:
             })
         migration = {
             "migrations": sum(r.migrations_out for r in self.replicas),
+            # rebalancer-initiated moves specifically (graftlint
+            # counter-wiring found this counted-but-never-snapshotted
+            # since PR 3 — the by_reason dict only aggregates moves that
+            # COMPLETED, while this counts moves the rebalancer ordered)
+            "rebalance_migrations": self.total_rebalance_migrations,
             "migrated_tokens": sum(r.migrated_tokens
                                    for r in self.replicas),
             # drain migrations skip re-prefill of prompt+generated; warm-

@@ -53,6 +53,7 @@ from .replica import EngineReplica
 from .transport import (CourierChunk, CourierReceiver,
                         HTTPCourierTransport, TransportError,
                         TransportStats)
+from ...analysis.annotations import (aiohttp_handler, engine_thread_only, supervisor_thread)
 
 logger = logging.getLogger("llmctl.serve.fleet.worker")
 
@@ -118,6 +119,7 @@ class FleetWorker:
 
     # -- engine-side hooks ---------------------------------------------------
 
+    @engine_thread_only
     def _on_finish(self, replica_id: int, req: Request) -> None:
         entry = {
             "kind": "finished",
@@ -132,6 +134,7 @@ class FleetWorker:
         with self._lock:
             self._outbox.append(entry)
 
+    @engine_thread_only
     def _on_token(self, replica_id: int, req: Request,
                   tokens: list) -> None:
         """Engine-thread streaming hook: publish one token batch with its
@@ -148,6 +151,7 @@ class FleetWorker:
         with self._lock:
             self._outbox.append(entry)
 
+    @engine_thread_only
     def _on_handoff(self, replica_id: int, req: Request,
                     dest) -> None:
         """Prefill-complete extraction (engine thread): park the payload
@@ -163,6 +167,7 @@ class FleetWorker:
 
     # -- local supervision ---------------------------------------------------
 
+    @supervisor_thread
     def _flush_orphans(self) -> None:
         for req in self.replica.take_orphans():
             payload = req.swapped_kv
@@ -179,6 +184,7 @@ class FleetWorker:
                                      "partial": partial,
                                      "request": request_to_wire(req)})
 
+    @supervisor_thread
     def _flush_migrated(self) -> None:
         for req, t in self.replica.take_migrated():
             payload, req.swapped_kv = req.swapped_kv, None
@@ -194,6 +200,7 @@ class FleetWorker:
                                      "reason": t.reason,
                                      "request": request_to_wire(req)})
 
+    @supervisor_thread
     def supervise_once(self, now: Optional[float] = None) -> None:
         """One local-janitor pass: collect orphans/migrations into the
         outbox and rebuild a crashed engine under doubling backoff."""
@@ -223,6 +230,7 @@ class FleetWorker:
         else:
             self._flush_orphans()       # drain victims etc.
 
+    @supervisor_thread
     def _janitor_loop(self) -> None:
         interval = min(self.fleet_cfg.probe_interval_s, 0.05)
         while not self._stop.wait(interval):
@@ -253,6 +261,7 @@ class FleetWorker:
 
     # -- RPC bodies (also driven directly by tests) --------------------------
 
+    @aiohttp_handler
     def submit_wire(self, body: dict) -> dict:
         req = request_from_wire(body, receiver=self.receiver)
         ok = self.replica.submit(req)
@@ -261,6 +270,7 @@ class FleetWorker:
             out["reject_error"] = req.error
         return out
 
+    @aiohttp_handler
     def probe_dict(self) -> dict:
         r = self.replica
         try:
@@ -305,12 +315,14 @@ class FleetWorker:
         })
         return base
 
+    @aiohttp_handler
     def take_outbox(self) -> dict:
         with self._lock:
             entries = list(self._outbox)
             self._outbox.clear()
         return {"entries": entries, "probe": self.probe_dict()}
 
+    @aiohttp_handler
     def ship(self, body: dict) -> dict:
         """Push a parked payload to another worker's courier endpoint.
         Pops the ticket — an aborted push means the payload is gone and
@@ -335,6 +347,7 @@ class FleetWorker:
             return {"ok": False, "error": str(e)}
         return {"ok": True, "ticket": ticket}
 
+    @aiohttp_handler
     def status_dict(self) -> dict:
         out = self.probe_dict()
         out["courier"] = {**self.courier_stats.snapshot(),
@@ -343,6 +356,7 @@ class FleetWorker:
 
     # -- fleet-global prefix cache -------------------------------------------
 
+    @engine_thread_only
     def _fetch_prefix(self, fetcher_id: int, owner,
                       owner_endpoint: Optional[str],
                       hashes: list) -> Optional[dict]:
@@ -375,6 +389,7 @@ class FleetWorker:
             return None
         return self.receiver.take_payload(ticket)
 
+    @aiohttp_handler
     def prefix_fetch(self, body: dict) -> dict:
         """Owner side of ``POST /fleet/courier/fetch`` (alias
         ``/worker/prefix``): extract the requested prefix pages on the

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config.schema import ModelConfig
+from ..analysis.annotations import engine_thread_only
 
 
 def prefix_page_hashes(tokens, page_size: int) -> list[bytes]:
@@ -307,12 +308,14 @@ class PagedKVCache:
 
     # -- swap (preemption to host memory) ------------------------------------
 
+    @engine_thread_only
     def extract_slot(self, slot: int) -> dict:
         """Copy ``slot``'s written pages to HOST memory (swap-out half of
         preemption=swap). One device fetch per buffer — the page gather
         runs on-device, only the slot's own pages cross the link."""
         return self.extract_slot_pages(slot, 0, self._chain_len.get(slot, 0))
 
+    @engine_thread_only
     def extract_slot_pages(self, slot: int, lo: int, hi: int) -> dict:
         """Copy chain entries [lo, hi) of ``slot`` to host memory.
 
@@ -334,6 +337,7 @@ class PagedKVCache:
                 f"{slot}'s chain of {chain} page(s)")
         return self._extract_pages_idx(self.block_tables[slot, lo:hi].copy())
 
+    @engine_thread_only
     def extract_pages(self, pages: list[int]) -> dict:
         """Copy arbitrary page ids to host memory — the owner half of the
         fleet-global prefix fetch (serve/fleet/): the pages come from
@@ -387,6 +391,7 @@ class PagedKVCache:
                 write, donate_argnums=(0, 1))
         return self._restore_cache[n_bucket]
 
+    @engine_thread_only
     def restore_slot(self, slot: int, content: dict) -> bool:
         """Swap-in: allocate fresh pages for the slot and write the saved
         K/V back. Returns False (allocating nothing) when the pool can't
@@ -490,6 +495,7 @@ class PagedKVCache:
                         f"restore payload '{name}' shape {got} != "
                         f"expected {expect}")
 
+    @engine_thread_only
     def write_slot_pages(self, slot: int, content: dict,
                          lo: int = 0) -> None:
         """Write a host payload's pages into chain entries
@@ -591,6 +597,7 @@ class PagedKVCache:
                 self._hash_to_page[h] = page
                 self._page_to_hash[page] = h
 
+    @engine_thread_only
     def insert_prefix_pages(self, hashes: list[bytes],
                             content: dict) -> list[int]:
         """Import FETCHED prefix pages (fleet-global prefix cache): write

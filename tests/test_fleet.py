@@ -1224,3 +1224,32 @@ class TestFleetMetrics:
                     assert s.value == {
                         "llmctl_fleet_migration_pause_ms_count": 2,
                         "llmctl_fleet_handoff_stall_ms_count": 3}[s.name]
+        # registry cross-check (graftlint counter-wiring satellite): the
+        # literal names pinned above AND the scrape output must both
+        # agree with metrics/names.py — the ONE source of truth the
+        # exporter constructs from and the lint pass verifies. A fleet
+        # metric added off-registry, or a registry entry that stops
+        # being scraped, fails here.
+        from distributed_llm_training_and_inference_system_tpu.metrics import (  # noqa: E501
+            names as metric_names)
+        observed = set()
+        for metric in prometheus_client.REGISTRY.collect():
+            for s in metric.samples:
+                if s.name.startswith("llmctl_fleet"):
+                    observed.add(s.name)
+        expected = set()
+        for n in metric_names.fleet_metric_names():
+            spec = metric_names.METRICS[n]
+            if spec.kind == metric_names.HISTOGRAM:
+                expected |= {f"{n}_count", f"{n}_sum", f"{n}_bucket"}
+            else:
+                expected.add(metric_names.scraped_name(n))
+        missing = expected - observed
+        assert not missing, f"registered but not scraped: {missing}"
+        allowed = expected | {
+            metric_names.scraped_name(n).replace("_total", "")
+            + "_created"
+            for n in metric_names.fleet_metric_names()
+            if metric_names.METRICS[n].kind != metric_names.GAUGE}
+        stray = observed - allowed
+        assert not stray, f"scraped but off-registry: {stray}"

@@ -1087,6 +1087,26 @@ class TestStreamMetrics:
         assert samples[
             ("llmctl_fleet_prefix_inventory_cache_misses_total",
              None)] == 3
+        # every stream/inventory name pinned above must also be the
+        # registry's scraped spelling (metrics/names.py — the one
+        # source of truth the exporter constructs from and graftlint's
+        # counter-wiring pass checks)
+        from distributed_llm_training_and_inference_system_tpu.metrics import (  # noqa: E501
+            names as metric_names)
+        registered_scraped = {metric_names.scraped_name(n)
+                              for n in metric_names.fleet_metric_names()}
+        for base in ("llmctl_fleet_stream_active",
+                     "llmctl_fleet_stream_tokens_total",
+                     "llmctl_fleet_stream_duplicates_total",
+                     "llmctl_fleet_stream_replayed_tokens_total",
+                     "llmctl_fleet_stream_reconnects_total",
+                     "llmctl_fleet_stream_gaps_healed_total",
+                     "llmctl_fleet_stream_backpressure_drops_total",
+                     "llmctl_fleet_prefix_inventory_cache_hits_total",
+                     "llmctl_fleet_prefix_inventory_cache_misses_total"):
+            assert base in registered_scraped, base
+        assert "llmctl_fleet_stream_replay_tokens" in \
+            metric_names.fleet_metric_names()
 
 
 class TestIncrementalDecoder:

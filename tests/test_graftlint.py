@@ -1,0 +1,509 @@
+"""graftlint (analysis/): unit tests per pass on synthetic fixture
+trees — positive (violation detected), negative (clean code passes),
+and suppressed — plus the tier-1 gate: all five passes over the REAL
+package report zero unsuppressed findings, so any future PR that breaks
+a thread-context, lock, counter, config, or parity contract fails the
+suite, not a reviewer's attention span.
+
+The fixture tests also demonstrate the acceptance criterion directly:
+deleting one thread-context annotation (the seam) or un-wiring one
+``total_*`` counter (dropping its snapshot key) flips the corresponding
+pass from clean to failing.
+"""
+
+from __future__ import annotations
+
+import textwrap
+
+import pytest
+
+from distributed_llm_training_and_inference_system_tpu.analysis import (
+    run_lint)
+from distributed_llm_training_and_inference_system_tpu.analysis.core import (
+    LintContext, apply_suppressions)
+from distributed_llm_training_and_inference_system_tpu.analysis import (
+    passes_config, passes_counters, passes_lock, passes_parity,
+    passes_thread)
+
+
+def make_tree(tmp_path, files: dict):
+    """Write a synthetic repo: {relpath: source} under tmp_path; the
+    package root is tmp_path/'pkg'."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return LintContext(package_root=tmp_path / "pkg", repo_root=tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# thread-context
+
+
+THREAD_FIXTURE = """
+    import threading
+
+    class Engine:
+        @engine_thread_only
+        def step(self):
+            pass
+
+    class Replica:
+        @thread_seam
+        def submit(self):
+            self.engine.step()      # inside the seam: allowed
+
+    class Supervisor:
+        @supervisor_thread
+        def poll(self):
+            self._helper()
+
+        def _helper(self):
+            # transitive reach through an unannotated helper
+            self.replica.engine.step()
+
+        @thread_seam
+        def safe_entry(self):
+            # a seam may touch engine state: it owns the handshake
+            self.replica.engine.step()
+
+        @supervisor_thread
+        def clean_poll(self):
+            self.safe_entry()
+
+    class Front:
+        @aiohttp_handler
+        async def handle(self):
+            eng = self._eng()
+            eng.step()
+"""
+
+
+class TestThreadContext:
+    def test_violation_detected_direct_and_transitive(self, tmp_path):
+        ctx = make_tree(tmp_path, {"pkg/mod.py": THREAD_FIXTURE})
+        findings = passes_thread.run(ctx)
+        keys = {f.key for f in findings}
+        # supervisor reaches step through the unannotated helper
+        assert any("Supervisor.poll->" in k and "Engine.step" in k
+                   for k in keys), keys
+        # handler reaches step by attribute name
+        assert any("Front.handle->" in k for k in keys), keys
+        # the seam path produces NO finding
+        assert not any("clean_poll" in k for k in keys), keys
+
+    def test_deleting_seam_annotation_fails_the_pass(self, tmp_path):
+        """Acceptance demo: remove ONE @thread_seam and the formerly
+        clean path becomes a finding."""
+        broken = THREAD_FIXTURE.replace("@thread_seam",
+                                        "# seam annotation deleted")
+        ctx = make_tree(tmp_path, {"pkg/mod.py": broken})
+        findings = passes_thread.run(ctx)
+        # clean_poll -> submit (now unannotated) -> engine.step
+        assert any("clean_poll" in f.key for f in findings), \
+            [f.key for f in findings]
+
+    def test_deleting_target_annotation_silences(self, tmp_path):
+        silent = THREAD_FIXTURE.replace("@engine_thread_only",
+                                        "# target annotation deleted")
+        ctx = make_tree(tmp_path, {"pkg/mod.py": silent})
+        assert passes_thread.run(ctx) == []
+
+    def test_module_function_resolution(self, tmp_path):
+        ctx = make_tree(tmp_path, {
+            "pkg/migration.py": """
+                @engine_thread_only
+                def precopy(engine, slot):
+                    pass
+            """,
+            "pkg/sup.py": """
+                from . import migration
+
+                class S:
+                    @supervisor_thread
+                    def poll(self):
+                        migration.precopy(self.eng, 0)
+            """,
+        })
+        findings = passes_thread.run(ctx)
+        assert len(findings) == 1 and "precopy" in findings[0].key
+
+    def test_inline_suppression(self, tmp_path):
+        src = THREAD_FIXTURE.replace(
+            "self.replica.engine.step()",
+            "self.replica.engine.step()  "
+            "# graftlint: ignore[thread-context]")
+        ctx = make_tree(tmp_path, {"pkg/mod.py": src})
+        findings = passes_thread.run(ctx)
+        apply_suppressions(ctx, findings, {})
+        poll = [f for f in findings if "Supervisor.poll->" in f.key]
+        assert poll and all(f.suppressed for f in poll)
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+
+
+class TestLockDiscipline:
+    def run(self, tmp_path, body):
+        ctx = make_tree(tmp_path, {"pkg/mod.py": body})
+        return passes_lock.run(ctx)
+
+    def test_sleep_and_io_and_transfer_under_lock(self, tmp_path):
+        findings = self.run(tmp_path, """
+            import time
+            import urllib.request
+
+            class C:
+                def bad(self):
+                    with self.lock:
+                        time.sleep(0.1)
+                        urllib.request.urlopen("http://x")
+                        self.transport.transfer(payload)
+        """)
+        kinds = sorted(f.message.split(" inside")[0] for f in findings)
+        assert len(findings) == 3, findings
+        assert any("time.sleep" in k for k in kinds)
+        assert any("urlopen" in k for k in kinds)
+        assert any("transfer" in k for k in kinds)
+
+    def test_await_under_lock(self, tmp_path):
+        findings = self.run(tmp_path, """
+            class C:
+                async def bad(self):
+                    with self._state_lock:
+                        await self.queue.get()
+        """)
+        assert len(findings) == 1
+        assert "await" in findings[0].message
+
+    def test_clean_and_nested_def_excluded(self, tmp_path):
+        findings = self.run(tmp_path, """
+            import time
+
+            class C:
+                def ok(self):
+                    with self.lock:
+                        x = 1 + 1
+                    time.sleep(0.1)     # outside the lock: fine
+
+                def cb(self):
+                    with self.lock:
+                        def later():
+                            time.sleep(1)   # defined, not called, here
+                        self.callbacks.append(later)
+        """)
+        assert findings == []
+
+    def test_non_lock_with_ignored(self, tmp_path):
+        findings = self.run(tmp_path, """
+            import time
+
+            def f(path):
+                with open(path) as fh:
+                    time.sleep(0.1)
+        """)
+        assert findings == []
+
+    def test_suppression(self, tmp_path):
+        ctx = make_tree(tmp_path, {"pkg/mod.py": """
+            import time
+
+            class C:
+                def deliberate(self):
+                    with self.lock:
+                        time.sleep(0.1)  # graftlint: ignore[lock-discipline]
+        """})
+        findings = passes_lock.run(ctx)
+        apply_suppressions(ctx, findings, {})
+        assert len(findings) == 1 and findings[0].suppressed
+
+
+# ---------------------------------------------------------------------------
+# counter-wiring
+
+
+ENGINE_TMPL = """
+    class InferenceEngine:
+        def __init__(self):
+            self.total_preemptions = 0
+            {extra}
+
+        def stats(self):
+            return {{
+                {stats}
+            }}
+"""
+
+
+class TestCounterWiring:
+    def run(self, tmp_path, extra="", stats='"preemptions": 1,'):
+        ctx = make_tree(tmp_path, {
+            "pkg/serve/engine.py": ENGINE_TMPL.format(extra=extra,
+                                                      stats=stats)})
+        return passes_counters.run(ctx)
+
+    def test_wired_counter_clean(self, tmp_path):
+        findings = self.run(tmp_path)
+        assert not any("total_preemptions" in f.key for f in findings), \
+            [f.key for f in findings]
+
+    def test_unregistered_counter_flagged(self, tmp_path):
+        findings = self.run(tmp_path, extra="self.total_bogus = 0")
+        assert any(f.key == "unregistered-counter:"
+                   "InferenceEngine.total_bogus" for f in findings)
+
+    def test_unwired_counter_fails(self, tmp_path):
+        """Acceptance demo: drop the snapshot key and the pass fails."""
+        findings = self.run(tmp_path, stats='"nothing": 0,')
+        assert any(f.key == "counter-not-in-snapshot:"
+                   "InferenceEngine.total_preemptions"
+                   for f in findings)
+
+    def test_off_registry_metric_literal_flagged(self, tmp_path):
+        ctx = make_tree(tmp_path, {"pkg/serve/engine.py": """
+            NAME = "llmctl_fleet_made_up_metric"
+
+            class InferenceEngine:
+                def __init__(self):
+                    self.total_preemptions = 0
+
+                def stats(self):
+                    return {"preemptions": 1}
+        """})
+        findings = passes_counters.run(ctx)
+        assert any("literal-off-registry" in f.key
+                   and "made_up" in f.key for f in findings)
+
+    def test_registry_and_exporter_agree_on_real_tree(self):
+        """Consolidation satellite: every registered metric is
+        constructed by the exporter and vice versa (checked via the
+        real package's AST)."""
+        findings = passes_counters.run(LintContext())
+        bad = [f for f in findings
+               if "registered-not-constructed" in f.key
+               or "literal-off-registry" in f.key]
+        assert bad == [], [f.message for f in bad]
+
+
+# ---------------------------------------------------------------------------
+# config-wiring
+
+
+CONFIG_TREE = {
+    "pkg/config/schema.py": """
+        from dataclasses import dataclass
+
+        @dataclass
+        class ServeConfig:
+            max_batch_size: int = 8
+            speculative_tokens: int = 8
+            prefix_caching: bool = True
+            hidden_knob: int = 3
+            quiet_knob: int = 4  # graftlint: ignore[config-wiring]
+
+        @dataclass
+        class FleetConfig:
+            replicas: int = 1
+    """,
+    "pkg/cli/commands/serve.py": """
+        FLAGS = ["--max-batch-size", "--spec-tokens",
+                 "--prefix-cache/--no-prefix-cache", "--replicas"]
+    """,
+    "docs/USER_GUIDE.md":
+        "max_batch_size speculative_tokens prefix_caching replicas "
+        "hidden_knob quiet_knob\n",
+}
+
+
+class TestConfigWiring:
+    def test_flag_matching_and_missing_flag(self, tmp_path):
+        ctx = make_tree(tmp_path, dict(CONFIG_TREE))
+        findings = passes_config.run(ctx)
+        apply_suppressions(ctx, findings, {})
+        live = [f for f in findings if not f.suppressed]
+        # abbreviated (--spec-tokens) and inflected (--prefix-cache)
+        # flags match their fields; hidden_knob has no flag
+        assert [f.key for f in live] == ["ServeConfig.hidden_knob:"
+                                         "no-cli-flag"]
+        # quiet_knob's finding exists but the inline comment on the
+        # schema line suppresses it
+        assert any(f.key == "ServeConfig.quiet_knob:no-cli-flag"
+                   and f.suppressed for f in findings)
+
+    def test_doc_mention_missing(self, tmp_path):
+        tree = dict(CONFIG_TREE)
+        tree["docs/USER_GUIDE.md"] = "max_batch_size only\n"
+        ctx = make_tree(tmp_path, tree)
+        keys = {f.key for f in passes_config.run(ctx)}
+        assert "ServeConfig.speculative_tokens:no-doc-mention" in keys
+        # the dashed flag form counts as a mention too
+        tree["docs/USER_GUIDE.md"] = "speculative-tokens etc\n"
+        ctx = make_tree(tmp_path / "b", tree)
+        keys = {f.key for f in passes_config.run(ctx)}
+        assert "ServeConfig.speculative_tokens:no-doc-mention" not in keys
+
+    def test_word_subsequence_guard(self, tmp_path):
+        """A one-word flag cannot claim a three-word field."""
+        tree = dict(CONFIG_TREE)
+        tree["pkg/config/schema.py"] = """
+            from dataclasses import dataclass
+
+            @dataclass
+            class ServeConfig:
+                param_seed_whatever: int = 0
+
+            @dataclass
+            class FleetConfig:
+                replicas: int = 1
+        """
+        tree["pkg/cli/commands/serve.py"] = \
+            'FLAGS = ["--seed", "--replicas"]\n'
+        tree["docs/USER_GUIDE.md"] = "param_seed_whatever replicas\n"
+        ctx = make_tree(tmp_path, tree)
+        keys = {f.key for f in passes_config.run(ctx)}
+        assert "ServeConfig.param_seed_whatever:no-cli-flag" in keys
+
+
+# ---------------------------------------------------------------------------
+# np/jnp parity
+
+
+class TestNpJnpParity:
+    def run(self, tmp_path, src):
+        ctx = make_tree(tmp_path, {"pkg/ops/quantization.py": src})
+        return passes_parity.run(ctx)
+
+    def test_matching_twins_clean(self, tmp_path):
+        assert self.run(tmp_path, """
+            def pack_rows(q, axis=-2):
+                pass
+
+            def pack_rows_np(q, axis=-2):
+                pass
+        """) == []
+
+    def test_param_name_mismatch_flagged(self, tmp_path):
+        findings = self.run(tmp_path, """
+            def pack_rows(q, axis=-2):
+                pass
+
+            def pack_rows_np(q, dim=-2):
+                pass
+        """)
+        assert any("param-name" in f.key for f in findings)
+
+    def test_default_mismatch_flagged(self, tmp_path):
+        findings = self.run(tmp_path, """
+            def pack_rows(q, axis=-2):
+                pass
+
+            def pack_rows_np(q, axis=-1):
+                pass
+        """)
+        assert any("param-default" in f.key for f in findings)
+
+    def test_missing_twin_and_host_only_escape(self, tmp_path):
+        findings = self.run(tmp_path, """
+            def lonely_np(a):
+                pass
+
+            @np_host_only("codec is host-side only")
+            def codec_np(a):
+                pass
+        """)
+        keys = [f.key for f in findings]
+        assert any("lonely_np:missing-twin" in k for k in keys)
+        assert not any("codec_np" in k for k in keys)
+
+    def test_np_twin_of_redirect_and_extra_required(self, tmp_path):
+        findings = self.run(tmp_path, """
+            def unpack_int4_rows(packed, axis=-2, n=None):
+                pass
+
+            @np_twin_of("unpack_int4_rows")
+            def unpack_nibbles_np(packed, axis=-2):
+                pass
+
+            def strict(q, axis, mandatory):
+                pass
+
+            @np_twin_of("strict")
+            def strict_np(q, axis):
+                pass
+        """)
+        keys = [f.key for f in findings]
+        # redirected twin with extra DEFAULTED trailing param: clean
+        assert not any("unpack_nibbles_np" in k for k in keys)
+        # extra REQUIRED twin param: flagged
+        assert any("strict_np:twin-extra-required:mandatory" in k
+                   for k in keys)
+
+
+# ---------------------------------------------------------------------------
+# the real tree (tier-1 gate)
+
+
+class TestRealTree:
+    def test_all_passes_zero_unsuppressed(self):
+        """The acceptance criterion: `llmctl admin lint` exits 0 on the
+        tree — all five passes, zero unsuppressed findings."""
+        result = run_lint()
+        assert len(result.rules_run) == 5
+        assert result.ok, "unsuppressed graftlint findings:\n" + \
+            "\n".join(f"[{f.rule}] {f.file}:{f.line} {f.message}"
+                      for f in result.unsuppressed)
+
+    def test_real_tree_has_annotation_coverage(self):
+        """The sweep actually landed: roots, engine-thread-only marks,
+        and seams all exist in the serve/fleet tree (an accidental
+        mass-deletion of annotations would make the thread pass
+        vacuously green — this pins the coverage)."""
+        ctx = LintContext()
+        marks = {}
+        for fn in ctx.functions:
+            for m in fn.marks:
+                marks.setdefault(m, []).append(fn.qualname)
+        assert len(marks.get("engine_thread_only", [])) >= 30
+        assert len(marks.get("thread_seam", [])) >= 20
+        assert len(marks.get("supervisor_thread", [])) >= 10
+        assert len(marks.get("aiohttp_handler", [])) >= 15
+        # spot-pin the load-bearing ones by name
+        eto = set(marks["engine_thread_only"])
+        seams = set(marks["thread_seam"])
+        assert {"InferenceEngine.step", "EngineReplica._drain_on_thread",
+                "PagedKVCache.extract_pages"} <= eto
+        assert {"EngineReplica.submit",
+                "EngineReplica.request_prefix_extract",
+                "EngineReplica.request_drain"} <= seams
+
+    def test_cli_lint_exits_zero(self):
+        """`llmctl admin lint` end to end through click."""
+        click_testing = pytest.importorskip("click.testing")
+        from distributed_llm_training_and_inference_system_tpu.cli.commands.admin import (  # noqa: E501
+            app)
+        runner = click_testing.CliRunner()
+        res = runner.invoke(app, ["lint", "--format", "json"])
+        assert res.exit_code == 0, res.output[-2000:]
+        import json
+        payload = json.loads(res.output)
+        assert payload["ok"] is True
+        assert payload["unsuppressed"] == 0
+        assert set(payload["rules"]) == {
+            "thread-context", "lock-discipline", "counter-wiring",
+            "config-wiring", "np-jnp-parity"}
+        # without the baseline the deliberate findings surface and the
+        # command exits nonzero — the CI-gate half of the contract
+        res = runner.invoke(app, ["lint", "--baseline",
+                                  "/nonexistent/baseline.json"])
+        assert res.exit_code == 1, res.output[-500:]
+
+    def test_baseline_notes_present(self):
+        """Every baselined finding carries a non-empty note — the
+        baseline is a register of DELIBERATE decisions, not a dumping
+        ground."""
+        from distributed_llm_training_and_inference_system_tpu.analysis import (  # noqa: E501
+            default_baseline_path)
+        import json
+        data = json.loads(default_baseline_path().read_text())
+        assert all(e.get("note", "").strip() for e in data["findings"])
