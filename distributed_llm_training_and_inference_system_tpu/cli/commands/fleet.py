@@ -142,6 +142,17 @@ def status(url, as_json):
             f"{st.get('gaps_healed', 0)} gap-healed, "
             f"{st.get('backpressure_drops', 0)} backpressure drops, "
             f"{st.get('identity_mismatches', 0)} identity violations")
+    ft = snap.get("front_tier")
+    if ft and ft.get("fronts"):
+        per_front = ", ".join(
+            f"{fid}:{e.get('port', '?')} "
+            f"[{'up' if e.get('alive') else 'fenced' if e.get('fenced') else 'down'}]"  # noqa: E501
+            for fid, e in sorted(ft["fronts"].items()))
+        console.print(
+            f"front tier: {per_front} — "
+            f"{ft.get('failovers', 0)} failovers, "
+            f"{ft.get('reconnects', 0)} failover resumes served here "
+            f"(this front: {ft.get('front_id', '?')})")
     sp = snap.get("spec")
     if sp and sp.get("dispatches"):
         console.print(
@@ -373,3 +384,105 @@ def worker(model_name, artifact, replica_id, role, host, port,
                     fleet_cfg=fleet_cfg, role=role, params=params,
                     seed=seed, fault_plan=plan)
     w.run_forever(host=host, port=port)
+
+
+@app.command()
+@click.option("--model", "model_name", default="gpt-125m",
+              show_default=True, help="Model template name.")
+@click.option("--artifact", default="",
+              help="Checkpoint dir or exported weights file (tokenizer "
+                   "source; fronts never load weights — replicas are "
+                   "remote).")
+@click.option("--front-id", default="", help="Stable front identity in "
+              "the shared state store (empty = random).")
+@click.option("--host", default="127.0.0.1", show_default=True)
+@click.option("--port", default=0, show_default=True, type=int,
+              help="0 binds an ephemeral port; the bound port is "
+                   "printed as 'LLMCTL_FRONT_READY port=N front=ID'.")
+@click.option("--replicas", default=1, show_default=True, type=int,
+              help="Fleet size this front routes over (all remote).")
+@click.option("--remote-replicas", default="", show_default=True,
+              help="Comma-separated replica ids served by `llmctl "
+                   "fleet worker` processes — for a stateless front "
+                   "this must name EVERY replica.")
+@click.option("--fleet-endpoint", "fleet_endpoints", multiple=True,
+              help="replica=url courier/control endpoint map entries "
+                   "(repeat per replica).")
+@click.option("--state-store-dir", required=True,
+              help="Shared file state store directory (stream logs + "
+                   "router ledger journal; every front and the tier "
+                   "must see the same path).")
+@click.option("--max-batch-size", default=8, show_default=True, type=int)
+@click.option("--max-seq-len", default=2048, show_default=True, type=int)
+@click.option("--kv-block-size", default=64, show_default=True, type=int)
+@click.option("--probe-interval", default=0.1, show_default=True,
+              type=float, help="Supervisor poll cadence on this front "
+              "(also the store heartbeat cadence).")
+@click.option("--probe-failures", default=3, show_default=True, type=int)
+@click.option("--remote-timeout", default=5.0, show_default=True,
+              type=float)
+@click.option("--max-pending", default=512, show_default=True, type=int)
+@click.option("--stream-ttl-ms", default=60_000.0, show_default=True,
+              type=float)
+@click.option("--affinity-tokens", default=0, show_default=True,
+              type=int, help="Prefix-affinity tokens (0 = pure "
+              "least-outstanding-tokens — the HA default, since hot "
+              "prefixes pin via the workers' own caches).")
+@click.option("--courier-chunk-bytes", default=256 * 1024,
+              show_default=True, type=int)
+@click.option("--courier-retries", default=4, show_default=True,
+              type=int)
+@click.option("--courier-deadline-ms", default=100.0, show_default=True,
+              type=float)
+@click.option("--fault-plan", default="",
+              help="JSON FaultPlan for deterministic chaos (testing).")
+def front(model_name, artifact, front_id, host, port, replicas,
+          remote_replicas, fleet_endpoints, state_store_dir,
+          max_batch_size, max_seq_len, kv_block_size, probe_interval,
+          probe_failures, remote_timeout, max_pending, stream_ttl_ms,
+          affinity_tokens, courier_chunk_bytes, courier_retries,
+          courier_deadline_ms, fault_plan):
+    """Run ONE stateless fleet front as its own OS process.
+
+    The HA front tier's unit (`llmctl serve start --fleet-fronts N`
+    spawns these): an OpenAI-compatible HTTP/SSE front over all-remote
+    replicas whose stream logs and router ledger live in the shared
+    file state store — so killing this process mid-stream costs the
+    client one reconnect (Last-Event-ID, to any sibling front), never
+    a token. /health answers 503 until the front has attached to the
+    store and read one supervisor snapshot."""
+    import json as _json
+
+    from ...config.presets import get_model_config
+    from ...config.schema import (FleetConfig, ServeConfig,
+                                  parse_fleet_endpoints)
+    from ...serve.fleet.faults import FaultPlan
+    from ...serve.fleet.front import run_front
+
+    model_cfg = get_model_config(model_name)
+    serve_cfg = ServeConfig(
+        model=model_name, artifact=artifact, host=host, port=port,
+        max_batch_size=max_batch_size,
+        max_seq_len=min(max_seq_len, model_cfg.max_position_embeddings),
+        kv_block_size=kv_block_size, dtype="float32")
+    serve_cfg.validate()
+    fleet_cfg = FleetConfig(
+        replicas=replicas, remote_replicas=remote_replicas,
+        fleet_endpoints=parse_fleet_endpoints(list(fleet_endpoints)),
+        state_store="file", state_store_dir=state_store_dir,
+        probe_interval_s=probe_interval, probe_failures=probe_failures,
+        remote_timeout_s=remote_timeout, max_pending=max_pending,
+        stream_log_ttl_ms=stream_ttl_ms,
+        affinity_prefix_tokens=affinity_tokens,
+        courier_chunk_bytes=courier_chunk_bytes,
+        courier_max_retries=courier_retries,
+        courier_chunk_deadline_ms=courier_deadline_ms)
+    fleet_cfg.validate()
+    plan = None
+    if fault_plan:
+        try:
+            plan = FaultPlan(**_json.loads(fault_plan))
+        except (TypeError, ValueError) as e:
+            raise click.ClickException(f"bad --fault-plan JSON: {e}")
+    run_front(model_cfg, serve_cfg, fleet_cfg,
+              front_id=front_id or None, fault_plan=plan)

@@ -277,6 +277,24 @@ def app(ctx):
                    "batches is disconnected (counted in llmctl_fleet_"
                    "stream_backpressure_drops_total) and replays via "
                    "Last-Event-ID. 0 disables.")
+@click.option("--fleet-fronts", default=1, show_default=True, type=int,
+              help="HA front tier: run this many stateless front "
+                   "processes (each a `llmctl fleet front` child on its "
+                   "own port, babysat + fenced by the tier; ports in "
+                   "`fleet status`). > 1 requires --fleet-state-store "
+                   "file and every replica remote — a front's SIGKILL "
+                   "mid-SSE is then healed by the client reconnecting "
+                   "to any survivor with Last-Event-ID.")
+@click.option("--fleet-state-store", default="memory", show_default=True,
+              type=click.Choice(["memory", "file"]),
+              help="Where stream logs + router ledger live: memory = "
+                   "this process (single front, the default), file = a "
+                   "shared fenced journal under "
+                   "--fleet-state-store-dir so N fronts serve one "
+                   "fleet.")
+@click.option("--fleet-state-store-dir", default="", show_default=True,
+              help="Directory for the file state store (every front "
+                   "must see the same path).")
 @click.option("--stream-abort-on-disconnect/--no-stream-abort-on-disconnect",  # noqa: E501
               "stream_abort_on_disconnect", default=True,
               show_default=True,
@@ -305,6 +323,7 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
           fleet_endpoints, fleet_remote_replicas, fleet_prefix_fetch,
           fleet_prefix_fetch_min_pages, fleet_inventory_ttl_ms,
           fleet_stream_ttl_ms, fleet_stream_max_buffered,
+          fleet_fronts, fleet_state_store, fleet_state_store_dir,
           stream_abort_on_disconnect):
     """Start the OpenAI-compatible inference server."""
     import jax
@@ -370,8 +389,36 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
             prefix_fetch_min_pages=fleet_prefix_fetch_min_pages,
             prefix_inventory_ttl_ms=fleet_inventory_ttl_ms,
             stream_log_ttl_ms=fleet_stream_ttl_ms,
-            stream_max_buffered_batches=fleet_stream_max_buffered)
+            stream_max_buffered_batches=fleet_stream_max_buffered,
+            fronts=fleet_fronts, state_store=fleet_state_store,
+            state_store_dir=fleet_state_store_dir)
         fleet_cfg.validate()
+
+    if fleet_cfg is not None and fleet_cfg.fronts > 1:
+        # HA front tier: this process becomes the tier babysitter; each
+        # front is its own `llmctl fleet front` child over the shared
+        # state store and the same remote workers
+        from ...serve.fleet.front import FleetFrontTier, default_spawn_cmd
+        from ...serve.fleet.state import SharedFileStateStore
+        store = SharedFileStateStore(fleet_cfg.state_store_dir,
+                                     front_id="tier")
+        tier = FleetFrontTier(
+            store,
+            default_spawn_cmd(
+                model=model_name, store_dir=fleet_cfg.state_store_dir,
+                replicas=fleet_cfg.replicas,
+                endpoints=fleet_cfg.endpoint_map(),
+                remote_replicas=fleet_cfg.remote_replicas,
+                host=host, artifact=artifact,
+                extra=["--max-seq-len", str(max_seq_len),
+                       "--max-batch-size", str(max_batch_size),
+                       "--kv-block-size", str(kv_block_size)]),
+            fronts=fleet_cfg.fronts)
+        ports = tier.start()
+        click.echo(f"HA front tier up: {fleet_cfg.fronts} fronts on "
+                   f"ports {ports} over {fleet_cfg.state_store_dir}")
+        tier.run_forever()
+        return
 
     observer = None
     if prometheus_port:

@@ -966,6 +966,23 @@ class FleetConfig:
     # reconnects with Last-Event-ID and replays exactly the unacked
     # tail. 0 disables the cap (PR-8 behavior).
     stream_max_buffered_batches: int = 256
+    # -- HA front tier (serve/fleet/state.py + front.py) ---------------------
+    # where the front-affine mutable state (stream logs, router ledger,
+    # parked queue) lives. "memory" = this process's heap, the
+    # single-front default, byte-for-byte the pre-store behavior.
+    # "file" = a shared, fenced, append-only journal under
+    # state_store_dir — N stateless fronts over the same directory and
+    # the same remote workers serve ONE fleet, and a front's SIGKILL
+    # mid-SSE is healed by the client reconnecting to any survivor with
+    # Last-Event-ID (zero gaps, zero duplicates).
+    state_store: str = "memory"
+    state_store_dir: str = ""
+    # how many front processes `llmctl serve start` runs (via the
+    # FleetFrontTier babysitter, each a `llmctl fleet front` child on
+    # its own port, surfaced in `fleet status`). > 1 requires
+    # state_store=file and all replicas remote — a front holding
+    # in-process engines would not be stateless.
+    fronts: int = 1
 
     def role_list(self) -> list[str]:
         """Per-replica role assignment; empty config = all mixed."""
@@ -1078,6 +1095,26 @@ class FleetConfig:
             raise ConfigError(
                 "stream_max_buffered_batches must be >= 0 (0 disables "
                 "the per-subscriber backpressure cap)")
+        if self.state_store not in ("memory", "file"):
+            raise ConfigError(
+                f"unknown state_store {self.state_store!r} "
+                f"(memory|file)")
+        if self.state_store == "file" and not self.state_store_dir:
+            raise ConfigError(
+                "state_store=file needs state_store_dir (the shared "
+                "directory every front folds the journal from)")
+        if self.fronts < 1:
+            raise ConfigError("fleet fronts must be >= 1")
+        if self.fronts > 1:
+            if self.state_store != "file":
+                raise ConfigError(
+                    "fronts > 1 needs state_store=file — stateless "
+                    "fronts must share the stream log and ledger")
+            if len(self.remote_replica_ids()) < self.replicas:
+                raise ConfigError(
+                    "fronts > 1 needs every replica remote "
+                    "(remote_replicas) — a front holding in-process "
+                    "engines is not stateless")
         endpoints = self.endpoint_map()       # raises on malformed entries
         for rid in endpoints:
             if not 0 <= rid < self.replicas:

@@ -191,6 +191,24 @@ METRICS: dict[str, MetricSpec] = {
         HISTOGRAM, "Tokens replayed per SSE reconnect (Last-Event-ID "
                    "tail size)",
         buckets=(1, 2, 5, 10, 25, 50, 100, 250, 1000)),
+    "llmctl_fleet_stream_orphan_gcs": MetricSpec(
+        COUNTER, "Unfinished stream logs collected because the router "
+                 "no longer knew their request (opened, then died "
+                 "outside the finish wiring)"),
+    # -- HA front tier ----------------------------------------------------
+    "llmctl_fleet_front_failovers": MetricSpec(
+        COUNTER, "Front processes that died and were fenced by the "
+                 "front tier (clients fail over to survivors)"),
+    "llmctl_fleet_front_reconnects": MetricSpec(
+        COUNTER, "SSE resumes served for streams ANOTHER front "
+                 "terminated (the log arrived via the shared state "
+                 "store) — each is a client surviving a front death"),
+    "llmctl_fleet_front_up": MetricSpec(
+        GAUGE, "1 while the front's store heartbeat is fresh and it is "
+               "not fenced", ("front",)),
+    "llmctl_fleet_front_active_streams": MetricSpec(
+        GAUGE, "Live SSE subscriptions per front (store heartbeat "
+               "info)", ("front",)),
     # -- speculative decode plane -----------------------------------------
     "llmctl_fleet_spec_dispatches": MetricSpec(
         COUNTER, "Fused speculative verify+decode dispatches "
@@ -237,9 +255,13 @@ class CounterFlow(NamedTuple):
 # Snapshot functions per owner (the counter-wiring pass scans these):
 #   InferenceEngine.stats            (serve/engine.py)
 #   ReplicaSupervisor.snapshot       (serve/fleet/supervisor.py)
+#   FleetStreamHub.stats             (serve/fleet/streams.py)
+#   FleetFrontTier.snapshot          (serve/fleet/front.py)
 COUNTER_SNAPSHOT_FN = {
     "InferenceEngine": ("serve/engine.py", "stats"),
     "ReplicaSupervisor": ("serve/fleet/supervisor.py", "snapshot"),
+    "FleetStreamHub": ("serve/fleet/streams.py", "stats"),
+    "FleetFrontTier": ("serve/fleet/front.py", "snapshot"),
 }
 
 COUNTER_FLOW: tuple[CounterFlow, ...] = (
@@ -279,6 +301,37 @@ COUNTER_FLOW: tuple[CounterFlow, ...] = (
                 "spec_accepted", "llmctl_fleet_spec_accepted"),
     CounterFlow("InferenceEngine", "total_spec_resumes", "spec_resumes",
                 "llmctl_fleet_spec_resumes"),
+    # stream-hub counters -> FleetStreamHub.stats() keys (the supervisor
+    # snapshot embeds them wholesale; the Prometheus pump deltas the
+    # mapped ones)
+    CounterFlow("FleetStreamHub", "total_opened", "opened", None),
+    CounterFlow("FleetStreamHub", "total_finished", "finished", None),
+    CounterFlow("FleetStreamHub", "total_tokens", "tokens",
+                "llmctl_fleet_stream_tokens"),
+    CounterFlow("FleetStreamHub", "total_duplicates", "duplicates",
+                "llmctl_fleet_stream_duplicates"),
+    CounterFlow("FleetStreamHub", "total_replayed", "replayed",
+                "llmctl_fleet_stream_replayed_tokens"),
+    CounterFlow("FleetStreamHub", "total_reconnects", "reconnects",
+                "llmctl_fleet_stream_reconnects"),
+    CounterFlow("FleetStreamHub", "total_gaps_healed", "gaps_healed",
+                "llmctl_fleet_stream_gaps_healed"),
+    CounterFlow("FleetStreamHub", "total_out_of_order", "out_of_order",
+                None),
+    CounterFlow("FleetStreamHub", "total_identity_mismatches",
+                "identity_mismatches", None),
+    CounterFlow("FleetStreamHub", "total_backpressure_drops",
+                "backpressure_drops",
+                "llmctl_fleet_stream_backpressure_drops"),
+    CounterFlow("FleetStreamHub", "total_orphan_logs_gc",
+                "orphan_logs_gc", "llmctl_fleet_stream_orphan_gcs"),
+    CounterFlow("FleetStreamHub", "total_front_resumes",
+                "front_resumes", "llmctl_fleet_front_reconnects"),
+    # front-tier counters -> FleetFrontTier.snapshot() keys
+    CounterFlow("FleetFrontTier", "total_front_failovers", "failovers",
+                "llmctl_fleet_front_failovers"),
+    CounterFlow("FleetFrontTier", "total_front_respawns", "respawns",
+                None),
     # supervisor counters -> ReplicaSupervisor.snapshot() keys
     # (per-replica restarts ride llmctl_fleet_replica_restarts; the
     # fleet-wide totals below are status-surface only)

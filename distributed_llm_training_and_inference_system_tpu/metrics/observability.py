@@ -262,6 +262,15 @@ class PrometheusExporter:
         self.fleet_stream_backpressure_drops = mk(
             "llmctl_fleet_stream_backpressure_drops")
         self.fleet_stream_replay = mk("llmctl_fleet_stream_replay_tokens")
+        self.fleet_stream_orphan_gcs = mk(
+            "llmctl_fleet_stream_orphan_gcs")
+        # HA front tier (serve/fleet/front.py + state.py)
+        self.fleet_front_failovers = mk("llmctl_fleet_front_failovers")
+        self.fleet_front_reconnects = mk(
+            "llmctl_fleet_front_reconnects")
+        self.fleet_front_up = mk("llmctl_fleet_front_up")
+        self.fleet_front_active_streams = mk(
+            "llmctl_fleet_front_active_streams")
         # speculative decode plane (serve/speculative.py SpecState)
         self.fleet_spec_dispatches = mk("llmctl_fleet_spec_dispatches")
         self.fleet_spec_drafts = mk("llmctl_fleet_spec_drafts")
@@ -463,7 +472,9 @@ class PrometheusExporter:
                 ("reconnects", self.fleet_stream_reconnects),
                 ("gaps_healed", self.fleet_stream_gaps_healed),
                 ("backpressure_drops",
-                 self.fleet_stream_backpressure_drops)):
+                 self.fleet_stream_backpressure_drops),
+                ("orphan_logs_gc", self.fleet_stream_orphan_gcs),
+                ("front_resumes", self.fleet_front_reconnects)):
             total = st.get(key, 0)
             delta = total - self._last_totals.get(f"fleet_st_{key}", 0)
             if delta > 0:
@@ -476,6 +487,20 @@ class PrometheusExporter:
             for s in sizes[-min(new, len(sizes)):]:
                 self.fleet_stream_replay.observe(s)
         self._last_totals["fleet_st_replays"] = count
+        # HA front tier: per-front liveness/load gauges from the shared
+        # store's registry + the tier failover counter (running total,
+        # delta'd like every other fleet counter)
+        ft = snap.get("front_tier", {})
+        for fid, entry in (ft.get("fronts") or {}).items():
+            self.fleet_front_up.labels(front=fid).set(
+                1.0 if entry.get("alive") else 0.0)
+            self.fleet_front_active_streams.labels(front=fid).set(
+                entry.get("active_streams", 0))
+        total = ft.get("failovers", 0)
+        delta = total - self._last_totals.get("fleet_front_failovers", 0)
+        if delta > 0:
+            self.fleet_front_failovers.inc(delta)
+        self._last_totals["fleet_front_failovers"] = total
 
 
 class OTLPExporter:

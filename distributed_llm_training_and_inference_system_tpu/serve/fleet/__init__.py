@@ -40,7 +40,10 @@ from .migration import MigrationTicket
 from .remote import RemoteReplica, RemoteUnavailable
 from .replica import (ROLE_DECODE, ROLE_MIXED, ROLE_PREFILL, EngineReplica,
                       reset_for_requeue)
+from .front import FleetFrontTier
 from .router import FleetRouter, FleetSaturated, prefix_digest
+from .state import (FleetStateStore, InMemoryStateStore,
+                    SharedFileStateStore, StoreFenced, build_state_store)
 from .streams import FleetStreamHub
 from .supervisor import ReplicaSupervisor
 from .transport import (CourierReceiver, HTTPCourierTransport,
@@ -54,10 +57,13 @@ __all__ = [
     "EngineReplica",
     "FaultInjector",
     "FaultPlan",
+    "FleetFrontTier",
     "FleetRouter",
     "FleetSaturated",
+    "FleetStateStore",
     "FleetStreamHub",
     "HTTPCourierTransport",
+    "InMemoryStateStore",
     "InProcTransport",
     "InjectedCrash",
     "KVCourier",
@@ -71,8 +77,11 @@ __all__ = [
     "ROLE_PREFILL",
     "ReplicaSupervisor",
     "ServeFleet",
+    "SharedFileStateStore",
+    "StoreFenced",
     "TransferAborted",
     "TransportError",
+    "build_state_store",
     "build_transport",
     "is_ticket_stub",
     "prefix_digest",
@@ -97,7 +106,8 @@ class ServeFleet:
                  fault_plan: Optional[FaultPlan] = None,
                  observer: Optional[Callable[[str, dict], None]] = None,
                  eos_token_id: Optional[int] = None, seed: int = 0,
-                 supervise: bool = True):
+                 supervise: bool = True,
+                 front_id: Optional[str] = None):
         self.fleet_cfg = fleet_cfg or FleetConfig()
         self.fleet_cfg.validate()    # incl. endpoint-map/remote mismatch
         self.serve_cfg = serve_cfg
@@ -113,6 +123,16 @@ class ServeFleet:
         # destinations use the local receiver; remote destinations are
         # pushed over HTTP per the fleet_endpoints map.
         self.courier = KVCourier(self.fleet_cfg, injector=self.injector)
+        # replicable front state (serve/fleet/state.py): the stream logs
+        # and router ledger live behind this store. The default
+        # in-memory store keeps today's single-front behavior
+        # byte-for-byte; `state_store = "file"` externalizes both so N
+        # stateless fronts (each its own ServeFleet over the SAME remote
+        # workers and store directory) serve one fleet — the HA front
+        # tier.
+        self.store = build_state_store(self.fleet_cfg,
+                                       front_id=front_id)
+        self.front_id = self.store.front_id
         # fleet SSE streaming: the per-request token log + stream hub
         # (serve/fleet/streams.py). Every replica a streaming request
         # crosses publishes its token batches here with monotonic
@@ -123,7 +143,8 @@ class ServeFleet:
         self.streams = FleetStreamHub(
             ttl_ms=self.fleet_cfg.stream_log_ttl_ms,
             max_buffered_batches=self.fleet_cfg
-            .stream_max_buffered_batches)
+            .stream_max_buffered_batches,
+            store=self.store)
         # inbound chunk reassembly for the HTTP front
         # (/fleet/courier/chunk) shares the courier's receiver, so
         # socket-delivered and in-proc transfers attach in one place
@@ -159,9 +180,15 @@ class ServeFleet:
                          and self.fleet_cfg.prefix_fetch) else 0)
         self.router = FleetRouter(self.replicas, self.fleet_cfg,
                                   observer=observer, courier=self.courier,
-                                  page_size=page_size)
+                                  page_size=page_size, store=self.store)
+        # HA front tier: a terminal record folded from a sibling front
+        # completes the local Request object (waiters, SSE finish)
+        self.router.on_store_pop = self._complete_from_store
         for r in self.replicas:
             if getattr(r, "remote", False):
+                # multi-front: finished entries for requests ANOTHER
+                # front submitted still close the shared log + ledger
+                r.on_foreign = self._on_foreign_finished
                 # a remote prefill worker parks its handoffs under a
                 # ticket and publishes them through its outbox; the
                 # supervisor's migrated-collection places them — and it
@@ -188,11 +215,38 @@ class ServeFleet:
         self.supervisor = ReplicaSupervisor(
             self.replicas, self.router, self.fleet_cfg,
             injector=self.injector, params=params, observer=observer,
-            streams=self.streams)
+            streams=self.streams, store=self.store)
         self._supervise = supervise
 
     def _on_request_exit(self, replica_id: int, req: Request) -> None:
         self.router.on_request_exit(replica_id, req)
+
+    # -- HA front tier seams -------------------------------------------------
+
+    def _on_foreign_finished(self, replica_id: int, entry: dict) -> None:
+        """A worker's finished entry for a request some OTHER front
+        submitted (the multi-front outbox split): final-sync + finish
+        the shared stream log, then close the shared ledger. The
+        journaled pop record carries the terminal tokens, so the owning
+        front folds it and completes its local waiter."""
+        rid = str(entry.get("request_id", ""))
+        if not rid:
+            return
+        tokens = [int(t) for t in entry.get("generated_tokens", [])]
+        if self.streams.has(rid):
+            self.streams.sync(rid, tokens, replica=replica_id)
+            self.streams.finish(rid, entry.get("finish_reason"),
+                                entry.get("error"))
+        self.router.foreign_exit(rid, entry, replica_id)
+
+    def _complete_from_store(self, rid: str, rec: dict) -> None:
+        """Folded terminal ledger record: if this front still holds the
+        Request object (it submitted it; the finish drained elsewhere),
+        complete it so HTTP waiters and SSE finish frames resolve."""
+        for r in self.replicas:
+            fn = getattr(r, "complete_foreign", None)
+            if fn is not None and fn(rid, rec):
+                return
 
     def _on_stream_tokens(self, replica_id: int, req: Request,
                           tokens: list) -> None:

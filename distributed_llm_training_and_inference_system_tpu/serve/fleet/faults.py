@@ -98,6 +98,19 @@ class FaultPlan:
     # count is a partition that heals before the miss budget runs out)
     rpc_blackhole_replica: Optional[int] = None
     rpc_blackhole_count: int = 0
+    # HA front tier faults (serve/fleet/front.py FleetFrontTier): kill
+    # (SIGKILL) or stall (SIGSTOP, SIGCONT after `front_stall_ms`) the
+    # front process at `front_*_front` once, `front_*_after_s` seconds
+    # after the tier starts. after_s <= 0 draws the time from the
+    # seeded RNG in [front_fault_lo_s, front_fault_hi_s) — "kill a
+    # front at a random-but-reproducible moment" stays a one-liner.
+    front_kill_front: Optional[int] = None
+    front_kill_after_s: float = 0.0
+    front_stall_front: Optional[int] = None
+    front_stall_after_s: float = 0.0
+    front_stall_ms: float = 200.0
+    front_fault_lo_s: float = 0.5
+    front_fault_hi_s: float = 3.0
 
 
 class FaultInjector:
@@ -123,6 +136,23 @@ class FaultInjector:
                                    if p.chunk_fault_budget > 0 else None)
         self._unreachable_left = p.dest_unreachable_count
         self._blackhole_left = p.rpc_blackhole_count
+        # front-fault state: times drawn once from a dedicated stream
+        # (seed+2) when the plan leaves them unpinned; each fires once
+        front_rng = np.random.default_rng(p.seed + 2)
+
+        def _front_at(after_s: float) -> float:
+            if after_s > 0:
+                return after_s
+            return float(front_rng.uniform(
+                p.front_fault_lo_s,
+                max(p.front_fault_hi_s, p.front_fault_lo_s + 1e-3)))
+
+        self._front_kill_at = (_front_at(p.front_kill_after_s)
+                               if p.front_kill_front is not None
+                               else None)
+        self._front_stall_at = (_front_at(p.front_stall_after_s)
+                                if p.front_stall_front is not None
+                                else None)
 
     def before_step(self, replica_id: int) -> None:
         """Called by the replica loop before each engine step; raises
@@ -214,6 +244,26 @@ class FaultInjector:
             if fault is not None and self._chunk_faults_left is not None:
                 self._chunk_faults_left -= 1
         return fault
+
+    def front_faults_due(self, elapsed_s: float) -> list[tuple]:
+        """Called by the FleetFrontTier babysit loop with the seconds
+        since the tier started. Returns the front faults now due, each
+        at most once, as ``("kill", front_index)`` /
+        ``("stall", front_index, stall_ms)`` tuples — the tier delivers
+        the signals (SIGKILL / SIGSTOP+SIGCONT)."""
+        due: list[tuple] = []
+        p = self.plan
+        with self._lock:
+            if self._front_kill_at is not None \
+                    and elapsed_s >= self._front_kill_at:
+                due.append(("kill", int(p.front_kill_front)))
+                self._front_kill_at = None
+            if self._front_stall_at is not None \
+                    and elapsed_s >= self._front_stall_at:
+                due.append(("stall", int(p.front_stall_front),
+                            float(p.front_stall_ms)))
+                self._front_stall_at = None
+        return due
 
     def steps_taken(self, replica_id: int) -> int:
         with self._lock:

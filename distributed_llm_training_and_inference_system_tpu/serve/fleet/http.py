@@ -75,7 +75,8 @@ logger = logging.getLogger("llmctl.serve.fleet.http")
 class FleetServer:
     def __init__(self, model_cfg: ModelConfig, serve_cfg: ServeConfig,
                  fleet_cfg: FleetConfig, params=None, observer=None,
-                 fault_plan: Optional[FaultPlan] = None):
+                 fault_plan: Optional[FaultPlan] = None,
+                 front_id: Optional[str] = None):
         self.serve_cfg = serve_cfg
         self.observer = observer or (lambda event, payload: None)
         self.tokenizer = load_tokenizer(serve_cfg.artifact or None,
@@ -83,8 +84,15 @@ class FleetServer:
         self.fleet = ServeFleet(
             model_cfg, serve_cfg, fleet_cfg, params=params,
             observer=self.observer, fault_plan=fault_plan,
-            eos_token_id=getattr(self.tokenizer, "eos_token_id", None))
+            eos_token_id=getattr(self.tokenizer, "eos_token_id", None),
+            front_id=front_id)
         self.model_cfg = self.fleet.model_cfg    # artifact-effective config
+        # readiness gate (HA front tier): /health answers 503 until this
+        # front has attached to the state store AND completed one
+        # supervisor snapshot read — a load balancer (or loadgen front
+        # list) never routes to a front that would 500 on arrival
+        self._ready = False
+        self._refresher: Optional[asyncio.Task] = None
         self.app = self._build_app()
 
     # -- handlers ------------------------------------------------------------
@@ -326,6 +334,12 @@ class FleetServer:
 
     @aiohttp_handler
     async def handle_health(self, request: web.Request) -> web.Response:
+        if not self._ready:
+            # not yet attached to the state store / first snapshot not
+            # read: refuse traffic instead of 500ing on it
+            return web.json_response(
+                {"status": "starting",
+                 "front": self.fleet.front_id}, status=503)
         snap = self.fleet.status()
         healthy = [r for r in snap["replicas"] if r["state"] == "healthy"]
         # the fleet is up while ANY replica can take traffic; a load
@@ -494,10 +508,40 @@ class FleetServer:
         await runner.setup()
         site = web.TCPSite(runner, self.serve_cfg.host, self.serve_cfg.port)
         await site.start()
-        logger.info("fleet serving %s on %s:%d (%d replicas)",
+        self.bound_port = runner.addresses[0][1]
+        # readiness, in order: attach to the state store (register this
+        # front's port + fencing epoch), fold the journal once, read one
+        # supervisor snapshot — only then does /health go 200
+        store = self.fleet.store
+        store.attach(info={"port": self.bound_port})
+        if store.shared:
+            store.sync()
+            # fold sibling fronts' journal records between supervisor
+            # polls too, so live SSE delivery for streams another front
+            # is feeding doesn't wait a whole probe interval
+            self._refresher = asyncio.get_running_loop().create_task(
+                self._store_refresher())
+        self.fleet.status()
+        self._ready = True
+        logger.info("fleet serving %s on %s:%d (%d replicas, front %s)",
                     self.model_cfg.name, self.serve_cfg.host,
-                    self.serve_cfg.port, len(self.fleet.replicas))
+                    self.bound_port, len(self.fleet.replicas),
+                    self.fleet.front_id)
         return runner
+
+    async def _store_refresher(self, interval_s: float = 0.02) -> None:
+        loop = asyncio.get_running_loop()
+        store = self.fleet.store
+        while True:
+            try:
+                # blocking file I/O off the event loop so SSE writes and
+                # courier chunk ingestion stay responsive
+                await loop.run_in_executor(None, store.sync)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("store refresh failed")
+            await asyncio.sleep(interval_s)
 
     def run_forever(self) -> None:
         async def _main():
@@ -506,6 +550,8 @@ class FleetServer:
                 while True:
                     await asyncio.sleep(3600)
             finally:
+                if self._refresher is not None:
+                    self._refresher.cancel()
                 await runner.cleanup()
                 self.fleet.shutdown()
         asyncio.run(_main())

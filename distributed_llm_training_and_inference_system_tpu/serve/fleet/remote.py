@@ -168,6 +168,14 @@ class RemoteReplica:
         # stream hub (which dedupes by seq, so late or re-delivered
         # batches after a SIGKILL/requeue are harmless).
         self.on_tokens: Optional[Callable] = None
+        # HA front tier: fired with (replica_id, entry) for a finished
+        # outbox entry whose request THIS front never submitted — in a
+        # multi-front deployment the worker's outbox drains to whichever
+        # front polls first, and the collector must finish the shared
+        # stream log + ledger on behalf of the front that owns the
+        # waiter (serve/fleet/state.py). None = drop, the single-front
+        # behavior.
+        self.on_foreign: Optional[Callable] = None
         self.role = role
         self.poll_interval_s = poll_interval_s
         self.timeout_s = float(getattr(fleet_cfg, "remote_timeout_s", 5.0))
@@ -578,6 +586,11 @@ class RemoteReplica:
         with self._lock:
             req = self._inflight.pop(rid, None)
         if req is None:
+            # another front submitted it (multi-front outbox split):
+            # hand the terminal facts to the fleet's foreign-finish
+            # path so the shared stream log and ledger still close
+            if self.on_foreign is not None:
+                self.on_foreign(self.replica_id, e)
             return
         req.generated_tokens = [int(t) for t in
                                 e.get("generated_tokens", [])]
@@ -593,6 +606,34 @@ class RemoteReplica:
             req.state = RequestState.FINISHED
         if self.on_finish is not None:
             self.on_finish(self.replica_id, req)
+
+    def complete_foreign(self, rid: str, rec: dict) -> bool:
+        """Complete a locally-held request from a FOLDED terminal ledger
+        record (serve/fleet/state.py): this front submitted the request,
+        but its finished outbox entry drained to a sibling front, which
+        journaled the terminal facts. Applies them to the local Request
+        object and fires ``on_finish`` so waiters (HTTP responses, SSE
+        finish frames) resolve. False = not held here."""
+        with self._lock:
+            req = self._inflight.pop(rid, None)
+        if req is None:
+            return False
+        toks = rec.get("tokens")
+        if toks is not None:
+            req.generated_tokens = [int(t) for t in toks]
+        now = time.monotonic()
+        if req.first_token_time is None:
+            req.first_token_time = now
+        req.finish_time = now
+        req.finish_reason = rec.get("finish_reason")
+        if rec.get("outcome") == "failed":
+            req.state = RequestState.FAILED
+            req.error = rec.get("error") or "failed on remote worker"
+        else:
+            req.state = RequestState.FINISHED
+        if self.on_finish is not None:
+            self.on_finish(self.replica_id, req)
+        return True
 
     def take_orphans(self) -> list[Request]:
         with self._lock:

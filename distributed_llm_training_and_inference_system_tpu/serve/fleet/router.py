@@ -26,6 +26,17 @@ Retry-After upstream) instead of growing unbounded tail latency.
 Every accepted request is accounted terminally: completed, failed (requeue
 budget exhausted / parked overflow), or still in flight — ``stats()``
 exposes the ledger and tests assert nothing is silently dropped.
+
+HA front tier (serve/fleet/state.py): the ledger (``_meta``), the
+terminal counters, and the parked queue are a working view over a
+replicable :class:`FleetStateStore`. The in-memory default changes
+nothing; with a shared store every mutation journals one record and
+:meth:`apply_record` folds other fronts' records in, so N stateless
+fronts agree on which requests are in flight, share one requeue budget
+per request, balance one fleet-wide ledger, and — via the
+deterministic adopter — recover a dead front's parked requests
+(re-prefilled from their journaled wire form: the payload bytes are
+advisory, the tokens are the truth).
 """
 
 from __future__ import annotations
@@ -41,6 +52,7 @@ from typing import Callable, Iterable, Optional, Sequence
 from ...config.schema import FleetConfig
 from ..scheduler import Request, RequestState, SamplingParams
 from .replica import reset_for_requeue
+from .state import FleetStateStore, StoreFenced
 
 logger = logging.getLogger("llmctl.serve.fleet.router")
 
@@ -81,7 +93,8 @@ def _needs_prefill(req: Request) -> bool:
 class FleetRouter:
     def __init__(self, replicas: Iterable, cfg: Optional[FleetConfig] = None,
                  observer: Optional[Callable[[str, dict], None]] = None,
-                 courier=None, page_size: int = 0):
+                 courier=None, page_size: int = 0,
+                 store: Optional[FleetStateStore] = None):
         self.cfg = cfg or FleetConfig()
         self.replicas = list(replicas)
         self.by_id = {r.replica_id: r for r in self.replicas}
@@ -130,6 +143,19 @@ class FleetRouter:
         self._meta: dict[str, dict] = {}            # rid -> ledger entry
         self._parked: list[Request] = []            # requeues awaiting a
         #                                             healthy replica
+        # replicable ledger (serve/fleet/state.py): the in-memory default
+        # journals nothing, so a single-front router is bit-identical to
+        # the pre-store one. Shared stores fold sibling fronts' records
+        # into _meta/counters and surface their parked requests here.
+        self.store = store or FleetStateStore()
+        self.store.on("ledger", self.apply_record)
+        self._folding = 0
+        self._parked_remote: dict[str, tuple[str, dict]] = {}
+        # fired on a folded terminal record so the owning front can
+        # complete its local Request object (waiters, SSE finish) for a
+        # request whose finished outbox entry another front collected
+        self.on_store_pop: Optional[Callable[[str, dict], None]] = None
+        self.total_parked_adopted = 0
         self.total_submitted = 0
         self.total_completed = 0
         self.total_failed = 0
@@ -302,6 +328,112 @@ class FleetRouter:
             req.prefix_owner = best
             req.prefix_owner_endpoint = self._endpoints.get(best)
 
+    # -- shared-ledger plumbing ----------------------------------------------
+
+    def _rec(self, rec: dict) -> None:
+        """Journal one ledger mutation (no-op on the in-memory store; a
+        fenced front keeps operating locally — it is being superseded
+        and its replacement folds from the journal, not from it)."""
+        if self._folding or not self.store.shared:
+            return
+        try:
+            self.store.record({"ns": "ledger", **rec})
+        except StoreFenced:
+            logger.warning("ledger store write refused: front %s is "
+                           "fenced", self.store.front_id)
+
+    @staticmethod
+    def _wire(req: Request) -> dict:
+        """Serializable resume form for the shared ledger (prompt +
+        progress + sampling; KV payloads stay host-local — an adopted
+        request re-prefills, degraded never wrong)."""
+        from .remote import request_to_wire
+        wire = request_to_wire(req)
+        wire.pop("ticket", None)      # the ticket dies with its host
+        return wire
+
+    def knows(self, request_id: str) -> bool:
+        """Ledger membership — fleet-wide when the store is shared. The
+        stream hub's unfinished-log GC keys off this."""
+        with self._lock:
+            return request_id in self._meta
+
+    def apply_record(self, rec: dict) -> None:
+        """Fold one sibling front's ledger record. Upsert semantics
+        throughout (requeues fold by max, pops are idempotent), so
+        interleaved or replayed records cannot corrupt the view."""
+        op = rec.get("op")
+        rid = str(rec.get("rid", ""))
+        hook = None
+        with self._lock:
+            self._folding += 1
+            try:
+                if op == "put":
+                    self._meta.setdefault(rid, {
+                        "requeues": 0, "replica": None,
+                        "owner": rec.get("f"),
+                        "wire": rec.get("wire")})
+                elif op == "meta":
+                    meta = self._meta.get(rid)
+                    if meta is not None:
+                        if rec.get("replica") is not None:
+                            meta["replica"] = rec["replica"]
+                        if rec.get("requeues") is not None:
+                            meta["requeues"] = max(
+                                meta.get("requeues", 0),
+                                int(rec["requeues"]))
+                elif op == "pop":
+                    meta = self._meta.pop(rid, None)
+                    self._parked_remote.pop(rid, None)
+                    outcome = rec.get("outcome")
+                    if meta is not None:
+                        if outcome == "completed":
+                            self.total_completed += 1
+                            r = rec.get("replica")
+                            if r is not None:
+                                self.completed_per_replica[r] = (
+                                    self.completed_per_replica.get(r, 0)
+                                    + 1)
+                        elif outcome == "failed":
+                            self.total_failed += 1
+                        elif outcome == "rejected":
+                            self.total_rejected += 1
+                    if outcome in ("completed", "failed"):
+                        hook = self.on_store_pop
+                elif op == "count":
+                    key = rec.get("key")
+                    n = int(rec.get("n", 1))
+                    if key == "submitted":
+                        self.total_submitted += n
+                        r = rec.get("replica")
+                        if r is not None:
+                            self.routed_per_replica[r] = (
+                                self.routed_per_replica.get(r, 0) + n)
+                    elif key == "requeues":
+                        self.total_requeues += n
+                        r = rec.get("replica")
+                        if r is not None:
+                            self.requeues_per_replica[r] = (
+                                self.requeues_per_replica.get(r, 0) + n)
+                    elif key == "rejected":
+                        self.total_rejected += n
+                    elif key == "migrations":
+                        self.total_migrations += n
+                    elif key == "handoffs":
+                        self.total_handoffs += n
+                elif op == "park":
+                    if rid in self._meta:
+                        self._parked_remote[rid] = (rec.get("f", ""),
+                                                    rec.get("wire") or {})
+                elif op == "unpark":
+                    self._parked_remote.pop(rid, None)
+            finally:
+                self._folding -= 1
+        if hook is not None:
+            # outside the lock: the hook walks replicas and fires the
+            # waiter for a request another front saw finish
+            hook(rid, rec)
+
     # -- submission ----------------------------------------------------------
 
     def submit(self, prompt_tokens: Sequence[int],
@@ -332,6 +464,11 @@ class FleetRouter:
             self._meta[req.request_id] = {"requeues": 0, "replica": None}
             if on_complete is not None:
                 self._waiters[req.request_id] = on_complete
+            # the journaled wire form lets a surviving front adopt this
+            # request if both its placement AND this front die
+            self._rec({"op": "put", "rid": req.request_id,
+                       "wire": (self._wire(req)
+                                if self.store.shared else None)})
         invs = self._inventories() if self._hints_enabled(req) else {}
         for i, r in enumerate(cands):
             if invs:
@@ -344,12 +481,18 @@ class FleetRouter:
                     self._meta[req.request_id]["replica"] = r.replica_id
                     if affinity_first and i == 0:
                         self.total_affinity_hits += 1
+                    self._rec({"op": "count", "key": "submitted",
+                               "replica": r.replica_id})
+                    self._rec({"op": "meta", "rid": req.request_id,
+                               "replica": r.replica_id})
                 return req
         # nobody accepted: either zero healthy replicas or every queue full
         with self._lock:
             self._meta.pop(req.request_id, None)
             self._waiters.pop(req.request_id, None)
             self.total_rejected += 1
+            self._rec({"op": "pop", "rid": req.request_id,
+                       "outcome": "rejected"})
         if req.error:      # per-replica validation rejected it (too long)
             raise ValueError(req.error)
         raise FleetSaturated(
@@ -373,10 +516,51 @@ class FleetRouter:
                     self.completed_per_replica[replica_id] = (
                         self.completed_per_replica.get(replica_id, 0) + 1)
                 final_meta = {**meta, "replica": replica_id}
+                failed = req.state is RequestState.FAILED
+                self._rec({
+                    "op": "pop", "rid": req.request_id,
+                    "outcome": "failed" if failed else "completed",
+                    "replica": replica_id,
+                    # the terminal token list rides the record so any
+                    # front can final-sync the stream log and complete
+                    # its local waiter for a request it submitted but
+                    # whose finish another front collected
+                    "tokens": ([int(t) for t in req.generated_tokens]
+                               if self.store.shared else None),
+                    "finish_reason": req.finish_reason,
+                    "error": req.error if failed else None})
         if meta is not None:
             req.fleet_meta = final_meta      # per-replica loadgen breakdown
         if waiter is not None:
             waiter(req)
+
+    def foreign_exit(self, rid: str, entry: dict,
+                     replica_id: int) -> None:
+        """Terminal accounting for a request THIS front never submitted
+        (multi-front outbox split: the worker's finished entry drained
+        here, the waiter lives on a sibling front). Pops the folded
+        ledger entry, settles the counters, and journals a pop record
+        carrying the terminal tokens so the owning front can complete
+        its local Request object."""
+        failed = entry.get("state") == "failed"
+        with self._lock:
+            meta = self._meta.pop(rid, None)
+            if meta is None:
+                return        # already settled (duplicate / raced fold)
+            if failed:
+                self.total_failed += 1
+            else:
+                self.total_completed += 1
+                self.completed_per_replica[replica_id] = (
+                    self.completed_per_replica.get(replica_id, 0) + 1)
+            self._rec({
+                "op": "pop", "rid": rid,
+                "outcome": "failed" if failed else "completed",
+                "replica": replica_id,
+                "tokens": [int(t) for t in
+                           entry.get("generated_tokens", [])],
+                "finish_reason": entry.get("finish_reason"),
+                "error": entry.get("error") if failed else None})
 
     def _fail(self, req: Request, error: str) -> None:
         req.state = RequestState.FAILED
@@ -387,6 +571,11 @@ class FleetRouter:
             self.total_failed += 1
             meta = self._meta.pop(req.request_id, None)
             waiter = self._waiters.pop(req.request_id, None)
+            self._rec({"op": "pop", "rid": req.request_id,
+                       "outcome": "failed",
+                       "tokens": ([int(t) for t in req.generated_tokens]
+                                  if self.store.shared else None),
+                       "finish_reason": "error", "error": error})
         if meta is not None:
             req.fleet_meta = meta
         if waiter is not None:
@@ -408,6 +597,10 @@ class FleetRouter:
                 self.total_requeues += 1
                 self.requeues_per_replica[from_replica] = (
                     self.requeues_per_replica.get(from_replica, 0) + 1)
+                self._rec({"op": "count", "key": "requeues",
+                           "replica": from_replica})
+                self._rec({"op": "meta", "rid": req.request_id,
+                           "requeues": n})
             if n > self.cfg.max_requeues:
                 self._fail(req, f"requeued {n} times (max_requeues="
                                 f"{self.cfg.max_requeues})")
@@ -428,6 +621,10 @@ class FleetRouter:
                                 >= self.cfg.max_pending)
                     if not overflow:
                         self._parked.append(req)
+                        self._rec({"op": "park", "rid": req.request_id,
+                                   "wire": (self._wire(req)
+                                            if self.store.shared
+                                            else None)})
                 if overflow:
                     self._fail(req, "no healthy replica and the requeue "
                                     "buffer is full")
@@ -470,6 +667,8 @@ class FleetRouter:
                     meta = self._meta.get(req.request_id)
                     if meta is not None:
                         meta["replica"] = dest
+                    self._rec({"op": "meta", "rid": req.request_id,
+                               "replica": dest})
         if not placed:
             placed = (self._place(req, exclude=frozenset({from_replica}),
                                   src=from_replica)
@@ -480,11 +679,18 @@ class FleetRouter:
                     self.total_handoffs += 1
                 else:
                     self.total_migrations += 1
+                self._rec({"op": "count",
+                           "key": ("handoffs" if kind == "handoff"
+                                   else "migrations")})
         else:
             with self._lock:
                 overflow = len(self._parked) >= self.cfg.max_pending
                 if not overflow:
                     self._parked.append(req)
+                    self._rec({"op": "park", "rid": req.request_id,
+                               "wire": (self._wire(req)
+                                        if self.store.shared
+                                        else None)})
             if overflow:
                 self._fail(req, f"no healthy replica for a {kind} "
                                 "sequence and the requeue buffer is full")
@@ -565,13 +771,19 @@ class FleetRouter:
                         meta = self._meta.get(req.request_id)
                         if meta is not None:
                             meta["replica"] = r.replica_id
+                        self._rec({"op": "meta", "rid": req.request_id,
+                                   "replica": r.replica_id})
                     return True
             else:
                 return False
 
     def flush_parked(self) -> int:
         """Retry parked requeues (called by the supervisor after a replica
-        returns to rotation). Returns how many found a home."""
+        returns to rotation). Returns how many found a home. With a
+        shared store, the deterministic adopter additionally rehydrates
+        requests a DEAD front parked — from their journaled wire form,
+        so they re-prefill on a survivor instead of being stranded in a
+        heap that no longer exists."""
         with self._lock:
             parked, self._parked = self._parked, []
         placed = 0
@@ -585,11 +797,58 @@ class FleetRouter:
                 src = meta.get("replica") if meta else None
             if self._place(req, src=src):
                 placed += 1
+                self._rec({"op": "unpark", "rid": req.request_id})
             else:
                 still_parked.append(req)
         if still_parked:
             with self._lock:
                 self._parked = still_parked + self._parked
+        placed += self._adopt_parked()
+        return placed
+
+    def _adopt_parked(self) -> int:
+        """Adopt dead fronts' parked requests (shared store only, one
+        deterministic adopter at a time). The adopter fences the dead
+        owner BEFORE claiming, so a zombie cannot re-place the same
+        request — and even if two fronts raced here, seq-dedupe plus
+        the idempotent pop fold make a double placement a waste of
+        FLOPs, never a correctness break."""
+        if not self.store.shared or not self._parked_remote \
+                or not self.store.is_adopter():
+            return 0
+        placed = 0
+        with self._lock:
+            candidates = list(self._parked_remote.items())
+        for rid, (owner, wire) in candidates:
+            if not owner or self.store.front_alive(owner):
+                continue
+            if not wire:
+                continue
+            self.store.fence(owner)
+            with self._lock:
+                if rid not in self._meta:      # finished concurrently
+                    self._parked_remote.pop(rid, None)
+                    continue
+                self._parked_remote.pop(rid, None)
+                self._rec({"op": "unpark", "rid": rid})
+            from .remote import request_from_wire
+            try:
+                req = request_from_wire(wire)
+            except (KeyError, TypeError, ValueError):
+                logger.warning("adoption: malformed parked wire for %s",
+                               rid)
+                continue
+            reset_for_requeue(req)
+            if self._place(req):
+                placed += 1
+                self.total_parked_adopted += 1
+                logger.warning("adopted parked request %s from dead "
+                               "front %s", rid, owner)
+            else:
+                with self._lock:
+                    self._parked.append(req)
+                    self._rec({"op": "park", "rid": rid,
+                               "wire": self._wire(req)})
         return placed
 
     def cancel(self, request_id: str) -> bool:
@@ -611,6 +870,8 @@ class FleetRouter:
                     self._parked.pop(i)
                     self._meta.pop(request_id, None)
                     self._waiters.pop(request_id, None)
+                    self._rec({"op": "pop", "rid": request_id,
+                               "outcome": "cancelled"})
                     return True
         return False
 
@@ -629,6 +890,8 @@ class FleetRouter:
                 "migrations": self.total_migrations,
                 "handoffs": self.total_handoffs,
                 "parked": len(self._parked),
+                "parked_remote": len(self._parked_remote),
+                "parked_adopted": self.total_parked_adopted,
                 "in_flight": in_flight,
                 "inventory_cache_hits": self.inventory_cache_hits,
                 "inventory_cache_misses": self.inventory_cache_misses,

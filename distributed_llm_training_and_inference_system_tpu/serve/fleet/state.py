@@ -1,0 +1,384 @@
+"""Replicable fleet state: the store behind stream logs and the ledger.
+
+Until this module, every piece of front-affine mutable state — the
+stream hub's per-request token logs (serve/fleet/streams.py) and the
+router's request ledger / parked queue (serve/fleet/router.py) — lived
+in ONE process's heap: the process terminating the HTTP connections.
+That made the front the fleet's single point of failure (ROADMAP item
+3, the PR-8 known gap verbatim: "hub logs live in control-plane memory;
+a multi-front deployment would need a shared log").
+
+:class:`FleetStateStore` externalizes exactly that state so N
+*stateless* ``FleetServer`` fronts can serve the same fleet:
+
+- :class:`InMemoryStateStore` — the single-front default. Journal
+  writes are no-ops and folds never happen, so the hub and router
+  behave byte-for-byte as before this refactor (their own dicts remain
+  the only copy).
+- :class:`SharedFileStateStore` — a host-local durable impl: an
+  append-only JSONL **journal** (every stream-log and ledger mutation,
+  one record per line, ``flock``-serialized) plus a small atomically
+  rewritten ``fronts.json`` (front registry, heartbeats, fencing,
+  tier-level counters). Each front folds the journal's tail into its
+  local working view via :meth:`sync`; a front's death loses nothing
+  because the log of record is on disk, not in its heap.
+
+Write/fold contract (the hub and router both follow it):
+
+1. every LOCAL mutation first applies to the in-process working view,
+   then appends one journal record (``record()``);
+2. ``sync()`` reads the journal tail and dispatches records from OTHER
+   fronts to the registered per-namespace handler, which applies them
+   through the same dedupe/idempotency paths a local mutation takes
+   (stream appends dedupe by seq, ledger folds are upserts) — so
+   replay, interleaving, and at-least-once delivery are all safe;
+3. records a front folds are never re-recorded (the fold guard), so
+   the journal holds each fact exactly once per originating front.
+
+Fencing: a front presumed dead (SIGKILL, stall past its heartbeat
+expiry) is **fenced** before any other actor adopts its work. A fenced
+front's next journal write raises :class:`StoreFenced` — a zombie that
+was merely stalled cannot scribble stale state over its successor's.
+
+Locking: the journal file lock (``fcntl.flock``) is never held while a
+component lock (hub/router) is wanted — ``poll`` reads and releases the
+file lock BEFORE dispatching, and ``record`` (called under component
+locks) only ever takes the file lock last. The pair (component lock ->
+file lock) and (sync lock -> component lock) cannot cycle.
+
+This is deliberately a host-local durable store (the Llumnix-style
+control plane taken to fleet scale needs the state OUT of the front
+process first); a networked store (Redis/etcd) slots behind the same
+interface without touching the hub or router.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+import uuid
+from contextlib import contextmanager
+from typing import Callable, Optional
+
+from ...analysis.annotations import thread_seam
+
+logger = logging.getLogger("llmctl.serve.fleet.state")
+
+
+class StoreFenced(RuntimeError):
+    """This front was fenced (declared dead and superseded): its writes
+    must not reach the shared state anymore."""
+
+
+class FleetStateStore:
+    """Interface + the in-memory single-front implementation.
+
+    The base class IS the in-memory store: journal writes vanish,
+    ``sync`` folds nothing, and the registry knows only this front.
+    Subclasses override the journal/registry verbs; the hub and router
+    only ever talk to this surface.
+    """
+
+    shared = False
+
+    def __init__(self, front_id: Optional[str] = None):
+        self.front_id = front_id or f"front-{uuid.uuid4().hex[:12]}"
+        self._handlers: dict[str, Callable[[dict], None]] = {}
+        # serializes fold passes so two threads can't race the cursor
+        self._sync_lock = threading.Lock()
+
+    # -- journal -------------------------------------------------------------
+
+    @thread_seam
+    def on(self, namespace: str, handler: Callable[[dict], None]) -> None:
+        """Register the fold handler for one record namespace
+        (``"stream"`` -> FleetStreamHub.apply_record, ``"ledger"`` ->
+        FleetRouter.apply_record)."""
+        self._handlers[namespace] = handler
+
+    @thread_seam
+    def record(self, rec: dict) -> None:
+        """Append one mutation record. No-op in memory: the caller's own
+        data structure already holds the only copy."""
+
+    @thread_seam
+    def poll(self) -> list[dict]:
+        """New journal records from OTHER fronts since the last poll."""
+        return []
+
+    @thread_seam
+    def sync(self) -> int:
+        """Fold the journal tail into the local working views via the
+        registered handlers. Returns how many records were applied."""
+        with self._sync_lock:
+            records = self.poll()
+            for rec in records:
+                handler = self._handlers.get(rec.get("ns", ""))
+                if handler is None:
+                    continue
+                try:
+                    handler(rec)
+                except Exception:
+                    logger.exception("state fold failed for %r", rec)
+        return len(records)
+
+    # -- front registry ------------------------------------------------------
+
+    @thread_seam
+    def attach(self, info: Optional[dict] = None) -> int:
+        """Register this front (port, pid) and return its fencing epoch."""
+        return 0
+
+    @thread_seam
+    def heartbeat(self, info: Optional[dict] = None) -> None:
+        """Refresh this front's liveness stamp (+ optional live info like
+        its active subscriber count)."""
+
+    @thread_seam
+    def fronts_view(self) -> dict:
+        """{front_id: {port, pid, epoch, alive, fenced, age_s, ...}} —
+        the `fleet status` / snapshot surface. Empty in memory (a
+        single-front fleet has nothing to coordinate)."""
+        return {}
+
+    @thread_seam
+    def fence(self, front_id: str) -> bool:
+        """Mark ``front_id`` dead-and-superseded; its next write raises
+        StoreFenced. Returns True when newly fenced."""
+        return False
+
+    @thread_seam
+    def is_fenced(self, front_id: Optional[str] = None) -> bool:
+        return False
+
+    @thread_seam
+    def front_alive(self, front_id: str) -> bool:
+        """Heartbeat-fresh and not fenced. The in-memory store only ever
+        hosts this front, which is trivially alive."""
+        return front_id == self.front_id
+
+    @thread_seam
+    def is_adopter(self) -> bool:
+        """Whether THIS front is the deterministic adopter (smallest
+        alive front id) for dead fronts' parked work — a leader chosen
+        without consensus machinery, safe because adoption is advisory
+        (the dedupe/idempotency layers absorb a double-adopt)."""
+        return True
+
+    # -- tier counters -------------------------------------------------------
+
+    @thread_seam
+    def incr(self, key: str, n: int = 1) -> int:
+        return 0
+
+    @thread_seam
+    def counters_view(self) -> dict:
+        return {}
+
+
+class InMemoryStateStore(FleetStateStore):
+    """Alias of the base store, named for configs and tests."""
+
+
+class SharedFileStateStore(FleetStateStore):
+    """File-backed shared store: journal + registry under one directory.
+
+    ``expiry_s`` is the heartbeat freshness window — a front silent for
+    longer reads as dead in :meth:`fronts_view` and stops being the
+    adopter. Fencing is explicit (the tier or a sibling front calls
+    :meth:`fence`), never implied by staleness alone: a stalled front
+    that wakes up may still write UNTIL someone fences it, and the
+    dedupe layers make those writes harmless.
+    """
+
+    shared = True
+
+    def __init__(self, root: str, front_id: Optional[str] = None,
+                 expiry_s: float = 2.0):
+        super().__init__(front_id)
+        self.root = str(root)
+        os.makedirs(self.root, exist_ok=True)
+        self._journal = os.path.join(self.root, "journal.jsonl")
+        self._fronts = os.path.join(self.root, "fronts.json")
+        self._lockfile = os.path.join(self.root, ".lock")
+        self.expiry_s = float(expiry_s)
+        self._cursor = 0
+        self.records_written = 0
+        self.records_folded = 0
+
+    @contextmanager
+    def _locked(self):
+        import fcntl
+        with open(self._lockfile, "a+") as fh:
+            fcntl.flock(fh, fcntl.LOCK_EX)
+            try:
+                yield
+            finally:
+                fcntl.flock(fh, fcntl.LOCK_UN)
+
+    def _load_registry(self) -> dict:
+        try:
+            with open(self._fronts) as fh:
+                return json.load(fh)
+        except (OSError, ValueError):
+            return {"epoch": 0, "fronts": {}, "fenced": [],
+                    "counters": {}}
+
+    def _save_registry(self, reg: dict) -> None:
+        # atomic rewrite: a reader (or a front SIGKILLed mid-save) never
+        # sees a torn registry
+        tmp = self._fronts + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(reg, fh)
+        os.replace(tmp, self._fronts)
+
+    # -- journal -------------------------------------------------------------
+
+    @thread_seam
+    def record(self, rec: dict) -> None:
+        line = json.dumps({"f": self.front_id, **rec},
+                          separators=(",", ":"))
+        with self._locked():
+            reg = self._load_registry()
+            if self.front_id in reg.get("fenced", ()):
+                raise StoreFenced(
+                    f"front {self.front_id} is fenced; write refused")
+            with open(self._journal, "a") as fh:
+                fh.write(line + "\n")
+        self.records_written += 1
+
+    @thread_seam
+    def poll(self) -> list[dict]:
+        # read under the file lock (complete lines only), dispatch after
+        # release — the file lock is never held while a component lock
+        # is wanted (see the module docstring's lock-order contract)
+        with self._locked():
+            try:
+                with open(self._journal, "rb") as fh:
+                    fh.seek(self._cursor)
+                    blob = fh.read()
+            except OSError:
+                return []
+            end = blob.rfind(b"\n")
+            if end < 0:
+                return []
+            self._cursor += end + 1
+            blob = blob[:end + 1]
+        out = []
+        for line in blob.splitlines():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("f") != self.front_id:
+                out.append(rec)
+        self.records_folded += len(out)
+        return out
+
+    # -- front registry ------------------------------------------------------
+
+    @thread_seam
+    def attach(self, info: Optional[dict] = None) -> int:
+        with self._locked():
+            reg = self._load_registry()
+            reg["epoch"] = int(reg.get("epoch", 0)) + 1
+            entry = {"epoch": reg["epoch"], "pid": os.getpid(),
+                     "t": time.time(), "started": time.time()}
+            entry.update(info or {})
+            reg.setdefault("fronts", {})[self.front_id] = entry
+            # re-attaching under the same id clears an old fence (a NEW
+            # incarnation re-using the id has a fresh epoch)
+            reg["fenced"] = [f for f in reg.get("fenced", [])
+                             if f != self.front_id]
+            self._save_registry(reg)
+            return int(reg["epoch"])
+
+    @thread_seam
+    def heartbeat(self, info: Optional[dict] = None) -> None:
+        with self._locked():
+            reg = self._load_registry()
+            entry = reg.setdefault("fronts", {}).setdefault(
+                self.front_id, {"epoch": 0, "pid": os.getpid(),
+                                "started": time.time()})
+            entry["t"] = time.time()
+            if info:
+                entry.update(info)
+            self._save_registry(reg)
+
+    @thread_seam
+    def fronts_view(self) -> dict:
+        with self._locked():
+            reg = self._load_registry()
+        now = time.time()
+        fenced = set(reg.get("fenced", ()))
+        out = {}
+        for fid, entry in sorted(reg.get("fronts", {}).items()):
+            age = now - float(entry.get("t", 0.0))
+            out[fid] = {**entry, "age_s": round(age, 3),
+                        "fenced": fid in fenced,
+                        "alive": (age < self.expiry_s
+                                  and fid not in fenced)}
+        return out
+
+    @thread_seam
+    def fence(self, front_id: str) -> bool:
+        with self._locked():
+            reg = self._load_registry()
+            if front_id in reg.get("fenced", ()):
+                return False
+            reg.setdefault("fenced", []).append(front_id)
+            self._save_registry(reg)
+        logger.warning("front %s fenced", front_id)
+        return True
+
+    @thread_seam
+    def is_fenced(self, front_id: Optional[str] = None) -> bool:
+        with self._locked():
+            reg = self._load_registry()
+        return (front_id or self.front_id) in reg.get("fenced", ())
+
+    @thread_seam
+    def front_alive(self, front_id: str) -> bool:
+        view = self.fronts_view()
+        entry = view.get(front_id)
+        return bool(entry and entry["alive"])
+
+    @thread_seam
+    def is_adopter(self) -> bool:
+        view = self.fronts_view()
+        alive = sorted(fid for fid, e in view.items() if e["alive"])
+        return bool(alive) and alive[0] == self.front_id
+
+    # -- tier counters -------------------------------------------------------
+
+    @thread_seam
+    def incr(self, key: str, n: int = 1) -> int:
+        with self._locked():
+            reg = self._load_registry()
+            counters = reg.setdefault("counters", {})
+            counters[key] = int(counters.get(key, 0)) + int(n)
+            self._save_registry(reg)
+            return counters[key]
+
+    @thread_seam
+    def counters_view(self) -> dict:
+        with self._locked():
+            reg = self._load_registry()
+        return dict(reg.get("counters", {}))
+
+
+def build_state_store(cfg, front_id: Optional[str] = None
+                      ) -> FleetStateStore:
+    """Store from FleetConfig: ``state_store`` = memory | file (the
+    latter rooted at ``state_store_dir``, which multi-front deployments
+    must share). Validation already refused file-without-dir."""
+    kind = getattr(cfg, "state_store", "memory")
+    if kind == "file":
+        expiry = max(3.0 * float(getattr(cfg, "probe_interval_s", 0.5)),
+                     0.25)
+        return SharedFileStateStore(cfg.state_store_dir,
+                                    front_id=front_id, expiry_s=expiry)
+    return InMemoryStateStore(front_id)

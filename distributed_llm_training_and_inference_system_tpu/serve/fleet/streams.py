@@ -33,6 +33,18 @@ ordered — callbacks must be non-blocking and must never call back into
 the hub or any engine (``loop.call_soon_threadsafe`` and
 ``queue.put_nowait`` are the intended shapes). The hub itself never
 calls into an engine, so hub-lock < engine-lock can never invert.
+
+HA front tier (serve/fleet/state.py): the hub's ``_logs`` dict is a
+WORKING VIEW over a replicable :class:`FleetStateStore`. With the
+default in-memory store nothing changes (writes are no-ops — the view
+is the only copy, byte-for-byte the single-front behavior). With a
+shared store, every local mutation (open / fresh append / finish /
+discard) writes one journal record, and :meth:`apply_record` folds
+OTHER fronts' records through the exact same dedupe-by-seq publish
+path — so N fronts converge on one log per request, any front can
+serve ``Last-Event-ID`` replay for a stream it never terminated, and a
+front's death loses nothing that reached the journal (the terminal
+``finish_from_request`` sync heals whatever didn't).
 """
 
 from __future__ import annotations
@@ -42,6 +54,8 @@ import threading
 import time
 from collections import deque
 from typing import Callable, Optional
+
+from .state import FleetStateStore, StoreFenced
 
 logger = logging.getLogger("llmctl.serve.fleet.streams")
 
@@ -69,10 +83,12 @@ class _Subscriber:
 
 
 class _StreamLog:
-    __slots__ = ("tokens", "finished", "finish_reason", "error", "replica",
-                 "subs", "pending", "created", "finished_at")
+    __slots__ = ("rid", "tokens", "finished", "finish_reason", "error",
+                 "replica", "subs", "pending", "created", "finished_at",
+                 "origin")
 
-    def __init__(self, now: float):
+    def __init__(self, now: float, rid: str = "", origin: str = "local"):
+        self.rid = rid
         self.tokens: list[int] = []
         self.finished = False
         self.finish_reason: Optional[str] = None
@@ -84,6 +100,11 @@ class _StreamLog:
         self.pending: dict[int, list[int]] = {}
         self.created = now
         self.finished_at: Optional[float] = None
+        # "local" = opened by this front's own submit path; "remote" =
+        # learned from the shared store (another front terminated the
+        # original connection) — a resume served off a remote-origin
+        # log IS a front failover the client survived
+        self.origin = origin
 
 
 # out-of-order buffer bound per log: batches further ahead than this are
@@ -97,7 +118,8 @@ class FleetStreamHub:
     supervisor snapshot / Prometheus pump read."""
 
     def __init__(self, ttl_ms: float = 60_000.0,
-                 max_buffered_batches: int = 0):
+                 max_buffered_batches: int = 0,
+                 store: Optional[FleetStateStore] = None):
         self._lock = threading.RLock()
         self._logs: dict[str, _StreamLog] = {}
         self._sub_seq = 0
@@ -105,6 +127,14 @@ class FleetStreamHub:
         # per-subscriber backpressure cap
         # (FleetConfig.stream_max_buffered_batches; 0 = unbounded)
         self._max_buffered = max(int(max_buffered_batches), 0)
+        # replicable log-of-record (serve/fleet/state.py): the in-memory
+        # default makes every record() a no-op and never folds, so a
+        # single-front hub is bit-identical to the pre-store one
+        self.store = store or FleetStateStore()
+        self.store.on("stream", self.apply_record)
+        # re-entrancy guard: records folded from the store must not be
+        # re-recorded (each fact lives once per originating front)
+        self._folding = 0
         # counters (running totals — the Prometheus pump deltas them)
         self.total_opened = 0
         self.total_finished = 0
@@ -116,8 +146,30 @@ class FleetStreamHub:
         self.total_out_of_order = 0      # batches buffered ahead of a gap
         self.total_identity_mismatches = 0
         self.total_backpressure_drops = 0   # slow subscribers disconnected
+        # unfinished logs evicted because the router no longer knew their
+        # request (the PR-8 leak: opened, died outside the finish wiring)
+        self.total_orphan_logs_gc = 0
+        # resumes served for streams ANOTHER front terminated (the log
+        # arrived via the shared store) — the client-visible half of a
+        # front failover, fed to llmctl_fleet_front_reconnects
+        self.total_front_resumes = 0
         self.replay_sizes: deque = deque(maxlen=64)   # per-reconnect burst
         self._dups_by_replica: dict[int, int] = {}
+
+    def _rec(self, rec: dict, force: bool = False) -> None:
+        """Journal one local mutation (no-op on the in-memory store; a
+        fenced front logs and carries on locally — it is about to be
+        torn down, and the fence exists precisely so these writes don't
+        reach the shared log). ``force`` records even mid-fold: a
+        LOCALLY-produced pending batch draining because a fold filled
+        its gap is still this front's fact to journal."""
+        if self._folding and not force:
+            return
+        try:
+            self.store.record({"ns": "stream", **rec})
+        except StoreFenced:
+            logger.warning("stream store write refused: front %s is "
+                           "fenced", self.store.front_id)
 
     # -- log lifecycle -------------------------------------------------------
 
@@ -127,11 +179,20 @@ class FleetStreamHub:
         with self._lock:
             if request_id in self._logs:
                 return False
-            self._logs[request_id] = _StreamLog(time.monotonic())
+            self._logs[request_id] = _StreamLog(time.monotonic(),
+                                                rid=request_id)
             self.total_opened += 1
+            self._rec({"op": "open", "rid": request_id})
             return True
 
     def has(self, request_id: str) -> bool:
+        with self._lock:
+            if request_id in self._logs:
+                return True
+        if not self.store.shared:
+            return False
+        # another front may have opened it: fold the journal tail first
+        self.store.sync()
         with self._lock:
             return request_id in self._logs
 
@@ -142,6 +203,7 @@ class FleetStreamHub:
             log = self._logs.pop(request_id, None)
             if log is not None and not log.finished:
                 self._finish_locked(log, "error", "stream discarded")
+                self._rec({"op": "discard", "rid": request_id})
 
     # -- publishing ----------------------------------------------------------
 
@@ -153,6 +215,20 @@ class FleetStreamHub:
         log's frontier is buffered until the gap fills."""
         if not tokens:
             return 0
+        with self._lock:
+            log = self._logs.get(request_id)
+            if log is not None:
+                if log.finished:
+                    return 0
+                return self._publish_locked(log, int(start_seq),
+                                            [int(t) for t in tokens],
+                                            replica)
+        if not self.store.shared:
+            return 0
+        # a producer this front adopted (worker outbox split across
+        # fronts) can outrun the journal fold that opens the log:
+        # catch up once and retry
+        self.store.sync()
         with self._lock:
             log = self._logs.get(request_id)
             if log is None or log.finished:
@@ -207,14 +283,20 @@ class FleetStreamHub:
             return appended
 
     def _publish_locked(self, log: _StreamLog, start: int, tokens: list,
-                        replica: Optional[int]) -> int:
+                        replica: Optional[int],
+                        record: Optional[bool] = None) -> int:
+        # whether a fresh extension here is OURS to journal: local
+        # publishes record, folded ones don't — and a buffered batch
+        # keeps the provenance it arrived with, so a local batch whose
+        # gap a FOLD fills still reaches the journal
+        rec_this = (not self._folding) if record is None else record
         if replica is not None:
             log.replica = replica
         if start > len(log.tokens):
             # ahead of a gap (remote cursor raced a requeue): hold it
             self.total_out_of_order += 1
             if len(log.pending) < _PENDING_MAX:
-                log.pending[start] = tokens
+                log.pending[start] = (tokens, rec_this)
             return 0
         skip = len(log.tokens) - start
         overlap = min(skip, len(tokens))
@@ -241,14 +323,21 @@ class FleetStreamHub:
             log.tokens.extend(fresh)
             self.total_tokens += len(fresh)
             appended = len(fresh)
+            # only the FRESH extension reaches the journal: the log of
+            # record holds each seq exactly once per originating front,
+            # and folds dedupe whatever interleaving remains
+            if rec_this:
+                self._rec({"op": "append", "rid": log.rid, "s": seq0,
+                           "t": fresh, "r": replica}, force=True)
             self._deliver_locked(log, seq0, fresh)
         # drain any buffered batch the frontier has reached
         while log.pending:
             nxt = min(log.pending)
             if nxt > len(log.tokens):
                 break
-            appended += self._publish_locked(log, nxt, log.pending.pop(nxt),
-                                             replica)
+            toks, was_local = log.pending.pop(nxt)
+            appended += self._publish_locked(log, nxt, toks, replica,
+                                             record=was_local)
         return appended
 
     def _deliver_locked(self, log: _StreamLog, start: int,
@@ -321,6 +410,8 @@ class FleetStreamHub:
         log.finished_at = time.monotonic()
         log.pending.clear()
         self.total_finished += 1
+        self._rec({"op": "finish", "rid": log.rid,
+                   "reason": finish_reason, "error": error})
         for sub in log.subs.values():
             sub.cb(("finish", finish_reason, error))
         log.subs.clear()
@@ -340,6 +431,10 @@ class FleetStreamHub:
         ``from_seq`` past the frontier clamps to it (a future
         ``Last-Event-ID`` must not wedge the reconnect); ``resume=True``
         counts the reconnect and the replayed tail."""
+        if self.store.shared:
+            # the stream may have been terminated by another front, and
+            # even a locally-known log may be behind the journal
+            self.store.sync()
         with self._lock:
             log = self._logs.get(request_id)
             if log is None:
@@ -355,6 +450,10 @@ class FleetStreamHub:
                 self.total_reconnects += 1
                 self.total_replayed += len(snapshot)
                 self.replay_sizes.append(len(snapshot))
+                if log.origin == "remote":
+                    # this front is serving a stream some OTHER front
+                    # terminated: the failover the HA tier exists for
+                    self.total_front_resumes += 1
             return {"sub": sub_id, "start": from_seq, "tokens": snapshot,
                     "finished": log.finished,
                     "finish_reason": log.finish_reason, "error": log.error}
@@ -367,11 +466,66 @@ class FleetStreamHub:
             if log is not None:
                 log.subs.pop(sub_id, None)
 
+    # -- shared-store folding ------------------------------------------------
+
+    def apply_record(self, rec: dict) -> None:
+        """Fold one journal record from another front. Applied through
+        the exact locked paths a local mutation takes (dedupe-by-seq,
+        idempotent finish), with re-recording suppressed — at-least-once
+        journal delivery is therefore safe."""
+        op = rec.get("op")
+        rid = str(rec.get("rid", ""))
+        if not rid:
+            return
+        with self._lock:
+            self._folding += 1
+            try:
+                log = self._logs.get(rid)
+                if op == "open":
+                    if log is None:
+                        self._logs[rid] = _StreamLog(
+                            time.monotonic(), rid=rid, origin="remote")
+                        self.total_opened += 1
+                elif op == "append":
+                    if log is None:
+                        # appends can reach us before (or without) the
+                        # open — e.g. this front attached mid-run
+                        log = _StreamLog(time.monotonic(), rid=rid,
+                                         origin="remote")
+                        self._logs[rid] = log
+                        self.total_opened += 1
+                    if not log.finished:
+                        self._publish_locked(
+                            log, int(rec.get("s", 0)),
+                            [int(t) for t in rec.get("t", [])],
+                            rec.get("r"))
+                elif op == "finish":
+                    if log is not None and not log.finished:
+                        self._finish_locked(log, rec.get("reason"),
+                                            rec.get("error"))
+                elif op == "discard":
+                    log = self._logs.pop(rid, None)
+                    if log is not None and not log.finished:
+                        self._finish_locked(log, "error",
+                                            "stream discarded")
+            finally:
+                self._folding -= 1
+
     # -- housekeeping / introspection ----------------------------------------
 
-    def gc(self, now: Optional[float] = None) -> int:
+    def gc(self, now: Optional[float] = None,
+           known: Optional[Callable[[str], bool]] = None) -> int:
         """Evict finished logs past the replay TTL (the reconnect window).
-        Live logs are never evicted — their request is still running."""
+
+        ``known`` (the router's ledger membership, when given) closes
+        the unfinished-log leak: a log opened by ``submit_streaming``
+        whose request died OUTSIDE the hub's finish wiring (router-side
+        failure before placement, a front that crashed between open and
+        submit) was retained forever. An unfinished log older than the
+        TTL whose request id the router no longer knows is collected —
+        its subscribers get a finish event — and counted in
+        ``orphan_logs_gc``. The TTL doubles as the grace window, so a
+        just-opened log can never race its own router registration."""
         if self._ttl_s <= 0:
             return 0
         now = time.monotonic() if now is None else now
@@ -383,6 +537,17 @@ class FleetStreamHub:
                         and now - log.finished_at > self._ttl_s:
                     del self._logs[rid]
                     evicted += 1
+                elif not log.finished and known is not None \
+                        and now - log.created > self._ttl_s \
+                        and not known(rid):
+                    self._finish_locked(log, "error",
+                                        "orphaned stream log collected")
+                    del self._logs[rid]
+                    self.total_orphan_logs_gc += 1
+                    evicted += 1
+                    logger.warning(
+                        "stream %s: unfinished log collected (router no "
+                        "longer knows the request)", rid)
         return evicted
 
     def active_count(self) -> int:
@@ -391,6 +556,8 @@ class FleetStreamHub:
 
     def tokens_of(self, request_id: str) -> Optional[list]:
         """The log's current token list (loadgen identity assertions)."""
+        if self.store.shared:
+            self.store.sync()
         with self._lock:
             log = self._logs.get(request_id)
             return None if log is None else list(log.tokens)
@@ -427,6 +594,8 @@ class FleetStreamHub:
                 "out_of_order": self.total_out_of_order,
                 "identity_mismatches": self.total_identity_mismatches,
                 "backpressure_drops": self.total_backpressure_drops,
+                "orphan_logs_gc": self.total_orphan_logs_gc,
+                "front_resumes": self.total_front_resumes,
                 # bounded recent replay bursts + the cumulative count the
                 # Prometheus pump deltas on (same contract as migration
                 # pauses)

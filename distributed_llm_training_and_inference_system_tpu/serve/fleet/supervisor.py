@@ -40,7 +40,7 @@ class ReplicaSupervisor:
                  injector: Optional[FaultInjector] = None,
                  params=None,
                  observer: Optional[Callable[[str, dict], None]] = None,
-                 streams=None):
+                 streams=None, store=None):
         self.cfg = cfg or FleetConfig()
         self.replicas = replicas
         self.router = router
@@ -49,6 +49,10 @@ class ReplicaSupervisor:
         # replay-window GC ride the supervisor poll. None = no streaming
         # plane (unit tests on bare routers).
         self.streams = streams
+        # replicable front state (serve/fleet/state.py): shared stores
+        # get a heartbeat + journal fold each poll, and the snapshot
+        # grows a "fronts" section. None/in-memory = single front.
+        self.store = store
         self.params = params          # shared weights for engine rebuilds
         self.observer = observer or (lambda event, payload: None)
         self._misses: dict[int, int] = {r.replica_id: 0 for r in replicas}
@@ -87,6 +91,14 @@ class ReplicaSupervisor:
         acted on. Deterministic: tests drive this directly."""
         now = time.monotonic() if now is None else now
         recovered = False
+        if self.store is not None and self.store.shared:
+            # fold sibling fronts' journal records into the local hub +
+            # ledger views, and stamp our own liveness (the HA tier's
+            # failure detector reads these heartbeats)
+            self.store.sync()
+            self.store.heartbeat(info={
+                "active_streams": (self.streams.active_count()
+                                   if self.streams is not None else 0)})
         # courier first: completed migrations carry live KV payloads and
         # their requests are homeless until placed — before any probe or
         # restart work, whatever the source replica's state is now
@@ -108,7 +120,10 @@ class ReplicaSupervisor:
         self._maybe_role_balance()
         self._maybe_rebalance()
         if self.streams is not None:
-            self.streams.gc()        # expire finished replay windows
+            # expire finished replay windows AND unfinished logs whose
+            # request the router no longer knows (the PR-8 leak: opened
+            # by submit_streaming, died outside the finish wiring)
+            self.streams.gc(known=self.router.knows)
         if recovered or self.router.parked_count():
             self.router.flush_parked()
         snap = self.snapshot()
@@ -649,9 +664,22 @@ class ReplicaSupervisor:
         # totals + a bounded recent transfer_ms window, same Prometheus
         # delta contract as the migration pauses above
         courier = getattr(self.router, "courier", None)
+        # HA front tier: the shared store's front registry (per-front
+        # heartbeat/port/alive) + tier counters. A single-front fleet
+        # reports itself alone; in-memory stores report nothing.
+        fronts: dict = {}
+        if self.store is not None and self.store.shared:
+            fronts = {
+                "fronts": self.store.fronts_view(),
+                "front_id": self.store.front_id,
+                "failovers": int(self.store.counters_view().get(
+                    "failovers", 0)),
+                "reconnects": (self.streams.total_front_resumes
+                               if self.streams is not None else 0),
+            }
         return {"replicas": reps, "router": self.router.stats(),
                 "restarts": self.total_restarts, "migration": migration,
-                "handoff": handoff,
+                "handoff": handoff, "front_tier": fronts,
                 # fleet SSE streaming: hub counters (running totals +
                 # the bounded replay-size window — the usual Prometheus
                 # delta contract; feeds llmctl_fleet_stream_*)

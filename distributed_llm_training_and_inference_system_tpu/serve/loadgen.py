@@ -28,6 +28,7 @@ Methodology notes:
 
 from __future__ import annotations
 
+import threading as _threading
 import time
 from dataclasses import dataclass, field
 from typing import Optional
@@ -95,7 +96,11 @@ class LoadResult:
     # exactly-once-delivery assertion), client-observed seq gaps/dups
     # (must be 0 — the hub's ordering contract), suppressed producer
     # duplicates, and per-token delivery-gap percentiles (jitter: how
-    # bursty delivery got across injected crashes/migrations)
+    # bursty delivery got across injected crashes/migrations).
+    # HTTP front-tier mode (run_stream_fronts / FrontStreamClient)
+    # additionally reports reconnects_per_front — how many times the
+    # hardened client resumed each front after a connection
+    # refused/reset (the kill-the-front failover ledger).
     stream: dict = field(default_factory=dict)
 
     def percentile(self, xs, q):
@@ -574,6 +579,199 @@ def _run_closed_loop_fleet(fleet, *, concurrency, num_requests, prompt_len,
         time.sleep(0.005)
     return _finalize_fleet(res, reqs, fleet, t0,
                            stream_clients=stream_clients)
+
+
+class FrontStreamClient:
+    """HTTP SSE client over an HA front tier's front list, hardened for
+    front death (serve/fleet/front.py).
+
+    One ``stream()`` call drives one request end to end: POST
+    ``/v1/completions`` (``stream: true``) to a front, consume SSE
+    frames, and on ANY connection failure — refused, reset mid-read,
+    timeout, a 404 from a front that hasn't folded the journal yet —
+    retry with **doubling backoff across the configured front list
+    (round-robin)** instead of failing the request: reconnect at
+    ``GET /v1/streams/{rid}`` with the last delivered seq as
+    ``Last-Event-ID`` so only the unacked tail replays. Client-side
+    dedupe-by-seq mirrors the hub's, so ``gaps``/``dups`` count real
+    contract violations (both must be 0 across a front SIGKILL).
+
+    ``reconnects_per_front`` is the failover ledger LoadResult.stream
+    surfaces: which surviving front picked each dropped client up.
+    """
+
+    def __init__(self, fronts, max_attempts: int = 16,
+                 backoff_s: float = 0.05, backoff_max_s: float = 2.0,
+                 read_timeout_s: float = 60.0):
+        self.fronts = [str(f).rstrip("/") for f in fronts]
+        self.max_attempts = int(max_attempts)
+        self.backoff_s = float(backoff_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.read_timeout_s = float(read_timeout_s)
+        self._lock = _threading.Lock()
+        self.reconnects_per_front = {f: 0 for f in self.fronts}
+        self.total_reconnects = 0
+        self.total_retries = 0          # failed attempts retried
+
+    def _count_reconnect(self, front: str) -> None:
+        with self._lock:
+            self.reconnects_per_front[front] = (
+                self.reconnects_per_front.get(front, 0) + 1)
+            self.total_reconnects += 1
+
+    def stream(self, prompt_tokens, max_tokens: int,
+               temperature: float = 0.0, seed=None,
+               start_front: int = 0) -> dict:
+        import json as _json
+        import urllib.request
+
+        rid = None
+        last_seq = -1
+        tokens: list[int] = []
+        gaps = dups = 0
+        finish_reason = None
+        done = False
+        error = None
+        fi = int(start_front)
+        backoff = self.backoff_s
+        attempts_left = self.max_attempts
+        while not done and attempts_left > 0:
+            front = self.fronts[fi % len(self.fronts)]
+            resumed = rid is not None
+            try:
+                if not resumed:
+                    body = {"prompt": [int(t) for t in prompt_tokens],
+                            "max_tokens": int(max_tokens),
+                            "temperature": float(temperature),
+                            "stream": True}
+                    if seed is not None:
+                        body["seed"] = int(seed)
+                    wire = urllib.request.Request(
+                        f"{front}/v1/completions",
+                        data=_json.dumps(body).encode(),
+                        headers={"Content-Type": "application/json"},
+                        method="POST")
+                else:
+                    wire = urllib.request.Request(
+                        f"{front}/v1/streams/{rid}"
+                        f"?last_event_id={last_seq}", method="GET")
+                with urllib.request.urlopen(
+                        wire, timeout=self.read_timeout_s) as resp:
+                    if resumed:
+                        self._count_reconnect(front)
+                    backoff = self.backoff_s
+                    for raw in resp:
+                        line = raw.decode("utf-8", "replace").strip()
+                        if not line.startswith("data:"):
+                            continue
+                        payload = line[len("data:"):].strip()
+                        if payload == "[DONE]":
+                            done = True
+                            break
+                        ev = _json.loads(payload)
+                        rid = ev.get("id", rid)
+                        choice = (ev.get("choices") or [{}])[0]
+                        toks = [int(t) for t in
+                                (choice.get("token_ids") or [])]
+                        if toks:
+                            seq_last = int(ev.get("seq",
+                                                  last_seq + len(toks)))
+                            start = seq_last - len(toks) + 1
+                            if start > last_seq + 1:
+                                gaps += 1
+                            elif start <= last_seq:
+                                dups += 1
+                            fresh = toks[max(last_seq + 1 - start, 0):]
+                            tokens.extend(fresh)
+                            last_seq = max(last_seq, seq_last)
+                        if choice.get("finish_reason"):
+                            finish_reason = choice["finish_reason"]
+            except Exception as e:          # refused/reset/timeout/404
+                error = e
+            if done:
+                break
+            # connection ended without [DONE] (killed front, dropped
+            # socket, backpressure drop) or failed outright: rotate to
+            # the next front under doubling backoff and resume
+            attempts_left -= 1
+            with self._lock:
+                self.total_retries += 1
+            if attempts_left <= 0:
+                break
+            time.sleep(backoff)
+            backoff = min(backoff * 2, self.backoff_max_s)
+            fi += 1
+        return {"ok": done, "rid": rid, "tokens": tokens, "gaps": gaps,
+                "dups": dups, "finish_reason": finish_reason,
+                "error": None if done else repr(error)}
+
+
+def run_stream_fronts(fronts, *, num_requests: int, prompt_len: int,
+                      max_tokens: int, seed: int = 0,
+                      vocab_hi: int = 1000, concurrency: int = 4,
+                      temperature: float = 0.0,
+                      client: Optional[FrontStreamClient] = None,
+                      prompts=None, pin_front: Optional[int] = None
+                      ) -> LoadResult:
+    """Closed-loop HTTP streaming load against an HA front tier.
+
+    Unlike the in-process stream mode (``run_poisson(stream=True)``),
+    every request here crosses real sockets to a front process and is
+    consumed as SSE — so killing a front mid-run exercises the full
+    failover path: reconnect to a survivor, Last-Event-ID replay,
+    shared-log delivery. ``LoadResult.stream`` reports the client-side
+    ledger: gaps/dups (must be 0), per-front reconnect counts, and the
+    per-request token lists (``token_lists``, submission order) for
+    token-identity assertions against an undisturbed engine.
+    ``pin_front`` starts every request on one front (the
+    kill-the-connection-holder scenario); default spreads round-robin.
+    """
+    rng = np.random.default_rng(seed)
+    if prompts is None:
+        prompts = [rng.integers(1, vocab_hi, size=prompt_len).tolist()
+                   for _ in range(num_requests)]
+    client = client or FrontStreamClient(fronts)
+    results: list = [None] * len(prompts)
+    sem = _threading.Semaphore(max(int(concurrency), 1))
+    t0 = time.monotonic()
+
+    def drive(i: int) -> None:
+        with sem:
+            results[i] = client.stream(
+                prompts[i], max_tokens, temperature=temperature,
+                start_front=(pin_front if pin_front is not None else i))
+
+    threads = [_threading.Thread(target=drive, args=(i,), daemon=True)
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    res = LoadResult(offered_rps=float("inf"))
+    res.duration_s = time.monotonic() - t0
+    done_tokens = 0
+    gaps = dups = 0
+    for r in results:
+        if r and r["ok"]:
+            res.completed += 1
+            done_tokens += len(r["tokens"])
+        else:
+            res.failed += 1
+        if r:
+            gaps += r["gaps"]
+            dups += r["dups"]
+    res.goodput_tokens_per_s = done_tokens / max(res.duration_s, 1e-9)
+    res.stream = {
+        "streams": len(prompts),
+        "tokens": done_tokens,
+        "gaps": gaps,
+        "duplicates": dups,
+        "reconnects": client.total_reconnects,
+        "retries": client.total_retries,
+        "reconnects_per_front": dict(client.reconnects_per_front),
+        "token_lists": [r["tokens"] if r else None for r in results],
+    }
+    return res
 
 
 def run_poisson(engine: InferenceEngine, *, offered_rps: float,

@@ -698,8 +698,14 @@ class TestCourierCompressed:
                                  params=ref_engine.params, seed=0)
         ref = [r.generated_tokens
                for r in q8_ref.generate([PROMPTS[0]], sampled)]
+        # slow-replica widener (same latent flake the fleet2+migrate
+        # regime fixed): on a warm process the 32-token run can finish
+        # before the drain lands on the engine thread, leaving nothing
+        # to migrate and an empty courier ledger. The fresh fleet's
+        # load-tie routes PROMPTS[0] to replica 0 deterministically.
         fleet = make_fleet(model_cfg, ref_engine.params, warm=True,
-                           plan=FaultPlan(**TestCourierChaos.CHAOS_PLAN),
+                           plan=FaultPlan(**TestCourierChaos.CHAOS_PLAN,
+                                          slow_replica=0, slow_ms=3.0),
                            serve_kw={"kv_quantization": "int8"},
                            fleet_kw=dict(self.COMP_KW))
         try:
@@ -1126,12 +1132,29 @@ class TestFleetMetrics:
                              "fetch_count": 4},
             "spec": {"dispatches": 10, "drafts": 70, "accepted": 35,
                      "resumes": 2, "acceptance": 0.5},
+            "streams": {"active": 1, "tokens": 11, "duplicates": 1,
+                        "replayed": 3, "reconnects": 1,
+                        "gaps_healed": 2, "backpressure_drops": 1,
+                        "orphan_logs_gc": 1, "front_resumes": 1,
+                        "replay_sizes": [3], "replay_count": 1},
+            "front_tier": {
+                "fronts": {
+                    "front-0": {"alive": True, "active_streams": 2,
+                                "port": 8080},
+                    "front-1": {"alive": False, "fenced": True,
+                                "active_streams": 0, "port": 8081}},
+                "front_id": "front-0", "failovers": 1,
+                "reconnects": 1},
         }
         exporter.export_fleet(snap)
         samples = {}
+        front_samples = {}
         for metric in prometheus_client.REGISTRY.collect():
             for s in metric.samples:
-                samples[(s.name, s.labels.get("replica"))] = s.value
+                if "front" in s.labels:
+                    front_samples[(s.name, s.labels["front"])] = s.value
+                else:
+                    samples[(s.name, s.labels.get("replica"))] = s.value
         assert samples[("llmctl_fleet_replica_queue_depth", "0")] == 3
         assert samples[("llmctl_fleet_replica_outstanding_tokens", "0")] \
             == 170
@@ -1201,6 +1224,20 @@ class TestFleetMetrics:
         assert samples[("llmctl_fleet_spec_drafts_total", None)] == 70
         assert samples[("llmctl_fleet_spec_accepted_total", None)] == 35
         assert samples[("llmctl_fleet_spec_resumes_total", None)] == 2
+        # stream plane + HA front tier (round 17): the orphan-log GC
+        # counter, failover resume counter, tier failovers, and the
+        # per-front liveness/load gauges
+        assert samples[("llmctl_fleet_stream_tokens_total", None)] == 11
+        assert samples[
+            ("llmctl_fleet_stream_orphan_gcs_total", None)] == 1
+        assert samples[
+            ("llmctl_fleet_front_reconnects_total", None)] == 1
+        assert samples[
+            ("llmctl_fleet_front_failovers_total", None)] == 1
+        assert front_samples[("llmctl_fleet_front_up", "front-0")] == 1.0
+        assert front_samples[("llmctl_fleet_front_up", "front-1")] == 0.0
+        assert front_samples[
+            ("llmctl_fleet_front_active_streams", "front-0")] == 2
         # counters export deltas: a second identical snapshot must not
         # double-count the running totals (incl. the pause histogram)
         exporter.export_fleet(snap)

@@ -99,11 +99,39 @@ class Recorder:
 
 
 # -- hub units (no engine) ----------------------------------------------------
+#
+# The whole matrix runs over BOTH FleetStateStore impls (the HA front
+# tier's conformance bar): the in-memory store must be byte-for-byte
+# the pre-store hub, and the shared file store must pass the exact
+# same suite — dedupe, ordering, healing, replay, TTL-GC — while also
+# journaling every mutation.
+
+
+@pytest.fixture(params=["memory", "file"])
+def hub_store_kind(request):
+    return request.param
 
 
 class TestHubUnits:
+    @pytest.fixture(autouse=True)
+    def _store(self, hub_store_kind, tmp_path):
+        from distributed_llm_training_and_inference_system_tpu.serve.fleet.state import (  # noqa: E501
+            InMemoryStateStore, SharedFileStateStore)
+        self._n = 0
+
+        def mk_hub(**kw):
+            self._n += 1
+            if hub_store_kind == "file":
+                store = SharedFileStateStore(
+                    tmp_path / f"store{self._n}", front_id="t")
+            else:
+                store = InMemoryStateStore()
+            return FleetStreamHub(store=store, **kw)
+
+        self.mk_hub = mk_hub
+
     def test_in_order_publish_subscribe_finish(self):
-        hub = FleetStreamHub()
+        hub = self.mk_hub()
         assert hub.open("r")
         assert not hub.open("r")          # idempotent-open refused
         rec = Recorder()
@@ -122,7 +150,7 @@ class TestHubUnits:
         """A re-placed producer regenerating tokens the log already
         delivered: overlap is absorbed by seq, clients see each token
         once, and the duplicate count attributes to the replica."""
-        hub = FleetStreamHub()
+        hub = self.mk_hub()
         hub.open("r")
         rec = Recorder()
         hub.subscribe("r", 0, rec)
@@ -137,7 +165,7 @@ class TestHubUnits:
         assert hub.replica_stats()[1]["replayed"] == 2
 
     def test_out_of_order_batch_buffered_until_gap_fills(self):
-        hub = FleetStreamHub()
+        hub = self.mk_hub()
         hub.open("r")
         rec = Recorder()
         hub.subscribe("r", 0, rec)
@@ -153,7 +181,7 @@ class TestHubUnits:
         """A crash can eat on_token callbacks AFTER tokens were recorded
         on the request; the in-proc publish path heals the hole from
         req.generated_tokens before the new batch lands."""
-        hub = FleetStreamHub()
+        hub = self.mk_hub()
         hub.open("r")
         rec = Recorder()
         hub.subscribe("r", 0, rec)
@@ -167,7 +195,7 @@ class TestHubUnits:
         assert hub.stats()["gaps_healed"] == 2    # 3 and 4 recovered
 
     def test_sync_appends_missing_tail(self):
-        hub = FleetStreamHub()
+        hub = self.mk_hub()
         hub.open("r")
         hub.publish("r", 0, [1, 2], replica=0)
         assert hub.sync("r", [1, 2, 3, 4]) == 2
@@ -175,7 +203,7 @@ class TestHubUnits:
         assert hub.sync("r", [1, 2, 3, 4]) == 0   # idempotent
 
     def test_reconnect_replays_only_unacked_tail(self):
-        hub = FleetStreamHub()
+        hub = self.mk_hub()
         hub.open("r")
         hub.publish("r", 0, list(range(10)), replica=0)
         rec = Recorder()
@@ -190,7 +218,7 @@ class TestHubUnits:
         assert rec.events == [("tokens", 10, [10, 11])]
 
     def test_stale_last_event_id_full_replay(self):
-        hub = FleetStreamHub()
+        hub = self.mk_hub()
         hub.open("r")
         hub.publish("r", 0, [1, 2, 3], replica=0)
         hub.finish("r", "stop")
@@ -200,7 +228,7 @@ class TestHubUnits:
         assert sub["sub"] is None          # finished: no live sub
 
     def test_future_last_event_id_clamps_to_frontier(self):
-        hub = FleetStreamHub()
+        hub = self.mk_hub()
         hub.open("r")
         hub.publish("r", 0, [1, 2], replica=0)
         rec = Recorder()
@@ -212,7 +240,7 @@ class TestHubUnits:
     def test_finish_during_replay_window(self):
         """Subscribe on a live log, finish immediately after: the finish
         event arrives after the snapshot, never instead of it."""
-        hub = FleetStreamHub()
+        hub = self.mk_hub()
         hub.open("r")
         hub.publish("r", 0, [1, 2], replica=0)
         rec = Recorder()
@@ -222,7 +250,7 @@ class TestHubUnits:
         assert rec.events == [("finish", "length", None)]
 
     def test_unknown_stream_and_discard(self):
-        hub = FleetStreamHub()
+        hub = self.mk_hub()
         assert hub.subscribe("nope", 0, Recorder()) is None
         assert hub.publish("nope", 0, [1]) == 0
         hub.open("r")
@@ -233,7 +261,7 @@ class TestHubUnits:
         assert not hub.has("r")
 
     def test_ttl_gc_drops_finished_logs_only(self):
-        hub = FleetStreamHub(ttl_ms=1.0)
+        hub = self.mk_hub(ttl_ms=1.0)
         hub.open("done")
         hub.open("live")
         hub.publish("live", 0, [1], replica=0)
@@ -243,7 +271,7 @@ class TestHubUnits:
         assert not hub.has("done") and hub.has("live")
 
     def test_identity_mismatch_counted_never_redelivered(self):
-        hub = FleetStreamHub()
+        hub = self.mk_hub()
         hub.open("r")
         rec = Recorder()
         hub.subscribe("r", 0, rec)
@@ -259,7 +287,7 @@ class TestHubUnits:
         one ("drop", ...) event — while fast subscribers and the log
         itself are untouched; a reconnect at the dropped client's last
         seq replays exactly the tail it missed."""
-        hub = FleetStreamHub(max_buffered_batches=3)
+        hub = self.mk_hub(max_buffered_batches=3)
         hub.open("r")
         slow, fast = Recorder(), Recorder()
         s_slow = hub.subscribe("r", 0, slow)
@@ -287,7 +315,7 @@ class TestHubUnits:
         """Acked batches drain the budget: a consumer that keeps up is
         never dropped no matter how long the stream runs; cap 0
         disables the bound entirely."""
-        hub = FleetStreamHub(max_buffered_batches=2)
+        hub = self.mk_hub(max_buffered_batches=2)
         hub.open("r")
         rec = Recorder()
         sub = hub.subscribe("r", 0, rec)
@@ -297,7 +325,7 @@ class TestHubUnits:
         assert rec.tokens == list(range(50))
         assert hub.stats()["backpressure_drops"] == 0
         # unbounded hub: no acks, no drops (PR-8 behavior)
-        hub0 = FleetStreamHub(max_buffered_batches=0)
+        hub0 = self.mk_hub(max_buffered_batches=0)
         hub0.open("r")
         rec0 = Recorder()
         hub0.subscribe("r", 0, rec0)
@@ -307,7 +335,7 @@ class TestHubUnits:
         assert hub0.stats()["backpressure_drops"] == 0
 
     def test_replica_stats_active_streams(self):
-        hub = FleetStreamHub()
+        hub = self.mk_hub()
         hub.open("a")
         hub.open("b")
         hub.publish("a", 0, [1], replica=0)
