@@ -206,6 +206,30 @@ def kernels(op, seq_len, hidden, heads, batch):
                    "fetch; compare fleet prefill_tokens and the "
                    "prefix_fetch section against 0 (all-unique "
                    "prompts). 0 disables.")
+@click.option("--serve-courier-zlib-level", default=-1, show_default=True,
+              type=int,
+              help="serve-load fleet: zlib level for the compressing "
+                   "courier codecs and the tiered KV store's at-rest "
+                   "frames (-1 = library default; 1 = fastest — the "
+                   "right choice when frame replay competes with cheap "
+                   "CPU prefill).")
+@click.option("--serve-returning", default=0, show_default=True,
+              type=int,
+              help="serve-load fleet: returning-conversation scenario "
+                   "(tiered fleet KV store) — this many multi-turn "
+                   "conversations prefill a long history, go quiet "
+                   "while filler traffic churns the KV pool past their "
+                   "HBM residency, then return with the same history. "
+                   "Runs a store-ON arm (evicted pages demote to the "
+                   "host tier and the return turn restores them at "
+                   "wire speed) AND a store-OFF recompute arm, "
+                   "asserting the two produce token-identical output; "
+                   "the headline is return-turn TTFT store-hit vs "
+                   "recompute.")
+@click.option("--serve-returning-history", default=96, show_default=True,
+              type=int,
+              help="Returning-conversation history length in tokens "
+                   "(the shared prefix each conversation re-uses).")
 @click.option("--serve-stream/--no-serve-stream", default=False,
               show_default=True,
               help="serve-load fleet: streaming client mode — every "
@@ -220,7 +244,8 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
         preemption, latency_dispatch_steps, artifact, quant, kv_quant,
         slots, pipelined, int8_pallas, serve_max_retries, serve_replicas,
         serve_disagg, serve_courier_chaos, serve_courier_codec,
-        serve_hot_prefix, serve_stream):
+        serve_courier_zlib_level, serve_hot_prefix, serve_returning,
+        serve_returning_history, serve_stream):
     """End-to-end train step throughput / serve TTFT+throughput
     (parity: reference bench.py:35-49). ``serve-load`` runs open-loop
     (Poisson) and closed-loop sweeps with p50/p99 TTFT, per-token latency,
@@ -446,6 +471,123 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
             # adaptive-dispatch A/B was undiagnosable without them
             s["engine"] = engine_counters()
             results["serve_load"]["closed_loop"].append(s)
+
+        if serve_returning > 0:
+            # returning-conversation A/B (tiered fleet KV store): one
+            # fleet per arm, KV pool sized so the filler phase MUST
+            # recycle the conversations' cached pages — the store-on
+            # arm then demotes them down a tier, the store-off arm
+            # destroys them (recompute). Token identity between arms is
+            # the degrade proof; TTFT split is the headline.
+            from ...config.schema import FleetConfig
+            from ...serve.fleet import ServeFleet
+            from ...serve.loadgen import run_returning
+            import gc
+            if last_engine:
+                eng = last_engine.pop()
+                (eng.shutdown if hasattr(eng, "router")
+                 else eng.release)()
+                gc.collect()
+                jax.clear_caches()
+            hist = serve_returning_history
+            B = slots or 4
+            ps = 64 if on_tpu else 16
+            per_req = -(-(hist + 4 + gen_len + 16) // ps)   # ceil pages
+            blocks = (B + 1) * per_req + 2
+
+            def returning_arm(store_on: bool):
+                scfg = point_serve_cfg()
+                scfg.max_batch_size = B
+                scfg.max_seq_len = min(hist + 4 + gen_len + 16,
+                                       cfg.max_position_embeddings)
+                scfg.kv_num_blocks = blocks
+                fleet = ServeFleet(
+                    cfg, scfg,
+                    FleetConfig(replicas=max(serve_replicas, 1),
+                                kv_store=store_on,
+                                kv_store_dram_mb=256.0,
+                                courier_codec=serve_courier_codec,
+                                courier_zlib_level=(
+                                    serve_courier_zlib_level)),
+                    supervise=False)
+                import numpy as np
+                for r in fleet.replicas:
+                    warm_p = list(range(1, hist + 5))
+                    r.engine.generate([warm_p],
+                                      SamplingParams(temperature=0.0,
+                                                     max_tokens=2))
+                    # second pass over the same history compiles the
+                    # TAIL-ONLY extend-prefill program (small suffix
+                    # bucket) the store-hit return turn dispatches —
+                    # compile time stays outside the timed window
+                    r.engine.generate([warm_p[:hist] + [9, 8, 7, 6]],
+                                      SamplingParams(temperature=0.0,
+                                                     max_tokens=2))
+                    # compile the page-restore scatter (the store-hit
+                    # import path) OUTSIDE the timed window, same rule
+                    # as the prefill/decode warmup above: write zeros
+                    # into scratch page 0 at the bucket the scenario's
+                    # fetches will hit (a documented no-op)
+                    kvp = r.engine.kv
+
+                    def zero_pages(bucket):
+                        shape = (cfg.num_layers, bucket,
+                                 cfg.num_kv_heads, ps, cfg.head_dim)
+                        if kvp.quant_kind == "int4":
+                            return {"values": np.zeros(
+                                (*shape[:-2], shape[-2] // 2,
+                                 shape[-1]), np.uint8),
+                                "scale": np.zeros(shape[:-1],
+                                                  np.float32)}
+                        if kvp.quant_kind == "int8":
+                            return {"values": np.zeros(shape, np.int8),
+                                    "scale": np.zeros(shape[:-1],
+                                                      np.float32)}
+                        return np.zeros(shape, np.float32)
+
+                    bucket = 1
+                    while bucket <= 2 * per_req:
+                        z = zero_pages(bucket)
+                        kvp._write_pages_idx(
+                            np.zeros(bucket, np.int32), z, z)
+                        bucket <<= 1
+                    _reset_counters(r.engine)
+                    r.engine.kv.flush_prefix_cache()
+                fleet.start()
+                try:
+                    return run_returning(
+                        fleet, conversations=serve_returning,
+                        history_len=hist, tail_len=4,
+                        max_tokens=gen_len,
+                        filler_requests=max(2 * serve_returning,
+                                            2 * B, 8),
+                        filler_len=hist, seed=0)
+                finally:
+                    fleet.shutdown()
+                    gc.collect()
+                    jax.clear_caches()
+
+            off = returning_arm(False)
+            on = returning_arm(True)
+            results["serve_load"]["returning"] = {
+                "store_on": on.summary(),
+                "store_off": off.summary(),
+                # the degrade contract: store hits must never change
+                # output — both arms' returning turns token-identical
+                "token_identical": (
+                    on.returning["token_lists"]
+                    == off.returning["token_lists"]),
+                "ttft_speedup_p50": (
+                    round(off.returning["return_p50_ttft_ms"]
+                          / on.returning["return_p50_ttft_ms"], 3)
+                    if on.returning["return_p50_ttft_ms"]
+                    and off.returning["return_p50_ttft_ms"] else None),
+            }
+            # token_lists proved identity; they are bulky and
+            # uninteresting in the recorded artifact
+            for arm in ("store_on", "store_off"):
+                results["serve_load"]["returning"][arm].get(
+                    "returning", {}).pop("token_lists", None)
 
     click.echo(json.dumps(results, indent=2))
 

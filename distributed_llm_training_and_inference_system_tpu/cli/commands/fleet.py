@@ -168,6 +168,21 @@ def status(url, as_json):
             f"{pf.get('fetches', 0)} fetches, "
             f"{pf.get('misses', 0)} misses, "
             f"{pf.get('aborts', 0)} aborts)")
+    ks = snap.get("kv_store")
+    if ks and (ks.get("demotions") or ks.get("hits") or ks.get("misses")):
+        console.print(
+            f"kv store: {ks.get('hits', 0)} page hits / "
+            f"{ks.get('misses', 0)} misses "
+            f"({ks.get('bytes_served', 0)} bytes replayed), "
+            f"{ks.get('demotions', 0)} demotions, "
+            f"dram {ks.get('dram_entries', 0)} pages / "
+            f"{ks.get('dram_bytes', 0)} bytes, "
+            f"disk {ks.get('disk_entries', 0)} pages / "
+            f"{ks.get('disk_bytes', 0)} bytes, "
+            f"{ks.get('evictions', 0)} evictions "
+            f"({ks.get('spills', 0)} spills, "
+            f"{ks.get('corrupt', 0)} corrupt) "
+            f"[{ks.get('codec', '?')}]")
     cour = snap.get("courier")
     if cour and (cour.get("transfers") or cour.get("aborts")
                  or cour.get("in_flight") or cour.get("expired")):

@@ -213,6 +213,13 @@ def app(ctx):
                    "bytes. Compression is pipelined behind the wire and "
                    "CRC-verified end to end — a codec failure degrades "
                    "to re-prefill, never wrong tokens.")
+@click.option("--fleet-courier-zlib-level", default=-1, show_default=True,
+              type=int,
+              help="zlib level for the compressing courier codecs "
+                   "(-1 = library default, 1 = fastest, 9 = smallest). "
+                   "Recorded per transfer in the frame manifest, so "
+                   "receivers stay level-agnostic; the tiered KV "
+                   "store's at-rest frames use it too.")
 @click.option("--fleet-courier-chunk-bytes", default=256 * 1024,
               show_default=True,
               help="Courier frame size: payloads are split into chunks "
@@ -259,6 +266,32 @@ def app(ctx):
               help="Skip fetches smaller than this many full pages "
                    "(raise when computing a page is cheaper than your "
                    "link).")
+@click.option("--fleet-kv-store/--fleet-no-kv-store", "fleet_kv_store",
+              default=False, show_default=True,
+              help="Tiered fleet KV store: a host-tier DRAM ring (+ "
+                   "optional disk spill) that receives prefix pages "
+                   "evicted from replica HBM or flushed at drain/retire "
+                   "— in their compressed courier-frame form, encoded "
+                   "once — and serves them back over the normal "
+                   "prefix-fetch path when no live replica holds them. "
+                   "Returning conversations restore from the store at "
+                   "wire speed instead of re-prefilling; scale-down "
+                   "stops destroying the cluster cache.")
+@click.option("--fleet-kv-store-dram-mb", default=256.0,
+              show_default=True, type=float,
+              help="DRAM ring capacity for the tiered KV store, in MB "
+                   "of compressed frames (LRU; overflow spills to "
+                   "--fleet-kv-store-dir or drops the oldest).")
+@click.option("--fleet-kv-store-dir", default="", show_default=True,
+              help="Disk-spill directory for the tiered KV store "
+                   "(empty = DRAM only).")
+@click.option("--fleet-kv-store-disk-mb", default=1024.0,
+              show_default=True, type=float,
+              help="Disk-spill capacity bound for the tiered KV store.")
+@click.option("--fleet-kv-store-ttl-ms", default=0.0, show_default=True,
+              type=float,
+              help="Expire store entries nobody fetched for this long "
+                   "(0 = keep until capacity pressure evicts).")
 @click.option("--fleet-inventory-ttl-ms", default=0.0, show_default=True,
               type=float,
               help="Cache the per-replica prefix-page inventory map this "
@@ -295,6 +328,13 @@ def app(ctx):
 @click.option("--fleet-state-store-dir", default="", show_default=True,
               help="Directory for the file state store (every front "
                    "must see the same path).")
+@click.option("--fleet-state-compact-every", default=1024,
+              show_default=True, type=int,
+              help="Compact the file state store's journal (snapshot + "
+                   "truncate, fenced and flock-serialized) every this "
+                   "many records written; fronts reload from snapshot "
+                   "+ tail. 0 disables (the journal then grows "
+                   "unboundedly).")
 @click.option("--stream-abort-on-disconnect/--no-stream-abort-on-disconnect",  # noqa: E501
               "stream_abort_on_disconnect", default=True,
               show_default=True,
@@ -317,14 +357,17 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
           fleet_rebalance_ratio, fleet_rebalance_hysteresis,
           fleet_max_migrations, fleet_roles, fleet_role_balance_ratio,
           fleet_courier_transport, fleet_courier_codec,
-          fleet_courier_chunk_bytes,
+          fleet_courier_zlib_level, fleet_courier_chunk_bytes,
           fleet_courier_retries, fleet_courier_deadline_ms,
           fleet_courier_endpoint, fleet_courier_ticket_ttl_ms,
           fleet_endpoints, fleet_remote_replicas, fleet_prefix_fetch,
-          fleet_prefix_fetch_min_pages, fleet_inventory_ttl_ms,
+          fleet_prefix_fetch_min_pages, fleet_kv_store,
+          fleet_kv_store_dram_mb, fleet_kv_store_dir,
+          fleet_kv_store_disk_mb, fleet_kv_store_ttl_ms,
+          fleet_inventory_ttl_ms,
           fleet_stream_ttl_ms, fleet_stream_max_buffered,
           fleet_fronts, fleet_state_store, fleet_state_store_dir,
-          stream_abort_on_disconnect):
+          fleet_state_compact_every, stream_abort_on_disconnect):
     """Start the OpenAI-compatible inference server."""
     import jax
 
@@ -378,6 +421,7 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
             role_balance_ratio=fleet_role_balance_ratio,
             courier_transport=fleet_courier_transport,
             courier_codec=fleet_courier_codec,
+            courier_zlib_level=fleet_courier_zlib_level,
             courier_chunk_bytes=fleet_courier_chunk_bytes,
             courier_max_retries=fleet_courier_retries,
             courier_chunk_deadline_ms=fleet_courier_deadline_ms,
@@ -387,11 +431,17 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
             remote_replicas=fleet_remote_replicas,
             prefix_fetch=fleet_prefix_fetch,
             prefix_fetch_min_pages=fleet_prefix_fetch_min_pages,
+            kv_store=fleet_kv_store,
+            kv_store_dram_mb=fleet_kv_store_dram_mb,
+            kv_store_dir=fleet_kv_store_dir,
+            kv_store_disk_mb=fleet_kv_store_disk_mb,
+            kv_store_ttl_ms=fleet_kv_store_ttl_ms,
             prefix_inventory_ttl_ms=fleet_inventory_ttl_ms,
             stream_log_ttl_ms=fleet_stream_ttl_ms,
             stream_max_buffered_batches=fleet_stream_max_buffered,
             fronts=fleet_fronts, state_store=fleet_state_store,
-            state_store_dir=fleet_state_store_dir)
+            state_store_dir=fleet_state_store_dir,
+            state_compact_every=fleet_state_compact_every)
         fleet_cfg.validate()
 
     if fleet_cfg is not None and fleet_cfg.fronts > 1:

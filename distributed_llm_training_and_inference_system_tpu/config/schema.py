@@ -887,6 +887,12 @@ class FleetConfig:
     # never wrong KV. Fewer wire bytes directly shrink migration pause,
     # handoff stall, and prefix-fetch latency (Mooncake economics).
     courier_codec: str = "none"
+    # zlib compression level for the compressing codecs (-1 = zlib's
+    # library default, the historical behavior; 1 = fastest, 9 =
+    # smallest). Recorded in each transfer's frame manifest, so
+    # receivers stay level-agnostic and mixed-level fleets interoperate;
+    # the tiered KV store encodes its at-rest frames at this level too.
+    courier_zlib_level: int = -1
     courier_chunk_bytes: int = 256 * 1024
     courier_max_retries: int = 4
     courier_retry_backoff_ms: float = 2.0
@@ -953,6 +959,25 @@ class FleetConfig:
     # within-TTL stale entry only costs a counted fetch miss. 0 = read
     # fresh every placement (exact hints; fine at small fleets).
     prefix_inventory_ttl_ms: float = 0.0
+    # -- tiered fleet KV store (serve/fleet/kv_store.py) ---------------------
+    # host-tier page cache behind the prefix inventory (Mooncake's
+    # cluster-cache claim): replicas DEMOTE evicted/retired prefix pages
+    # here in their compressed courier-frame form (encoded once, stored
+    # as frames, replayed byte-identical on fetch), the store advertises
+    # its holdings through the same hint path replica inventories use,
+    # and a returning conversation whose pages left every HBM pool
+    # restores from the store at wire speed instead of re-prefilling.
+    # Requires prefix_fetch (the fetch plane IS the restore path).
+    kv_store: bool = False
+    # bounded DRAM ring capacity, in MB of COMPRESSED frames (LRU;
+    # overflow spills to kv_store_dir when set, else drops the oldest)
+    kv_store_dram_mb: float = 256.0
+    # optional disk-spill directory ("" = DRAM only); also LRU-bounded
+    kv_store_dir: str = ""
+    kv_store_disk_mb: float = 1024.0
+    # entries nobody fetched for this long are expired (0 = keep until
+    # capacity pressure evicts them)
+    kv_store_ttl_ms: float = 0.0
     # -- fleet SSE streaming (serve/fleet/streams.py) ------------------------
     # finished stream logs stay replayable (Last-Event-ID reconnect) for
     # this long before the hub GCs them; live logs never expire. 0 keeps
@@ -977,6 +1002,14 @@ class FleetConfig:
     # Last-Event-ID (zero gaps, zero duplicates).
     state_store: str = "memory"
     state_store_dir: str = ""
+    # snapshot+truncate compaction cadence for the file store's journal
+    # (records written between compaction attempts; 0 disables). The
+    # journal otherwise grows unboundedly — compaction folds the prefix
+    # every attached front has already consumed into snapshot.jsonl
+    # (terminal request groups collapsed to put+pop, counter records
+    # aggregated, finished stream groups dropped) and truncates the
+    # journal, flock-serialized and fencing-aware.
+    state_compact_every: int = 1024
     # how many front processes `llmctl serve start` runs (via the
     # FleetFrontTier babysitter, each a `llmctl fleet front` child on
     # its own port, surfaced in `fleet status`). > 1 requires
@@ -1067,6 +1100,10 @@ class FleetConfig:
             raise ConfigError(
                 f"unknown courier_codec {self.courier_codec!r} "
                 f"(none|zlib|delta-zlib)")
+        if not -1 <= self.courier_zlib_level <= 9:
+            raise ConfigError(
+                f"courier_zlib_level {self.courier_zlib_level} outside "
+                f"[-1, 9] (-1 = zlib default)")
         if self.courier_chunk_bytes < 1024:
             raise ConfigError("courier_chunk_bytes must be >= 1024")
         if self.courier_ticket_ttl_ms < 0:
@@ -1087,6 +1124,22 @@ class FleetConfig:
             raise ConfigError(
                 "prefix_inventory_ttl_ms must be >= 0 (0 = read fresh "
                 "per placement)")
+        if self.kv_store:
+            if not self.prefix_fetch:
+                raise ConfigError(
+                    "kv_store needs prefix_fetch — the fetch plane is "
+                    "how store-held pages restore to a replica")
+            if self.kv_store_dram_mb <= 0:
+                raise ConfigError("kv_store_dram_mb must be > 0")
+        if self.kv_store_disk_mb < 0:
+            raise ConfigError("kv_store_disk_mb must be >= 0")
+        if self.kv_store_ttl_ms < 0:
+            raise ConfigError(
+                "kv_store_ttl_ms must be >= 0 (0 = no expiry)")
+        if self.state_compact_every < 0:
+            raise ConfigError(
+                "state_compact_every must be >= 0 (0 disables journal "
+                "compaction)")
         if self.stream_log_ttl_ms < 0:
             raise ConfigError(
                 "stream_log_ttl_ms must be >= 0 (0 keeps finished "

@@ -165,6 +165,23 @@ METRICS: dict[str, MetricSpec] = {
     "llmctl_fleet_prefix_inventory_cache_misses": MetricSpec(
         COUNTER, "Placements that re-read every replica's prefix "
                  "inventory (cache cold, expired, or invalidated)"),
+    # -- tiered fleet KV store --------------------------------------------
+    "llmctl_fleet_kvstore_hits": MetricSpec(
+        COUNTER, "Prefix pages served from the host-tier KV store "
+                 "(compressed frames replayed instead of re-prefilling "
+                 "— the returning-conversation payoff)"),
+    "llmctl_fleet_kvstore_misses": MetricSpec(
+        COUNTER, "Store fetches that served nothing (entry evicted, "
+                 "expired, or corrupt) — degraded to plain prefill"),
+    "llmctl_fleet_kvstore_demotions": MetricSpec(
+        COUNTER, "Prefix pages demoted into the store (HBM eviction "
+                 "and drain/retire inventory flushes; encoded once)"),
+    "llmctl_fleet_kvstore_evictions": MetricSpec(
+        COUNTER, "Store entries dropped (capacity pressure past the "
+                 "disk tier, TTL expiry, or failed verification)"),
+    "llmctl_fleet_kvstore_bytes": MetricSpec(
+        COUNTER, "Compressed wire bytes replayed out of the store on "
+                 "fetch hits"),
     # -- fleet SSE streaming plane ----------------------------------------
     "llmctl_fleet_stream_active": MetricSpec(
         GAUGE, "Live SSE streams fleet-wide"),
@@ -262,6 +279,7 @@ COUNTER_SNAPSHOT_FN = {
     "ReplicaSupervisor": ("serve/fleet/supervisor.py", "snapshot"),
     "FleetStreamHub": ("serve/fleet/streams.py", "stats"),
     "FleetFrontTier": ("serve/fleet/front.py", "snapshot"),
+    "FleetKVStore": ("serve/fleet/kv_store.py", "snapshot"),
 }
 
 COUNTER_FLOW: tuple[CounterFlow, ...] = (
@@ -327,6 +345,25 @@ COUNTER_FLOW: tuple[CounterFlow, ...] = (
                 "orphan_logs_gc", "llmctl_fleet_stream_orphan_gcs"),
     CounterFlow("FleetStreamHub", "total_front_resumes",
                 "front_resumes", "llmctl_fleet_front_reconnects"),
+    # tiered-KV-store counters -> FleetKVStore.snapshot() keys (the
+    # supervisor snapshot embeds the section wholesale; the Prometheus
+    # pump deltas the mapped ones)
+    CounterFlow("FleetKVStore", "total_hits", "hits",
+                "llmctl_fleet_kvstore_hits"),
+    CounterFlow("FleetKVStore", "total_misses", "misses",
+                "llmctl_fleet_kvstore_misses"),
+    CounterFlow("FleetKVStore", "total_demotions", "demotions",
+                "llmctl_fleet_kvstore_demotions"),
+    CounterFlow("FleetKVStore", "total_duplicates", "duplicates", None),
+    CounterFlow("FleetKVStore", "total_evictions", "evictions",
+                "llmctl_fleet_kvstore_evictions"),
+    CounterFlow("FleetKVStore", "total_expired", "expired", None),
+    CounterFlow("FleetKVStore", "total_spills", "spills", None),
+    CounterFlow("FleetKVStore", "total_corrupt", "corrupt", None),
+    CounterFlow("FleetKVStore", "total_bytes_served", "bytes_served",
+                "llmctl_fleet_kvstore_bytes"),
+    CounterFlow("FleetKVStore", "total_bytes_stored", "bytes_stored",
+                None),
     # front-tier counters -> FleetFrontTier.snapshot() keys
     CounterFlow("FleetFrontTier", "total_front_failovers", "failovers",
                 "llmctl_fleet_front_failovers"),

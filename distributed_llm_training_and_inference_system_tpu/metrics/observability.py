@@ -247,6 +247,14 @@ class PrometheusExporter:
             "llmctl_fleet_prefix_inventory_cache_hits")
         self.fleet_inventory_cache_misses = mk(
             "llmctl_fleet_prefix_inventory_cache_misses")
+        # tiered fleet KV store (serve/fleet/kv_store.py)
+        self.fleet_kvstore_hits = mk("llmctl_fleet_kvstore_hits")
+        self.fleet_kvstore_misses = mk("llmctl_fleet_kvstore_misses")
+        self.fleet_kvstore_demotions = mk(
+            "llmctl_fleet_kvstore_demotions")
+        self.fleet_kvstore_evictions = mk(
+            "llmctl_fleet_kvstore_evictions")
+        self.fleet_kvstore_bytes = mk("llmctl_fleet_kvstore_bytes")
         # fleet SSE streaming (serve/fleet/streams.py): the exactly-once
         # delivery ledger
         self.fleet_stream_active = mk("llmctl_fleet_stream_active")
@@ -445,6 +453,21 @@ class PrometheusExporter:
             for t in window[-min(new, len(window)):]:
                 self.fleet_prefix_fetch.observe(t)
         self._last_totals["fleet_pf_fetches"] = count
+        # tiered fleet KV store: demotion/hit/miss/eviction counters and
+        # the compressed bytes replayed on hits, delta'd from the
+        # snapshot's running totals like every other fleet counter
+        ks = snap.get("kv_store", {})
+        for key, counter in (
+                ("hits", self.fleet_kvstore_hits),
+                ("misses", self.fleet_kvstore_misses),
+                ("demotions", self.fleet_kvstore_demotions),
+                ("evictions", self.fleet_kvstore_evictions),
+                ("bytes_served", self.fleet_kvstore_bytes)):
+            total = ks.get(key, 0)
+            delta = total - self._last_totals.get(f"fleet_ks_{key}", 0)
+            if delta > 0:
+                counter.inc(delta)
+            self._last_totals[f"fleet_ks_{key}"] = total
         # speculative-decode plane: per-replica counters arrive fleet-
         # aggregated as running totals (supervisor snapshot "spec"
         # section); the pump deltas them like every other fleet counter

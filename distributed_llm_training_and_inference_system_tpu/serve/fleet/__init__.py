@@ -41,6 +41,7 @@ from .remote import RemoteReplica, RemoteUnavailable
 from .replica import (ROLE_DECODE, ROLE_MIXED, ROLE_PREFILL, EngineReplica,
                       reset_for_requeue)
 from .front import FleetFrontTier
+from .kv_store import KV_STORE_OWNER, FleetKVStore
 from .router import FleetRouter, FleetSaturated, prefix_digest
 from .state import (FleetStateStore, InMemoryStateStore,
                     SharedFileStateStore, StoreFenced, build_state_store)
@@ -58,9 +59,11 @@ __all__ = [
     "FaultInjector",
     "FaultPlan",
     "FleetFrontTier",
+    "FleetKVStore",
     "FleetRouter",
     "FleetSaturated",
     "FleetStateStore",
+    "KV_STORE_OWNER",
     "FleetStreamHub",
     "HTTPCourierTransport",
     "InMemoryStateStore",
@@ -123,6 +126,15 @@ class ServeFleet:
         # destinations use the local receiver; remote destinations are
         # pushed over HTTP per the fleet_endpoints map.
         self.courier = KVCourier(self.fleet_cfg, injector=self.injector)
+        # tiered fleet KV store (serve/fleet/kv_store.py): a host-tier
+        # DRAM ring (+ optional disk spill) holding demoted prefix pages
+        # in compressed courier-frame form. Replicas demote evicted and
+        # drain-flushed pages here; the router's hint path falls back to
+        # it when no live replica covers a prompt; fetches replay the
+        # frames through the courier receiver. None = no store tier.
+        self.kv_store = (FleetKVStore(self.fleet_cfg)
+                         if self.fleet_cfg.kv_store else None)
+        self.courier.kv_store = self.kv_store
         # replicable front state (serve/fleet/state.py): the stream logs
         # and router ledger live behind this store. The default
         # in-memory store keeps today's single-front behavior
@@ -180,7 +192,8 @@ class ServeFleet:
                          and self.fleet_cfg.prefix_fetch) else 0)
         self.router = FleetRouter(self.replicas, self.fleet_cfg,
                                   observer=observer, courier=self.courier,
-                                  page_size=page_size, store=self.store)
+                                  page_size=page_size, store=self.store,
+                                  kv_store=self.kv_store)
         # HA front tier: a terminal record folded from a sibling front
         # completes the local Request object (waiters, SSE finish)
         self.router.on_store_pop = self._complete_from_store
@@ -212,10 +225,15 @@ class ServeFleet:
             self.courier.prefix_providers[r.replica_id] = \
                 r.request_prefix_extract
             r.prefix_fetcher = self.courier.fetch_prefix
+            # tiered KV store: evicted/retired prefix pages demote down
+            # a tier instead of being destroyed
+            if self.kv_store is not None:
+                r.set_kv_store(self.kv_store)
         self.supervisor = ReplicaSupervisor(
             self.replicas, self.router, self.fleet_cfg,
             injector=self.injector, params=params, observer=observer,
-            streams=self.streams, store=self.store)
+            streams=self.streams, store=self.store,
+            kv_store=self.kv_store)
         self._supervise = supervise
 
     def _on_request_exit(self, replica_id: int, req: Request) -> None:

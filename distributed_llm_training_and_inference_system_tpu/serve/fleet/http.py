@@ -264,6 +264,24 @@ class FleetServer:
         decoder = IncrementalDecoder(self.tokenizer, prefix)
         try:
             await resp.prepare(http_req)
+            if not resume:
+                # announce the request id IMMEDIATELY (empty batch, no
+                # `id:` line — Last-Event-ID semantics untouched): a
+                # client whose front dies before the first token then
+                # RESUMES the same request on a survivor instead of
+                # resubmitting it. Without this, the lost-first-frame
+                # window forced a duplicate execution — correct tokens
+                # (the hub dedupes), but wasted FLOPs and a ledger that
+                # legitimately counts both submissions.
+                announce = {
+                    "id": rid, "object": "text_completion",
+                    "model": self.model_cfg.name, "seq": -1,
+                    "choices": [{"index": 0, "text": "",
+                                 "token_ids": [],
+                                 "finish_reason": None}],
+                }
+                await resp.write(
+                    f"data: {json.dumps(announce)}\n\n".encode())
             if sub["tokens"]:
                 seq_next = sub["start"] + len(sub["tokens"])
                 await resp.write(self._sse_event(

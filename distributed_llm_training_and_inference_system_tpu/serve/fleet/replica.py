@@ -163,6 +163,16 @@ class EngineReplica:
         self.handoffs_local = 0          # fallbacks: decoded at the source
         self.handoff_stalls_ms: deque = deque(maxlen=64)
         self.handoff_log: deque = deque(maxlen=64)
+        # tiered fleet KV store (serve/fleet/kv_store.py): when set (via
+        # `set_kv_store`), hashed prefix pages this engine evicts are
+        # DEMOTED to the host-tier store instead of destroyed
+        # (asynchronously — the store's encoder worker pays the
+        # deflate, not this engine thread), and drain/retire flushes
+        # the whole inventory there synchronously — scale-down stops
+        # being cache-destructive. Duck-typed FleetKVStore surface:
+        # demote_async(hashes, payload) / demote(hashes, payload).
+        self.kv_store = None
+        self.store_flush_pages = 0      # pages flushed at drain/retire
         # fired with (replica_id, request) whenever a request leaves its
         # slot terminally on this replica (finished/cancelled) — the
         # router's completion hook. NOT fired on crash/drain extraction.
@@ -206,6 +216,64 @@ class EngineReplica:
         self.engine.expect_pure_decode = (self.role == ROLE_DECODE)
         self.engine.prefix_fetch_hook = (self._fetch_prefix
                                          if self._prefix_fetch else None)
+        kv = getattr(self.engine, "kv", None)
+        if kv is not None:
+            kv.demote_hook = (self._demote_pages
+                              if self.kv_store is not None else None)
+
+    @thread_seam
+    def set_kv_store(self, store) -> None:
+        """Attach (or detach) the tiered-store demotion sink. Applied to
+        the current engine and re-applied by ``_wire_engine`` after every
+        restart, so a rebuilt engine keeps demoting."""
+        self.kv_store = store
+        kv = getattr(self.engine, "kv", None)
+        if kv is not None:
+            kv.demote_hook = (self._demote_pages
+                              if store is not None else None)
+
+    @engine_thread_only
+    def _demote_pages(self, hashes: list, content: dict) -> None:
+        """PagedKVCache.demote_hook: the hashed pages an allocation just
+        evicted (batched — one gather per allocation) — hand their
+        content to the fleet store's background encoder (the engine
+        thread never pays the deflate). Failures are the store's to
+        swallow, and cost only a future recompute."""
+        store = self.kv_store
+        if store is not None:
+            store.demote_async(hashes, content)
+
+    @engine_thread_only
+    def _flush_inventory_to_store(self) -> None:
+        """Demote EVERY cached prefix page to the fleet store — the
+        drain/retire seam that makes scale-down preserve the cluster
+        cache. One batched device extract, split per page by the store.
+        Guarded: a broken engine (teardown after a crash declaration)
+        just skips the flush."""
+        store = self.kv_store
+        eng = self.engine
+        kv = getattr(eng, "kv", None)
+        if store is None or kv is None:
+            return
+        try:
+            with eng.lock:
+                pairs = kv.prefix_cache_pairs()
+                if not pairs:
+                    return
+                hashes = [h for h, _p in pairs]
+                payload = kv.extract_pages([p for _h, p in pairs])
+            # synchronous on purpose: a retiring replica must have its
+            # inventory durably down a tier before it leaves rotation
+            flushed = store.demote(hashes, payload)
+            with self._state_lock:
+                self.store_flush_pages += int(flushed or 0)
+            logger.info("replica %d flushed %d/%d cached prefix pages "
+                        "to the fleet KV store", self.replica_id,
+                        int(flushed or 0), len(pairs))
+        except Exception:
+            logger.exception(
+                "replica %d inventory flush to the KV store failed",
+                self.replica_id)
 
     @thread_seam
     def set_role(self, role: str) -> None:
@@ -429,6 +497,12 @@ class EngineReplica:
                 # migrate_on_drain, queued swap-preempted victims keep
                 # theirs too (host arrays restore anywhere)
                 reset_for_requeue(r, keep_kv=self._migrate_on_drain)
+            # tiered KV store: a drain is the scale-down path — flush
+            # the whole prefix inventory down a tier so the cluster
+            # cache survives this replica leaving rotation (the
+            # preemptions above just published the residents' pages, so
+            # the flush covers them too)
+            self._flush_inventory_to_store()
             with self._state_lock:
                 self._orphans.extend(victims)
                 self.state = DRAINED
@@ -976,6 +1050,11 @@ class EngineReplica:
         with self._state_lock:
             partials = self._salvage_precopies()
             self._migrations.clear()
+        # retire seam for the tiered KV store: the engine thread is
+        # joined, so direct extraction is safe — salvage the prefix
+        # cache down a tier before the buffers are released. A truly
+        # broken engine makes the flush a guarded no-op.
+        self._flush_inventory_to_store()
         orphans = self.take_orphans() + self._rip_out()
         for r in orphans:
             p = partials.get(r.request_id)

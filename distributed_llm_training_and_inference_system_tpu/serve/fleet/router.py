@@ -53,6 +53,7 @@ from ...config.schema import FleetConfig
 from ..scheduler import Request, RequestState, SamplingParams
 from .replica import reset_for_requeue
 from .state import FleetStateStore, StoreFenced
+from .transport import KV_STORE_OWNER
 
 logger = logging.getLogger("llmctl.serve.fleet.router")
 
@@ -94,7 +95,8 @@ class FleetRouter:
     def __init__(self, replicas: Iterable, cfg: Optional[FleetConfig] = None,
                  observer: Optional[Callable[[str, dict], None]] = None,
                  courier=None, page_size: int = 0,
-                 store: Optional[FleetStateStore] = None):
+                 store: Optional[FleetStateStore] = None,
+                 kv_store=None):
         self.cfg = cfg or FleetConfig()
         self.replicas = list(replicas)
         self.by_id = {r.replica_id: r for r in self.replicas}
@@ -110,6 +112,11 @@ class FleetRouter:
         # chain prefix of the prompt. 0 disables hints entirely (plain
         # engines, fake-replica unit tests).
         self.page_size = int(page_size)
+        # tiered fleet KV store (serve/fleet/kv_store.py): its holdings
+        # join the inventory map under KV_STORE_OWNER so the hint path
+        # can fall back to store-served fetches when no live replica
+        # covers the prompt. None = no store tier.
+        self.kv_store = kv_store
         try:
             self._endpoints = self.cfg.endpoint_map()
         except Exception:
@@ -273,6 +280,11 @@ class FleetRouter:
                 hashes = ()
             if hashes:
                 out[r.replica_id] = set(hashes)
+        if self.kv_store is not None:
+            held = self.kv_store.inventory(
+                getattr(self.cfg, "prefix_inventory_max", 0))
+            if held:
+                out[KV_STORE_OWNER] = set(held)
         if self._inv_ttl_s > 0:
             with self._lock:
                 self.inventory_cache_misses += 1
@@ -294,7 +306,16 @@ class FleetRouter:
         replica whose inventory covers the destination's prompt better
         than the destination itself does — the destination then FETCHES
         those pages instead of re-prefilling. Advisory only: a stale
-        hint costs one counted miss, never wrong tokens."""
+        hint costs one counted miss, never wrong tokens.
+
+        Tier preference: a LIVE replica owner wins (its pages are hot
+        HBM and its extract path is cheapest); the host-tier KV store
+        (``KV_STORE_OWNER``) is the fall-back, chosen only when its
+        holdings cover strictly more of the prompt than both the
+        destination and every live inventory — the
+        returning-conversation case where HBM residency has expired.
+        Store hints are only stamped for in-proc destinations (a remote
+        worker cannot reach this process's store)."""
         req.prefix_owner = None
         req.prefix_owner_endpoint = None
         if not invs:
@@ -318,12 +339,18 @@ class FleetRouter:
 
         best, best_cov = None, coverage(invs.get(dest_id, ()))
         for rid, inv in invs.items():
-            if rid == dest_id:
+            if rid == dest_id or rid == KV_STORE_OWNER:
                 continue
             c = coverage(inv)
             if c > best_cov or (c == best_cov and best is not None
                                 and rid < best):
                 best, best_cov = rid, c
+        # store fall-back: strictly-better coverage only, in-proc dest
+        if KV_STORE_OWNER in invs \
+                and not getattr(self.by_id.get(dest_id), "remote", False):
+            c = coverage(invs[KV_STORE_OWNER])
+            if c > best_cov:
+                best, best_cov = KV_STORE_OWNER, c
         if best is not None:
             req.prefix_owner = best
             req.prefix_owner_endpoint = self._endpoints.get(best)
@@ -403,7 +430,19 @@ class FleetRouter:
                 elif op == "count":
                     key = rec.get("key")
                     n = int(rec.get("n", 1))
-                    if key == "submitted":
+                    if key == "completed":
+                        # journal compaction rewrites a terminal
+                        # put..pop group into one aggregated count
+                        # record (state.py) — same net counter effect a
+                        # fresh front would get from folding the pair
+                        self.total_completed += n
+                        r = rec.get("replica")
+                        if r is not None:
+                            self.completed_per_replica[r] = (
+                                self.completed_per_replica.get(r, 0) + n)
+                    elif key == "failed":
+                        self.total_failed += n
+                    elif key == "submitted":
                         self.total_submitted += n
                         r = rec.get("replica")
                         if r is not None:

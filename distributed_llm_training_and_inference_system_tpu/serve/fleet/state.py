@@ -183,6 +183,81 @@ class InMemoryStateStore(FleetStateStore):
     """Alias of the base store, named for configs and tests."""
 
 
+def _compact_records(records: list[dict]) -> list[dict]:
+    """Prune a fully-folded record sequence to its replay-equivalent
+    core (see :meth:`SharedFileStateStore.compact` for the contract):
+
+    - ``ledger``/``count`` records merge per (front, key, replica);
+    - ledger groups whose request reached a terminal ``pop`` collapse
+      into aggregated completed/failed/rejected count records stamped
+      with the POP's originating front (the front whose counters the
+      others must fold);
+    - finished/discarded stream groups drop wholesale;
+    - live groups (in-flight requests, streaming logs) keep every
+      record in order.
+    """
+    counts: dict = {}           # (f, key, replica) -> n, insertion-ordered
+    ledger: dict = {}           # rid -> [records]
+    stream: dict = {}           # rid -> [records]
+    ordered: list = []          # (kind, payload) preserving first-seen order
+
+    for rec in records:
+        ns = rec.get("ns")
+        if ns == "ledger" and rec.get("op") == "count":
+            key = (rec.get("f"), rec.get("key"), rec.get("replica"))
+            counts[key] = counts.get(key, 0) + int(rec.get("n", 1))
+            continue
+        rid = str(rec.get("rid", ""))
+        if ns == "ledger" and rid:
+            group = ledger.setdefault(rid, [])
+            if not group:
+                ordered.append(("ledger", rid))
+            group.append(rec)
+        elif ns == "stream" and rid:
+            group = stream.setdefault(rid, [])
+            if not group:
+                ordered.append(("stream", rid))
+            group.append(rec)
+        else:
+            ordered.append(("raw", rec))
+
+    _TERMINAL_COUNT = {"completed": "completed", "failed": "failed",
+                       "rejected": "rejected"}
+    kept: list = []
+    for kind, item in ordered:
+        if kind == "raw":
+            kept.append(item)
+        elif kind == "ledger":
+            group = ledger[item]
+            last_pop = -1
+            for idx, r in enumerate(group):
+                if r.get("op") != "pop":
+                    continue
+                last_pop = idx
+                # EVERY pop is one finished lifecycle — a client-chosen
+                # request id may be reused, so one rid can hold several
+                key = _TERMINAL_COUNT.get(r.get("outcome"))
+                if key is not None:
+                    ck = (r.get("f"), key, r.get("replica")
+                          if key == "completed" else None)
+                    counts[ck] = counts.get(ck, 0) + 1
+                # cancelled outcomes increment nothing: drop silently
+            # anything after the last pop is a LIVE lifecycle (or the
+            # whole group, when no pop ever landed): keep it verbatim
+            kept.extend(group[last_pop + 1:])
+        else:
+            group = stream[item]
+            if any(r.get("op") in ("finish", "discard") for r in group):
+                continue                      # finished: drop wholesale
+            kept.extend(group)                # live stream: keep all
+    for (f, key, replica), n in counts.items():
+        rec = {"f": f, "ns": "ledger", "op": "count", "key": key, "n": n}
+        if replica is not None:
+            rec["replica"] = replica
+        kept.append(rec)
+    return kept
+
+
 class SharedFileStateStore(FleetStateStore):
     """File-backed shared store: journal + registry under one directory.
 
@@ -197,17 +272,54 @@ class SharedFileStateStore(FleetStateStore):
     shared = True
 
     def __init__(self, root: str, front_id: Optional[str] = None,
-                 expiry_s: float = 2.0):
+                 expiry_s: float = 2.0, compact_every: int = 0):
         super().__init__(front_id)
         self.root = str(root)
         os.makedirs(self.root, exist_ok=True)
-        self._journal = os.path.join(self.root, "journal.jsonl")
         self._fronts = os.path.join(self.root, "fronts.json")
         self._lockfile = os.path.join(self.root, ".lock")
         self.expiry_s = float(expiry_s)
+        # LOGICAL journal offset (bytes since the journal's beginning of
+        # time): compaction trims the physical file and advances
+        # ``journal_base`` in the registry, so physical offset =
+        # _cursor - base. A cursor behind the base means records this
+        # front never folded were compacted into the snapshot — it
+        # reads snapshot.jsonl first, then the journal tail.
         self._cursor = 0
+        # snapshot+truncate compaction (the PR-12 journal-growth gap):
+        # every `compact_every` records written, the prefix that EVERY
+        # attached, unfenced front has already folded is folded into
+        # snapshot.jsonl — terminal request groups collapsed to
+        # aggregated count records, finished stream groups dropped —
+        # and the journal file is replaced by its tail under a fresh
+        # generation number (one atomic registry flip switches readers
+        # over). 0 disables.
+        self.compact_every = int(compact_every)
+        self._since_compact = 0
+        self._cursor_published = 0.0
+        # poll() fast path: (gen, base, journal path, snapshot path)
+        # cached so the hot fold loop reads ONLY the journal file. A
+        # compaction flip invalidates it naturally — the old journal
+        # file is unlinked under the same flock, so the next open
+        # fails and the registry is re-read.
+        self._reg_cache: Optional[tuple] = None
+        self.compactions = 0
+        self.records_pruned = 0
         self.records_written = 0
         self.records_folded = 0
+
+    # journal/snapshot filenames are GENERATION-suffixed: compaction
+    # writes the new generation's files completely, then flips the
+    # registry (atomic rewrite) — a crash mid-compaction leaves orphan
+    # files, never a torn journal. Generation 0 keeps the legacy name.
+    def _journal_path(self, reg: dict) -> str:
+        gen = int(reg.get("journal_gen", 0))
+        name = "journal.jsonl" if gen == 0 else f"journal.{gen}.jsonl"
+        return os.path.join(self.root, name)
+
+    def _snapshot_path(self, reg: dict) -> Optional[str]:
+        name = reg.get("journal_snapshot")
+        return os.path.join(self.root, name) if name else None
 
     @contextmanager
     def _locked(self):
@@ -246,29 +358,94 @@ class SharedFileStateStore(FleetStateStore):
             if self.front_id in reg.get("fenced", ()):
                 raise StoreFenced(
                     f"front {self.front_id} is fenced; write refused")
-            with open(self._journal, "a") as fh:
+            with open(self._journal_path(reg), "a") as fh:
                 fh.write(line + "\n")
         self.records_written += 1
+        self._since_compact += 1
+        if self.compact_every > 0 \
+                and self._since_compact >= self.compact_every:
+            # outside the flock (it is not reentrant across fds);
+            # compaction takes its own
+            self._since_compact = 0
+            try:
+                self.compact()
+            except Exception:
+                logger.exception("journal compaction failed (journal "
+                                 "keeps growing until the next attempt)")
+
+    def _cache_paths(self) -> tuple:
+        """(gen, base, journal path, snapshot path) from a fresh
+        registry read. Caller holds the flock."""
+        reg = self._load_registry()
+        self._reg_cache = (int(reg.get("journal_gen", 0)),
+                           int(reg.get("journal_base", 0)),
+                           self._journal_path(reg),
+                           self._snapshot_path(reg))
+        return self._reg_cache
 
     @thread_seam
     def poll(self) -> list[dict]:
         # read under the file lock (complete lines only), dispatch after
         # release — the file lock is never held while a component lock
-        # is wanted (see the module docstring's lock-order contract)
+        # is wanted (see the module docstring's lock-order contract).
+        # The hot path touches ONLY the journal file: the registry view
+        # (generation/base/paths) is cached, and a compaction flip
+        # surfaces as the old journal's unlink (done under the same
+        # flock), which forces a re-read here.
+        raw: list[bytes] = []
         with self._locked():
+            gen, base, jpath, spath = (self._reg_cache
+                                       or self._cache_paths())
+            blob = b""
             try:
-                with open(self._journal, "rb") as fh:
-                    fh.seek(self._cursor)
+                if self._cursor < base:
+                    raise OSError       # fell behind: slow branch
+                with open(jpath, "rb") as fh:
+                    fh.seek(self._cursor - base)
                     blob = fh.read()
             except OSError:
-                return []
+                # slow branch (rare): the journal rotated under us, was
+                # never created, or a compaction moved past our cursor.
+                # Refresh the registry view FIRST so the snapshot we
+                # load is exactly the one the current base describes.
+                gen, base, jpath, spath = self._cache_paths()
+                if self._cursor < base:
+                    # records we never folded were compacted away: the
+                    # snapshot holds their replay-equivalent form
+                    if spath:
+                        try:
+                            with open(spath, "rb") as fh:
+                                raw.extend(fh.read().splitlines())
+                        except OSError:
+                            pass
+                    self._cursor = base
+                try:
+                    with open(jpath, "rb") as fh:
+                        fh.seek(self._cursor - base)
+                        blob = fh.read()
+                except OSError:
+                    blob = b""
             end = blob.rfind(b"\n")
-            if end < 0:
-                return []
-            self._cursor += end + 1
-            blob = blob[:end + 1]
+            if end >= 0:
+                self._cursor += end + 1
+                raw.extend(blob[:end + 1].splitlines())
+            # publish the fold frontier so the compactor never trims
+            # records some live front still needs (trim bound = min
+            # cursor over attached, unfenced fronts). Throttled: a
+            # registry rewrite per poll would contend the flock with
+            # every sibling's journal append; heartbeats republish it
+            # each supervisor pass anyway, and a stale (smaller)
+            # cursor only makes compaction conservative, never wrong.
+            now = time.monotonic()
+            if end >= 0 and now - self._cursor_published > 0.2:
+                reg = self._load_registry()
+                ent = reg.get("fronts", {}).get(self.front_id)
+                if ent is not None:
+                    ent["cursor"] = self._cursor
+                    self._save_registry(reg)
+                self._cursor_published = now
         out = []
-        for line in blob.splitlines():
+        for line in raw:
             try:
                 rec = json.loads(line)
             except ValueError:
@@ -277,6 +454,106 @@ class SharedFileStateStore(FleetStateStore):
                 out.append(rec)
         self.records_folded += len(out)
         return out
+
+    # -- snapshot + truncate compaction --------------------------------------
+
+    @thread_seam
+    def compact(self) -> int:
+        """Fold the journal prefix every attached, unfenced front has
+        already consumed into ``snapshot.jsonl`` and truncate the
+        journal to its tail. Returns how many records were pruned
+        (0 = nothing to do). Fenced fronts must not compact — their
+        successor owns the log now.
+
+        Replay contract: a FRESH front folding snapshot + journal tail
+        reaches the same live state (ledger entries, counters, live
+        stream logs) as one folding the original journal. Terminal
+        request groups collapse to aggregated ``count`` records
+        (completed/failed/rejected — same net counter effect), counter
+        records merge per (front, key, replica), and finished stream
+        groups are dropped (every live front already folded them; a
+        front attaching later cannot replay a stream that finished
+        before it existed, which the TTL would have GC'd anyway)."""
+        with self._locked():
+            reg = self._load_registry()
+            fenced = set(reg.get("fenced", ()))
+            if self.front_id in fenced:
+                return 0
+            base = int(reg.get("journal_base", 0))
+            gen = int(reg.get("journal_gen", 0))
+            cursors = [self._cursor]
+            for fid, ent in reg.get("fronts", {}).items():
+                if fid in fenced or fid == self.front_id:
+                    continue
+                # fronts with no cursor yet have folded nothing — the
+                # snapshot covers them completely, so they don't bound
+                # the trim; fronts WITH one must keep their tail
+                if "cursor" in ent:
+                    cursors.append(int(ent["cursor"]))
+            lo = min(cursors)
+            trim = lo - base
+            if trim <= 0:
+                return 0
+            jpath = self._journal_path(reg)
+            try:
+                with open(jpath, "rb") as fh:
+                    blob = fh.read()
+            except OSError:
+                return 0
+            trim = min(trim, len(blob))
+            head, tail = blob[:trim], blob[trim:]
+            raw = []
+            spath = self._snapshot_path(reg)
+            if spath:
+                try:
+                    with open(spath, "rb") as fh:
+                        raw.extend(fh.read().splitlines())
+                except OSError:
+                    pass
+            raw.extend(head.splitlines())
+            records = []
+            for line in raw:
+                try:
+                    records.append(json.loads(line))
+                except ValueError:
+                    continue
+            kept = _compact_records(records)
+            new_gen = gen + 1
+            snap_name = f"snapshot.{new_gen}.jsonl"
+            new_snap = os.path.join(self.root, snap_name)
+            new_journal = os.path.join(self.root,
+                                       f"journal.{new_gen}.jsonl")
+            tmp = new_snap + ".tmp"
+            with open(tmp, "wb") as fh:
+                for rec in kept:
+                    fh.write(json.dumps(
+                        rec, separators=(",", ":")).encode() + b"\n")
+            os.replace(tmp, new_snap)
+            tmp = new_journal + ".tmp"
+            with open(tmp, "wb") as fh:
+                fh.write(tail)
+            os.replace(tmp, new_journal)
+            # the atomic flip: readers resolve paths from the registry,
+            # so one rewrite switches every front over consistently
+            reg["journal_gen"] = new_gen
+            reg["journal_base"] = base + trim
+            reg["journal_snapshot"] = snap_name
+            self._save_registry(reg)
+            self._reg_cache = None      # our own poll view rotated too
+            for stale in (jpath, spath):
+                if stale and stale != new_journal:
+                    try:
+                        os.unlink(stale)
+                    except OSError:
+                        pass
+            pruned = len(records) - len(kept)
+            self.compactions += 1
+            self.records_pruned += pruned
+            logger.info(
+                "journal compacted (gen %d): %d records -> %d snapshot "
+                "records + %d journal bytes", new_gen, len(records),
+                len(kept), len(tail))
+            return pruned
 
     # -- front registry ------------------------------------------------------
 
@@ -304,6 +581,11 @@ class SharedFileStateStore(FleetStateStore):
                 self.front_id, {"epoch": 0, "pid": os.getpid(),
                                 "started": time.time()})
             entry["t"] = time.time()
+            # the fold frontier rides every heartbeat for free (the
+            # registry is being rewritten anyway) — poll() only
+            # publishes it on a throttle
+            entry["cursor"] = self._cursor
+            self._cursor_published = time.monotonic()
             if info:
                 entry.update(info)
             self._save_registry(reg)
@@ -379,6 +661,8 @@ def build_state_store(cfg, front_id: Optional[str] = None
     if kind == "file":
         expiry = max(3.0 * float(getattr(cfg, "probe_interval_s", 0.5)),
                      0.25)
-        return SharedFileStateStore(cfg.state_store_dir,
-                                    front_id=front_id, expiry_s=expiry)
+        return SharedFileStateStore(
+            cfg.state_store_dir, front_id=front_id, expiry_s=expiry,
+            compact_every=int(getattr(cfg, "state_compact_every", 0)
+                              or 0))
     return InMemoryStateStore(front_id)

@@ -40,11 +40,14 @@ class ReplicaSupervisor:
                  injector: Optional[FaultInjector] = None,
                  params=None,
                  observer: Optional[Callable[[str, dict], None]] = None,
-                 streams=None, store=None):
+                 streams=None, store=None, kv_store=None):
         self.cfg = cfg or FleetConfig()
         self.replicas = replicas
         self.router = router
         self.injector = injector
+        # tiered fleet KV store (serve/fleet/kv_store.py): snapshot
+        # section + `fleet status` line. None = no store tier.
+        self.kv_store = kv_store
         # fleet stream hub (serve/fleet/streams.py): snapshot columns +
         # replay-window GC ride the supervisor poll. None = no streaming
         # plane (unit tests on bare routers).
@@ -697,4 +700,9 @@ class ReplicaSupervisor:
                     4)},
                 # per-replica courier endpoint map (string keys: JSON)
                 "endpoints": {str(k): v for k, v in endpoints.items()},
+                # tiered fleet KV store: demotion/hit/miss counters +
+                # tier occupancy (running totals, the Prometheus pump
+                # deltas the mapped ones; feeds llmctl_fleet_kvstore_*)
+                "kv_store": (self.kv_store.snapshot()
+                             if self.kv_store is not None else {}),
                 "courier": courier.snapshot() if courier else {}}

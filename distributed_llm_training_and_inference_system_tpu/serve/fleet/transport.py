@@ -192,8 +192,8 @@ def _scalars(node, prefix, out):
                 out[path] = v.item() if hasattr(v, "item") else v
 
 
-def encode_payload(payload: dict,
-                   codec: str = CODEC_NONE) -> tuple[dict, bytes]:
+def encode_payload(payload: dict, codec: str = CODEC_NONE,
+                   zlib_level: int = -1) -> tuple[dict, bytes]:
     """Flatten a courier payload into (manifest, blob). The manifest is
     JSON-able (the HTTP transport sends it verbatim) and carries the
     whole-payload CRC32 over the RAW bytes, used for end-to-end
@@ -201,10 +201,19 @@ def encode_payload(payload: dict,
     decompression + inverse filtering — so a codec bug aborts the
     transfer instead of restoring wrong KV). Under ``delta-zlib`` the
     returned blob holds the delta-FILTERED bytes (size-preserving); the
-    per-chunk deflate happens at framing time."""
+    per-chunk deflate happens at framing time.
+
+    ``zlib_level`` (-1 = zlib's default, the pre-PR-13 behavior) is
+    recorded in the manifest under a compressing codec so the SENDER
+    side frames deterministically at that level; receivers stay
+    agnostic — inflate never needs the level, so mixed-level fleets
+    interoperate freely."""
     if codec not in KNOWN_CODECS:
         raise ValueError(f"unknown courier codec {codec!r} "
                          f"({'|'.join(KNOWN_CODECS)})")
+    if not -1 <= int(zlib_level) <= 9:
+        raise ValueError(
+            f"courier zlib level {zlib_level!r} outside [-1, 9]")
     arrays: list[tuple[str, np.ndarray]] = []
     _walk_arrays(payload, "", arrays)
     scalars: dict = {}
@@ -230,6 +239,8 @@ def encode_payload(payload: dict,
     blob = b"".join(parts)
     manifest = {"scalars": scalars, "arrays": specs,
                 "nbytes": len(blob), "crc32": raw_crc, "codec": codec}
+    if codec != CODEC_NONE:
+        manifest["zlib_level"] = int(zlib_level)
     return manifest, blob
 
 
@@ -310,13 +321,15 @@ class CourierChunk:
 
 
 def _frame_chunk(ticket: str, manifest: dict, blob: bytes, seq: int,
-                 total: int, chunk_bytes: int, codec: str) -> CourierChunk:
+                 total: int, chunk_bytes: int, codec: str,
+                 level: int = -1) -> CourierChunk:
     """Build ONE wire frame: slice [seq*chunk_bytes, ...) of the blob,
-    deflate it under a compressing codec, CRC the bytes that actually
-    travel. Deterministic, so a retransmitted frame is byte-identical."""
+    deflate it under a compressing codec (at the manifest's recorded
+    zlib level), CRC the bytes that actually travel. Deterministic, so
+    a retransmitted frame is byte-identical."""
     data = blob[seq * chunk_bytes:(seq + 1) * chunk_bytes]
     if codec != CODEC_NONE:
-        data = zlib.compress(data)
+        data = zlib.compress(data, level)
     return CourierChunk(
         ticket=ticket, seq=seq, total=total, crc32=zlib.crc32(data),
         data=data, manifest=manifest if seq == 0 else None)
@@ -328,8 +341,10 @@ def make_chunks(ticket: str, manifest: dict, blob: bytes,
     manifest declares a codec). A zero-length blob (a payload of pure
     scalars) still produces one chunk so the manifest travels."""
     codec = manifest.get("codec", CODEC_NONE)
+    level = int(manifest.get("zlib_level", -1))
     n = max((len(blob) + chunk_bytes - 1) // chunk_bytes, 1)
-    return [_frame_chunk(ticket, manifest, blob, i, n, chunk_bytes, codec)
+    return [_frame_chunk(ticket, manifest, blob, i, n, chunk_bytes, codec,
+                         level)
             for i in range(n)]
 
 
@@ -351,6 +366,7 @@ class FramePipeline:
         self.blob = blob
         self.chunk_bytes = chunk_bytes
         self.codec = codec
+        self.level = int(manifest.get("zlib_level", -1))
         self.total = max((len(blob) + chunk_bytes - 1) // chunk_bytes, 1)
         self._frames: dict[int, CourierChunk] = {}
         self._ahead: Optional[tuple[int, threading.Thread]] = None
@@ -365,7 +381,7 @@ class FramePipeline:
         if seq not in self._frames:
             self._frames[seq] = _frame_chunk(
                 self.ticket, self.manifest, self.blob, seq, self.total,
-                self.chunk_bytes, self.codec)
+                self.chunk_bytes, self.codec, self.level)
 
     def frame(self, seq: int,
               prefetch: Optional[int] = None) -> CourierChunk:
@@ -692,6 +708,10 @@ class CourierTransport:
         if self.codec not in KNOWN_CODECS:
             raise ValueError(f"unknown courier codec {self.codec!r} "
                              f"({'|'.join(KNOWN_CODECS)})")
+        self.zlib_level = int(getattr(cfg, "courier_zlib_level", -1))
+        if not -1 <= self.zlib_level <= 9:
+            raise ValueError(
+                f"courier zlib level {self.zlib_level} outside [-1, 9]")
         self.injector = injector
         self.stats = stats or TransportStats()
 
@@ -716,7 +736,8 @@ class CourierTransport:
         t0 = time.perf_counter()
         self.stats.bump(in_flight=1)
         try:
-            manifest, blob = encode_payload(payload, codec=self.codec)
+            manifest, blob = encode_payload(payload, codec=self.codec,
+                                            zlib_level=self.zlib_level)
             frames = FramePipeline(ticket, manifest, blob,
                                    self.chunk_bytes, self.codec)
             pending = list(range(frames.total))
@@ -938,6 +959,14 @@ def build_transport(cfg, injector=None,
 
 TICKET_KEY = "courier_ticket"
 
+# sentinel `prefix_owner` id naming the host-tier fleet KV store
+# (serve/fleet/kv_store.py) instead of a live replica: the router stamps
+# it when no live replica's inventory beats the store's, and
+# KVCourier.fetch_prefix answers it by replaying the store's cached
+# frames through the local receiver. Negative so it can never collide
+# with a real replica id.
+KV_STORE_OWNER = -1
+
 
 def ticket_stub(ticket: str, at, partial=False) -> dict:
     return {TICKET_KEY: ticket, "at": at, "partial": bool(partial)}
@@ -992,6 +1021,13 @@ class KVCourier:
         # for IN-PROC replicas (replica_id -> request_prefix_extract);
         # remote owners are reached over /fleet/courier/fetch instead.
         self.prefix_providers: dict[int, object] = {}
+        # host-tier KV store (serve/fleet/kv_store.py): set by ServeFleet
+        # when FleetConfig.kv_store is on. A fetch hinted at
+        # KV_STORE_OWNER replays the store's cached frames through the
+        # local receiver — the same CRC/verify path a live transfer
+        # rides, so a corrupt stored frame is a counted miss, never
+        # wrong KV.
+        self.kv_store = None
         self.fetch_timeout_s = float(getattr(
             cfg, "prefix_fetch_timeout_s", 5.0) or 5.0)
         self.local_transport = InProcTransport(
@@ -1111,7 +1147,15 @@ class KVCourier:
         Returns the decoded {"hashes": [hex], "pages": {...}} payload,
         None on a miss (owner has nothing / no endpoint / expired
         ticket), and raises TransferAborted when the transfer itself
-        failed — the caller counts it and re-prefills either way."""
+        failed — the caller counts it and re-prefills either way.
+
+        A hint naming ``KV_STORE_OWNER`` is the tiered-store fall-back:
+        the pages live in no replica's HBM anymore, only as compressed
+        frames in the host-tier store — replay them locally."""
+        if owner_id == KV_STORE_OWNER:
+            if self.kv_store is None:
+                return None
+            return self.kv_store.fetch(hashes, self.receiver)
         ticket = f"courier-{uuid.uuid4().hex[:16]}"
         provider = self.prefix_providers.get(owner_id)
         if provider is not None:

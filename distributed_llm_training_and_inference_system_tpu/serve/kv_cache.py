@@ -16,6 +16,7 @@ vLLM-style paging mapped onto XLA's static-shape world:
 from __future__ import annotations
 
 import hashlib
+import logging
 import math
 from collections import OrderedDict
 from typing import Optional
@@ -25,6 +26,8 @@ import numpy as np
 
 from ..config.schema import ModelConfig
 from ..analysis.annotations import engine_thread_only
+
+logger = logging.getLogger("llmctl.serve.kv_cache")
 
 
 def prefix_page_hashes(tokens, page_size: int) -> list[bytes]:
@@ -165,6 +168,18 @@ class PagedKVCache:
         self._evictable: OrderedDict[int, None] = OrderedDict()
         self.prefix_hits = 0          # pages served from cache
         self.prefix_queries = 0       # full pages looked up
+        # tiered fleet KV store (serve/fleet/kv_store.py): when set,
+        # called with (hashes, multi-page extract payload) covering the
+        # cached pages an allocation evicted — the demotion seam.
+        # Evictions are BATCHED per allocation call: _take_free_page
+        # only records (hash, page) pairs and the allocation flushes
+        # them through ONE device gather before returning (the pages'
+        # content is untouched until a later dispatch writes them, and
+        # every write happens on this same engine thread). A hook
+        # failure must never break allocation, so the flush is guarded.
+        # None (the default) changes nothing.
+        self.demote_hook = None
+        self._demote_pending: list[tuple[bytes, int]] = []
 
     def _new_pages(self, shape, dtype):
         """Allocate a (possibly int8/int4-quantized, possibly tensor-
@@ -232,7 +247,13 @@ class PagedKVCache:
     # -- alloc / grow / free -------------------------------------------------
 
     def _take_free_page(self) -> int:
-        """Pop a free page, evicting the LRU cached page if needed."""
+        """Pop a free page, evicting the LRU cached page if needed. An
+        evicted hashed page is queued for the demote hook (tiered fleet
+        KV store); the allocation that triggered the eviction flushes
+        the queue in one batched extract before returning — HBM
+        eviction then moves pages down a tier instead of destroying
+        them, at one device gather per allocation instead of one per
+        page."""
         if self._free:
             return self._free.pop()
         if self._evictable:
@@ -240,8 +261,30 @@ class PagedKVCache:
             h = self._page_to_hash.pop(page, None)
             if h is not None:
                 self._hash_to_page.pop(h, None)
+                if self.demote_hook is not None:
+                    self._demote_pending.append((h, page))
             return page
         raise RuntimeError("KV cache OOM: no free or evictable pages")
+
+    def _flush_demotions(self) -> None:
+        """Hand every eviction queued by ``_take_free_page`` to the
+        demote hook in one batched extract. Must run before the caller
+        releases the engine lock (the evicted pages' content is only
+        guaranteed until the next dispatch writes them)."""
+        if not self._demote_pending:
+            return
+        pairs, self._demote_pending = self._demote_pending, []
+        hook = self.demote_hook
+        if hook is None:
+            return
+        try:
+            content = self._extract_pages_idx(
+                np.asarray([p for _h, p in pairs], np.int32))
+            hook([h for h, _p in pairs], content)
+        except Exception:
+            logger.exception(
+                "KV page demotion hook failed; %d page(s) evicted "
+                "without demoting", len(pairs))
 
     def _drop_ref(self, page: int) -> None:
         self._ref[page] -= 1
@@ -275,6 +318,7 @@ class PagedKVCache:
         self.block_tables[slot, :] = 0
         self.block_tables[slot, :len(table)] = table
         self._chain_len[slot] = len(table)
+        self._flush_demotions()
 
     def slot_capacity_tokens(self, slot: int) -> int:
         """Tokens the slot's current page chain can hold."""
@@ -298,6 +342,7 @@ class PagedKVCache:
         self._owned.setdefault(slot, []).extend(pages)
         self.block_tables[slot, start:start + need] = pages
         self._chain_len[slot] = start + need
+        self._flush_demotions()
         return True
 
     def release(self, slot: int) -> None:
@@ -632,6 +677,10 @@ class PagedKVCache:
                 break                      # pool dry: partial import
             pages.append(self._take_free_page())
             take_pos.append(i)
+        # flush queued demotions BEFORE the fetched content is written
+        # into the taken pages — extracting after the write would file
+        # the NEW content under the evicted pages' OLD hashes
+        self._flush_demotions()
         if not pages:
             return []
 
@@ -646,6 +695,12 @@ class PagedKVCache:
             self._page_to_hash[p] = hashes[i]
             self._evictable[p] = None      # ref 0 until a request pins it
         return pages
+
+    def prefix_cache_pairs(self) -> list[tuple[bytes, int]]:
+        """Every (hash, page) pair currently cached — the whole-inventory
+        flush a draining/retiring replica demotes to the tiered fleet
+        KV store so scale-down preserves the cluster cache."""
+        return list(self._hash_to_page.items())
 
     def prefix_inventory(self, max_entries: int = 0) -> list[bytes]:
         """The page hashes currently cached here — the compact inventory

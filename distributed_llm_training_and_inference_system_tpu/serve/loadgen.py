@@ -102,6 +102,14 @@ class LoadResult:
     # hardened client resumed each front after a connection
     # refused/reset (the kill-the-front failover ledger).
     stream: dict = field(default_factory=dict)
+    # returning-conversation scenario (run_returning, the tiered fleet
+    # KV store's headline): warm-turn vs returning-turn TTFT split, the
+    # prefill tokens the return turns actually spent, and the store's
+    # hit/miss/demotion counters — store-hit TTFT vs recompute is THE
+    # readout. token_lists carries the returning turns' outputs so a
+    # store-on/store-off A/B can assert token identity.
+    returning: dict = field(default_factory=dict)
+    kv_store: dict = field(default_factory=dict)
 
     def percentile(self, xs, q):
         return float(np.percentile(np.asarray(xs), q)) if xs else float("nan")
@@ -145,6 +153,8 @@ class LoadResult:
             **({"prefix_fetch": self.prefix_fetch}
                if self.prefix_fetch else {}),
             **({"stream": self.stream} if self.stream else {}),
+            **({"returning": self.returning} if self.returning else {}),
+            **({"kv_store": self.kv_store} if self.kv_store else {}),
         }
 
 
@@ -367,6 +377,16 @@ def _finalize_fleet(res: LoadResult, reqs: list, fleet,
             "p99_fetch_ms": pct4(window, 99),
         }
 
+    # tiered fleet KV store: demotion/hit/miss counters + tier
+    # occupancy — nonzero whenever HBM eviction or a drain pushed pages
+    # down a tier (the returning-conversation scenario's machinery)
+    ks = snap.get("kv_store", {})
+    if ks.get("demotions") or ks.get("hits") or ks.get("misses"):
+        res.kv_store = {k: ks.get(k, 0) for k in (
+            "hits", "misses", "demotions", "evictions", "spills",
+            "corrupt", "bytes_served", "bytes_stored",
+            "dram_entries", "disk_entries")}
+
     # streaming client mode: per-token delivery jitter + the
     # exactly-once ledger. ``identity_ok`` is the headline assertion:
     # every request's STREAMED token sequence equals its final
@@ -579,6 +599,111 @@ def _run_closed_loop_fleet(fleet, *, concurrency, num_requests, prompt_len,
         time.sleep(0.005)
     return _finalize_fleet(res, reqs, fleet, t0,
                            stream_clients=stream_clients)
+
+
+def run_returning(fleet, *, conversations: int, history_len: int,
+                  tail_len: int = 4, max_tokens: int = 16,
+                  filler_requests: int = 8, filler_len: int = 64,
+                  think_time_s: float = 0.0, seed: int = 0,
+                  vocab_hi: int = 0) -> LoadResult:
+    """Returning-conversation scenario (the tiered fleet KV store's
+    headline, ROADMAP item 2): ``conversations`` multi-turn chats each
+    prefill a ``history_len``-token shared history (warm turn), then go
+    quiet for a think-time gap LONGER than their pages' HBM residency —
+    modeled by ``filler_requests`` distinct prompts churning the pool so
+    LRU eviction demotes the histories down a tier — and finally RETURN
+    with the same history and a fresh tail. With the store on, the
+    return turn fetches its history's pages back (store hits) and
+    prefills only the tail; with it off, the whole history re-prefills.
+
+    ``LoadResult.returning`` carries the warm-vs-return TTFT split, the
+    prefill tokens the return turns actually spent, and the returning
+    token lists (a store-on/store-off A/B must be token-identical —
+    degrade never changes output). Closed-loop per turn; fleet targets
+    only."""
+    rng = np.random.default_rng(seed)
+    hi = vocab_hi or fleet.model_cfg.vocab_size
+    histories = [
+        [int(t) for t in rng.integers(1, hi, size=history_len)]
+        for _ in range(conversations)]
+    reqs: list[Request] = []
+    res = LoadResult(offered_rps=float("inf"))
+    supervised = fleet.supervisor._thread is not None
+    t0 = time.monotonic()
+
+    def turn(prompts) -> list[Request]:
+        events: list = []
+        batch: list[Request] = []
+        for p in prompts:
+            _submit_fleet(fleet, p, max_tokens, batch, events, res)
+        while not all(e.is_set() for e in events):
+            res.queue_peak = max(res.queue_peak,
+                                 fleet.router.pending_total())
+            if not supervised:
+                fleet.supervisor.poll_once()
+            time.sleep(0.005)
+        reqs.extend(batch)
+        return batch
+
+    def engines():
+        return [rep.engine for rep in fleet.replicas
+                if getattr(rep, "engine", None) is not None]
+
+    def prefill_total() -> int:
+        return sum(e.total_prefill_tokens for e in engines())
+
+    warm = turn([h + [int(t) for t in rng.integers(1, hi, size=tail_len)]
+                 for h in histories])
+    # the think-time gap: other tenants' traffic outlives this
+    # conversation's HBM residency
+    deadline = time.monotonic() + max(think_time_s, 0.0)
+    while time.monotonic() < deadline:
+        if not supervised:
+            fleet.supervisor.poll_once()
+        time.sleep(0.005)
+    if filler_requests > 0:
+        turn([[int(t) for t in rng.integers(1, hi, size=filler_len)]
+              for _ in range(filler_requests)])
+    # eviction demotions encode on the store's background worker; the
+    # think-time gap is exactly when that drains in production — make
+    # it deterministic here
+    store = getattr(fleet, "kv_store", None)
+    if store is not None:
+        store.flush_pending()
+    fetched0 = sum(getattr(e, "total_prefix_fetched_tokens", 0)
+                   for e in engines())
+    spent0 = prefill_total()
+    # returns are SEQUENTIAL: real conversations come back after
+    # independent think times, not as a thundering herd — and per-
+    # request TTFT is the honest store-hit-vs-recompute readout only
+    # without co-batching artifacts
+    ret = []
+    for h in histories:
+        ret.extend(turn([h + [int(t) for t in
+                              rng.integers(1, hi, size=tail_len)]]))
+    ret_spent = prefill_total() - spent0
+    ret_fetched = sum(getattr(e, "total_prefix_fetched_tokens", 0)
+                      for e in engines()) - fetched0
+
+    def pct(xs, q):
+        return (round(float(np.percentile(np.asarray(xs), q)), 2)
+                if xs else None)
+
+    warm_ttft = [r.ttft_ms for r in warm if r.ttft_ms is not None]
+    ret_ttft = [r.ttft_ms for r in ret if r.ttft_ms is not None]
+    out = _finalize_fleet(res, reqs, fleet, t0)
+    out.returning = {
+        "conversations": conversations,
+        "history_len": history_len,
+        "warm_p50_ttft_ms": pct(warm_ttft, 50),
+        "warm_p99_ttft_ms": pct(warm_ttft, 99),
+        "return_p50_ttft_ms": pct(ret_ttft, 50),
+        "return_p99_ttft_ms": pct(ret_ttft, 99),
+        "return_prefill_tokens": int(ret_spent),
+        "return_fetched_tokens": int(ret_fetched),
+        "token_lists": [list(r.generated_tokens) for r in ret],
+    }
+    return out
 
 
 class FrontStreamClient:

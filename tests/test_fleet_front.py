@@ -690,3 +690,158 @@ class TestFrontReadiness:
                 srv.fleet.shutdown()
 
         asyncio.run(scenario())
+
+
+# -- journal compaction (PR-12 known gap: snapshot + truncate) ---------------
+
+
+class TestJournalCompaction:
+    """The file store's journal grows unboundedly without compaction
+    (PR-12 known gap). The contract: ``compact()`` folds the prefix
+    every attached, unfenced front has already consumed into
+    snapshot.jsonl — terminal request groups collapsed to aggregated
+    count records, finished stream groups dropped, counter records
+    merged — truncates the journal to its tail under a fresh generation
+    (one atomic registry flip), and a FRESH front folding snapshot +
+    tail reaches the same live state and counters as one folding the
+    original journal."""
+
+    def _workload(self, store, requests=30, terminal=20, streams=5,
+                  finished=3):
+        for i in range(requests):
+            rid = f"r{i}"
+            store.record({"ns": "ledger", "op": "put", "rid": rid,
+                          "wire": {"prompt_tokens": [1, 2, 3]}})
+            store.record({"ns": "ledger", "op": "count",
+                          "key": "submitted", "replica": 0})
+            store.record({"ns": "ledger", "op": "meta", "rid": rid,
+                          "replica": 0})
+            if i < terminal:
+                store.record({"ns": "ledger", "op": "pop", "rid": rid,
+                              "outcome": "completed", "replica": 0,
+                              "tokens": [i]})
+        for i in range(streams):
+            rid = f"s{i}"
+            store.record({"ns": "stream", "op": "open", "rid": rid})
+            store.record({"ns": "stream", "op": "append", "rid": rid,
+                          "s": 0, "t": [1, 2, 3], "r": 0})
+            if i < finished:
+                store.record({"ns": "stream", "op": "finish",
+                              "rid": rid, "reason": "stop",
+                              "error": None})
+
+    def _fresh_state(self, tmp_path, fid="FRESH"):
+        store = SharedFileStateStore(tmp_path, front_id=fid)
+        store.attach()
+        hub = FleetStreamHub(store=store)
+        router = FleetRouter([FakeReplica(0)],
+                             FleetConfig(affinity_prefix_tokens=0),
+                             store=store)
+        store.sync()
+        return hub, router
+
+    def test_compacted_store_replays_identically(self, tmp_path):
+        import shutil
+        a_dir = tmp_path / "a"
+        a = SharedFileStateStore(a_dir, front_id="A")
+        a.attach()
+        self._workload(a)
+        a.poll()                              # advance A's fold frontier
+        before = (a_dir / "journal.jsonl").stat().st_size
+        shutil.copytree(a_dir, tmp_path / "b")   # uncompacted twin
+        pruned = a.compact()
+        assert pruned > 0
+        reg = json.loads((a_dir / "fronts.json").read_text())
+        tail = (a_dir / f"journal.{reg['journal_gen']}.jsonl")
+        snap = (a_dir / reg["journal_snapshot"])
+        assert tail.stat().st_size + snap.stat().st_size < before
+        assert not (a_dir / "journal.jsonl").exists()   # old gen gone
+
+        h1, r1 = self._fresh_state(a_dir)
+        h2, r2 = self._fresh_state(tmp_path / "b")
+        s1, s2 = r1.stats(), r2.stats()
+        for key in ("completed", "failed", "rejected", "submitted",
+                    "requeues", "in_flight"):
+            assert s1[key] == s2[key], (key, s1[key], s2[key])
+        assert s1["completed_per_replica"] == s2["completed_per_replica"]
+        assert sorted(r1._meta) == sorted(r2._meta)
+        # LIVE streams replay identically; finished ones (which the TTL
+        # would GC anyway) are dropped by compaction — the documented
+        # semantic difference
+        live1 = {rid for rid, log in h1._logs.items()
+                 if not log.finished}
+        live2 = {rid for rid, log in h2._logs.items()
+                 if not log.finished}
+        assert live1 == live2
+        for rid in live1:
+            assert h1._logs[rid].tokens == h2._logs[rid].tokens
+
+    def test_trim_bounded_by_slowest_front_cursor(self, tmp_path):
+        """A sibling that has folded nothing past its cursor must keep
+        its unread tail in the journal — and keep folding correctly
+        across the generation flip, with nothing double-counted."""
+        a = SharedFileStateStore(tmp_path, front_id="A")
+        b = SharedFileStateStore(tmp_path, front_id="B")
+        a.attach()
+        b.attach()
+        rb = FleetRouter([FakeReplica(0)],
+                         FleetConfig(affinity_prefix_tokens=0), store=b)
+        self._workload(a, requests=10, terminal=10, streams=0)
+        b.sync()                              # B fully folded
+        completed_mid = rb.stats()["completed"]
+        assert completed_mid == 10
+        self._workload(a, requests=4, terminal=4, streams=0)
+        a.poll()
+        assert a.compact() > 0                # trims only B's folded part
+        # B folds the tail (the 4 new requests) across the flip
+        b.sync()
+        assert rb.stats()["completed"] == 14  # no loss, no double count
+        # second compaction can now take the rest
+        a.poll()
+        a.compact()
+        c = SharedFileStateStore(tmp_path, front_id="C")
+        c.attach()
+        rc = FleetRouter([FakeReplica(0)],
+                         FleetConfig(affinity_prefix_tokens=0), store=c)
+        c.sync()
+        assert rc.stats()["completed"] == 14
+
+    def test_fenced_front_cannot_compact(self, tmp_path):
+        a = SharedFileStateStore(tmp_path, front_id="A")
+        b = SharedFileStateStore(tmp_path, front_id="B")
+        a.attach()
+        self._workload(a, requests=3, terminal=3, streams=0)
+        a.poll()
+        b.fence("A")
+        assert a.compact() == 0
+
+    def test_periodic_compaction_via_record(self, tmp_path):
+        a = SharedFileStateStore(tmp_path, front_id="A",
+                                 compact_every=40)
+        a.attach()
+        # interleave folds so the cursor keeps up and compaction can
+        # actually trim when record() triggers it
+        for _ in range(4):
+            self._workload(a, requests=5, terminal=5, streams=0)
+            a.poll()
+        assert a.compactions >= 1
+        reg = json.loads((tmp_path / "fronts.json").read_text())
+        assert reg.get("journal_gen", 0) >= 1
+        # the store still round-trips for a fresh reader
+        _hub, router = self._fresh_state(tmp_path)
+        assert router.stats()["completed"] == 20
+
+    def test_aggregated_counts_preserve_per_front_filtering(
+            self, tmp_path):
+        """Compacted count records keep their originating front id, so
+        the originator never double-folds its own aggregates."""
+        a = SharedFileStateStore(tmp_path, front_id="A")
+        a.attach()
+        ra = FleetRouter([FakeReplica(0)],
+                         FleetConfig(affinity_prefix_tokens=0), store=a)
+        self._workload(a, requests=6, terminal=6, streams=0)
+        a.poll()
+        a.compact()
+        before = ra.stats()["completed"]
+        a.sync()                              # folds nothing of its own
+        assert ra.stats()["completed"] == before

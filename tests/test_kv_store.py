@@ -1,0 +1,456 @@
+"""Tiered fleet KV store: pooled DRAM/disk cache behind the prefix
+inventory (serve/fleet/kv_store.py).
+
+The contract under test:
+
+- demotion encodes ONCE into courier frames and a fetch replays those
+  frames byte-identical through the standard receiver (frame CRC +
+  end-to-end raw CRC + decode) — content round-trips exactly, fp and
+  int8;
+- the DRAM ring is LRU-bounded: overflow evicts oldest-first, spilling
+  to disk when a directory is configured, and a disk round trip
+  reproduces content exactly;
+- degrade, never wrong: a corrupt frame on disk (bit rot, truncation)
+  is rejected by CRC, counted, the entry dropped, and the fetch is a
+  MISS — plain prefill, never garbage KV;
+- TTL expiry, duplicate-demotion idempotency, and fetch racing
+  eviction are all safe;
+- the router's hint path prefers a live replica owner and falls back
+  to the store only on strictly-better coverage;
+- the zlib-level satellite: FleetConfig.courier_zlib_level rides the
+  frame manifest, receivers stay agnostic, payloads round-trip at
+  every level.
+"""
+
+import os
+import threading
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from distributed_llm_training_and_inference_system_tpu.config import (
+    get_model_config)
+from distributed_llm_training_and_inference_system_tpu.config.schema import (
+    ConfigError, FleetConfig)
+from distributed_llm_training_and_inference_system_tpu.serve.fleet.kv_store import (  # noqa: E501
+    KV_STORE_OWNER, FleetKVStore)
+from distributed_llm_training_and_inference_system_tpu.serve.fleet.router import (  # noqa: E501
+    FleetRouter)
+from distributed_llm_training_and_inference_system_tpu.serve.fleet.transport import (  # noqa: E501
+    CODEC_DELTA_ZLIB, CODEC_ZLIB, CourierReceiver, CourierTransport,
+    InProcTransport, decode_payload, encode_payload, make_chunks)
+from distributed_llm_training_and_inference_system_tpu.serve.kv_cache import (
+    PagedKVCache, prefix_page_hashes)
+from distributed_llm_training_and_inference_system_tpu.serve.scheduler import (
+    Request, SamplingParams)
+
+PS = 8
+HOT = [7, 3, 9, 1, 4, 8, 2, 6] * 4            # 32 tokens = 4 full pages
+
+
+@pytest.fixture(scope="module")
+def model_cfg():
+    return get_model_config("gpt-test")
+
+
+def make_kv(model_cfg, num_pages=32, quantized=False) -> PagedKVCache:
+    return PagedKVCache(model_cfg, num_slots=2, max_seq_len=128,
+                        page_size=PS, num_pages=num_pages,
+                        quantized=quantized)
+
+
+def stamped_payload(model_cfg, n_pages=4, quantized=False, seed=0):
+    rng = np.random.default_rng(seed)
+    shape = (model_cfg.num_layers, n_pages, model_cfg.num_kv_heads, PS,
+             model_cfg.head_dim)
+    if quantized:
+        return {
+            "k": {"values": rng.integers(-127, 127, shape, np.int8),
+                  "scale": rng.random(shape[:-1], np.float32)},
+            "v": {"values": rng.integers(-127, 127, shape, np.int8),
+                  "scale": rng.random(shape[:-1], np.float32)},
+            "num_pages": n_pages,
+        }
+    return {"k": rng.random(shape, np.float32),
+            "v": rng.random(shape, np.float32), "num_pages": n_pages}
+
+
+def warm_store(model_cfg, hashes=None, quantized=False, seed=0,
+               **cfg_kw) -> tuple:
+    """A store holding one 4-page conversation; returns (store, hashes,
+    payload)."""
+    hashes = hashes or prefix_page_hashes(HOT, PS)
+    payload = stamped_payload(model_cfg, len(hashes),
+                              quantized=quantized, seed=seed)
+    cfg = FleetConfig(kv_store=True, **cfg_kw)
+    store = FleetKVStore(cfg)
+    assert store.demote(hashes, payload) == len(hashes)
+    return store, hashes, payload
+
+
+def assert_pages_equal(a, b, quantized=False):
+    if quantized:
+        np.testing.assert_array_equal(a["k"]["values"], b["k"]["values"])
+        np.testing.assert_allclose(a["k"]["scale"], b["k"]["scale"])
+        np.testing.assert_array_equal(a["v"]["values"], b["v"]["values"])
+        np.testing.assert_allclose(a["v"]["scale"], b["v"]["scale"])
+    else:
+        np.testing.assert_allclose(a["k"], b["k"])
+        np.testing.assert_allclose(a["v"], b["v"])
+
+
+class TestStoreCore:
+    @pytest.mark.parametrize("quantized", [False, True])
+    def test_demote_fetch_round_trip(self, model_cfg, quantized):
+        store, hashes, payload = warm_store(model_cfg,
+                                            quantized=quantized)
+        out = store.fetch(hashes, CourierReceiver())
+        assert out is not None
+        assert [bytes.fromhex(h) for h in out["hashes"]] == hashes
+        assert out["pages"]["num_pages"] == 4
+        assert_pages_equal(out["pages"], payload, quantized=quantized)
+        snap = store.snapshot()
+        assert snap["hits"] == 4 and snap["misses"] == 0
+        assert snap["bytes_served"] == snap["bytes_stored"]
+
+    def test_partial_coverage_serves_prefix(self, model_cfg):
+        store, hashes, payload = warm_store(model_cfg)
+        longer = hashes + [b"y" * 16]
+        out = store.fetch(longer, CourierReceiver())
+        assert len(out["hashes"]) == 4       # held prefix only
+        # unknown FIRST hash: nothing served, one counted miss
+        assert store.fetch([b"z" * 16] + hashes,
+                           CourierReceiver()) is None
+        assert store.snapshot()["misses"] == 1
+
+    def test_duplicate_demotion_idempotent(self, model_cfg):
+        store, hashes, payload = warm_store(model_cfg)
+        assert store.demote(hashes, payload) == 0
+        snap = store.snapshot()
+        assert snap["demotions"] == 4 and snap["duplicates"] == 4
+        assert snap["dram_entries"] == 4     # nothing double-stored
+
+    def test_dram_ring_evicts_lru_first(self, model_cfg):
+        """Tiny DRAM cap, no disk: inserting past capacity drops the
+        OLDEST entries; the newest survive and still fetch."""
+        hashes = prefix_page_hashes(list(range(1, 1 + 12 * PS)), PS)
+        payload = stamped_payload(model_cfg, 12)
+        cfg = FleetConfig(kv_store=True, kv_store_dram_mb=256.0)
+        store = FleetKVStore(cfg)
+        store.demote(hashes[:1], {
+            k: (v if not isinstance(v, np.ndarray) else v[:, :1])
+            for k, v in payload.items()} | {"num_pages": 1})
+        one_page = store.snapshot()["dram_bytes"]
+        # capacity for ~4 pages, then insert 12
+        store2 = FleetKVStore(cfg)
+        store2.dram_capacity = int(one_page * 4.5)
+        store2.demote(hashes, payload)
+        snap = store2.snapshot()
+        assert snap["demotions"] == 12
+        assert snap["evictions"] >= 7        # oldest dropped
+        held = store2.inventory()
+        assert held == hashes[-len(held):]   # newest survive, in order
+        assert store2.fetch(hashes[:1], CourierReceiver()) is None
+        out = store2.fetch(held, CourierReceiver())
+        assert out is not None and len(out["hashes"]) == len(held)
+
+    def test_disk_spill_round_trip(self, model_cfg, tmp_path):
+        store, hashes, payload = warm_store(
+            model_cfg, kv_store_dir=str(tmp_path))
+        # shrink the ring so every entry spills
+        with store._lock:
+            store.dram_capacity = 1
+            store._enforce_caps_locked()
+        snap = store.snapshot()
+        assert snap["spills"] >= 3 and snap["disk_entries"] >= 3
+        assert len(list(tmp_path.glob("*.kvf"))) == snap["disk_entries"]
+        out = store.fetch(hashes, CourierReceiver())
+        assert out is not None and len(out["hashes"]) == 4
+        assert_pages_equal(out["pages"], payload)
+
+    def test_corrupt_disk_frame_is_counted_miss(self, model_cfg,
+                                                tmp_path):
+        store, hashes, _payload = warm_store(
+            model_cfg, kv_store_dir=str(tmp_path))
+        with store._lock:
+            store.dram_capacity = 1
+            store._enforce_caps_locked()
+        # flip bytes in the middle of the first spilled entry's data
+        victim = sorted(tmp_path.glob("*.kvf"))[0]
+        blob = bytearray(victim.read_bytes())
+        blob[-10] ^= 0xFF
+        victim.write_bytes(bytes(blob))
+        h = bytes.fromhex(victim.stem)
+        out = store.fetch([h], CourierReceiver())
+        assert out is None                   # rejected, never wrong KV
+        snap = store.snapshot()
+        assert snap["corrupt"] >= 1 and snap["misses"] == 1
+        assert not store.holds(h)            # dropped: hint path heals
+
+    def test_truncated_disk_file_is_counted_miss(self, model_cfg,
+                                                 tmp_path):
+        store, hashes, _payload = warm_store(
+            model_cfg, kv_store_dir=str(tmp_path))
+        with store._lock:
+            store.dram_capacity = 1
+            store._enforce_caps_locked()
+        victim = sorted(tmp_path.glob("*.kvf"))[0]
+        victim.write_bytes(victim.read_bytes()[:40])
+        out = store.fetch([bytes.fromhex(victim.stem)],
+                          CourierReceiver())
+        assert out is None
+        assert store.snapshot()["misses"] == 1
+
+    def test_ttl_expiry(self, model_cfg):
+        store, hashes, payload = warm_store(model_cfg,
+                                            kv_store_ttl_ms=1e-3)
+        # born stamps are in the past relative to any later access
+        assert store.inventory() == []
+        assert store.fetch(hashes, CourierReceiver()) is None
+        snap = store.snapshot()
+        assert snap["expired"] == 4 and snap["misses"] == 1
+
+    def test_fetch_racing_eviction(self, model_cfg):
+        """Concurrent fetch + clear: every outcome is a clean payload
+        or a miss — no exception, no partial garbage."""
+        store, hashes, payload = warm_store(model_cfg)
+        results, errors = [], []
+
+        def fetcher():
+            try:
+                for _ in range(20):
+                    results.append(store.fetch(hashes, CourierReceiver()))
+            except Exception as e:             # pragma: no cover
+                errors.append(e)
+
+        def evictor():
+            for _ in range(10):
+                store.clear()
+                store.demote(hashes, payload)
+
+        ts = [threading.Thread(target=fetcher),
+              threading.Thread(target=evictor)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        assert not errors
+        for out in results:
+            if out is not None:
+                # whatever prefix was served is internally consistent;
+                # a full 4-page answer must match the demoted content
+                assert out["pages"]["num_pages"] == len(out["hashes"])
+                if len(out["hashes"]) == 4:
+                    assert_pages_equal(out["pages"], payload)
+
+    def test_async_demotion_drains_to_store(self, model_cfg):
+        """The hot eviction seam queues pages for the background
+        encoder — the engine thread never pays the deflate — and the
+        drained store serves them exactly like sync demotions."""
+        hashes = prefix_page_hashes(HOT, PS)
+        payload = stamped_payload(model_cfg, 4)
+        store = FleetKVStore(FleetConfig(kv_store=True))
+        assert store.demote_async(hashes, payload) == 4
+        store.flush_pending()
+        assert store.snapshot()["demotions"] == 4
+        out = store.fetch(hashes, CourierReceiver())
+        assert out is not None and len(out["hashes"]) == 4
+        assert_pages_equal(out["pages"], payload)
+        # duplicates are idempotent across the queue too
+        assert store.demote_async(hashes, payload) == 0
+        assert store.snapshot()["duplicates"] == 4
+
+    def test_fetch_racing_pending_queue_degrades_to_miss(self,
+                                                         model_cfg):
+        """A fetch for a page still waiting in the encode queue is a
+        miss (or a hit if the worker won the race) — never an error,
+        never wrong content."""
+        hashes = prefix_page_hashes(HOT, PS)
+        payload = stamped_payload(model_cfg, 4)
+        store = FleetKVStore(FleetConfig(kv_store=True))
+        store.demote_async(hashes, payload)
+        out = store.fetch(hashes, CourierReceiver())
+        if out is not None:
+            assert out["pages"]["num_pages"] == len(out["hashes"])
+        store.flush_pending()
+        out = store.fetch(hashes, CourierReceiver())
+        assert out is not None and len(out["hashes"]) == 4
+
+    def test_clear_wipes_both_tiers(self, model_cfg, tmp_path):
+        store, hashes, _p = warm_store(model_cfg,
+                                       kv_store_dir=str(tmp_path))
+        with store._lock:
+            store.dram_capacity = 1
+            store._enforce_caps_locked()
+        store.clear()
+        snap = store.snapshot()
+        assert snap["dram_entries"] == 0 and snap["disk_entries"] == 0
+        assert list(tmp_path.glob("*.kvf")) == []
+
+    def test_config_validation(self):
+        with pytest.raises(ConfigError):
+            FleetConfig(kv_store=True, prefix_fetch=False).validate()
+        with pytest.raises(ConfigError):
+            FleetConfig(kv_store=True, kv_store_dram_mb=0).validate()
+        with pytest.raises(ConfigError):
+            FleetConfig(kv_store_ttl_ms=-1).validate()
+        FleetConfig(kv_store=True).validate()
+
+
+class TestStoreHints:
+    """Router-side: live replica preferred, store as the fall-back."""
+
+    def _router(self, invs_by_rid, store):
+        reps = []
+        for rid, inv in invs_by_rid.items():
+            reps.append(SimpleNamespace(
+                replica_id=rid, state="healthy", remote=False,
+                prefix_inventory=(lambda inv=inv: list(inv)),
+                accepting=lambda: True, queue_depth=lambda: 0,
+                outstanding_tokens=lambda: 0))
+        return FleetRouter(reps, FleetConfig(replicas=len(reps)),
+                           page_size=PS, kv_store=store)
+
+    def _req(self, tokens):
+        return Request(request_id="r", prompt_tokens=list(tokens),
+                       sampling=SamplingParams(max_tokens=4))
+
+    def test_live_owner_beats_store_on_tie(self, model_cfg):
+        store, hashes, _p = warm_store(model_cfg)
+        router = self._router({0: [], 1: hashes}, store)
+        req = self._req(HOT + [99])
+        router._attach_prefix_hint(req, 0, router._inventories())
+        assert req.prefix_owner == 1         # live replica, not the store
+
+    def test_store_wins_on_strictly_better_coverage(self, model_cfg):
+        store, hashes, _p = warm_store(model_cfg)
+        router = self._router({0: [], 1: hashes[:2]}, store)
+        req = self._req(HOT + [99])
+        router._attach_prefix_hint(req, 0, router._inventories())
+        assert req.prefix_owner == KV_STORE_OWNER
+        assert req.prefix_owner_endpoint is None
+
+    def test_no_store_hint_for_remote_dest(self, model_cfg):
+        store, hashes, _p = warm_store(model_cfg)
+        router = self._router({0: []}, store)
+        router.by_id[0].remote = True
+        req = self._req(HOT + [99])
+        router._attach_prefix_hint(req, 0, router._inventories())
+        assert req.prefix_owner is None
+
+    def test_empty_store_adds_no_inventory(self, model_cfg):
+        store = FleetKVStore(FleetConfig(kv_store=True))
+        router = self._router({0: []}, store)
+        assert KV_STORE_OWNER not in router._inventories()
+
+
+class TestZlibLevel:
+    """PR-10 satellite: configurable courier zlib level, recorded in
+    the manifest, receiver-agnostic."""
+
+    @pytest.mark.parametrize("level", [-1, 1, 6, 9])
+    @pytest.mark.parametrize("codec", [CODEC_ZLIB, CODEC_DELTA_ZLIB])
+    def test_round_trip_at_every_level(self, model_cfg, codec, level):
+        payload = stamped_payload(model_cfg, 2, quantized=True)
+        manifest, blob = encode_payload(payload, codec=codec,
+                                        zlib_level=level)
+        assert manifest["zlib_level"] == level
+        recv = CourierReceiver()
+        for c in make_chunks("t", manifest, blob, 4096):
+            recv.add_chunk(c)
+        out = recv.take_payload("t")
+        assert out is not None
+        np.testing.assert_array_equal(out["k"]["values"],
+                                      payload["k"]["values"])
+        np.testing.assert_array_equal(out["v"]["values"],
+                                      payload["v"]["values"])
+
+    def test_level_changes_wire_bytes_not_content(self, model_cfg):
+        """Level 9 must deflate at least as well as level 1 on
+        compressible (correlated) planes, and both must decode to the
+        same raw bytes."""
+        rng = np.random.default_rng(0)
+        base = rng.integers(-8, 8, (2, 1, 4, 64, 64), np.int8)
+        plane = np.cumsum(base, axis=-2, dtype=np.int8)
+        payload = {"pages": {"k": {"values": plane,
+                                   "scale": np.ones((2, 1, 4, 64),
+                                                    np.float32)}},
+                   "positions": 64}
+        sizes = {}
+        for level in (1, 9):
+            manifest, blob = encode_payload(payload, codec=CODEC_ZLIB,
+                                            zlib_level=level)
+            chunks = make_chunks("t", manifest, blob, 1 << 20)
+            sizes[level] = sum(len(c.data) for c in chunks)
+            recv = CourierReceiver()
+            for c in chunks:
+                recv.add_chunk(c)
+            out = recv.take_payload("t")
+            np.testing.assert_array_equal(
+                out["pages"]["k"]["values"], plane)
+        assert sizes[9] <= sizes[1]
+
+    def test_transport_reads_config_level(self):
+        cfg = SimpleNamespace(courier_codec="zlib",
+                              courier_zlib_level=9)
+        t = InProcTransport(cfg)
+        assert t.zlib_level == 9
+        with pytest.raises(ValueError):
+            CourierTransport(SimpleNamespace(courier_zlib_level=10))
+
+    def test_fleet_config_validates_level(self):
+        with pytest.raises(ConfigError):
+            FleetConfig(courier_zlib_level=11).validate()
+        with pytest.raises(ConfigError):
+            FleetConfig(courier_zlib_level=-2).validate()
+        FleetConfig(courier_zlib_level=9).validate()
+
+    def test_bad_level_rejected_at_encode(self):
+        with pytest.raises(ValueError):
+            encode_payload({"x": 1}, codec=CODEC_ZLIB, zlib_level=12)
+
+    def test_level_none_codec_has_no_manifest_key(self):
+        manifest, _ = encode_payload({"x": 1})
+        assert "zlib_level" not in manifest
+        decode_payload(manifest, b"")        # receivers stay agnostic
+
+
+class TestKvCacheDemoteSeam:
+    def test_eviction_fires_demote_hook(self, model_cfg):
+        """LRU evictions are BATCHED per allocation: one hook call with
+        the evicted hashes (oldest first) and their exact content,
+        extracted before anything reuses the pages."""
+        kv = make_kv(model_cfg, num_pages=6)   # 5 usable pages
+        hashes = prefix_page_hashes(HOT, PS)
+        kv.allocate(0, len(HOT))
+        payload = stamped_payload(model_cfg, 4)
+        kv.write_slot_pages(0, payload)
+        table = kv.block_tables[0]
+        kv.register_pages([(hashes[i], int(table[i]))
+                           for i in range(4)])
+        kv.release(0)                          # 4 pages cached evictable
+        demoted = []
+        kv.demote_hook = lambda hs, content: demoted.append((hs, content))
+        kv.allocate(1, 3 * PS)                 # needs 3: 1 free + 2 evicted
+        assert len(demoted) == 1               # one batched call
+        hs, content = demoted[0]
+        assert hs == hashes[:2]                # oldest first
+        assert content["num_pages"] == 2
+        for i in range(2):
+            # pool dtype is bf16: compare at bf16 tolerance
+            np.testing.assert_allclose(
+                np.asarray(content["k"])[:, i].astype(np.float32),
+                payload["k"][:, i], rtol=2e-2, atol=1e-2)
+
+    def test_hook_failure_never_breaks_allocation(self, model_cfg):
+        kv = make_kv(model_cfg, num_pages=6)
+        hashes = prefix_page_hashes(HOT, PS)
+        kv.allocate(0, len(HOT))
+        table = kv.block_tables[0]
+        kv.register_pages([(hashes[i], int(table[i]))
+                           for i in range(4)])
+        kv.release(0)
+        kv.demote_hook = lambda h, c: 1 / 0
+        kv.allocate(1, 4 * PS)                 # evicts through the hook
+        assert kv._chain_len[1] == 4           # allocation succeeded
