@@ -230,6 +230,22 @@ def kernels(op, seq_len, hidden, heads, batch):
               type=int,
               help="Returning-conversation history length in tokens "
                    "(the shared prefix each conversation re-uses).")
+@click.option("--serve-long-prompts", default=0, show_default=True,
+              type=int,
+              help="serve-load fleet: pipelined-prefill scenario — mix "
+                   "this many long-context prompts into the short chat "
+                   "traffic and run a pipelining-ON arm (the prompt is "
+                   "split across the prefill pool, stage KV shipped "
+                   "forward while the next chunk computes) against a "
+                   "pipelining-OFF single-replica-prefill arm, plus a "
+                   "chaos arm (stage kill + chunk faults, pipelining "
+                   "on). Asserts token identity across all arms; the "
+                   "headline is long-prompt TTFT vs stage count and "
+                   "co-resident short-request TPOT p99 protection.")
+@click.option("--serve-long-prompt-len", default=384, show_default=True,
+              type=int,
+              help="Long-context prompt length in tokens for "
+                   "--serve-long-prompts.")
 @click.option("--serve-stream/--no-serve-stream", default=False,
               show_default=True,
               help="serve-load fleet: streaming client mode — every "
@@ -245,7 +261,8 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
         slots, pipelined, int8_pallas, serve_max_retries, serve_replicas,
         serve_disagg, serve_courier_chaos, serve_courier_codec,
         serve_courier_zlib_level, serve_hot_prefix, serve_returning,
-        serve_returning_history, serve_stream):
+        serve_returning_history, serve_long_prompts, serve_long_prompt_len,
+        serve_stream):
     """End-to-end train step throughput / serve TTFT+throughput
     (parity: reference bench.py:35-49). ``serve-load`` runs open-loop
     (Poisson) and closed-loop sweeps with p50/p99 TTFT, per-token latency,
@@ -588,6 +605,127 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
             for arm in ("store_on", "store_off"):
                 results["serve_load"]["returning"][arm].get(
                     "returning", {}).pop("token_lists", None)
+
+        if serve_long_prompts > 0:
+            # pipelined multi-replica prefill A/B: one fleet per arm,
+            # same traffic. The ON arm splits each long prompt across
+            # the prefill pool (stage KV pre-shipped forward while the
+            # next chunk computes); the OFF arm prefills on one replica.
+            # Both arms run a warm lap first (compiles every stage /
+            # tail bucket the pipeline dispatches), then a measured lap
+            # from a clean ledger. Token identity between arms is the
+            # degrade proof; the headline is long-prompt TTFT plus
+            # co-resident short-request TPOT p99 protection. A third
+            # chaos arm (stage kill + chunk faults, pipelining on) must
+            # collapse to single-replica prefill, counted, tokens still
+            # identical.
+            import gc
+
+            from ...config.schema import FleetConfig
+            from ...serve.fleet import FaultPlan, ServeFleet
+            if last_engine:
+                eng = last_engine.pop()
+                (eng.shutdown if hasattr(eng, "router")
+                 else eng.release)()
+                gc.collect()
+                jax.clear_caches()
+            L = serve_long_prompt_len
+            n_reps = max(serve_replicas, 2)
+            chunk = 64
+            pl_rps = [float(x) for x in str(rps).split(",") if x][0]
+            min_on = max(prompt_len + 1, L // 2)
+
+            def pipeline_arm(min_tokens, fault_plan=None, warm_lap=True):
+                scfg = point_serve_cfg()
+                scfg.max_seq_len = min(L + gen_len + 16,
+                                       cfg.max_position_embeddings)
+                scfg.chunked_prefill_tokens = chunk
+                # interleave decode between chunks: the tax the pipeline
+                # divides across stages (and the reason the OFF arm's
+                # co-resident decodes stall for the whole prefill)
+                scfg.prefill_budget_tokens = chunk
+                fleet = ServeFleet(
+                    cfg, scfg,
+                    FleetConfig(replicas=n_reps, prefix_fetch=True,
+                                pipeline_prefill_min_tokens=min_tokens,
+                                pipeline_prefill_max_stages=min(n_reps, 4),
+                                # cold-lap stage chunks pay XLA compiles
+                                # (minutes on small CPU hosts); the default
+                                # 30 s timeout would collapse every warm-up
+                                # pipeline and leave the measured lap cold
+                                pipeline_prefill_stage_timeout_ms=240_000.0,
+                                courier_codec=serve_courier_codec,
+                                courier_zlib_level=(
+                                    serve_courier_zlib_level)),
+                    fault_plan=fault_plan, supervise=False)
+                for r in fleet.replicas:
+                    for n in (L, prompt_len):
+                        r.engine.generate(
+                            [list(range(1, n + 1))],
+                            SamplingParams(temperature=0.0, max_tokens=2))
+                fleet.start()
+                try:
+                    def lap(seed):
+                        return run_poisson(
+                            fleet, offered_rps=pl_rps,
+                            num_requests=requests,
+                            prompt_len=prompt_len, max_tokens=gen_len,
+                            seed=seed, max_retries=serve_max_retries,
+                            long_prompts=serve_long_prompts,
+                            long_prompt_len=L)
+                    if warm_lap:
+                        lap(0)
+                        fleet.pipeline.reset_counters()
+                        for r in fleet.replicas:
+                            _reset_counters(r.engine)
+                            with r.engine.lock:
+                                r.engine.kv.flush_prefix_cache()
+                    return lap(1)
+                finally:
+                    fleet.shutdown()
+                    gc.collect()
+                    jax.clear_caches()
+
+            off = pipeline_arm(0)
+            on = pipeline_arm(min_on)
+            # chaos arm: no warm lap (the injected crash fires exactly
+            # once — a warm lap would absorb it; compile noise is fine
+            # here, this arm measures correctness, not latency)
+            chaos = pipeline_arm(
+                min_on, warm_lap=False,
+                fault_plan=FaultPlan(seed=0, chunk_drop_rate=0.1,
+                                     chunk_corrupt_rate=0.1,
+                                     crash_replica=0,
+                                     crash_after_steps=6))
+            ref_tokens = off.pipeline.get("token_lists")
+            pl = {
+                "replicas": n_reps,
+                "stages_planned": min(n_reps, 4),
+                "long_prompts": serve_long_prompts,
+                "long_prompt_len": L,
+                "pipeline_on": on.summary(),
+                "pipeline_off": off.summary(),
+                "chaos": chaos.summary(),
+                # the degrade contract: pipelining (and its collapse
+                # path) must never change output
+                "token_identical": (
+                    on.pipeline.get("token_lists") == ref_tokens),
+                "chaos_token_identical": (
+                    chaos.pipeline.get("token_lists") == ref_tokens),
+            }
+            on_t = on.pipeline.get("p50_long_ttft_ms")
+            off_t = off.pipeline.get("p50_long_ttft_ms")
+            if on_t and off_t:
+                pl["long_ttft_speedup_p50"] = round(off_t / on_t, 3)
+            on_d = on.pipeline.get("p99_short_tpot_ms")
+            off_d = off.pipeline.get("p99_short_tpot_ms")
+            if on_d and off_d:
+                pl["short_tpot_p99_ratio_on_vs_off"] = round(
+                    on_d / off_d, 3)
+            # token_lists proved identity; bulky in the artifact
+            for arm in ("pipeline_on", "pipeline_off", "chaos"):
+                pl[arm].get("pipeline", {}).pop("token_lists", None)
+            results["serve_load"]["pipeline"] = pl
 
     click.echo(json.dumps(results, indent=2))
 

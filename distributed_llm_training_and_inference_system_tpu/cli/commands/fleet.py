@@ -168,6 +168,25 @@ def status(url, as_json):
             f"{pf.get('fetches', 0)} fetches, "
             f"{pf.get('misses', 0)} misses, "
             f"{pf.get('aborts', 0)} aborts)")
+    pl = snap.get("pipeline")
+    if pl and (pl.get("pipelines") or pl.get("collapses")):
+        overlap = pl.get("overlap_ratio")
+        console.print(
+            f"pipelined prefill: {pl.get('completed', 0)}/"
+            f"{pl.get('pipelines', 0)} pipelines completed "
+            f"({pl.get('stages', 0)} stages, "
+            f"{pl.get('collapses', 0)} collapses to single-replica, "
+            f"{pl.get('in_flight', 0)} in flight), "
+            f"{pl.get('preshipped_pages', 0)} pages pre-shipped "
+            f"({pl.get('preship_hidden_ms', 0)}/"
+            f"{pl.get('preship_ms', 0)} ms hidden behind compute"
+            + (f", {overlap:.0%} overlap" if overlap is not None
+               else "") + ")")
+    if rt.get("store_hint_remote_skips"):
+        console.print(
+            f"store hints: {rt['store_hint_remote_skips']} skipped for "
+            f"remote destinations (store tier unreachable from "
+            f"workers)")
     ks = snap.get("kv_store")
     if ks and (ks.get("demotions") or ks.get("hits") or ks.get("misses")):
         console.print(

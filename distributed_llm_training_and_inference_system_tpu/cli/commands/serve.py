@@ -292,6 +292,25 @@ def app(ctx):
               type=float,
               help="Expire store entries nobody fetched for this long "
                    "(0 = keep until capacity pressure evicts).")
+@click.option("--fleet-pipeline-min-tokens", default=0, show_default=True,
+              type=int,
+              help="Pipelined multi-replica prefill: needs-prefill "
+                   "prompts at least this long are split page-aligned "
+                   "across the prefill pool as a chunk pipeline, each "
+                   "stage's KV pages pre-shipped to the next replica "
+                   "while it computes (0 disables; requires "
+                   "--fleet-prefix-fetch).")
+@click.option("--fleet-pipeline-max-stages", default=4, show_default=True,
+              type=int,
+              help="Most prefill stages one pipelined prompt is split "
+                   "across (also bounded by accepting prefill-capable "
+                   "in-process replicas).")
+@click.option("--fleet-pipeline-stage-timeout-ms", default=30_000.0,
+              show_default=True, type=float,
+              help="A pipeline stage that neither finishes nor reports "
+                   "chunk progress within this window collapses the "
+                   "pipeline to single-replica prefill (counted, never "
+                   "wrong tokens).")
 @click.option("--fleet-inventory-ttl-ms", default=0.0, show_default=True,
               type=float,
               help="Cache the per-replica prefix-page inventory map this "
@@ -364,6 +383,8 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
           fleet_prefix_fetch_min_pages, fleet_kv_store,
           fleet_kv_store_dram_mb, fleet_kv_store_dir,
           fleet_kv_store_disk_mb, fleet_kv_store_ttl_ms,
+          fleet_pipeline_min_tokens, fleet_pipeline_max_stages,
+          fleet_pipeline_stage_timeout_ms,
           fleet_inventory_ttl_ms,
           fleet_stream_ttl_ms, fleet_stream_max_buffered,
           fleet_fronts, fleet_state_store, fleet_state_store_dir,
@@ -436,6 +457,10 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
             kv_store_dir=fleet_kv_store_dir,
             kv_store_disk_mb=fleet_kv_store_disk_mb,
             kv_store_ttl_ms=fleet_kv_store_ttl_ms,
+            pipeline_prefill_min_tokens=fleet_pipeline_min_tokens,
+            pipeline_prefill_max_stages=fleet_pipeline_max_stages,
+            pipeline_prefill_stage_timeout_ms=(
+                fleet_pipeline_stage_timeout_ms),
             prefix_inventory_ttl_ms=fleet_inventory_ttl_ms,
             stream_log_ttl_ms=fleet_stream_ttl_ms,
             stream_max_buffered_batches=fleet_stream_max_buffered,

@@ -959,6 +959,24 @@ class FleetConfig:
     # within-TTL stale entry only costs a counted fetch miss. 0 = read
     # fresh every placement (exact hints; fine at small fleets).
     prefix_inventory_ttl_ms: float = 0.0
+    # -- pipelined multi-replica prefill (serve/fleet/pipeline.py) -----------
+    # needs-prefill prompts at least this many tokens long are split
+    # into page-aligned chunks and streamed through the prefill pool as
+    # a chunk pipeline (Mooncake-style chunked pipeline parallelism):
+    # stage k computes chunk k against the shipped-in KV of chunks < k
+    # while its finished pages pre-ship to stage k+1 over the courier —
+    # transfer hides behind compute. Token-identical to single-replica
+    # prefill (greedy and seeded); any stage failure collapses to a
+    # counted single-replica prefill. 0 disables pipelining. Requires
+    # prefix_fetch (stages import shipped chunks through the fetch
+    # plane).
+    pipeline_prefill_min_tokens: int = 0
+    # most stages one prompt is split across (also bounded by the number
+    # of accepting prefill-capable in-process replicas)
+    pipeline_prefill_max_stages: int = 4
+    # a stage that neither finishes nor reports chunk progress within
+    # this window collapses the pipeline to single-replica prefill
+    pipeline_prefill_stage_timeout_ms: float = 30_000.0
     # -- tiered fleet KV store (serve/fleet/kv_store.py) ---------------------
     # host-tier page cache behind the prefix inventory (Mooncake's
     # cluster-cache claim): replicas DEMOTE evicted/retired prefix pages
@@ -1116,6 +1134,21 @@ class FleetConfig:
             raise ConfigError("prefix_fetch_min_pages must be >= 1")
         if self.prefix_fetch_timeout_s <= 0:
             raise ConfigError("prefix_fetch_timeout_s must be > 0")
+        if self.pipeline_prefill_min_tokens < 0:
+            raise ConfigError(
+                "pipeline_prefill_min_tokens must be >= 0 (0 disables "
+                "pipelined prefill)")
+        if self.pipeline_prefill_min_tokens > 0 and not self.prefix_fetch:
+            raise ConfigError(
+                "pipeline_prefill_min_tokens requires prefix_fetch "
+                "(pipeline stages import shipped chunks through the "
+                "prefix-fetch plane)")
+        if self.pipeline_prefill_max_stages < 2:
+            raise ConfigError("pipeline_prefill_max_stages must be >= 2 "
+                              "(one stage is just a plain prefill)")
+        if self.pipeline_prefill_stage_timeout_ms <= 0:
+            raise ConfigError(
+                "pipeline_prefill_stage_timeout_ms must be > 0")
         if self.prefix_inventory_max < 0:
             raise ConfigError(
                 "prefix_inventory_max must be >= 0 (0 disables the "

@@ -182,6 +182,30 @@ METRICS: dict[str, MetricSpec] = {
     "llmctl_fleet_kvstore_bytes": MetricSpec(
         COUNTER, "Compressed wire bytes replayed out of the store on "
                  "fetch hits"),
+    # -- pipelined multi-replica prefill -----------------------------------
+    "llmctl_fleet_pipeline_prefills": MetricSpec(
+        COUNTER, "Long prompts split across the prefill pool as a "
+                 "chunk pipeline (Mooncake-style chunked pipeline "
+                 "parallelism)"),
+    "llmctl_fleet_pipeline_stages": MetricSpec(
+        COUNTER, "Prefill stages planned across all pipelined prompts "
+                 "(stages / prefills = mean pipeline depth)"),
+    "llmctl_fleet_pipeline_collapses": MetricSpec(
+        COUNTER, "Pipelines degraded to single-replica prefill (stage "
+                 "crash, courier chaos, pool-full, timeout) — counted, "
+                 "never wrong tokens"),
+    "llmctl_fleet_pipeline_preshipped_pages": MetricSpec(
+        COUNTER, "KV pages shipped to the next stage's replica ahead "
+                 "of its prefill (transfer hidden behind compute)"),
+    "llmctl_fleet_pipeline_stage_ms": MetricSpec(
+        HISTOGRAM, "Wall time per completed pipeline stage (submit -> "
+                   "pages published, ms)",
+        buckets=_XFER_BUCKETS),
+    "llmctl_fleet_store_hint_remote_skips": MetricSpec(
+        COUNTER, "Placements where the KV store tier covered the "
+                 "prompt best but the destination was a remote worker "
+                 "that cannot reach it — the hint fell back to a live "
+                 "owner (ROADMAP item-2 gap, now measurable)"),
     # -- fleet SSE streaming plane ----------------------------------------
     "llmctl_fleet_stream_active": MetricSpec(
         GAUGE, "Live SSE streams fleet-wide"),
@@ -280,6 +304,7 @@ COUNTER_SNAPSHOT_FN = {
     "FleetStreamHub": ("serve/fleet/streams.py", "stats"),
     "FleetFrontTier": ("serve/fleet/front.py", "snapshot"),
     "FleetKVStore": ("serve/fleet/kv_store.py", "snapshot"),
+    "PipelineCoordinator": ("serve/fleet/pipeline.py", "snapshot"),
 }
 
 COUNTER_FLOW: tuple[CounterFlow, ...] = (
@@ -364,6 +389,24 @@ COUNTER_FLOW: tuple[CounterFlow, ...] = (
                 "llmctl_fleet_kvstore_bytes"),
     CounterFlow("FleetKVStore", "total_bytes_stored", "bytes_stored",
                 None),
+    # pipelined-prefill counters -> PipelineCoordinator.snapshot() keys
+    # (the supervisor snapshot embeds the section wholesale; the
+    # Prometheus pump deltas the mapped ones)
+    CounterFlow("PipelineCoordinator", "total_pipelines", "pipelines",
+                "llmctl_fleet_pipeline_prefills"),
+    CounterFlow("PipelineCoordinator", "total_pipelines_completed",
+                "completed", None),
+    CounterFlow("PipelineCoordinator", "total_pipeline_collapses",
+                "collapses", "llmctl_fleet_pipeline_collapses"),
+    CounterFlow("PipelineCoordinator", "total_pipeline_stages", "stages",
+                "llmctl_fleet_pipeline_stages"),
+    CounterFlow("PipelineCoordinator", "total_preshipped_pages",
+                "preshipped_pages",
+                "llmctl_fleet_pipeline_preshipped_pages"),
+    CounterFlow("PipelineCoordinator", "total_preship_ms", "preship_ms",
+                None),
+    CounterFlow("PipelineCoordinator", "total_preship_hidden_ms",
+                "preship_hidden_ms", None),
     # front-tier counters -> FleetFrontTier.snapshot() keys
     CounterFlow("FleetFrontTier", "total_front_failovers", "failovers",
                 "llmctl_fleet_front_failovers"),

@@ -255,6 +255,17 @@ class PrometheusExporter:
         self.fleet_kvstore_evictions = mk(
             "llmctl_fleet_kvstore_evictions")
         self.fleet_kvstore_bytes = mk("llmctl_fleet_kvstore_bytes")
+        # pipelined multi-replica prefill (serve/fleet/pipeline.py)
+        self.fleet_pipeline_prefills = mk(
+            "llmctl_fleet_pipeline_prefills")
+        self.fleet_pipeline_stages = mk("llmctl_fleet_pipeline_stages")
+        self.fleet_pipeline_collapses = mk(
+            "llmctl_fleet_pipeline_collapses")
+        self.fleet_pipeline_preshipped_pages = mk(
+            "llmctl_fleet_pipeline_preshipped_pages")
+        self.fleet_pipeline_stage = mk("llmctl_fleet_pipeline_stage_ms")
+        self.fleet_store_hint_remote_skips = mk(
+            "llmctl_fleet_store_hint_remote_skips")
         # fleet SSE streaming (serve/fleet/streams.py): the exactly-once
         # delivery ledger
         self.fleet_stream_active = mk("llmctl_fleet_stream_active")
@@ -366,7 +377,9 @@ class PrometheusExporter:
                 ("rejected", self.fleet_rejected),
                 ("inventory_cache_hits", self.fleet_inventory_cache_hits),
                 ("inventory_cache_misses",
-                 self.fleet_inventory_cache_misses)):
+                 self.fleet_inventory_cache_misses),
+                ("store_hint_remote_skips",
+                 self.fleet_store_hint_remote_skips)):
             total = router.get(key, 0)
             delta = total - self._last_totals.get(f"fleet_{key}", 0)
             if delta > 0:
@@ -468,6 +481,29 @@ class PrometheusExporter:
             if delta > 0:
                 counter.inc(delta)
             self._last_totals[f"fleet_ks_{key}"] = total
+        # pipelined multi-replica prefill: counters on running totals,
+        # the stage-latency histogram on the bounded recent window gated
+        # by the cumulative stage count (same contract as courier
+        # transfers above)
+        pl = snap.get("pipeline", {})
+        for key, counter in (
+                ("pipelines", self.fleet_pipeline_prefills),
+                ("stages", self.fleet_pipeline_stages),
+                ("collapses", self.fleet_pipeline_collapses),
+                ("preshipped_pages",
+                 self.fleet_pipeline_preshipped_pages)):
+            total = pl.get(key, 0)
+            delta = total - self._last_totals.get(f"fleet_pl_{key}", 0)
+            if delta > 0:
+                counter.inc(delta)
+            self._last_totals[f"fleet_pl_{key}"] = total
+        count = pl.get("stage_count", 0)
+        new = int(count - self._last_totals.get("fleet_pl_stages_obs", 0))
+        window = pl.get("stage_ms", [])
+        if new > 0:
+            for t in window[-min(new, len(window)):]:
+                self.fleet_pipeline_stage.observe(t)
+        self._last_totals["fleet_pl_stages_obs"] = count
         # speculative-decode plane: per-replica counters arrive fleet-
         # aggregated as running totals (supervisor snapshot "spec"
         # section); the pump deltas them like every other fleet counter

@@ -209,6 +209,16 @@ class InferenceEngine:
         # from the owning replica, or None (miss/abort — plain prefill).
         # None (the default) disables fetching entirely.
         self.prefix_fetch_hook: Optional[Callable] = None
+        # pipelined multi-replica prefill (serve/fleet/pipeline.py):
+        # called on the ENGINE thread with (request, done_tokens,
+        # finished) after each chunk of a STAGE request (one carrying
+        # req.pipeline_stage) — by then the chunk's full pages are
+        # registered in the prefix cache, so the coordinator can ship
+        # them to the next stage while the remaining chunks compute.
+        # Fired with no locks held. None disables the notifications
+        # (stage requests still complete; the coordinator just falls
+        # back to its stage timeout).
+        self.pipeline_chunk_hook: Optional[Callable] = None
         # context tokens covered by pages FETCHED from another replica's
         # prefix cache instead of being re-prefilled here
         self.total_prefix_fetched_tokens = 0
@@ -863,7 +873,12 @@ class InferenceEngine:
                 continue
             ctx = st["ctx"]
             n, done = len(ctx), st["done"]
-            this = min(n - done, C)
+            stage = req.pipeline_stage
+            # stage requests reach here even with chunking disabled
+            # (C == 0): fall back to the prefill bucketing granularity
+            # so the per-chunk page-publish cadence still exists
+            this = min(n - done,
+                       C if C > 0 else max(self.serve_cfg.prefill_chunk, 1))
             # charge what the program actually computes — the padded
             # suffix bucket — not the raw token count (a 33-token final
             # chunk dispatches a 64-row program) and not the constant C
@@ -881,10 +896,22 @@ class InferenceEngine:
                       jnp.asarray([this], jnp.int32),
                       self.kv.k_pages, self.kv.v_pages,
                       jnp.asarray(st["table_row"][None]))
-            if done + this < n:
+            if done + this < n or stage is not None:
+                # intermediate chunk — and EVERY chunk of a pipeline
+                # stage request, whose product is pages, not logits:
+                # even its final chunk runs the sampling-free program
                 self.kv.k_pages, self.kv.v_pages = \
                     self._extend_chunk_fn(bucket)(*common)
                 st["done"] = done + this
+                if stage is not None:
+                    self._publish_stage_pages(st)
+                    if done + this >= n:
+                        # stage complete: pages published, slot freed
+                        # without arming decode (the registered pages
+                        # outlive the slot, evictable until pinned)
+                        with self.lock:
+                            self.scheduler.finish_prefill_only(rid)
+                        del self._partial_prefills[rid]
             else:
                 s = req.sampling
                 first_key = jax.random.fold_in(st["slot_key"], n)
@@ -902,7 +929,31 @@ class InferenceEngine:
                 completed.append((req, token))
                 del self._partial_prefills[rid]
             self.total_prefill_tokens += this
+            if stage is not None and self.pipeline_chunk_hook is not None:
+                # no locks held: the coordinator side only enqueues
+                self.pipeline_chunk_hook(req, st["done"], st["done"] >= n)
         return completed
+
+    @engine_thread_only
+    def _publish_stage_pages(self, st: dict) -> None:
+        """Register a pipeline stage request's freshly-completed FULL
+        pages in the prefix cache as soon as they exist — not at prefill
+        end like ordinary requests: the pipeline coordinator ships
+        published pages to the next stage while the remaining chunks
+        compute, which is the transfer-hides-behind-compute half of the
+        pipelined prefill (serve/fleet/pipeline.py)."""
+        req: Request = st["req"]
+        if not self.serve_cfg.prefix_caching or not req.prefix_hashes:
+            return
+        full = min(st["done"] // self.kv.page_size, len(req.prefix_hashes))
+        pub = st.setdefault("published", st["pins"])
+        if full <= pub:
+            return
+        with self.lock:
+            table = self.kv.block_tables[req.slot]
+            self.kv.register_pages([(req.prefix_hashes[i], int(table[i]))
+                                    for i in range(pub, full)])
+        st["published"] = full
 
     @engine_thread_only
     def _prefill(self, req: Request):
@@ -1663,8 +1714,13 @@ class InferenceEngine:
             # threshold even when the original prompt didn't — and the
             # high-KV-pressure regime that preempts is exactly where a
             # dense multi-thousand-token dispatch would stall residents
-            if C > 0 and len(req.context_tokens) > C \
-                    and req.swapped_kv is None:
+            # pipeline STAGE requests always take the chunked path: their
+            # value is the per-chunk page-publish cadence the forward
+            # shipper overlaps transfers against, chunk threshold or not
+            if (C > 0 and len(req.context_tokens) > C
+                    and req.swapped_kv is None) \
+                    or (req.pipeline_stage is not None
+                        and req.swapped_kv is None):
                 self._start_chunked_prefill(req)
             else:
                 pending.append(self._prefill(req))

@@ -37,6 +37,7 @@ from ..scheduler import Request, SamplingParams
 from .faults import (DestUnreachable, FaultInjector, FaultPlan,
                      InjectedCrash, ProbeTimeout, RpcBlackhole)
 from .migration import MigrationTicket
+from .pipeline import PipelineCoordinator, plan_stages
 from .remote import RemoteReplica, RemoteUnavailable
 from .replica import (ROLE_DECODE, ROLE_MIXED, ROLE_PREFILL, EngineReplica,
                       reset_for_requeue)
@@ -71,6 +72,7 @@ __all__ = [
     "InjectedCrash",
     "KVCourier",
     "MigrationTicket",
+    "PipelineCoordinator",
     "ProbeTimeout",
     "RemoteReplica",
     "RemoteUnavailable",
@@ -87,6 +89,7 @@ __all__ = [
     "build_state_store",
     "build_transport",
     "is_ticket_stub",
+    "plan_stages",
     "prefix_digest",
     "reset_for_requeue",
     "ticket_stub",
@@ -197,6 +200,12 @@ class ServeFleet:
         # HA front tier: a terminal record folded from a sibling front
         # completes the local Request object (waiters, SSE finish)
         self.router.on_store_pop = self._complete_from_store
+        # pipelined multi-replica prefill: the coordinator exists even
+        # when gated off (min_tokens=0) so the snapshot/metrics surface
+        # is stable; the router delegates qualifying long prompts to it
+        self.pipeline = PipelineCoordinator(self.fleet_cfg, page_size)
+        self.pipeline.bind(self.router, self.replicas, self.courier)
+        self.router.pipeline = self.pipeline
         for r in self.replicas:
             if getattr(r, "remote", False):
                 # multi-front: finished entries for requests ANOTHER
@@ -225,6 +234,9 @@ class ServeFleet:
             self.courier.prefix_providers[r.replica_id] = \
                 r.request_prefix_extract
             r.prefix_fetcher = self.courier.fetch_prefix
+            # pipelined prefill: stage chunk progress feeds the
+            # coordinator's event pump (enqueue-only on its side)
+            r.on_pipeline_chunk = self.pipeline.on_stage_chunk
             # tiered KV store: evicted/retired prefix pages demote down
             # a tier instead of being destroyed
             if self.kv_store is not None:
@@ -233,7 +245,7 @@ class ServeFleet:
             self.replicas, self.router, self.fleet_cfg,
             injector=self.injector, params=params, observer=observer,
             streams=self.streams, store=self.store,
-            kv_store=self.kv_store)
+            kv_store=self.kv_store, pipeline=self.pipeline)
         self._supervise = supervise
 
     def _on_request_exit(self, replica_id: int, req: Request) -> None:

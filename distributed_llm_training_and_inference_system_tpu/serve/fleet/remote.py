@@ -101,6 +101,12 @@ def request_to_wire(req: Request) -> dict:
         # courier-aware speculation: the sequence's SpecState dict (tiny,
         # plain scalars) so a remote worker arms the tuned window
         "spec_state": getattr(req, "spec_state", None),
+        # pipelined multi-replica prefill: the stage manifest travels so
+        # a worker-hosted engine bounds the chunked prefill and releases
+        # page-only stage requests the same way an in-proc one does
+        # (stage DUTY still needs the in-proc import seam — see
+        # serve/fleet/pipeline.py stage_candidates)
+        "pipeline_stage": getattr(req, "pipeline_stage", None),
     }
 
 
@@ -122,6 +128,9 @@ def request_from_wire(d: dict, receiver=None) -> Request:
     spec = d.get("spec_state")
     if isinstance(spec, dict):
         req.spec_state = spec
+    stage = d.get("pipeline_stage")
+    if isinstance(stage, dict):
+        req.pipeline_stage = stage
     ticket = d.get("ticket")
     if ticket and receiver is not None:
         payload = receiver.take_payload(ticket)

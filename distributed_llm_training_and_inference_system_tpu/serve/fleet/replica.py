@@ -137,8 +137,13 @@ class EngineReplica:
         self.prefix_fetch_ms: deque = deque(maxlen=64)
         # owner half: extract jobs other replicas queued for our prefix
         # pages; serviced ON the engine thread between steps (the donated
-        # page buffers are only safe to read at a loop boundary)
+        # page buffers are only safe to read at a loop boundary). Import
+        # jobs (pipelined-prefill pre-ship deliveries) share the queue.
         self._prefix_jobs: list[dict] = []
+        # pipelined prefill (serve/fleet/pipeline.py): the coordinator's
+        # chunk-progress sink, fired from the engine thread after every
+        # chunk of a stage request (enqueue-only on the far side)
+        self.on_pipeline_chunk: Optional[Callable] = None
         # single-request migrations (rebalance / operator): ticket state
         # advances ONLY on the engine thread at step boundaries; the dict
         # itself is shared with the supervisor thread (_state_lock)
@@ -216,6 +221,7 @@ class EngineReplica:
         self.engine.expect_pure_decode = (self.role == ROLE_DECODE)
         self.engine.prefix_fetch_hook = (self._fetch_prefix
                                          if self._prefix_fetch else None)
+        self.engine.pipeline_chunk_hook = self._pipeline_chunk
         kv = getattr(self.engine, "kv", None)
         if kv is not None:
             kv.demote_hook = (self._demote_pages
@@ -231,6 +237,21 @@ class EngineReplica:
         if kv is not None:
             kv.demote_hook = (self._demote_pages
                               if store is not None else None)
+
+    @engine_thread_only
+    def _pipeline_chunk(self, req: Request, done: int,
+                        finished: bool) -> None:
+        """Engine pipeline_chunk_hook: a pipelined-prefill stage request
+        advanced one chunk (its full pages are registered). Forward to
+        the coordinator with our id; the far side only enqueues."""
+        cb = self.on_pipeline_chunk
+        if cb is not None and getattr(req, "pipeline_stage", None):
+            try:
+                cb(self.replica_id, req, done, finished)
+            except Exception:
+                logger.exception(
+                    "replica %d pipeline chunk callback failed",
+                    self.replica_id)
 
     @engine_thread_only
     def _demote_pages(self, hashes: list, content: dict) -> None:
@@ -977,22 +998,76 @@ class EngineReplica:
             return None
         return job["result"]
 
+    @thread_seam
+    def request_prefix_import(self, hashes: list, pages: dict,
+                              timeout_s: Optional[float] = None
+                              ) -> Optional[int]:
+        """Receiver half of the pipelined-prefill pre-ship: insert the
+        couriered ``pages`` for ``hashes`` into this replica's prefix
+        cache ahead of the stage that will pin them. Runs ON the engine
+        thread at the next loop boundary (same queue as extracts — the
+        pool is only safe to mutate between dispatches); this caller
+        waits (bounded). Returns the number of pages claimed or already
+        present, None on failure/timeout — the pre-shipper stops and the
+        stage's own prefix fetch covers the gap."""
+        if not hashes or not pages:
+            return None
+        with self._state_lock:
+            if self.state in (CRASHED, STOPPED):
+                return None
+        if self._thread is None or not self._thread.is_alive():
+            return self._import_prefix_payload(hashes, pages)
+        job = {"hashes": list(hashes), "pages": pages,
+               "event": threading.Event(), "result": None}
+        with self._state_lock:
+            self._prefix_jobs.append(job)
+        self._wake.set()
+        if not job["event"].wait(
+                timeout=timeout_s or self._prefix_fetch_timeout_s):
+            return None
+        return job["result"]
+
     @engine_thread_only
     def _service_prefix_extracts(self) -> None:
-        """Answer queued prefix-extract jobs (engine thread, between
-        steps). Per-job failures — a deleted-buffer race with an
-        in-flight dispatch, a released engine — answer None (the fetcher
-        re-prefills) instead of killing the replica."""
+        """Answer queued prefix-extract (and pipeline pre-ship import)
+        jobs (engine thread, between steps). Per-job failures — a
+        deleted-buffer race with an in-flight dispatch, a released
+        engine — answer None (the fetcher re-prefills / the pre-shipper
+        stops) instead of killing the replica."""
         with self._state_lock:
             jobs, self._prefix_jobs = self._prefix_jobs, []
         for job in jobs:
             try:
-                job["result"] = self._extract_prefix_payload(job["hashes"])
+                if "pages" in job:
+                    job["result"] = self._import_prefix_payload(
+                        job["hashes"], job["pages"])
+                else:
+                    job["result"] = self._extract_prefix_payload(
+                        job["hashes"])
             except Exception:
                 logger.exception(
                     "replica %d prefix extract failed", self.replica_id)
                 job["result"] = None
             job["event"].set()
+
+    @engine_thread_only
+    def _import_prefix_payload(self, hashes: list,
+                               pages: dict) -> Optional[int]:
+        """Insert pre-shipped pages under the engine lock. First-writer-
+        wins and partial import on a dry pool both count as delivery (the
+        content is there either way); an exception is a real failure."""
+        eng = self.engine
+        kv = getattr(eng, "kv", None)
+        if kv is None:
+            return None
+        try:
+            with eng.lock:
+                kv.insert_prefix_pages(hashes, pages)
+            return len(hashes)
+        except Exception as e:
+            logger.warning("replica %d pipeline page import failed (%s)",
+                           self.replica_id, e)
+            return None
 
     @engine_thread_only
     def _extract_prefix_payload(self, hashes: list) -> Optional[dict]:

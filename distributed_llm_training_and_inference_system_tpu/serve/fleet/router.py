@@ -117,6 +117,11 @@ class FleetRouter:
         # can fall back to store-served fetches when no live replica
         # covers the prompt. None = no store tier.
         self.kv_store = kv_store
+        # pipelined multi-replica prefill (serve/fleet/pipeline.py):
+        # bound by ServeFleet post-construction. When set, qualifying
+        # long needs-prefill prompts hand their placement to the
+        # coordinator's stage pipeline instead of the loop below.
+        self.pipeline = None
         try:
             self._endpoints = self.cfg.endpoint_map()
         except Exception:
@@ -133,6 +138,10 @@ class FleetRouter:
         self._inv_cache: Optional[tuple[float, dict]] = None
         self.inventory_cache_hits = 0
         self.inventory_cache_misses = 0
+        # store hints silently skipped because the destination was a
+        # remote worker (it cannot reach this process's store tier) —
+        # the measurable face of the ROADMAP item-2 gap
+        self.total_store_hint_remote_skips = 0
         # _lock guards router bookkeeping ONLY. It is never held across a
         # replica.submit() call: submit takes the engine lock, and the
         # engine thread calls back into on_request_exit under that same
@@ -346,11 +355,18 @@ class FleetRouter:
                                 and rid < best):
                 best, best_cov = rid, c
         # store fall-back: strictly-better coverage only, in-proc dest
-        if KV_STORE_OWNER in invs \
-                and not getattr(self.by_id.get(dest_id), "remote", False):
+        if KV_STORE_OWNER in invs:
             c = coverage(invs[KV_STORE_OWNER])
             if c > best_cov:
-                best, best_cov = KV_STORE_OWNER, c
+                if getattr(self.by_id.get(dest_id), "remote", False):
+                    # the store would have won but a remote worker
+                    # cannot reach this process's store tier — counted
+                    # (ROADMAP item-2 gap), hint falls back to the best
+                    # live owner
+                    with self._lock:
+                        self.total_store_hint_remote_skips += 1
+                else:
+                    best, best_cov = KV_STORE_OWNER, c
         if best is not None:
             req.prefix_owner = best
             req.prefix_owner_endpoint = self._endpoints.get(best)
@@ -508,6 +524,17 @@ class FleetRouter:
             self._rec({"op": "put", "rid": req.request_id,
                        "wire": (self._wire(req)
                                 if self.store.shared else None)})
+        # pipelined multi-replica prefill: a qualifying long prompt
+        # hands its placement to the coordinator — its pipeline thread
+        # either lands the request on the final stage replica or
+        # collapses back through place_pipeline_final/pipeline_abandon.
+        # Counted as submitted HERE (the launch is the admission); the
+        # exit path settles completed/failed as for any request.
+        if self.pipeline is not None and self.pipeline.try_launch(req):
+            with self._lock:
+                self.total_submitted += 1
+                self._rec({"op": "count", "key": "submitted"})
+            return req
         invs = self._inventories() if self._hints_enabled(req) else {}
         for i, r in enumerate(cands):
             if invs:
@@ -544,6 +571,13 @@ class FleetRouter:
         """Per-replica engine ``on_finish`` hook (fires on the engine
         thread, possibly under that engine's lock — must not call back
         into any engine)."""
+        if getattr(req, "pipeline_stage", None) is not None:
+            # pipelined-prefill stage requests live OUTSIDE the ledger
+            # (the original request holds the meta entry); route the
+            # exit to the coordinator's event pump instead
+            if self.pipeline is not None:
+                self.pipeline.stage_exited(replica_id, req)
+            return
         with self._lock:
             meta = self._meta.pop(req.request_id, None)
             waiter = self._waiters.pop(req.request_id, None)
@@ -627,6 +661,14 @@ class FleetRouter:
         were placed immediately."""
         placed = 0
         for req in reqs:
+            if getattr(req, "pipeline_stage", None) is not None:
+                # pipelined-prefill stages are never re-placed: their
+                # product is cache pages on the replica that just died.
+                # Notify the coordinator so the pipeline collapses to a
+                # single-replica prefill instead of waiting to timeout.
+                if self.pipeline is not None:
+                    self.pipeline.stage_orphaned(req)
+                continue
             with self._lock:
                 meta = self._meta.get(req.request_id)
                 if meta is None:      # completed/cancelled concurrently
@@ -770,6 +812,45 @@ class FleetRouter:
         merely un-disaggregated, not wrong."""
         return self.place_migrated(req, from_replica, dest=dest,
                                    kind="handoff")
+
+    # -- pipelined multi-replica prefill -------------------------------------
+
+    def place_pipeline_final(self, req: Request,
+                             dest: Optional[int] = None) -> bool:
+        """Place the ORIGINAL request of a pipelined prefill (called from
+        the coordinator's pipeline thread). With ``dest`` (the planned
+        final stage replica) the submit is direct and PRESERVES the
+        coordinator's prefix hint — the predecessor stage owns the
+        shipped chain, which the destination's inventory may not
+        advertise yet. ``dest=None`` is the collapse path: ordinary
+        candidate order with placement-time hints, which usually
+        recovers whatever chunks completed before the failure."""
+        with self._lock:
+            known = req.request_id in self._meta
+        if not known:            # cancelled/failed concurrently
+            return False
+        if dest is not None:
+            r = self.by_id.get(dest)
+            if r is not None and r.accepting() and r.submit(req):
+                with self._lock:
+                    self.routed_per_replica[dest] = (
+                        self.routed_per_replica.get(dest, 0) + 1)
+                    meta = self._meta.get(req.request_id)
+                    if meta is not None:
+                        meta["replica"] = dest
+                    self._rec({"op": "meta", "rid": req.request_id,
+                               "replica": dest})
+                return True
+            # planned destination refused (drained/full since planning):
+            # fall through to the ordinary path — still correct, the
+            # hint re-attachment finds the pages wherever they are
+        return self._place(req)
+
+    def pipeline_abandon(self, req: Request, error: str) -> None:
+        """Terminal failure for a pipelined request that neither the
+        pipeline nor the collapse placement could land: settles the
+        ledger (submitted=1/failed=1) and fires the waiter."""
+        self._fail(req, error)
 
     def parked_count(self) -> int:
         with self._lock:
@@ -934,6 +1015,8 @@ class FleetRouter:
                 "in_flight": in_flight,
                 "inventory_cache_hits": self.inventory_cache_hits,
                 "inventory_cache_misses": self.inventory_cache_misses,
+                "store_hint_remote_skips":
+                    self.total_store_hint_remote_skips,
                 "completed_per_replica": dict(self.completed_per_replica),
                 "routed_per_replica": dict(self.routed_per_replica),
                 "requeues_per_replica": dict(self.requeues_per_replica),

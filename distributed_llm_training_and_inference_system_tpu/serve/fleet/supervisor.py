@@ -40,7 +40,7 @@ class ReplicaSupervisor:
                  injector: Optional[FaultInjector] = None,
                  params=None,
                  observer: Optional[Callable[[str, dict], None]] = None,
-                 streams=None, store=None, kv_store=None):
+                 streams=None, store=None, kv_store=None, pipeline=None):
         self.cfg = cfg or FleetConfig()
         self.replicas = replicas
         self.router = router
@@ -48,6 +48,10 @@ class ReplicaSupervisor:
         # tiered fleet KV store (serve/fleet/kv_store.py): snapshot
         # section + `fleet status` line. None = no store tier.
         self.kv_store = kv_store
+        # pipelined multi-replica prefill (serve/fleet/pipeline.py):
+        # snapshot section + `fleet status` line. None = bare-router
+        # unit tests.
+        self.pipeline = pipeline
         # fleet stream hub (serve/fleet/streams.py): snapshot columns +
         # replay-window GC ride the supervisor poll. None = no streaming
         # plane (unit tests on bare routers).
@@ -705,4 +709,6 @@ class ReplicaSupervisor:
                 # deltas the mapped ones; feeds llmctl_fleet_kvstore_*)
                 "kv_store": (self.kv_store.snapshot()
                              if self.kv_store is not None else {}),
+                "pipeline": (self.pipeline.snapshot()
+                             if self.pipeline is not None else {}),
                 "courier": courier.snapshot() if courier else {}}

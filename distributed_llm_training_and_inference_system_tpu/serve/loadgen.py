@@ -110,6 +110,14 @@ class LoadResult:
     # store-on/store-off A/B can assert token identity.
     returning: dict = field(default_factory=dict)
     kv_store: dict = field(default_factory=dict)
+    # pipelined multi-replica prefill (long-context scenario,
+    # --serve-long-prompts): stage counts, collapses, the overlap ratio
+    # (pre-ship ms hidden behind stage compute / total pre-ship ms),
+    # the long-prompt TTFT split, and the co-resident SHORT requests'
+    # TPOT percentiles — the interference-protection readout. token_lists
+    # carries every request's output in submission order so a
+    # pipelining-on/off A/B can assert token identity.
+    pipeline: dict = field(default_factory=dict)
 
     def percentile(self, xs, q):
         return float(np.percentile(np.asarray(xs), q)) if xs else float("nan")
@@ -155,6 +163,7 @@ class LoadResult:
             **({"stream": self.stream} if self.stream else {}),
             **({"returning": self.returning} if self.returning else {}),
             **({"kv_store": self.kv_store} if self.kv_store else {}),
+            **({"pipeline": self.pipeline} if self.pipeline else {}),
         }
 
 
@@ -251,7 +260,8 @@ class _StreamClient:
 
 def _finalize_fleet(res: LoadResult, reqs: list, fleet,
                     t0: float,
-                    stream_clients: Optional[dict] = None) -> LoadResult:
+                    stream_clients: Optional[dict] = None,
+                    long_prompt_len: int = 0) -> LoadResult:
     """Fleet-side accounting: aggregate latencies like _finalize, then the
     per-replica breakdown (requests, p50/p99 TTFT, requeues) from each
     request's routing metadata + the router ledger."""
@@ -387,6 +397,51 @@ def _finalize_fleet(res: LoadResult, reqs: list, fleet,
             "corrupt", "bytes_served", "bytes_stored",
             "dram_entries", "disk_entries")}
 
+    # pipelined multi-replica prefill: the coordinator's counters plus
+    # the interference split — long-prompt TTFT (the pipelining payoff)
+    # vs the co-resident SHORT requests' TPOT (the protection readout).
+    # token_lists rides along (submission order) for on/off identity.
+    pl = snap.get("pipeline", {})
+    if pl.get("pipelines", 0) or long_prompt_len > 0:
+        def pct6(xs, q):
+            return round(res.percentile(xs, q), 2) if xs else None
+        long_ttft, short_ttft, short_tpot = [], [], []
+        for r in reqs:
+            if r.state is not RequestState.FINISHED:
+                continue
+            is_long = (long_prompt_len > 0
+                       and len(r.prompt_tokens) >= long_prompt_len)
+            if r.ttft_ms is not None:
+                (long_ttft if is_long else short_ttft).append(r.ttft_ms)
+            if not is_long and len(r.generated_tokens) > 1 \
+                    and r.finish_time is not None \
+                    and r.first_token_time is not None:
+                short_tpot.append(
+                    (r.finish_time - r.first_token_time) * 1000.0
+                    / (len(r.generated_tokens) - 1))
+        pipes = pl.get("pipelines", 0)
+        res.pipeline = {
+            "pipelines": pipes,
+            "completed": pl.get("completed", 0),
+            "stages": pl.get("stages", 0),
+            "mean_stages": (round(pl.get("stages", 0) / pipes, 2)
+                            if pipes else None),
+            "collapses": pl.get("collapses", 0),
+            "preshipped_pages": pl.get("preshipped_pages", 0),
+            "preship_ms": pl.get("preship_ms", 0),
+            "preship_hidden_ms": pl.get("preship_hidden_ms", 0),
+            "overlap_ratio": pl.get("overlap_ratio"),
+            "long_prompts": len(long_ttft),
+            "p50_long_ttft_ms": pct6(long_ttft, 50),
+            "p99_long_ttft_ms": pct6(long_ttft, 99),
+            "p50_short_ttft_ms": pct6(short_ttft, 50),
+            "p99_short_ttft_ms": pct6(short_ttft, 99),
+            "p50_short_tpot_ms": pct6(short_tpot, 50),
+            "p99_short_tpot_ms": pct6(short_tpot, 99),
+            "token_lists": [[int(t) for t in r.generated_tokens]
+                            for r in reqs],
+        }
+
     # streaming client mode: per-token delivery jitter + the
     # exactly-once ledger. ``identity_ok`` is the headline assertion:
     # every request's STREAMED token sequence equals its final
@@ -521,19 +576,35 @@ def _hot_prefix(rng, hi, prompt_len, hot_prefix_len: int) -> list:
 def _run_poisson_fleet(fleet, *, offered_rps, num_requests, prompt_len,
                        max_tokens, seed, vocab_hi, prompt_pool,
                        max_retries=0, hot_prefix_len=0,
-                       stream=False) -> LoadResult:
+                       stream=False, long_prompts=0,
+                       long_prompt_len=0) -> LoadResult:
     """Open-loop arrivals against a fleet router: replica threads do the
     stepping; the generator only submits on schedule and waits. The
     supervisor is polled inline when no background supervisor runs, so
-    injected faults recover deterministically inside the measured window."""
+    injected faults recover deterministically inside the measured window.
+
+    ``long_prompts > 0`` is the long-context scenario: that many
+    ``long_prompt_len``-token summarization prompts join the SAME
+    Poisson arrival stream, evenly interleaved with the short chat
+    traffic. Their prompts are drawn up front from the run seed, so two
+    runs differing only in fleet config (pipelining on vs off) offer a
+    token-identical workload — LoadResult.pipeline carries the A/B."""
     rng = np.random.default_rng(seed)
     hi = vocab_hi or fleet.model_cfg.vocab_size
-    gaps = rng.exponential(1.0 / offered_rps, size=num_requests)
+    total = num_requests + max(long_prompts, 0)
+    gaps = rng.exponential(1.0 / offered_rps, size=total)
     arrivals = np.cumsum(gaps)
     hot = _hot_prefix(rng, hi, prompt_len, hot_prefix_len)
     pool = [hot + rng.integers(1, hi,
                                size=prompt_len - len(hot)).tolist()
             for _ in range(max(prompt_pool, 1))]
+    # long prompts drawn up front (deterministic across fleet-config
+    # A/Bs) and spread evenly through the arrival order
+    long_pool = [rng.integers(1, hi, size=long_prompt_len).tolist()
+                 for _ in range(max(long_prompts, 0))]
+    long_at = {(k * total) // max(long_prompts, 1) + 1: k
+               for k in range(max(long_prompts, 0))} \
+        if long_prompts > 0 else {}
     reqs: list[Request] = []
     events: list = []
     retryq: list = []
@@ -542,13 +613,17 @@ def _run_poisson_fleet(fleet, *, offered_rps, num_requests, prompt_len,
     supervised = fleet.supervisor._thread is not None
     t0 = time.monotonic()
     i = 0
-    while i < num_requests or retryq \
+    while i < total or retryq \
             or not all(e.is_set() for e in events):
         now = time.monotonic() - t0
-        while i < num_requests and arrivals[i] <= now:
-            prompt = (pool[int(rng.integers(len(pool)))] if prompt_pool
-                      else hot + rng.integers(
-                          1, hi, size=prompt_len - len(hot)).tolist())
+        while i < total and arrivals[i] <= now:
+            if i in long_at:
+                prompt = long_pool[long_at[i]]
+            elif prompt_pool:
+                prompt = pool[int(rng.integers(len(pool)))]
+            else:
+                prompt = hot + rng.integers(
+                    1, hi, size=prompt_len - len(hot)).tolist()
             _submit_fleet(fleet, prompt, max_tokens, reqs, events, res,
                           retryq=retryq, max_retries=max_retries,
                           stream_clients=stream_clients)
@@ -560,7 +635,9 @@ def _run_poisson_fleet(fleet, *, offered_rps, num_requests, prompt_len,
             fleet.supervisor.poll_once()
         time.sleep(0.005)
     return _finalize_fleet(res, reqs, fleet, t0,
-                           stream_clients=stream_clients)
+                           stream_clients=stream_clients,
+                           long_prompt_len=long_prompt_len
+                           if long_prompts > 0 else 0)
 
 
 def _run_closed_loop_fleet(fleet, *, concurrency, num_requests, prompt_len,
@@ -904,7 +981,8 @@ def run_poisson(engine: InferenceEngine, *, offered_rps: float,
                 seed: int = 0, vocab_hi: Optional[int] = None,
                 prompt_pool: int = 0, max_retries: int = 0,
                 hot_prefix_len: int = 0, stream: bool = False,
-                device_times: bool = False) -> LoadResult:
+                device_times: bool = False, long_prompts: int = 0,
+                long_prompt_len: int = 0) -> LoadResult:
     """Open-loop run: arrivals follow a seeded Poisson process regardless of
     engine progress; steps until everything admitted drains.
 
@@ -928,14 +1006,20 @@ def run_poisson(engine: InferenceEngine, *, offered_rps: float,
     streamed-token identity vs the final completion, client-observed
     gaps/duplicates (must be 0), and per-token delivery-gap percentiles
     — the client-side half of the migration-transparent streaming
-    contract. Ignored for plain engines."""
+    contract. Ignored for plain engines.
+
+    ``long_prompts``/``long_prompt_len`` (fleet only) mix that many
+    long-context prompts into the short traffic — the pipelined-prefill
+    scenario; LoadResult.pipeline carries its stage/overlap/TPOT-
+    protection readout."""
     if _is_fleet(engine):
         return _run_poisson_fleet(
             engine, offered_rps=offered_rps, num_requests=num_requests,
             prompt_len=prompt_len, max_tokens=max_tokens, seed=seed,
             vocab_hi=vocab_hi, prompt_pool=prompt_pool,
             max_retries=max_retries, hot_prefix_len=hot_prefix_len,
-            stream=stream)
+            stream=stream, long_prompts=long_prompts,
+            long_prompt_len=long_prompt_len)
     rng = np.random.default_rng(seed)
     hi = vocab_hi or engine.cfg.vocab_size
     gaps = rng.exponential(1.0 / offered_rps, size=num_requests)

@@ -102,6 +102,17 @@ class Request:
     # tuned window. NOT replica-local (it digests sequence content), so
     # requeue paths preserve it.
     spec_state: Optional[dict] = field(default=None, repr=False)
+    # pipelined multi-replica prefill (serve/fleet/pipeline.py): set on
+    # the synthetic stage-k request of a split long prompt —
+    # {"origin": <original request_id>, "stage": k, "stages": S,
+    # "bound": <cumulative token boundary>}. A stage request produces
+    # prefix-cache pages, never tokens: the engine runs its chunks
+    # through the sampling-free extend program, publishes each finished
+    # full page, and releases the slot without arming decode. Carried on
+    # the worker submit wire so a remotely-placed stage keeps its
+    # manifest. None on every ordinary request (including the pipeline's
+    # own final stage, which is the original request itself).
+    pipeline_stage: Optional[dict] = field(default=None, repr=False)
     arrival_time: float = field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None   # for TTFT
     # when the engine dispatched this request's prefill (host clock, no
@@ -251,6 +262,19 @@ class ContinuousBatchingScheduler:
             if (r is not None and r.request_id == request_id
                     and r.state == RequestState.PREFILLING):
                 self._release_slot(i, "cancelled")
+                return True
+        return False
+
+    def finish_prefill_only(self, request_id: str) -> bool:
+        """Release a PREFILLING slot whose request wanted pages, not
+        tokens (a pipelined-prefill stage, serve/fleet/pipeline.py): the
+        full pages it registered stay published in the prefix cache
+        (evictable until the next stage pins them); the slot itself
+        frees now instead of arming decode."""
+        for i, r in enumerate(self.slots):
+            if (r is not None and r.request_id == request_id
+                    and r.state == RequestState.PREFILLING):
+                self._release_slot(i, "pipeline_stage")
                 return True
         return False
 

@@ -1,0 +1,286 @@
+"""Pipelined multi-replica prefill: split long-context prompts across
+the prefill pool.
+
+A needs-prefill prompt over ``pipeline_prefill_min_tokens`` is planned
+as an ordered stage list over prefill-capable replicas; stage k runs the
+chunked-prefill engine path over chunk k against the streamed-in KV of
+chunks < k, shipping its finished pages forward over the courier while
+the next chunk computes. These tests hold the feature to its contract:
+
+- ``plan_stages`` gates: below min-tokens, fewer than two candidates,
+  and fewer full pages than stages all decline; bounds are page-aligned
+  with the final bound exactly the prompt length;
+- candidate filtering: decode-role and remote replicas never host a
+  stage; candidates come least-loaded-first;
+- engine-backed 2- and 3-stage runs are token-identical to an
+  undisturbed single engine (greedy, seeded sampling, int8-KV) with
+  exact per-stage prefill-token accounting: stage k computes exactly
+  its chunk, downstream stages see the shipped pages as cached;
+- degrade, never wrong: seeded chunk chaos on the ship path and an
+  injected crash killing a stage mid-pipeline both end in the right
+  tokens — the crash collapses to single-replica prefill, counted,
+  with a balanced router ledger.
+"""
+
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from distributed_llm_training_and_inference_system_tpu.config import (
+    get_model_config)
+from distributed_llm_training_and_inference_system_tpu.config.schema import (
+    FleetConfig, ServeConfig)
+from distributed_llm_training_and_inference_system_tpu.serve import (
+    InferenceEngine, SamplingParams)
+from distributed_llm_training_and_inference_system_tpu.serve.fleet import (
+    FaultPlan, ServeFleet)
+from distributed_llm_training_and_inference_system_tpu.serve.fleet.pipeline import (  # noqa: E501
+    PipelineCoordinator, plan_stages)
+
+PS = 8                                   # page size everywhere below
+LONG = [(i * 7 + 3) % 50 + 1 for i in range(100)]   # 100-token prompt
+SHORT = [5, 9, 2, 4, 8, 1]
+
+
+@pytest.fixture(scope="module")
+def model_cfg():
+    return get_model_config("gpt-test")
+
+
+@pytest.fixture(scope="module")
+def params(model_cfg):
+    import jax
+
+    from distributed_llm_training_and_inference_system_tpu.models import (
+        init as model_init)
+    return model_init(model_cfg, jax.random.PRNGKey(3))
+
+
+def serve_cfg(**overrides) -> ServeConfig:
+    kw = dict(model="gpt-test", max_batch_size=2, max_seq_len=128,
+              prefill_chunk=32, chunked_prefill_tokens=16,
+              kv_block_size=PS, dtype="float32")
+    kw.update(overrides)
+    return ServeConfig(**kw)
+
+
+# -- stage planning -----------------------------------------------------------
+
+
+class TestPlanStages:
+    def test_short_prompt_declines(self):
+        assert plan_stages(30, PS, 3, min_tokens=48, max_stages=4) is None
+
+    def test_single_candidate_declines(self):
+        assert plan_stages(100, PS, 1, min_tokens=48, max_stages=4) is None
+
+    def test_fewer_full_pages_than_stages_declines(self):
+        # 17 tokens -> 2 usable full pages < 4 stages
+        assert plan_stages(17, PS, 4, min_tokens=8, max_stages=4) is None
+
+    def test_bounds_page_aligned_final_is_prompt_len(self):
+        bounds = plan_stages(100, PS, 3, min_tokens=48, max_stages=4)
+        assert bounds == [32, 64, 100]
+        for b in bounds[:-1]:
+            assert b % PS == 0
+        assert bounds[-1] == 100
+
+    def test_max_stages_bounds_the_plan(self):
+        assert plan_stages(100, PS, 8, min_tokens=48, max_stages=2) \
+            == [48, 100]
+
+    def test_bounds_strictly_increase(self):
+        bounds = plan_stages(120, PS, 4, min_tokens=8, max_stages=4)
+        assert bounds is not None and bounds[-1] == 120
+        assert all(a < b for a, b in zip(bounds, bounds[1:]))
+
+
+class TestStageCandidates:
+    @staticmethod
+    def _coord(replicas):
+        cfg = FleetConfig(replicas=max(len(replicas), 1),
+                          pipeline_prefill_min_tokens=48)
+        c = PipelineCoordinator(cfg, PS)
+        c.bind(SimpleNamespace(), replicas, None)
+        return c
+
+    @staticmethod
+    def _rep(rid, role="mixed", load=0, remote=False, accepting=True):
+        return SimpleNamespace(
+            replica_id=rid, role=role, remote=remote,
+            accepting=lambda a=accepting: a,
+            outstanding_tokens=lambda n=load: n)
+
+    def test_decode_role_and_remote_filtered(self):
+        reps = [self._rep(0, role="decode"), self._rep(1),
+                self._rep(2, remote=True), self._rep(3, role="prefill")]
+        got = [r.replica_id for r in self._coord(reps).stage_candidates()]
+        assert got == [1, 3]
+
+    def test_least_loaded_first(self):
+        reps = [self._rep(0, load=300), self._rep(1, load=10),
+                self._rep(2, load=100)]
+        got = [r.replica_id for r in self._coord(reps).stage_candidates()]
+        assert got == [1, 2, 0]
+
+    def test_not_accepting_filtered(self):
+        reps = [self._rep(0, accepting=False), self._rep(1)]
+        got = [r.replica_id for r in self._coord(reps).stage_candidates()]
+        assert got == [1]
+
+
+# -- engine-backed ------------------------------------------------------------
+
+
+def _fleet(model_cfg, params, fault_plan=None, kv_quant="none",
+           **fleet_kw):
+    kw = dict(replicas=2, affinity_prefix_tokens=0,
+              restart_backoff_s=0.05, probe_interval_s=0.05,
+              courier_chunk_bytes=1024, prefix_fetch=True,
+              pipeline_prefill_min_tokens=48,
+              pipeline_prefill_max_stages=2)
+    kw.update(fleet_kw)
+    fleet = ServeFleet(model_cfg, serve_cfg(kv_quantization=kv_quant),
+                       FleetConfig(**kw), params=params,
+                       fault_plan=fault_plan, supervise=False, seed=0)
+    for rep in fleet.replicas:
+        rep.engine.generate([[1, 2, 3]],
+                            SamplingParams(temperature=0.0, max_tokens=4))
+        rep.engine.total_prefill_tokens = 0
+        rep.engine.total_prefix_cached_tokens = 0
+    fleet.start()
+    return fleet
+
+
+def _ref_tokens(model_cfg, params, prompts, sampling):
+    eng = InferenceEngine(model_cfg, serve_cfg(), params=params, seed=0)
+    try:
+        return [r.generated_tokens for r in eng.generate(prompts, sampling)]
+    finally:
+        eng.release()
+
+
+def _ledger_balanced(st):
+    assert st["completed"] + st["failed"] + st["rejected"] \
+        == st["submitted"], st
+
+
+class TestPipelinedPrefill:
+    def test_two_stage_greedy_token_identity_and_accounting(
+            self, model_cfg, params):
+        greedy = SamplingParams(temperature=0.0, max_tokens=12)
+        ref = _ref_tokens(model_cfg, params, [LONG], greedy)
+        fleet = _fleet(model_cfg, params)
+        try:
+            reqs = fleet.generate([LONG], greedy, timeout_s=240)
+            assert [r.generated_tokens for r in reqs] == ref
+            pl = fleet.pipeline.snapshot()
+            assert pl["pipelines"] == 1 and pl["completed"] == 1
+            assert pl["collapses"] == 0
+            assert pl["stages"] == 2
+            assert pl["preshipped_pages"] >= 1
+            # plan over 2 replicas: bounds [48, 100]. Stage 0 computes
+            # its 48 tokens on replica 0; the final leg sees those 48 as
+            # cached pages and computes exactly the remaining 52.
+            spent = sorted(r.engine.total_prefill_tokens
+                           for r in fleet.replicas)
+            assert spent == [48, 52], spent
+            cached = sorted(r.engine.total_prefix_cached_tokens
+                            for r in fleet.replicas)
+            assert cached == [0, 48], cached
+            st = fleet.router.stats()
+            assert st["completed"] == 1 and st["failed"] == 0
+            _ledger_balanced(st)
+        finally:
+            fleet.shutdown()
+
+    def test_three_stage_seeded_token_identity(self, model_cfg, params):
+        seeded = SamplingParams(temperature=0.8, max_tokens=12, seed=123)
+        ref = _ref_tokens(model_cfg, params, [LONG], seeded)
+        fleet = _fleet(model_cfg, params, replicas=3,
+                       pipeline_prefill_max_stages=3)
+        try:
+            reqs = fleet.generate([LONG], seeded, timeout_s=240)
+            assert [r.generated_tokens for r in reqs] == ref
+            pl = fleet.pipeline.snapshot()
+            assert pl["pipelines"] == 1 and pl["completed"] == 1
+            assert pl["stages"] == 3 and pl["collapses"] == 0
+            # bounds [32, 64, 100]: per-stage compute 32 + 32 + 36
+            spent = sorted(r.engine.total_prefill_tokens
+                           for r in fleet.replicas)
+            assert spent == [32, 32, 36], spent
+            _ledger_balanced(fleet.router.stats())
+        finally:
+            fleet.shutdown()
+
+    def test_int8_kv_pages_pipeline_token_identity(self, model_cfg, params):
+        greedy = SamplingParams(temperature=0.0, max_tokens=10)
+        eng = InferenceEngine(model_cfg, serve_cfg(kv_quantization="int8"),
+                              params=params, seed=0)
+        try:
+            ref = [r.generated_tokens
+                   for r in eng.generate([LONG], greedy)]
+        finally:
+            eng.release()
+        fleet = _fleet(model_cfg, params, kv_quant="int8")
+        try:
+            reqs = fleet.generate([LONG], greedy, timeout_s=240)
+            assert [r.generated_tokens for r in reqs] == ref
+            pl = fleet.pipeline.snapshot()
+            assert pl["completed"] == 1 and pl["collapses"] == 0
+            assert pl["preshipped_pages"] >= 1
+        finally:
+            fleet.shutdown()
+
+    def test_short_prompts_never_pipeline(self, model_cfg, params):
+        greedy = SamplingParams(temperature=0.0, max_tokens=8)
+        ref = _ref_tokens(model_cfg, params, [SHORT], greedy)
+        fleet = _fleet(model_cfg, params)
+        try:
+            reqs = fleet.generate([SHORT], greedy, timeout_s=240)
+            assert [r.generated_tokens for r in reqs] == ref
+            assert fleet.pipeline.snapshot()["pipelines"] == 0
+        finally:
+            fleet.shutdown()
+
+    def test_chunk_chaos_on_ship_path_token_identity(
+            self, model_cfg, params):
+        """Seeded chunk faults on the courier: pre-ship attempts may die,
+        stage fetches retry/degrade — tokens never wrong, nothing fails."""
+        greedy = SamplingParams(temperature=0.0, max_tokens=12)
+        ref = _ref_tokens(model_cfg, params, [LONG], greedy)
+        plan = FaultPlan(seed=5, chunk_drop_rate=0.2,
+                         chunk_corrupt_rate=0.15, chunk_duplicate_rate=0.1)
+        fleet = _fleet(model_cfg, params, fault_plan=plan)
+        try:
+            reqs = fleet.generate([LONG], greedy, timeout_s=240)
+            assert [r.generated_tokens for r in reqs] == ref
+            st = fleet.router.stats()
+            assert st["failed"] == 0
+            _ledger_balanced(st)
+        finally:
+            fleet.shutdown()
+
+    def test_stage_kill_mid_pipeline_collapses_counted(
+            self, model_cfg, params):
+        """Crash the replica running stage 0 mid-chunk: the pipeline
+        collapses to single-replica prefill on a survivor — counted,
+        token-identical, balanced ledger."""
+        greedy = SamplingParams(temperature=0.0, max_tokens=12)
+        ref = _ref_tokens(model_cfg, params, [LONG], greedy)
+        plan = FaultPlan(crash_replica=0, crash_after_steps=1)
+        fleet = _fleet(model_cfg, params, replicas=3,
+                       pipeline_prefill_max_stages=3, fault_plan=plan,
+                       pipeline_prefill_stage_timeout_ms=8_000.0)
+        try:
+            reqs = fleet.generate([LONG], greedy, timeout_s=240)
+            assert [r.generated_tokens for r in reqs] == ref
+            pl = fleet.pipeline.snapshot()
+            assert pl["collapses"] == 1, pl
+            assert pl["in_flight"] == 0
+            st = fleet.router.stats()
+            assert st["completed"] == 1 and st["failed"] == 0
+            _ledger_balanced(st)
+        finally:
+            fleet.shutdown()
