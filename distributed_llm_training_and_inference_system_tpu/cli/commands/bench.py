@@ -246,6 +246,26 @@ def kernels(op, seq_len, hidden, heads, batch):
               type=int,
               help="Long-context prompt length in tokens for "
                    "--serve-long-prompts.")
+@click.option("--serve-scenario", default="", show_default=True,
+              help="serve-load fleet: scenario matrix — comma-separated "
+                   "names from {diurnal, flash-crowd, phase-shift, "
+                   "returning-churn, long-context} or 'all'. Each cell "
+                   "runs an autoscale-on/off A/B and reports per-SLO-"
+                   "class TTFT/TPOT attainment, goodput under targets, "
+                   "and the scaling events on the run timeline.")
+@click.option("--serve-scenario-duration", default=10.0,
+              show_default=True, type=float,
+              help="serve-scenario: offered-load window per cell (s).")
+@click.option("--serve-scenario-base-rps", default=3.0,
+              show_default=True, type=float,
+              help="serve-scenario: trough arrival rate.")
+@click.option("--serve-scenario-peak-rps", default=12.0,
+              show_default=True, type=float,
+              help="serve-scenario: burst/peak arrival rate.")
+@click.option("--serve-ttft-target-ms", default=2000.0,
+              show_default=True, type=float,
+              help="serve-scenario: interactive-class TTFT attainment "
+                   "target (standard gets 3x; best-effort none).")
 @click.option("--serve-stream/--no-serve-stream", default=False,
               show_default=True,
               help="serve-load fleet: streaming client mode — every "
@@ -262,7 +282,8 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
         serve_disagg, serve_courier_chaos, serve_courier_codec,
         serve_courier_zlib_level, serve_hot_prefix, serve_returning,
         serve_returning_history, serve_long_prompts, serve_long_prompt_len,
-        serve_stream):
+        serve_scenario, serve_scenario_duration, serve_scenario_base_rps,
+        serve_scenario_peak_rps, serve_ttft_target_ms, serve_stream):
     """End-to-end train step throughput / serve TTFT+throughput
     (parity: reference bench.py:35-49). ``serve-load`` runs open-loop
     (Poisson) and closed-loop sweeps with p50/p99 TTFT, per-token latency,
@@ -690,13 +711,18 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
             on = pipeline_arm(min_on)
             # chaos arm: no warm lap (the injected crash fires exactly
             # once — a warm lap would absorb it; compile noise is fine
-            # here, this arm measures correctness, not latency)
+            # here, this arm measures correctness, not latency). The
+            # crash is keyed on a pipeline STAGE request id (every stage
+            # rid carries "::stage"), so the collapse path fires
+            # deterministically no matter which replica the planner put
+            # stage work on — crash_replica=0 only sometimes hit a
+            # stage host.
             chaos = pipeline_arm(
                 min_on, warm_lap=False,
                 fault_plan=FaultPlan(seed=0, chunk_drop_rate=0.1,
                                      chunk_corrupt_rate=0.1,
-                                     crash_replica=0,
-                                     crash_after_steps=6))
+                                     crash_request_substr="::stage",
+                                     crash_request_after_steps=4))
             ref_tokens = off.pipeline.get("token_lists")
             pl = {
                 "replicas": n_reps,
@@ -726,6 +752,164 @@ def e2e(model_name, mode, steps, batch, seq_len, prompt_len, gen_len,
             for arm in ("pipeline_on", "pipeline_off", "chaos"):
                 pl[arm].get("pipeline", {}).pop("token_lists", None)
             results["serve_load"]["pipeline"] = pl
+
+        if serve_scenario:
+            # scenario matrix (elastic autoscaler + SLO tiers): per
+            # cell, an autoscale-on/off A/B over the SAME seeded
+            # offered plan. The ON arm may grow the fleet toward the
+            # ceiling under pressure and drain-retire back on the fade
+            # (store flush — no re-prefill); the OFF arm holds the
+            # provisioned size. Per-class attainment is the headline;
+            # token identity over commonly-completed requests is the
+            # degrade proof (admission shedding differs by design).
+            import gc
+
+            from ...config.schema import FleetConfig
+            from ...serve.fleet import ServeFleet
+            from ...serve.loadgen import SCENARIOS, run_scenario
+            if last_engine:
+                eng = last_engine.pop()
+                (eng.shutdown if hasattr(eng, "router")
+                 else eng.release)()
+                gc.collect()
+                jax.clear_caches()
+            names = [s.strip() for s in str(serve_scenario).split(",")
+                     if s.strip()]
+            if names == ["all"]:
+                names = list(SCENARIOS)
+            bad = [n for n in names if n not in SCENARIOS]
+            if bad:
+                raise click.UsageError(
+                    f"unknown --serve-scenario {bad}; "
+                    f"choose from {SCENARIOS}")
+            ttft_targets = {"interactive": serve_ttft_target_ms,
+                            "standard": serve_ttft_target_ms * 3}
+
+            def scenario_arm(name, autoscale_on):
+                L = (serve_long_prompt_len if name == "long-context"
+                     else 0)
+                scfg = point_serve_cfg()
+                scfg.max_seq_len = min(
+                    max(prompt_len * 3, L, prompt_len * 5)
+                    + 2 * gen_len + 16, cfg.max_position_embeddings)
+                base = max(serve_replicas, 2)
+                # the A/B toggles the WHOLE new subsystem: the OFF arm
+                # is the pre-elastic fleet (fixed size, class-blind
+                # admission, no TTFT guard); the ON arm adds elastic
+                # scaling AND the SLO tier plane. max_pending is bound
+                # identically in both arms so saturation actually
+                # sheds — the arms differ only in WHO gets shed: the
+                # ON arm reserves nearly the whole queue for
+                # interactive (standard/best-effort take the
+                # Retry-After), which is what holds interactive TTFT
+                # under the burst on a fixed CPU budget.
+                fleet = ServeFleet(
+                    cfg, scfg,
+                    FleetConfig(
+                        replicas=base,
+                        kv_store=True,
+                        max_pending=96,
+                        autoscale=autoscale_on,
+                        # floor at the provisioned size: elasticity is
+                        # proven upward (grow into the burst, retire
+                        # the extra on the fade) — letting the fleet
+                        # dip below base during a lull just re-buys
+                        # the capacity mid-window
+                        autoscale_min_replicas=base,
+                        autoscale_max_replicas=base + 1,
+                        autoscale_up_queue_per_replica=2.0,
+                        autoscale_down_queue_per_replica=0.25,
+                        # at the 0.05s probe these put scale decisions
+                        # on an O(seconds) cadence — pressure must
+                        # hold 0.5s to act, then 2s of quiet before
+                        # the next move. Tighter windows flap: buy a
+                        # replica into a blip, retire one 1s later
+                        autoscale_hysteresis_polls=10,
+                        autoscale_cooldown_polls=40,
+                        priority_headroom_requests=(
+                            80 if autoscale_on else 0),
+                        interactive_ttft_target_ms=(
+                            serve_ttft_target_ms if autoscale_on
+                            else 0.0),
+                        probe_interval_s=0.05,
+                        courier_codec=serve_courier_codec))
+                # supervised (background poll thread), unlike the other
+                # serve-load arms: a scale-up's warm-compile runs on the
+                # supervisor thread, so the open-loop arrival clock and
+                # the replica step threads never stall behind XLA
+                for r in fleet.replicas:
+                    # pow-2 warm lap covers every prompt bucket the
+                    # scenario geometries dispatch (incl. the phase
+                    # shift's 3x prompts and long-context mix)
+                    n = 8
+                    while n <= min(512, scfg.max_seq_len - 4):
+                        r.engine.generate(
+                            [list(range(1, n + 1))],
+                            SamplingParams(temperature=0.0,
+                                           max_tokens=2))
+                        n <<= 1
+                    _reset_counters(r.engine)
+                    with r.engine.lock:
+                        r.engine.kv.flush_prefix_cache()
+                fleet.start()
+                # the standby pool's XLA compiles must not contend
+                # with serving inside the measured window (this host
+                # may be a single core); a production spare pre-warms
+                # before entering rotation for the same reason
+                fleet.wait_warm_spares()
+                try:
+                    return run_scenario(
+                        fleet, scenario=name,
+                        duration_s=serve_scenario_duration,
+                        base_rps=serve_scenario_base_rps,
+                        peak_rps=serve_scenario_peak_rps,
+                        prompt_len=prompt_len, max_tokens=gen_len,
+                        long_prompt_len=serve_long_prompt_len,
+                        seed=0, max_retries=serve_max_retries,
+                        ttft_targets_ms=ttft_targets)
+                finally:
+                    fleet.shutdown()
+                    gc.collect()
+                    jax.clear_caches()
+
+            matrix = {}
+            for name in names:
+                off = scenario_arm(name, False)
+                on = scenario_arm(name, True)
+                tl_on = on.scenario.pop("token_lists", [])
+                tl_off = off.scenario.pop("token_lists", [])
+                both = [i for i in
+                        range(min(len(tl_on), len(tl_off)))
+                        if tl_on[i] is not None
+                        and tl_off[i] is not None]
+                cell = {
+                    "autoscale_on": on.summary(),
+                    "autoscale_off": off.summary(),
+                    "token_identical": all(
+                        tl_on[i] == tl_off[i] for i in both),
+                    "common_completed": len(both),
+                }
+                ia_on = on.scenario.get("classes", {}).get(
+                    "interactive", {})
+                ia_off = off.scenario.get("classes", {}).get(
+                    "interactive", {})
+                if ia_on.get("attainment") is not None \
+                        and ia_off.get("attainment") is not None:
+                    cell["interactive_attainment_on"] = \
+                        ia_on["attainment"]
+                    cell["interactive_attainment_off"] = \
+                        ia_off["attainment"]
+                # scale-down store-flush credit: pages the retiring
+                # replica pushed into the fleet store — the ~0
+                # re-prefill proof for elastic shrink
+                downs = [e for e in on.scenario.get(
+                    "scaling", {}).get("events", [])
+                    if e.get("kind") == "scale_down"]
+                if downs:
+                    cell["scale_down_flushed_pages"] = sum(
+                        e.get("flushed_pages", 0) for e in downs)
+                matrix[name] = cell
+            results["serve_load"]["scenario_matrix"] = matrix
 
     click.echo(json.dumps(results, indent=2))
 

@@ -179,9 +179,30 @@ def status(url, as_json):
             f"{pl.get('in_flight', 0)} in flight), "
             f"{pl.get('preshipped_pages', 0)} pages pre-shipped "
             f"({pl.get('preship_hidden_ms', 0)}/"
-            f"{pl.get('preship_ms', 0)} ms hidden behind compute"
+            f"{pl.get('preship_ms', 0)} ms hidden behind compute, "
+            f"{pl.get('preship_timeouts', 0)} pre-ship timeouts"
             + (f", {overlap:.0%} overlap" if overlap is not None
                else "") + ")")
+    au = snap.get("autoscale")
+    if au and au.get("enabled"):
+        retiring = au.get("retiring")
+        console.print(
+            f"autoscale: {au.get('replicas', 0)} replicas "
+            f"(floor {au.get('floor', 0)}, ceiling {au.get('ceiling', 0)}"
+            + (f", retiring {retiring}" if retiring is not None else "")
+            + f"), {au.get('scale_ups', 0)} scale-ups / "
+            f"{au.get('scale_downs', 0)} scale-downs, "
+            f"{au.get('spawn_failures', 0)} spawn failures, "
+            f"{au.get('retire_rollbacks', 0)} retire rollbacks, "
+            f"{au.get('preemptions', 0)} best-effort preemptions")
+    by_cls = (rt.get("submitted_by_class") or {})
+    rej_cls = (rt.get("rejected_by_class") or {})
+    if any(by_cls.values()) or any(rej_cls.values()):
+        console.print(
+            "priority: " + ", ".join(
+                f"{cls} {by_cls.get(cls, 0)} admitted / "
+                f"{rej_cls.get(cls, 0)} shed"
+                for cls in ("interactive", "standard", "best-effort")))
     if rt.get("store_hint_remote_skips"):
         console.print(
             f"store hints: {rt['store_hint_remote_skips']} skipped for "

@@ -354,6 +354,61 @@ def app(ctx):
                    "many records written; fronts reload from snapshot "
                    "+ tail. 0 disables (the journal then grows "
                    "unboundedly).")
+@click.option("--fleet-autoscale/--fleet-no-autoscale", "fleet_autoscale",
+              default=False, show_default=True,
+              help="Elastic autoscaler: add replicas under sustained "
+                   "queue pressure and retire idle ones through "
+                   "drain-with-migration + KV-store flush (scale-down "
+                   "costs zero re-prefill tokens). Decisions ride the "
+                   "supervisor poll with hysteresis + cooldown.")
+@click.option("--fleet-autoscale-min-replicas", default=1,
+              show_default=True, type=int,
+              help="Scale-down floor: the autoscaler never retires "
+                   "below this many replicas (provisioned role "
+                   "coverage is additionally preserved).")
+@click.option("--fleet-autoscale-max-replicas", default=0,
+              show_default=True, type=int,
+              help="Scale-up ceiling (0 = 2x the provisioned fleet).")
+@click.option("--fleet-autoscale-up-queue-per-replica", default=4.0,
+              show_default=True, type=float,
+              help="Scale UP when admission-queue depth per healthy "
+                   "replica stays above this for the hysteresis "
+                   "window.")
+@click.option("--fleet-autoscale-down-queue-per-replica", default=0.5,
+              show_default=True, type=float,
+              help="Scale DOWN when queue depth per healthy replica "
+                   "stays below this (with an idle replica on hand); "
+                   "must be under the up threshold or the fleet would "
+                   "oscillate.")
+@click.option("--fleet-autoscale-hysteresis-polls", default=2,
+              show_default=True, type=int,
+              help="Consecutive supervisor polls a threshold must hold "
+                   "before the autoscaler acts — one bursty poll must "
+                   "not resize the fleet.")
+@click.option("--fleet-autoscale-cooldown-polls", default=10,
+              show_default=True, type=int,
+              help="Polls to sit out after any scaling action before "
+                   "measuring again (0 = no cooldown).")
+@click.option("--fleet-autoscale-spawn-timeout-s", default=30.0,
+              show_default=True, type=float,
+              help="How long a spawned `llmctl fleet worker` may take "
+                   "to print its LLMCTL_WORKER_READY line (and how "
+                   "long a retirement drain may run) before the "
+                   "action is counted failed and rolled back.")
+@click.option("--fleet-priority-headroom-requests", default=0,
+              show_default=True, type=int,
+              help="SLO priority tiers: queue slots reserved for "
+                   "interactive-class requests — standard admits up "
+                   "to max_pending minus this, best-effort up to half "
+                   "of max_pending; shed classes get a class-scaled "
+                   "Retry-After on the 429.")
+@click.option("--fleet-interactive-ttft-target-ms", default=0.0,
+              show_default=True, type=float,
+              help="TTFT guard: when an interactive request has queued "
+                   "past this many ms on a replica, one resident "
+                   "best-effort sequence there is preempted — "
+                   "migrated with its KV to the least-loaded sibling, "
+                   "never dropped (0 disables).")
 @click.option("--stream-abort-on-disconnect/--no-stream-abort-on-disconnect",  # noqa: E501
               "stream_abort_on_disconnect", default=True,
               show_default=True,
@@ -388,7 +443,15 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
           fleet_inventory_ttl_ms,
           fleet_stream_ttl_ms, fleet_stream_max_buffered,
           fleet_fronts, fleet_state_store, fleet_state_store_dir,
-          fleet_state_compact_every, stream_abort_on_disconnect):
+          fleet_state_compact_every, fleet_autoscale,
+          fleet_autoscale_min_replicas, fleet_autoscale_max_replicas,
+          fleet_autoscale_up_queue_per_replica,
+          fleet_autoscale_down_queue_per_replica,
+          fleet_autoscale_hysteresis_polls,
+          fleet_autoscale_cooldown_polls,
+          fleet_autoscale_spawn_timeout_s,
+          fleet_priority_headroom_requests,
+          fleet_interactive_ttft_target_ms, stream_abort_on_disconnect):
     """Start the OpenAI-compatible inference server."""
     import jax
 
@@ -466,7 +529,19 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
             stream_max_buffered_batches=fleet_stream_max_buffered,
             fronts=fleet_fronts, state_store=fleet_state_store,
             state_store_dir=fleet_state_store_dir,
-            state_compact_every=fleet_state_compact_every)
+            state_compact_every=fleet_state_compact_every,
+            autoscale=fleet_autoscale,
+            autoscale_min_replicas=fleet_autoscale_min_replicas,
+            autoscale_max_replicas=fleet_autoscale_max_replicas,
+            autoscale_up_queue_per_replica=(
+                fleet_autoscale_up_queue_per_replica),
+            autoscale_down_queue_per_replica=(
+                fleet_autoscale_down_queue_per_replica),
+            autoscale_hysteresis_polls=fleet_autoscale_hysteresis_polls,
+            autoscale_cooldown_polls=fleet_autoscale_cooldown_polls,
+            autoscale_spawn_timeout_s=fleet_autoscale_spawn_timeout_s,
+            priority_headroom_requests=fleet_priority_headroom_requests,
+            interactive_ttft_target_ms=fleet_interactive_ttft_target_ms)
         fleet_cfg.validate()
 
     if fleet_cfg is not None and fleet_cfg.fronts > 1:

@@ -1034,6 +1034,41 @@ class FleetConfig:
     # state_store=file and all replicas remote — a front holding
     # in-process engines would not be stateless.
     fronts: int = 1
+    # -- elastic autoscaling (serve/fleet/autoscaler.py) ---------------------
+    # react to load: the supervisor-driven FleetAutoscaler adds replicas
+    # when the fleet queues (spawning `llmctl fleet worker` processes
+    # when a spawner is wired, in-proc engine replicas otherwise) and
+    # retires the least-loaded replica when load fades — through the
+    # lossless drain-with-migration + store-flush path, so scale-down
+    # never destroys cached prefixes or in-flight tokens.
+    autoscale: bool = False
+    # hard floor/ceiling on live replicas (ceiling 0 = 2x provisioned)
+    autoscale_min_replicas: int = 1
+    autoscale_max_replicas: int = 0
+    # scale up when queued-but-not-resident requests per healthy replica
+    # exceed this for `autoscale_hysteresis_polls` consecutive polls
+    autoscale_up_queue_per_replica: float = 4.0
+    # scale down when the per-replica queue falls below this AND at
+    # least one replica is fully idle, held for the same hysteresis
+    autoscale_down_queue_per_replica: float = 0.5
+    # consecutive over/under-threshold polls before a decision fires
+    # (one bursty poll must not thrash the fleet)
+    autoscale_hysteresis_polls: int = 2
+    # polls to sit out after ANY scale action before the next one —
+    # lets spawned replicas warm and drained load settle
+    autoscale_cooldown_polls: int = 10
+    # how long a spawned worker process gets to print its ready line
+    # (LLMCTL_WORKER_READY port=N) before the spawn is rolled back
+    autoscale_spawn_timeout_s: float = 30.0
+    # -- SLO priority classes (router admission + preemption) ----------------
+    # queue slots (out of max_pending) held back from standard and
+    # best-effort admission so interactive requests are still admissible
+    # at saturation; 0 = single-class admission (pre-tier behavior)
+    priority_headroom_requests: int = 0
+    # preempt a best-effort resident (KV migrated, never dropped) when
+    # an interactive request has been queued longer than this TTFT
+    # target; 0 disables preemption
+    interactive_ttft_target_ms: float = 0.0
 
     def role_list(self) -> list[str]:
         """Per-replica role assignment; empty config = all mixed."""
@@ -1225,6 +1260,48 @@ class FleetConfig:
             raise ConfigError("courier retry backoff values must be >= 0")
         if self.courier_chunk_deadline_ms <= 0:
             raise ConfigError("courier_chunk_deadline_ms must be > 0")
+        if self.autoscale_min_replicas < 1:
+            raise ConfigError(
+                "autoscale_min_replicas must be >= 1 — the scale-down "
+                "floor keeps at least one replica serving")
+        if self.autoscale_max_replicas and \
+                self.autoscale_max_replicas < self.autoscale_min_replicas:
+            raise ConfigError(
+                "autoscale_max_replicas must be >= autoscale_min_replicas "
+                "(0 = default ceiling of 2x the provisioned fleet)")
+        if self.autoscale_up_queue_per_replica <= 0 \
+                or self.autoscale_down_queue_per_replica < 0:
+            raise ConfigError(
+                "autoscale_up_queue_per_replica must be > 0 and "
+                "autoscale_down_queue_per_replica >= 0")
+        if self.autoscale_down_queue_per_replica \
+                >= self.autoscale_up_queue_per_replica:
+            raise ConfigError(
+                "autoscale_down_queue_per_replica must be below "
+                "autoscale_up_queue_per_replica — overlapping scale "
+                "thresholds would oscillate the fleet")
+        if self.autoscale_hysteresis_polls < 1:
+            raise ConfigError("autoscale_hysteresis_polls must be >= 1")
+        if self.autoscale_cooldown_polls < 0:
+            raise ConfigError(
+                "autoscale_cooldown_polls must be >= 0 (0 = no cooldown)")
+        if self.autoscale_spawn_timeout_s <= 0:
+            raise ConfigError("autoscale_spawn_timeout_s must be > 0")
+        if self.autoscale and self.fronts > 1:
+            raise ConfigError(
+                "autoscale with fronts > 1 is not supported yet — each "
+                "front would scale the shared worker set independently")
+        if self.priority_headroom_requests < 0:
+            raise ConfigError("priority_headroom_requests must be >= 0")
+        if self.priority_headroom_requests >= self.max_pending:
+            raise ConfigError(
+                "priority_headroom_requests must be below max_pending — "
+                "reserving every queue slot for interactive traffic "
+                "would reject all standard requests")
+        if self.interactive_ttft_target_ms < 0:
+            raise ConfigError(
+                "interactive_ttft_target_ms must be >= 0 (0 disables "
+                "TTFT-driven preemption)")
 
     @classmethod
     def from_dict(cls, d: dict[str, Any] | None) -> "FleetConfig":

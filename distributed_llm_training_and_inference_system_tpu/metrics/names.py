@@ -201,6 +201,11 @@ METRICS: dict[str, MetricSpec] = {
         HISTOGRAM, "Wall time per completed pipeline stage (submit -> "
                    "pages published, ms)",
         buckets=_XFER_BUCKETS),
+    "llmctl_fleet_pipeline_preship_timeouts": MetricSpec(
+        COUNTER, "Pre-ship deliveries the next stage's replica never "
+                 "imported within the extract window (the transfer "
+                 "falls back to the collapse path — counted, never "
+                 "wrong tokens)"),
     "llmctl_fleet_store_hint_remote_skips": MetricSpec(
         COUNTER, "Placements where the KV store tier covered the "
                  "prompt best but the destination was a remote worker "
@@ -263,6 +268,30 @@ METRICS: dict[str, MetricSpec] = {
     "llmctl_fleet_spec_resumes": MetricSpec(
         COUNTER, "Slots armed from a MIGRATED SpecState (tuned window "
                  "kept across migration / prefill->decode handoff)"),
+    # -- elastic autoscaler + SLO priority tiers ---------------------------
+    "llmctl_fleet_autoscale_scale_ups": MetricSpec(
+        COUNTER, "Replicas the autoscaler added (in-proc engine or "
+                 "spawned `llmctl fleet worker` process) under "
+                 "sustained queue pressure"),
+    "llmctl_fleet_autoscale_scale_downs": MetricSpec(
+        COUNTER, "Replicas the autoscaler retired through drain-with-"
+                 "migration + store flush (scale-down costs zero "
+                 "re-prefill tokens)"),
+    "llmctl_fleet_autoscale_spawn_failures": MetricSpec(
+        COUNTER, "Scale-up attempts whose worker never reported ready "
+                 "(or whose adoption failed) — counted and fully "
+                 "rolled back"),
+    "llmctl_fleet_autoscale_retire_rollbacks": MetricSpec(
+        COUNTER, "Retirements abandoned mid-drain (victim crashed or "
+                 "the drain timed out) — the replica returns to "
+                 "rotation or the crash path; no request is lost"),
+    "llmctl_fleet_autoscale_preemptions": MetricSpec(
+        COUNTER, "Best-effort residents migrated off a replica to "
+                 "protect a queued interactive request's TTFT target "
+                 "(KV moves with them — preempted, never dropped)"),
+    "llmctl_fleet_replicas": MetricSpec(
+        GAUGE, "Live fleet size under elastic scaling (provisioned + "
+               "autoscaler-added - retired)"),
 }
 
 
@@ -305,6 +334,7 @@ COUNTER_SNAPSHOT_FN = {
     "FleetFrontTier": ("serve/fleet/front.py", "snapshot"),
     "FleetKVStore": ("serve/fleet/kv_store.py", "snapshot"),
     "PipelineCoordinator": ("serve/fleet/pipeline.py", "snapshot"),
+    "FleetAutoscaler": ("serve/fleet/autoscaler.py", "snapshot"),
 }
 
 COUNTER_FLOW: tuple[CounterFlow, ...] = (
@@ -407,6 +437,22 @@ COUNTER_FLOW: tuple[CounterFlow, ...] = (
                 None),
     CounterFlow("PipelineCoordinator", "total_preship_hidden_ms",
                 "preship_hidden_ms", None),
+    CounterFlow("PipelineCoordinator", "total_pipeline_preship_timeouts",
+                "preship_timeouts",
+                "llmctl_fleet_pipeline_preship_timeouts"),
+    # elastic autoscaler counters -> FleetAutoscaler.snapshot() keys
+    # (the supervisor snapshot embeds the "autoscale" section wholesale)
+    CounterFlow("FleetAutoscaler", "total_scale_ups", "scale_ups",
+                "llmctl_fleet_autoscale_scale_ups"),
+    CounterFlow("FleetAutoscaler", "total_scale_downs", "scale_downs",
+                "llmctl_fleet_autoscale_scale_downs"),
+    CounterFlow("FleetAutoscaler", "total_spawn_failures",
+                "spawn_failures", "llmctl_fleet_autoscale_spawn_failures"),
+    CounterFlow("FleetAutoscaler", "total_retire_rollbacks",
+                "retire_rollbacks",
+                "llmctl_fleet_autoscale_retire_rollbacks"),
+    CounterFlow("FleetAutoscaler", "total_preemptions", "preemptions",
+                "llmctl_fleet_autoscale_preemptions"),
     # front-tier counters -> FleetFrontTier.snapshot() keys
     CounterFlow("FleetFrontTier", "total_front_failovers", "failovers",
                 "llmctl_fleet_front_failovers"),

@@ -264,6 +264,8 @@ class PrometheusExporter:
         self.fleet_pipeline_preshipped_pages = mk(
             "llmctl_fleet_pipeline_preshipped_pages")
         self.fleet_pipeline_stage = mk("llmctl_fleet_pipeline_stage_ms")
+        self.fleet_pipeline_preship_timeouts = mk(
+            "llmctl_fleet_pipeline_preship_timeouts")
         self.fleet_store_hint_remote_skips = mk(
             "llmctl_fleet_store_hint_remote_skips")
         # fleet SSE streaming (serve/fleet/streams.py): the exactly-once
@@ -295,6 +297,18 @@ class PrometheusExporter:
         self.fleet_spec_drafts = mk("llmctl_fleet_spec_drafts")
         self.fleet_spec_accepted = mk("llmctl_fleet_spec_accepted")
         self.fleet_spec_resumes = mk("llmctl_fleet_spec_resumes")
+        # elastic autoscaler + SLO tiers (serve/fleet/autoscaler.py)
+        self.fleet_autoscale_scale_ups = mk(
+            "llmctl_fleet_autoscale_scale_ups")
+        self.fleet_autoscale_scale_downs = mk(
+            "llmctl_fleet_autoscale_scale_downs")
+        self.fleet_autoscale_spawn_failures = mk(
+            "llmctl_fleet_autoscale_spawn_failures")
+        self.fleet_autoscale_retire_rollbacks = mk(
+            "llmctl_fleet_autoscale_retire_rollbacks")
+        self.fleet_autoscale_preemptions = mk(
+            "llmctl_fleet_autoscale_preemptions")
+        self.fleet_replicas = mk("llmctl_fleet_replicas")
         self._last_totals: dict[str, float] = {}
         self._server_started = False
 
@@ -491,7 +505,9 @@ class PrometheusExporter:
                 ("stages", self.fleet_pipeline_stages),
                 ("collapses", self.fleet_pipeline_collapses),
                 ("preshipped_pages",
-                 self.fleet_pipeline_preshipped_pages)):
+                 self.fleet_pipeline_preshipped_pages),
+                ("preship_timeouts",
+                 self.fleet_pipeline_preship_timeouts)):
             total = pl.get(key, 0)
             delta = total - self._last_totals.get(f"fleet_pl_{key}", 0)
             if delta > 0:
@@ -560,6 +576,23 @@ class PrometheusExporter:
         if delta > 0:
             self.fleet_front_failovers.inc(delta)
         self._last_totals["fleet_front_failovers"] = total
+        # elastic autoscaler: scale/preempt counters (running totals,
+        # delta'd) + the live fleet-size gauge
+        au = snap.get("autoscale", {})
+        if au:
+            self.fleet_replicas.set(au.get("replicas", 0))
+        for key, counter in (
+                ("scale_ups", self.fleet_autoscale_scale_ups),
+                ("scale_downs", self.fleet_autoscale_scale_downs),
+                ("spawn_failures", self.fleet_autoscale_spawn_failures),
+                ("retire_rollbacks",
+                 self.fleet_autoscale_retire_rollbacks),
+                ("preemptions", self.fleet_autoscale_preemptions)):
+            total = au.get(key, 0)
+            delta = total - self._last_totals.get(f"fleet_au_{key}", 0)
+            if delta > 0:
+                counter.inc(delta)
+            self._last_totals[f"fleet_au_{key}"] = total
 
 
 class OTLPExporter:

@@ -28,6 +28,7 @@ payloads move over the push-based, destination-terminated courier
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Callable, Optional, Sequence
@@ -43,7 +44,9 @@ from .replica import (ROLE_DECODE, ROLE_MIXED, ROLE_PREFILL, EngineReplica,
                       reset_for_requeue)
 from .front import FleetFrontTier
 from .kv_store import KV_STORE_OWNER, FleetKVStore
-from .router import FleetRouter, FleetSaturated, prefix_digest
+from .autoscaler import (FleetAutoscaler, ProcessWorkerSpawner)
+from .router import (FleetRouter, FleetSaturated, normalize_priority,
+                     prefix_digest)
 from .state import (FleetStateStore, InMemoryStateStore,
                     SharedFileStateStore, StoreFenced, build_state_store)
 from .streams import FleetStreamHub
@@ -59,6 +62,7 @@ __all__ = [
     "EngineReplica",
     "FaultInjector",
     "FaultPlan",
+    "FleetAutoscaler",
     "FleetFrontTier",
     "FleetKVStore",
     "FleetRouter",
@@ -74,6 +78,7 @@ __all__ = [
     "MigrationTicket",
     "PipelineCoordinator",
     "ProbeTimeout",
+    "ProcessWorkerSpawner",
     "RemoteReplica",
     "RemoteUnavailable",
     "RpcBlackhole",
@@ -89,11 +94,14 @@ __all__ = [
     "build_state_store",
     "build_transport",
     "is_ticket_stub",
+    "normalize_priority",
     "plan_stages",
     "prefix_digest",
     "reset_for_requeue",
     "ticket_stub",
 ]
+
+logger = logging.getLogger("llmctl.serve.fleet")
 
 
 class ServeFleet:
@@ -188,6 +196,10 @@ class ServeFleet:
             self.replicas.append(r)
         self.model_cfg = model_cfg
         self._params = params
+        # elastic scaling needs to build replicas AFTER construction:
+        # keep the remaining EngineReplica constructor inputs around
+        self._seed = seed
+        self._eos_token_id = eos_token_id
         # fleet-global prefix cache: hints need the page size the
         # engines actually hash with; 0 disables the whole plane
         page_size = (serve_cfg.kv_block_size
@@ -207,46 +219,217 @@ class ServeFleet:
         self.pipeline.bind(self.router, self.replicas, self.courier)
         self.router.pipeline = self.pipeline
         for r in self.replicas:
-            if getattr(r, "remote", False):
-                # multi-front: finished entries for requests ANOTHER
-                # front submitted still close the shared log + ledger
-                r.on_foreign = self._on_foreign_finished
-                # a remote prefill worker parks its handoffs under a
-                # ticket and publishes them through its outbox; the
-                # supervisor's migrated-collection places them — and it
-                # runs its own prefix fetches (the hint travels on the
-                # submit wire). Its token batches arrive cursor-tagged
-                # through the same outbox poll.
-                r.on_tokens = self._on_remote_stream_tokens
-                continue
-            # in-proc streaming: the engine's on_token feeds the hub
-            # directly, with the request object as the gap authority
-            r.on_token = self._on_stream_tokens
-            # disaggregation wiring: a prefill-role replica asks the
-            # router for a decode destination BEFORE extracting (local-
-            # decode fallback when no pool has room), then places the
-            # handed-off sequence synchronously from its engine thread
-            r.handoff_dest = self.router.handoff_dest
-            r.on_handoff = self._place_handoff
-            # prefix-fetch wiring: this replica both serves its cached
-            # pages to the fleet (provider) and fetches missing ones
-            # through the courier's fetch verb
-            self.courier.prefix_providers[r.replica_id] = \
-                r.request_prefix_extract
-            r.prefix_fetcher = self.courier.fetch_prefix
-            # pipelined prefill: stage chunk progress feeds the
-            # coordinator's event pump (enqueue-only on its side)
-            r.on_pipeline_chunk = self.pipeline.on_stage_chunk
-            # tiered KV store: evicted/retired prefix pages demote down
-            # a tier instead of being destroyed
-            if self.kv_store is not None:
-                r.set_kv_store(self.kv_store)
+            self._wire_replica(r)
+        # elastic autoscaler (serve/fleet/autoscaler.py): scale up/down
+        # from queue pressure + TTFT-guard preemption, driven from the
+        # supervisor poll. None = fixed fleet (today's default).
+        self.autoscaler = (FleetAutoscaler(self, self.fleet_cfg)
+                           if self.fleet_cfg.autoscale else None)
         self.supervisor = ReplicaSupervisor(
             self.replicas, self.router, self.fleet_cfg,
             injector=self.injector, params=params, observer=observer,
             streams=self.streams, store=self.store,
-            kv_store=self.kv_store, pipeline=self.pipeline)
+            kv_store=self.kv_store, pipeline=self.pipeline,
+            autoscaler=self.autoscaler)
         self._supervise = supervise
+        # warm-spare pool: in-proc provisioning time IS XLA compile
+        # time, and paying it on the supervisor thread mid-burst would
+        # land the new replica after the crowd has passed. A background
+        # warmer pre-builds + pre-compiles up to two standby engines
+        # (ids just above the provisioned range); `_scale_up` adopts
+        # one instantly and falls back to a cold build once the pool
+        # is spent.
+        self._spares: list = []
+        self._spares_pending: set = set()
+        self._spares_cv = threading.Condition()
+        self._spares_closed = False
+        if self.autoscaler is not None \
+                and self.autoscaler.spawner is None:
+            n = len(self.replicas)
+            spare_ids = [n + k for k in
+                         range(max(min(self.autoscaler.ceiling(),
+                                       n + 2) - n, 0))]
+            if spare_ids:
+                self._spares_pending.update(spare_ids)
+                threading.Thread(
+                    target=self._warm_spares, args=(spare_ids,),
+                    daemon=True, name="fleet-spare-warmer").start()
+
+    def _wire_replica(self, r) -> None:
+        """Attach one replica's fleet-facing callbacks — factored out of
+        ``__init__`` so elastically-added replicas join with the exact
+        wiring provisioned ones get."""
+        if getattr(r, "remote", False):
+            # multi-front: finished entries for requests ANOTHER
+            # front submitted still close the shared log + ledger
+            r.on_foreign = self._on_foreign_finished
+            # a remote prefill worker parks its handoffs under a
+            # ticket and publishes them through its outbox; the
+            # supervisor's migrated-collection places them — and it
+            # runs its own prefix fetches (the hint travels on the
+            # submit wire). Its token batches arrive cursor-tagged
+            # through the same outbox poll.
+            r.on_tokens = self._on_remote_stream_tokens
+            return
+        r.courier_receiver = self.courier_receiver
+        # in-proc streaming: the engine's on_token feeds the hub
+        # directly, with the request object as the gap authority
+        r.on_token = self._on_stream_tokens
+        # disaggregation wiring: a prefill-role replica asks the
+        # router for a decode destination BEFORE extracting (local-
+        # decode fallback when no pool has room), then places the
+        # handed-off sequence synchronously from its engine thread
+        r.handoff_dest = self.router.handoff_dest
+        r.on_handoff = self._place_handoff
+        # prefix-fetch wiring: this replica both serves its cached
+        # pages to the fleet (provider) and fetches missing ones
+        # through the courier's fetch verb
+        self.courier.prefix_providers[r.replica_id] = \
+            r.request_prefix_extract
+        r.prefix_fetcher = self.courier.fetch_prefix
+        # pipelined prefill: stage chunk progress feeds the
+        # coordinator's event pump (enqueue-only on its side)
+        r.on_pipeline_chunk = self.pipeline.on_stage_chunk
+        # tiered KV store: evicted/retired prefix pages demote down
+        # a tier instead of being destroyed
+        if self.kv_store is not None:
+            r.set_kv_store(self.kv_store)
+
+    # -- elastic membership (autoscaler mechanics) ---------------------------
+
+    def _build_engine_replica(self, replica_id: int) -> EngineReplica:
+        """Construct + warm-compile one in-proc replica sharing the
+        already-loaded weights. Jitted closures are per-engine, so a
+        cold engine would bill its XLA compiles to the first unlucky
+        requests' TTFT — exactly the class a scale-up exists to
+        protect. Pow-2 prompt lengths cover the prefill buckets; decode
+        compiles once; the counter ledger is left clean."""
+        r = EngineReplica(
+            replica_id, self.model_cfg, self.serve_cfg,
+            params=self._params, seed=self._seed + 1000 * replica_id,
+            injector=self.injector, on_finish=self._on_request_exit,
+            eos_token_id=self._eos_token_id,
+            fleet_cfg=self.fleet_cfg, role=ROLE_MIXED)
+        n = 8
+        while n <= min(256, self.serve_cfg.max_seq_len - 4):
+            r.engine.generate([list(range(1, n + 1))],
+                              SamplingParams(temperature=0.0,
+                                             max_tokens=2))
+            n <<= 1
+        r.engine.total_prefill_tokens = 0
+        r.engine.total_decode_steps = 0
+        r.engine.total_padded_slot_steps = 0
+        r.engine.total_short_dispatches = 0
+        return r
+
+    def _warm_spares(self, ids: list) -> None:
+        """Background warmer: stock the standby pool while the
+        provisioned fleet serves. Runs once, at construction."""
+        for rid in ids:
+            if self._spares_closed:
+                return
+            try:
+                r = self._build_engine_replica(rid)
+            except Exception:
+                logger.exception("spare replica %d warm-up failed", rid)
+                with self._spares_cv:
+                    self._spares_pending.discard(rid)
+                    self._spares_cv.notify_all()
+                continue
+            with self._spares_cv:
+                self._spares_pending.discard(rid)
+                if self._spares_closed:
+                    released = r
+                else:
+                    self._spares.append(r)
+                    released = None
+                self._spares_cv.notify_all()
+            if released is not None:
+                try:
+                    released.engine.release()
+                except Exception:
+                    pass
+
+    def wait_warm_spares(self, timeout_s: float = 300.0) -> bool:
+        """Block until the standby pool finishes warming (or the
+        timeout passes). Latency-sensitive callers — benchmarks, SLO
+        measurement windows — use this so spare XLA compiles don't
+        contend with serving inside the window they care about; a
+        scale-up after this returns adopts a spare instantly. True
+        when no spare warm-ups remain in flight."""
+        deadline = time.monotonic() + timeout_s
+        with self._spares_cv:
+            while self._spares_pending \
+                    and time.monotonic() < deadline:
+                self._spares_cv.wait(timeout=1.0)
+            return not self._spares_pending
+
+    def spawn_engine_replica(self, replica_id: int) -> EngineReplica:
+        """One in-proc replica for the autoscaler's default scale-up —
+        from the warm-spare pool when stocked (instant), else a cold
+        build (construct + compile, seconds). If the warmer is compiling
+        exactly this id, wait for the warm engine rather than start a
+        duplicate cold build."""
+        deadline = time.monotonic() + 300.0
+        with self._spares_cv:
+            while replica_id in self._spares_pending \
+                    and time.monotonic() < deadline:
+                self._spares_cv.wait(timeout=1.0)
+            for s in self._spares:
+                if s.replica_id == replica_id:
+                    self._spares.remove(s)
+                    return s
+        return self._build_engine_replica(replica_id)
+
+    def spawn_remote_replica(self, replica_id: int,
+                             endpoint: str) -> RemoteReplica:
+        """Build (don't start/wire) a front for a freshly-spawned
+        ``llmctl fleet worker`` at its discovered endpoint."""
+        return RemoteReplica(
+            replica_id, endpoint, fleet_cfg=self.fleet_cfg,
+            injector=self.injector, on_finish=self._on_request_exit,
+            role=ROLE_MIXED)
+
+    def adopt_replica(self, replica, endpoint: Optional[str] = None
+                      ) -> None:
+        """Join a started replica to the live fleet: wiring, router ring
+        + endpoint map, pipeline candidate set. ``self.replicas`` is the
+        SAME list object the supervisor iterates, so it sees the new
+        member on its next poll step; membership only ever changes on
+        the supervisor thread (autoscaler), so no iterator races."""
+        self._wire_replica(replica)
+        self.replicas.append(replica)
+        if endpoint is not None:
+            # live endpoint-map update: status, courier pushes, and
+            # sibling workers all resolve the newcomer from here
+            self.fleet_cfg.fleet_endpoints[replica.replica_id] = endpoint
+        self.router.add_replica(replica, endpoint=endpoint)
+        self.pipeline.bind(self.router, self.replicas, self.courier)
+
+    def release_replica(self, replica_id: int) -> None:
+        """Remove a DRAINED replica from the live fleet and free its
+        engine. The drain already migrated residents and flushed the
+        prefix inventory to the KV store — this is pure teardown."""
+        r = next((x for x in self.replicas
+                  if x.replica_id == replica_id), None)
+        if r is None:
+            return
+        self.replicas.remove(r)
+        self.router.remove_replica(replica_id)
+        self.pipeline.bind(self.router, self.replicas, self.courier)
+        self.courier.prefix_providers.pop(replica_id, None)
+        self.fleet_cfg.fleet_endpoints.pop(replica_id, None)
+        self.supervisor.forget(replica_id)
+        try:
+            r.stop()
+        except Exception:
+            pass
+        engine = getattr(r, "engine", None)
+        if engine is not None:
+            try:
+                engine.release()
+            except Exception:
+                pass
 
     def _on_request_exit(self, replica_id: int, req: Request) -> None:
         self.router.on_request_exit(replica_id, req)
@@ -301,6 +484,21 @@ class ServeFleet:
 
     def shutdown(self) -> None:
         self.supervisor.stop()
+        if self.autoscaler is not None and \
+                self.autoscaler.spawner is not None:
+            self.autoscaler.spawner.shutdown()
+        # drain the standby pool: unconsumed spares free their engines;
+        # the warmer releases any build still in flight when it lands
+        with self._spares_cv:
+            self._spares_closed = True
+            spares, self._spares = self._spares, []
+            self._spares_pending.clear()
+            self._spares_cv.notify_all()
+        for s in spares:
+            try:
+                s.engine.release()
+            except Exception:
+                pass
         for r in self.replicas:
             r.stop()
             engine = getattr(r, "engine", None)   # remote: no engine here
@@ -316,16 +514,17 @@ class ServeFleet:
                sampling: Optional[SamplingParams] = None,
                request_id: Optional[str] = None,
                on_complete: Optional[Callable[[Request], None]] = None,
-               ) -> Request:
+               priority: str = "standard") -> Request:
         return self.router.submit(prompt_tokens, sampling,
                                   request_id=request_id,
-                                  on_complete=on_complete)
+                                  on_complete=on_complete,
+                                  priority=priority)
 
     def submit_streaming(self, prompt_tokens: Sequence[int],
                          sampling: Optional[SamplingParams] = None,
                          request_id: Optional[str] = None,
                          on_complete: Optional[Callable[[Request], None]]
-                         = None) -> Request:
+                         = None, priority: str = "standard") -> Request:
         """Admit one STREAMING request: its token batches flow through
         the fleet stream hub (``self.streams``) with monotonic sequence
         numbers, across every re-placement the fleet performs. The log
@@ -348,7 +547,8 @@ class ServeFleet:
         try:
             return self.router.submit(prompt_tokens, sampling,
                                       request_id=rid,
-                                      on_complete=_complete, stream=True)
+                                      on_complete=_complete, stream=True,
+                                      priority=priority)
         except Exception:
             self.streams.discard(rid)
             raise

@@ -66,6 +66,17 @@ class FaultPlan:
     crash_after_steps: int = 0
     crash_step_lo: int = 1
     crash_step_hi: int = 8
+    # request-keyed crash: the replica CURRENTLY serving a request whose
+    # id contains `crash_request_substr` crashes after
+    # `crash_request_after_steps` engine steps with such a request
+    # active (fires once, ever). Unlike `crash_replica` this follows the
+    # request, not the hardware — the bench's pipeline chaos arm keys on
+    # "::stage" so the injected crash deterministically lands on a
+    # pipelined-prefill stage request wherever the planner placed it,
+    # exercising the collapse path instead of whichever replica happened
+    # to be id 0.
+    crash_request_substr: str = ""
+    crash_request_after_steps: int = 1
     # probe timeouts: the next `probe_timeout_count` health probes of
     # `probe_timeout_replica` raise ProbeTimeout
     probe_timeout_replica: Optional[int] = None
@@ -122,6 +133,10 @@ class FaultInjector:
         self.plan = plan or FaultPlan()
         self._lock = threading.Lock()
         self._steps: dict[int, int] = {}
+        # request-keyed crash: steps each replica has taken WITH a
+        # matching request active (the countdown is per replica — the
+        # crash must land where the request is)
+        self._req_match_steps: dict[int, int] = {}
         self._crash_fired = False
         self._probe_timeouts_left = self.plan.probe_timeout_count
         p = self.plan
@@ -154,18 +169,42 @@ class FaultInjector:
                                 if p.front_stall_front is not None
                                 else None)
 
-    def before_step(self, replica_id: int) -> None:
+    @property
+    def wants_request_ids(self) -> bool:
+        """True when the plan needs to see the active request ids each
+        step (request-keyed crash) — replicas skip collecting them
+        otherwise."""
+        return bool(self.plan.crash_request_substr)
+
+    def before_step(self, replica_id: int,
+                    active: Optional[list] = None) -> None:
         """Called by the replica loop before each engine step; raises
-        InjectedCrash exactly once at the planned (replica, step)."""
+        InjectedCrash exactly once at the planned (replica, step) — or,
+        for request-keyed plans, once the replica serving a matching
+        request has taken ``crash_request_after_steps`` steps with it
+        active (``active`` is that replica's current request ids)."""
+        sub = self.plan.crash_request_substr
         with self._lock:
             step = self._steps.get(replica_id, 0)
             self._steps[replica_id] = step + 1
             fire = (not self._crash_fired
                     and self.plan.crash_replica == replica_id
                     and step >= self._crash_step)
+            matched = None
+            if not fire and not self._crash_fired and sub and active:
+                matched = next((rid for rid in active if sub in rid),
+                               None)
+                if matched is not None:
+                    n = self._req_match_steps.get(replica_id, 0) + 1
+                    self._req_match_steps[replica_id] = n
+                    fire = n >= self.plan.crash_request_after_steps
             if fire:
                 self._crash_fired = True
         if fire:
+            if matched is not None:
+                raise InjectedCrash(
+                    f"injected crash: replica {replica_id} serving "
+                    f"{matched} at step {step}")
             raise InjectedCrash(
                 f"injected crash: replica {replica_id} at step {step}")
 

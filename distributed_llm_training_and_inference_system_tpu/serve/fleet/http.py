@@ -108,16 +108,22 @@ class FleetServer:
                 body, self.tokenizer, self.model_cfg.vocab_size)
         except BadRequest as e:
             return web.json_response({"error": str(e)}, status=400)
+        # SLO priority tier (interactive|standard|best-effort): admission
+        # sheds best-effort first at saturation, placement favors
+        # interactive, and the autoscaler may preempt best-effort
+        # residents to protect interactive TTFT. Unknown -> standard.
+        priority = str(body.get("priority", "standard"))
         if stream:
             return await self._stream_completion(request, prompt_tokens,
-                                                 sampling)
+                                                 sampling, priority)
 
         loop = asyncio.get_running_loop()
         event = asyncio.Event()
         try:
             req = self.fleet.submit(
                 prompt_tokens, sampling,
-                on_complete=lambda _r: loop.call_soon_threadsafe(event.set))
+                on_complete=lambda _r: loop.call_soon_threadsafe(event.set),
+                priority=priority)
         except FleetSaturated as e:
             return web.json_response(
                 {"error": str(e)},
@@ -170,11 +176,13 @@ class FleetServer:
 
     @aiohttp_handler
     async def _stream_completion(self, http_req: web.Request,
-                                 prompt_tokens, sampling):
+                                 prompt_tokens, sampling,
+                                 priority: str = "standard"):
         """`stream: true` path: admit through the stream hub and serve
         the SSE response from seq 0."""
         try:
-            req = self.fleet.submit_streaming(prompt_tokens, sampling)
+            req = self.fleet.submit_streaming(prompt_tokens, sampling,
+                                              priority=priority)
         except FleetSaturated as e:
             return web.json_response(
                 {"error": str(e)}, status=429,

@@ -118,6 +118,12 @@ class PipelineCoordinator:
         self.total_pipeline_collapses = 0
         self.total_pipeline_stages = 0
         self.total_preshipped_pages = 0
+        # pre-ship import half timed out on the destination's engine
+        # thread (the bench-host gap PR 14 found): the pages shipped but
+        # never attached, so the next stage falls back to its own fetch.
+        # Counted apart from generic fetch misses — a busy destination
+        # engine is a different disease than a cold cache.
+        self.total_pipeline_preship_timeouts = 0
         self.total_preship_ms = 0.0
         self.total_preship_hidden_ms = 0.0
         self._stage_ms: deque = deque(maxlen=256)
@@ -357,6 +363,7 @@ class PipelineCoordinator:
             return 0
         t0 = time.perf_counter()
         delivered = 0
+        import_timed_out = False
         try:
             if self.courier is not None:
                 payload = self.courier.fetch_prefix(
@@ -381,6 +388,8 @@ class PipelineCoordinator:
                     if dest.request_prefix_import(hb[:j],
                                                   pages) is not None:
                         delivered = j
+                    else:
+                        import_timed_out = True
         except Exception as e:     # TransferAborted + wire surprises
             logger.warning(
                 "pipeline pre-ship %d -> %d aborted (%s); next stage "
@@ -394,6 +403,8 @@ class PipelineCoordinator:
                 self.total_preship_hidden_ms += ms
             if delivered > 0:
                 self.total_preshipped_pages += delivered
+            if import_timed_out:
+                self.total_pipeline_preship_timeouts += 1
         return delivered
 
     def _place_final(self, pipe: _Pipe) -> bool:
@@ -445,6 +456,7 @@ class PipelineCoordinator:
             self.total_pipeline_collapses = 0
             self.total_pipeline_stages = 0
             self.total_preshipped_pages = 0
+            self.total_pipeline_preship_timeouts = 0
             self.total_preship_ms = 0.0
             self.total_preship_hidden_ms = 0.0
             self._stage_ms.clear()
@@ -460,6 +472,8 @@ class PipelineCoordinator:
                 "collapses": self.total_pipeline_collapses,
                 "stages": self.total_pipeline_stages,
                 "preshipped_pages": self.total_preshipped_pages,
+                "preship_timeouts":
+                    self.total_pipeline_preship_timeouts,
                 "preship_ms": round(self.total_preship_ms, 3),
                 "preship_hidden_ms": round(self.total_preship_hidden_ms,
                                            3),

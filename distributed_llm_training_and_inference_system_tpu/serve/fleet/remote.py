@@ -88,6 +88,10 @@ def request_to_wire(req: Request) -> dict:
         # fleet SSE streaming: a streaming request's worker publishes
         # cursor-tagged token batches through its outbox
         "stream": bool(getattr(req, "stream_requested", False)),
+        # SLO priority class: the worker's scheduler is class-blind, but
+        # the wire carries it so migrated/requeued requests keep their
+        # class and the worker's probe can report per-class residents
+        "priority": str(getattr(req, "priority", "standard")),
         "sampling": sampling_to_wire(req.sampling),
         "ticket": ticket,
         "partial": bool(kv.get("partial")) if isinstance(kv, dict)
@@ -123,6 +127,7 @@ def request_from_wire(d: dict, receiver=None) -> Request:
     req.fleet_requeued = bool(d.get("fleet_requeued"))
     req.handoffs = int(d.get("handoffs", 0))
     req.stream_requested = bool(d.get("stream"))
+    req.priority = str(d.get("priority", "standard"))
     req.prefix_owner = d.get("prefix_owner")
     req.prefix_owner_endpoint = d.get("prefix_owner_endpoint")
     spec = d.get("spec_state")
@@ -402,9 +407,22 @@ class RemoteReplica:
             return (int(self._cache.get("outstanding_tokens", 0))
                     + self._pending_outstanding)
 
-    def resident_requests(self) -> list[tuple[str, int]]:
-        return [(str(rid), int(rem))
-                for rid, rem in self._cache.get("resident_requests", [])]
+    def resident_requests(self) -> list[tuple[str, int, str]]:
+        # older workers probe 2-tuples (no priority); default the class
+        out = []
+        for row in self._cache.get("resident_requests", []):
+            rid, rem = row[0], row[1]
+            pri = row[2] if len(row) > 2 else "standard"
+            out.append((str(rid), int(rem), str(pri)))
+        return out
+
+    def queued_priority_wait_ms(self, priority: str) -> float:
+        """Probe-stale mirror of the worker's worst queueing age for
+        ``priority`` (only 'interactive' travels the probe wire today —
+        the autoscaler's TTFT-preemption signal)."""
+        if priority != "interactive":
+            return 0.0
+        return float(self._cache.get("queued_interactive_wait_ms", 0.0))
 
     def prefix_cache_stats(self) -> tuple[int, int, int]:
         return (int(self._cache.get("prefix_hits", 0)),

@@ -345,7 +345,19 @@ class EngineReplica:
                 continue
             try:
                 if self.injector is not None:
-                    self.injector.before_step(self.replica_id)
+                    active = None
+                    if self.injector.wants_request_ids:
+                        # request-keyed crash plans (the bench's pipeline
+                        # chaos arm) need to see WHICH requests this step
+                        # serves, not just that a step happened
+                        with eng.lock:
+                            active = [r.request_id
+                                      for r in eng.scheduler.slots
+                                      if r is not None]
+                            active += [r.request_id
+                                       for r in eng.scheduler.waiting]
+                    self.injector.before_step(self.replica_id,
+                                              active=active)
                     d = self.injector.step_delay_s(self.replica_id)
                     if d > 0:
                         time.sleep(d)
@@ -848,15 +860,29 @@ class EngineReplica:
         return out
 
     @thread_seam
-    def resident_requests(self) -> list[tuple[str, int]]:
-        """(request_id, remaining_tokens) of RUNNING requests — the
-        rebalancer's victim-selection input."""
+    def resident_requests(self) -> list[tuple[str, int, str]]:
+        """(request_id, remaining_tokens, priority) of RUNNING requests —
+        the rebalancer's and the preemption pass's victim-selection
+        input."""
         out = []
         with self.engine.lock:
             for r in self.engine.scheduler.slots:
                 if r is not None and r.state is RequestState.RUNNING:
-                    out.append((r.request_id, r.remaining_tokens))
+                    out.append((r.request_id, r.remaining_tokens,
+                                getattr(r, "priority", "standard")))
         return out
+
+    @thread_seam
+    def queued_priority_wait_ms(self, priority: str) -> float:
+        """Longest current queue wait (ms) among QUEUED requests of the
+        given class — the preemption pass's TTFT-risk signal. Lock-free
+        read, same contract as ``outstanding_tokens``."""
+        now = time.monotonic()
+        worst = 0.0
+        for r in list(self.engine.scheduler.waiting):
+            if getattr(r, "priority", "standard") == priority:
+                worst = max(worst, (now - r.arrival_time) * 1e3)
+        return worst
 
     @thread_seam
     def prefix_cache_stats(self) -> tuple[int, int, int]:

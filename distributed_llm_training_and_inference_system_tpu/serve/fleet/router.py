@@ -67,6 +67,26 @@ class FleetSaturated(RuntimeError):
         self.retry_after_s = retry_after_s
 
 
+# SLO priority classes (Llumnix-style isolation, PAPERS.md). Admission
+# sheds best-effort first: it only gets half the queue, while standard
+# loses just the interactive headroom reservation and interactive keeps
+# the full bound. Retry-After is class-aware — shed best-effort clients
+# back off hard, shed interactive clients retry soon (their 429 means a
+# genuine full-fleet outage, usually brief once the autoscaler reacts).
+PRIORITIES = ("interactive", "standard", "best-effort")
+_BEST_EFFORT_ADMIT_FRACTION = 0.5
+_RETRY_AFTER_SCALE = {"interactive": 0.5, "standard": 1.0,
+                      "best-effort": 4.0}
+
+
+def normalize_priority(priority) -> str:
+    """Clamp arbitrary client input onto the known classes (unknown or
+    missing = standard — a typo must not silently outrank paying
+    interactive traffic)."""
+    p = str(priority or "standard").strip().lower().replace("_", "-")
+    return p if p in PRIORITIES else "standard"
+
+
 def _hash_point(data: bytes) -> int:
     return int.from_bytes(hashlib.sha1(data).digest()[:8], "big")
 
@@ -149,12 +169,7 @@ class FleetRouter:
         # deadlock between the HTTP thread and the engine thread.
         self._lock = threading.Lock()
         self._ring: list[tuple[int, int]] = []      # (point, replica_id)
-        for r in self.replicas:
-            for v in range(self.cfg.affinity_vnodes):
-                self._ring.append((
-                    _hash_point(f"replica-{r.replica_id}:{v}".encode()),
-                    r.replica_id))
-        self._ring.sort()
+        self._rebuild_ring()
         self._waiters: dict[str, Callable[[Request], None]] = {}
         self._meta: dict[str, dict] = {}            # rid -> ledger entry
         self._parked: list[Request] = []            # requeues awaiting a
@@ -176,6 +191,12 @@ class FleetRouter:
         self.total_completed = 0
         self.total_failed = 0
         self.total_rejected = 0
+        # per-class admission ledger (SLO priority tiers): who got in and
+        # who was shed. Keys are the PRIORITIES constants.
+        self.submitted_by_class: dict[str, int] = {p: 0
+                                                   for p in PRIORITIES}
+        self.rejected_by_class: dict[str, int] = {p: 0
+                                                  for p in PRIORITIES}
         self.total_requeues = 0
         self.total_affinity_hits = 0
         self.total_migrations = 0       # migrated sequences placed
@@ -186,6 +207,50 @@ class FleetRouter:
             r.replica_id: 0 for r in self.replicas}
         self.requeues_per_replica: dict[int, int] = {
             r.replica_id: 0 for r in self.replicas}
+
+    def _rebuild_ring(self) -> None:
+        ring: list[tuple[int, int]] = []
+        for r in self.replicas:
+            for v in range(self.cfg.affinity_vnodes):
+                ring.append((
+                    _hash_point(f"replica-{r.replica_id}:{v}".encode()),
+                    r.replica_id))
+        ring.sort()
+        self._ring = ring
+
+    # -- elastic membership (serve/fleet/autoscaler.py) ----------------------
+
+    def add_replica(self, replica, endpoint: Optional[str] = None) -> None:
+        """Join a freshly spawned replica to the placement plane:
+        membership list, consistent-hash ring (only this replica's arc
+        reassigns — hot prefixes elsewhere stay put), per-replica
+        counters, and the courier endpoint map for a remote worker."""
+        with self._lock:
+            if any(r.replica_id == replica.replica_id
+                   for r in self.replicas):
+                return
+            self.replicas = self.replicas + [replica]
+            self.by_id = {r.replica_id: r for r in self.replicas}
+            self._rebuild_ring()
+            self.completed_per_replica.setdefault(replica.replica_id, 0)
+            self.routed_per_replica.setdefault(replica.replica_id, 0)
+            self.requeues_per_replica.setdefault(replica.replica_id, 0)
+            if endpoint:
+                self._endpoints[replica.replica_id] = endpoint
+        self.invalidate_inventories()
+
+    def remove_replica(self, replica_id: int) -> None:
+        """Retire a replica from the placement plane (drained + flushed
+        upstream by the autoscaler). Its ring arc reassigns to the
+        survivors; its historical counters stay in the stats — a retire
+        must not erase completed-work accounting."""
+        with self._lock:
+            self.replicas = [r for r in self.replicas
+                             if r.replica_id != replica_id]
+            self.by_id = {r.replica_id: r for r in self.replicas}
+            self._rebuild_ring()
+            self._endpoints.pop(replica_id, None)
+        self.invalidate_inventories()
 
     # -- placement -----------------------------------------------------------
 
@@ -204,7 +269,8 @@ class FleetRouter:
 
     def _candidates(self, prompt_tokens: Sequence[int],
                     exclude: frozenset = frozenset(),
-                    needs_prefill: bool = True) -> tuple[list, bool]:
+                    needs_prefill: bool = True,
+                    priority: str = "standard") -> tuple[list, bool]:
         """(replicas to try in order, affinity_applied): affinity owner
         first when within the imbalance bound, then by least outstanding
         tokens. ``affinity_applied`` is True only when the ring owner was
@@ -226,8 +292,18 @@ class FleetRouter:
             return [], False
         load = {r.replica_id: r.outstanding_tokens() for r in accepting}
         depth = {r.replica_id: r.queue_depth() for r in accepting}
-        ordered = sorted(accepting,
-                         key=lambda r: (load[r.replica_id], r.replica_id))
+        if priority == "interactive":
+            # TTFT-first ordering: the requests QUEUED ahead are what an
+            # interactive arrival actually waits behind — shallowest
+            # queue first, outstanding tokens as the tiebreak
+            ordered = sorted(accepting,
+                             key=lambda r: (depth[r.replica_id],
+                                            load[r.replica_id],
+                                            r.replica_id))
+        else:
+            ordered = sorted(accepting,
+                             key=lambda r: (load[r.replica_id],
+                                            r.replica_id))
         if not needs_prefill:
             # stable sort: decode < mixed < prefill, least-loaded within
             ordered.sort(key=lambda r: {"decode": 0, "mixed": 1}.get(
@@ -491,30 +567,60 @@ class FleetRouter:
 
     # -- submission ----------------------------------------------------------
 
+    def admit_bound(self, priority: str) -> int:
+        """Class-aware admission bound on pending requests. Interactive
+        keeps the full ``max_pending``; standard gives up the
+        ``priority_headroom_requests`` reservation; best-effort is
+        additionally capped at half the queue so it sheds FIRST as the
+        fleet approaches saturation."""
+        bound = self.cfg.max_pending
+        headroom = int(getattr(self.cfg, "priority_headroom_requests", 0))
+        if priority == "interactive":
+            return bound
+        bound = max(bound - headroom, 1)
+        if priority == "best-effort":
+            bound = min(bound, max(
+                int(self.cfg.max_pending
+                    * _BEST_EFFORT_ADMIT_FRACTION), 1))
+        return bound
+
     def submit(self, prompt_tokens: Sequence[int],
                sampling: Optional[SamplingParams] = None,
                request_id: Optional[str] = None,
                on_complete: Optional[Callable[[Request], None]] = None,
-               stream: bool = False) -> Request:
+               stream: bool = False,
+               priority: str = "standard") -> Request:
         """Admit one request into the fleet. Returns the (QUEUED) Request;
         raises FleetSaturated on backpressure. ``on_complete`` fires (from
         an engine thread) when the request reaches a terminal state, however
         many replicas it crossed on the way. ``stream`` marks the request
         for token streaming: every replica it crosses publishes its token
-        batches to the fleet stream hub (serve/fleet/streams.py)."""
+        batches to the fleet stream hub (serve/fleet/streams.py).
+        ``priority`` is the SLO class (interactive|standard|best-effort):
+        best-effort is shed first at saturation, with a class-aware
+        Retry-After."""
+        priority = normalize_priority(priority)
         req = Request(
             request_id=request_id or f"fleet-{uuid.uuid4().hex[:24]}",
             prompt_tokens=list(prompt_tokens),
             sampling=sampling or SamplingParams(),
-            stream_requested=bool(stream))
-        if self.pending_total() >= self.cfg.max_pending:
+            stream_requested=bool(stream),
+            priority=priority)
+        if self.pending_total() >= self.admit_bound(priority):
             with self._lock:
                 self.total_rejected += 1
+                self.rejected_by_class[priority] = (
+                    self.rejected_by_class.get(priority, 0) + 1)
+                self._rec({"op": "count", "key": "rejected"})
             raise FleetSaturated(
-                f"fleet saturated: {self.pending_total()} pending >= "
-                f"max_pending {self.cfg.max_pending}",
-                self.cfg.retry_after_s)
-        cands, affinity_first = self._candidates(req.prompt_tokens)
+                f"fleet saturated for class {priority}: "
+                f"{self.pending_total()} pending >= admission bound "
+                f"{self.admit_bound(priority)} "
+                f"(max_pending {self.cfg.max_pending})",
+                self.cfg.retry_after_s
+                * _RETRY_AFTER_SCALE.get(priority, 1.0))
+        cands, affinity_first = self._candidates(req.prompt_tokens,
+                                                 priority=priority)
         with self._lock:
             self._meta[req.request_id] = {"requeues": 0, "replica": None}
             if on_complete is not None:
@@ -533,6 +639,8 @@ class FleetRouter:
         if self.pipeline is not None and self.pipeline.try_launch(req):
             with self._lock:
                 self.total_submitted += 1
+                self.submitted_by_class[priority] = (
+                    self.submitted_by_class.get(priority, 0) + 1)
                 self._rec({"op": "count", "key": "submitted"})
             return req
         invs = self._inventories() if self._hints_enabled(req) else {}
@@ -542,6 +650,8 @@ class FleetRouter:
             if r.submit(req):
                 with self._lock:
                     self.total_submitted += 1
+                    self.submitted_by_class[priority] = (
+                        self.submitted_by_class.get(priority, 0) + 1)
                     self.routed_per_replica[r.replica_id] = (
                         self.routed_per_replica.get(r.replica_id, 0) + 1)
                     self._meta[req.request_id]["replica"] = r.replica_id
@@ -557,13 +667,16 @@ class FleetRouter:
             self._meta.pop(req.request_id, None)
             self._waiters.pop(req.request_id, None)
             self.total_rejected += 1
+            self.rejected_by_class[priority] = (
+                self.rejected_by_class.get(priority, 0) + 1)
             self._rec({"op": "pop", "rid": req.request_id,
                        "outcome": "rejected"})
         if req.error:      # per-replica validation rejected it (too long)
             raise ValueError(req.error)
         raise FleetSaturated(
             "fleet saturated: no replica accepted the request",
-            self.cfg.retry_after_s)
+            self.cfg.retry_after_s
+            * _RETRY_AFTER_SCALE.get(priority, 1.0))
 
     # -- completion / requeue ------------------------------------------------
 
@@ -871,8 +984,10 @@ class FleetRouter:
     def _place(self, req: Request, exclude: frozenset = frozenset(),
                src: Optional[int] = None) -> bool:
         while True:
-            cands, _ = self._candidates(req.prompt_tokens, exclude=exclude,
-                                        needs_prefill=_needs_prefill(req))
+            cands, _ = self._candidates(
+                req.prompt_tokens, exclude=exclude,
+                needs_prefill=_needs_prefill(req),
+                priority=getattr(req, "priority", "standard"))
             invs = self._inventories() if self._hints_enabled(req) else {}
             for r in cands:
                 if invs:
@@ -1013,6 +1128,10 @@ class FleetRouter:
                 "parked_remote": len(self._parked_remote),
                 "parked_adopted": self.total_parked_adopted,
                 "in_flight": in_flight,
+                # SLO priority tiers: per-class admission ledger (dict
+                # copies — callers mutate snapshots freely)
+                "submitted_by_class": dict(self.submitted_by_class),
+                "rejected_by_class": dict(self.rejected_by_class),
                 "inventory_cache_hits": self.inventory_cache_hits,
                 "inventory_cache_misses": self.inventory_cache_misses,
                 "store_hint_remote_skips":

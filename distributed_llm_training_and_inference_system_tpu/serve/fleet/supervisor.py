@@ -40,7 +40,8 @@ class ReplicaSupervisor:
                  injector: Optional[FaultInjector] = None,
                  params=None,
                  observer: Optional[Callable[[str, dict], None]] = None,
-                 streams=None, store=None, kv_store=None, pipeline=None):
+                 streams=None, store=None, kv_store=None, pipeline=None,
+                 autoscaler=None):
         self.cfg = cfg or FleetConfig()
         self.replicas = replicas
         self.router = router
@@ -52,6 +53,10 @@ class ReplicaSupervisor:
         # snapshot section + `fleet status` line. None = bare-router
         # unit tests.
         self.pipeline = pipeline
+        # elastic autoscaler (serve/fleet/autoscaler.py): scale up/down
+        # + SLO preemption decisions ride this poll loop — one decision
+        # point per poll, after the rebalancer. None = fixed fleet.
+        self.autoscaler = autoscaler
         # fleet stream hub (serve/fleet/streams.py): snapshot columns +
         # replay-window GC ride the supervisor poll. None = no streaming
         # plane (unit tests on bare routers).
@@ -126,6 +131,8 @@ class ReplicaSupervisor:
         self._maybe_role_restore()
         self._maybe_role_balance()
         self._maybe_rebalance()
+        if self.autoscaler is not None:
+            self.autoscaler.poll(now=time.monotonic())
         if self.streams is not None:
             # expire finished replay windows AND unfinished logs whose
             # request the router no longer knows (the PR-8 leak: opened
@@ -186,7 +193,7 @@ class ReplicaSupervisor:
         residents = sorted(hot.resident_requests(),
                            key=lambda x: x[1], reverse=True)
         moved = 0
-        for rid, _remaining in residents[:budget]:
+        for rid, *_rest in residents[:budget]:
             if hot.request_migrate(rid, dest=cold.replica_id,
                                    reason="rebalance"):
                 moved += 1
@@ -445,6 +452,19 @@ class ReplicaSupervisor:
             del self._next_restart[r.replica_id]
             self._schedule_restart(r, time.monotonic())
             return False
+
+    @thread_seam
+    def forget(self, replica_id: int) -> None:
+        """Drop all per-replica bookkeeping for a retired member (the
+        autoscaler's release path) — a later replica reusing the id
+        must not inherit probe misses or restart backoff."""
+        self._misses.pop(replica_id, None)
+        self._next_restart.pop(replica_id, None)
+        self._backoff.pop(replica_id, None)
+        self._promoted.pop(replica_id, None)
+        self._restore_streak.pop(replica_id, None)
+        if self._rerole is not None and self._rerole[0] == replica_id:
+            self._rerole = None
 
     @thread_seam
     def current_backoff_s(self, replica_id: int) -> float:
@@ -711,4 +731,9 @@ class ReplicaSupervisor:
                              if self.kv_store is not None else {}),
                 "pipeline": (self.pipeline.snapshot()
                              if self.pipeline is not None else {}),
+                # elastic autoscaler: scale/preempt counters + the
+                # event timeline (feeds llmctl_fleet_autoscale_* and
+                # the bench scenario report)
+                "autoscale": (self.autoscaler.snapshot()
+                              if self.autoscaler is not None else {}),
                 "courier": courier.snapshot() if courier else {}}

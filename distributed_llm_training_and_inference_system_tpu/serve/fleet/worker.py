@@ -287,6 +287,12 @@ class FleetWorker:
         base.update({
             "resident_requests": r.resident_requests()
             if base["state"] == replica_mod.HEALTHY else [],
+            # SLO preemption signal: worst queueing age of an
+            # interactive request (ms) — the parent's autoscaler
+            # compares it to interactive_ttft_target_ms
+            "queued_interactive_wait_ms":
+            r.queued_priority_wait_ms("interactive")
+            if base["state"] == replica_mod.HEALTHY else 0.0,
             "migrations_in_flight": r.migrations_in_flight(),
             "migrations": r.migrations_out,
             "migrated_tokens": r.migrated_tokens,

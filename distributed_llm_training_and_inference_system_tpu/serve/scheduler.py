@@ -93,6 +93,14 @@ class Request:
     # its token batches (with sequence cursors) to the fleet stream hub.
     # Carried on the worker submit wire; survives requeue/migration.
     stream_requested: bool = False
+    # SLO priority class (serve/fleet/): "interactive" | "standard" |
+    # "best-effort". Admission sheds best-effort first at saturation,
+    # placement reserves headroom for interactive, and the preemption
+    # pass migrates best-effort residents out of the way of an
+    # interactive request missing its TTFT target. Carried on the
+    # worker submit wire; survives requeue/migration. Engines below the
+    # fleet layer ignore it.
+    priority: str = "standard"
     # courier-aware speculation (serve/speculative.py SpecState): the
     # sequence's acceptance EWMA / adaptive window / proposer warmup as
     # a plain-scalar dict. Stamped at every slot extraction (preempt,

@@ -1,0 +1,480 @@
+"""FleetAutoscaler state machine + FleetConfig error-catalog tests.
+
+The autoscaler tests run against duck-typed fakes: FleetAutoscaler
+touches the fleet facade only through `replicas`, `router`, and the
+spawn/adopt/release trio, so a fake fleet exercises every decision
+branch (hysteresis, cooldown, floors, rollback, preemption) in
+microseconds with no engines, weights, or threads involved.
+"""
+
+import re
+
+import pytest
+
+from distributed_llm_training_and_inference_system_tpu.config.schema import (
+    ConfigError,
+    FleetConfig,
+)
+from distributed_llm_training_and_inference_system_tpu.serve.fleet import (
+    autoscaler as asc,
+)
+from distributed_llm_training_and_inference_system_tpu.serve.fleet import (
+    replica as replica_mod,
+)
+
+
+# ---------------------------------------------------------------------------
+# FleetConfig.validate(): every documented ConfigError, by name
+# ---------------------------------------------------------------------------
+
+# (kwargs, message fragment) — one row per raise site in
+# FleetConfig.validate() / its parse helpers. The fragment is matched
+# with re.search after re.escape, so rows read as plain prose.
+FLEET_CONFIG_ERRORS = [
+    ({"replicas": 0}, "fleet replicas must be >= 1"),
+    ({"probe_interval_s": 0.0}, "probe_interval_s must be > 0"),
+    ({"probe_failures": 0}, "probe_failures must be >= 1"),
+    ({"restart_backoff_s": -1.0}, "restart backoff values must be >= 0"),
+    ({"affinity_prefix_tokens": -1}, "affinity_prefix_tokens must be >= 0"),
+    ({"affinity_vnodes": 0}, "affinity_vnodes must be >= 1"),
+    ({"max_pending": 0}, "max_pending must be >= 1"),
+    ({"max_requeues": -1}, "max_requeues must be >= 0"),
+    ({"rebalance_imbalance_ratio": 1.0},
+     "rebalance_imbalance_ratio must be in [0, 1)"),
+    ({"rebalance_poll_hysteresis": 0},
+     "rebalance_poll_hysteresis must be >= 1"),
+    ({"max_concurrent_migrations": 0},
+     "max_concurrent_migrations must be >= 1"),
+    ({"replicas": 2, "roles": "prefill"},
+     "fleet roles names 1 replicas but the fleet has 2"),
+    ({"replicas": 2, "roles": "prefill,bogus"}, "unknown fleet role(s)"),
+    ({"replicas": 2, "roles": "decode,decode"},
+     "at least one prefill-capable"),
+    ({"role_balance_ratio": -0.1}, "role_balance_ratio must be >= 0"),
+    ({"role_balance_poll_hysteresis": 0},
+     "role_balance_poll_hysteresis must be >= 1"),
+    ({"role_min_prefill": 0},
+     "role_min_prefill/role_min_decode must be >= 1"),
+    ({"role_restore_hysteresis": -1},
+     "role_restore_hysteresis must be >= 0"),
+    ({"courier_transport": "carrier-pigeon"}, "unknown courier_transport"),
+    ({"courier_transport": "http"},
+     "courier_transport=http needs courier_endpoint"),
+    ({"courier_codec": "gzip"}, "unknown courier_codec"),
+    ({"courier_zlib_level": 10}, "courier_zlib_level 10 outside [-1, 9]"),
+    ({"courier_chunk_bytes": 512}, "courier_chunk_bytes must be >= 1024"),
+    ({"courier_ticket_ttl_ms": -1.0}, "courier_ticket_ttl_ms must be >= 0"),
+    ({"remote_timeout_s": 0.0},
+     "remote_timeout_s / courier_ship_timeout_s must be > 0"),
+    ({"prefix_fetch_min_pages": 0}, "prefix_fetch_min_pages must be >= 1"),
+    ({"prefix_fetch_timeout_s": 0.0}, "prefix_fetch_timeout_s must be > 0"),
+    ({"pipeline_prefill_min_tokens": -1},
+     "pipeline_prefill_min_tokens must be >= 0"),
+    ({"pipeline_prefill_min_tokens": 1024, "prefix_fetch": False},
+     "pipeline_prefill_min_tokens requires prefix_fetch"),
+    ({"pipeline_prefill_max_stages": 1},
+     "pipeline_prefill_max_stages must be >= 2"),
+    ({"pipeline_prefill_stage_timeout_ms": 0.0},
+     "pipeline_prefill_stage_timeout_ms must be > 0"),
+    ({"prefix_inventory_max": -1}, "prefix_inventory_max must be >= 0"),
+    ({"prefix_inventory_ttl_ms": -1.0},
+     "prefix_inventory_ttl_ms must be >= 0"),
+    ({"kv_store": True, "prefix_fetch": False},
+     "kv_store needs prefix_fetch"),
+    ({"kv_store": True, "kv_store_dram_mb": 0.0},
+     "kv_store_dram_mb must be > 0"),
+    ({"kv_store_disk_mb": -1.0}, "kv_store_disk_mb must be >= 0"),
+    ({"kv_store_ttl_ms": -1.0}, "kv_store_ttl_ms must be >= 0"),
+    ({"state_compact_every": -1}, "state_compact_every must be >= 0"),
+    ({"stream_log_ttl_ms": -1.0}, "stream_log_ttl_ms must be >= 0"),
+    ({"stream_max_buffered_batches": -1},
+     "stream_max_buffered_batches must be >= 0"),
+    ({"state_store": "redis"}, "unknown state_store"),
+    ({"state_store": "file"}, "state_store=file needs state_store_dir"),
+    ({"fronts": 0}, "fleet fronts must be >= 1"),
+    ({"fronts": 2}, "fronts > 1 needs state_store=file"),
+    ({"fronts": 2, "state_store": "file", "state_store_dir": "/tmp/x"},
+     "fronts > 1 needs every replica remote"),
+    ({"fleet_endpoints": {5: "http://h:1"}},
+     "fleet endpoint names replica 5"),
+    ({"fleet_endpoints": ["nonsense"]},
+     "fleet endpoint entries must be 'replica=url'"),
+    ({"fleet_endpoints": "x=http://h:1"},
+     "fleet endpoint replica id must be an integer"),
+    ({"fleet_endpoints": "0=ftp://h:1"},
+     "must be an http(s) base URL"),
+    ({"fleet_endpoints": "0=http://a:1,0=http://b:2"},
+     "duplicate fleet endpoint for replica 0"),
+    ({"remote_replicas": "5"}, "remote_replicas names replica 5"),
+    ({"replicas": 2, "remote_replicas": "1"},
+     "remote replica 1 has no fleet endpoint"),
+    ({"remote_replicas": "zero"},
+     "remote_replicas must be comma-separated replica ids"),
+    ({"courier_max_retries": -1}, "courier_max_retries must be >= 0"),
+    ({"courier_retry_backoff_ms": -1.0},
+     "courier retry backoff values must be >= 0"),
+    ({"courier_chunk_deadline_ms": 0.0},
+     "courier_chunk_deadline_ms must be > 0"),
+    ({"autoscale_min_replicas": 0}, "autoscale_min_replicas must be >= 1"),
+    ({"autoscale_min_replicas": 2, "autoscale_max_replicas": 1},
+     "autoscale_max_replicas must be >= autoscale_min_replicas"),
+    ({"autoscale_up_queue_per_replica": 0.0},
+     "autoscale_up_queue_per_replica must be > 0"),
+    ({"autoscale_up_queue_per_replica": 2.0,
+      "autoscale_down_queue_per_replica": 2.0},
+     "autoscale_down_queue_per_replica must be below"),
+    ({"autoscale_hysteresis_polls": 0},
+     "autoscale_hysteresis_polls must be >= 1"),
+    ({"autoscale_cooldown_polls": -1},
+     "autoscale_cooldown_polls must be >= 0"),
+    ({"autoscale_spawn_timeout_s": 0.0},
+     "autoscale_spawn_timeout_s must be > 0"),
+    ({"autoscale": True, "fronts": 2, "state_store": "file",
+      "state_store_dir": "/tmp/x", "remote_replicas": "0",
+      "replicas": 1, "fleet_endpoints": {0: "http://h:1"}},
+     "autoscale with fronts > 1 is not supported yet"),
+    ({"priority_headroom_requests": -1},
+     "priority_headroom_requests must be >= 0"),
+    ({"max_pending": 4, "priority_headroom_requests": 4},
+     "priority_headroom_requests must be below max_pending"),
+    ({"interactive_ttft_target_ms": -1.0},
+     "interactive_ttft_target_ms must be >= 0"),
+]
+
+
+def test_fleet_config_defaults_validate():
+    FleetConfig().validate()
+
+
+@pytest.mark.parametrize(
+    "kwargs,fragment", FLEET_CONFIG_ERRORS,
+    ids=[fr[:48] for _, fr in FLEET_CONFIG_ERRORS])
+def test_fleet_config_error(kwargs, fragment):
+    with pytest.raises(ConfigError, match=re.escape(fragment)):
+        FleetConfig(**kwargs).validate()
+
+
+def test_fleet_config_error_table_covers_every_raise_site():
+    # the table above should not rot: every distinct ConfigError message
+    # FleetConfig.validate()/parse_fleet_endpoints can produce must have
+    # a row. Count raise sites in the source; each row kills one.
+    import inspect
+
+    from distributed_llm_training_and_inference_system_tpu.config import (
+        schema,
+    )
+    src = inspect.getsource(schema.FleetConfig.validate)
+    src += inspect.getsource(schema.parse_fleet_endpoints)
+    src += inspect.getsource(schema.FleetConfig.remote_replica_ids)
+    sites = src.count("raise ConfigError")
+    assert len(FLEET_CONFIG_ERRORS) >= sites, (
+        f"{sites} raise sites but only {len(FLEET_CONFIG_ERRORS)} table "
+        f"rows — new validation error needs a row here")
+
+
+# ---------------------------------------------------------------------------
+# FleetAutoscaler decision machine, on fakes
+# ---------------------------------------------------------------------------
+
+
+class FakeReplica:
+    def __init__(self, rid, role=replica_mod.ROLE_MIXED):
+        self.replica_id = rid
+        self.state = replica_mod.HEALTHY
+        self.role = role
+        self.queue = 0
+        self.active = 0
+        self.store_flush_pages = 0
+        self.drain_requested = False
+        self.interactive_wait_ms = 0.0
+        self.residents = []          # (request_id, remaining, priority)
+        self.migrated = []
+
+    def queue_depth(self):
+        return self.queue
+
+    def active_count(self):
+        return self.active
+
+    def outstanding_tokens(self):
+        return self.queue * 16
+
+    def migrations_in_flight(self):
+        return 0
+
+    def accepting(self):
+        return self.state == replica_mod.HEALTHY
+
+    def request_drain(self):
+        self.drain_requested = True
+
+    def undrain(self):
+        self.drain_requested = False
+        self.state = replica_mod.HEALTHY
+
+    def queued_priority_wait_ms(self, cls):
+        return self.interactive_wait_ms
+
+    def resident_requests(self):
+        return list(self.residents)
+
+    def request_migrate(self, vid, dest=None, reason=None):
+        self.migrated.append((vid, dest, reason))
+        return True
+
+    def start(self):
+        pass
+
+    def stop(self):
+        pass
+
+
+class FakeRouter:
+    def __init__(self):
+        self.pending = 0
+        self.invalidations = 0
+        self.parked_flushes = 0
+
+    def pending_total(self):
+        return self.pending
+
+    def invalidate_inventories(self):
+        self.invalidations += 1
+
+    def flush_parked(self):
+        self.parked_flushes += 1
+
+
+class FakeFleet:
+    def __init__(self, cfg, n):
+        self.fleet_cfg = cfg
+        self.replicas = [FakeReplica(i) for i in range(n)]
+        self.router = FakeRouter()
+        self.spawn_error = None
+        self.released = []
+
+    def spawn_engine_replica(self, rid):
+        if self.spawn_error is not None:
+            raise self.spawn_error
+        return FakeReplica(rid)
+
+    def adopt_replica(self, r, endpoint=None):
+        self.replicas.append(r)
+
+    def release_replica(self, rid):
+        self.released.append(rid)
+        self.replicas = [x for x in self.replicas if x.replica_id != rid]
+
+
+def make_scaler(n=2, **cfg_kw):
+    kw = dict(replicas=n, autoscale=True, autoscale_min_replicas=1,
+              autoscale_max_replicas=4,
+              autoscale_up_queue_per_replica=2.0,
+              autoscale_down_queue_per_replica=0.25,
+              autoscale_hysteresis_polls=2, autoscale_cooldown_polls=0,
+              autoscale_spawn_timeout_s=5.0)
+    kw.update(cfg_kw)
+    cfg = FleetConfig(**kw)
+    cfg.validate()
+    fleet = FakeFleet(cfg, n)
+    return fleet, asc.FleetAutoscaler(fleet, cfg)
+
+
+def test_scale_up_needs_hysteresis_then_fires():
+    fleet, a = make_scaler()
+    fleet.router.pending = 10          # 5 per replica, over the 2.0 bar
+    a.poll(now=0.0)
+    assert len(fleet.replicas) == 2    # streak 1 of 2: no action yet
+    a.poll(now=0.1)
+    assert len(fleet.replicas) == 3
+    assert a.total_scale_ups == 1
+    assert [e["kind"] for e in a.events] == ["scale_up"]
+    # one bursty poll alone must never scale
+    fleet2, a2 = make_scaler()
+    fleet2.router.pending = 100
+    a2.poll(now=0.0)
+    assert len(fleet2.replicas) == 2
+
+
+def test_scale_up_respects_ceiling():
+    fleet, a = make_scaler(autoscale_max_replicas=2)
+    fleet.router.pending = 100
+    for i in range(6):
+        a.poll(now=0.1 * i)
+    assert len(fleet.replicas) == 2
+    assert a.total_scale_ups == 0
+
+
+def test_default_ceiling_is_twice_provisioned():
+    _, a = make_scaler(n=3, autoscale_max_replicas=0)
+    assert a.ceiling() == 6
+
+
+def test_idle_scale_down_flushes_store_and_respects_floor():
+    fleet, a = make_scaler()
+    a.poll(now=0.0)
+    a.poll(now=0.1)                    # down streak reaches hysteresis
+    assert fleet.replicas[1].drain_requested
+    assert a._retiring == 1            # LIFO: highest id retires first
+    assert fleet.router.invalidations == 1
+    fleet.replicas[1].state = replica_mod.DRAINED
+    fleet.replicas[1].store_flush_pages = 7
+    a.poll(now=0.2)
+    assert fleet.released == [1]
+    assert [r.replica_id for r in fleet.replicas] == [0]
+    assert a.total_scale_downs == 1
+    down = [e for e in a.events if e["kind"] == "scale_down"]
+    assert down and down[0]["flushed_pages"] == 7
+    # floor: the last replica never retires
+    for i in range(6):
+        a.poll(now=1.0 + 0.1 * i)
+    assert len(fleet.replicas) == 1
+    assert not fleet.replicas[0].drain_requested
+
+
+def test_busy_fleet_never_scales_down():
+    fleet, a = make_scaler()
+    for r in fleet.replicas:
+        r.active = 1                   # under the queue bar but not idle
+    for i in range(6):
+        a.poll(now=0.1 * i)
+    assert a.total_scale_downs == 0
+    assert not any(r.drain_requested for r in fleet.replicas)
+
+
+def test_retire_rollback_on_crash_mid_drain():
+    fleet, a = make_scaler()
+    a.poll(now=0.0)
+    a.poll(now=0.1)
+    assert a._retiring == 1
+    fleet.replicas[1].state = replica_mod.CRASHED
+    a.poll(now=0.2)
+    assert a._retiring is None
+    assert a.total_retire_rollbacks == 1
+    assert a.total_scale_downs == 0
+    assert fleet.released == []        # crash path owns it, not us
+    assert any(e["kind"] == "retire_rollback" for e in a.events)
+
+
+def test_retire_rollback_on_drain_timeout_undrains():
+    fleet, a = make_scaler(autoscale_spawn_timeout_s=2.0)
+    a.poll(now=0.0)
+    a.poll(now=0.1)
+    victim = fleet.replicas[1]
+    assert victim.drain_requested
+    a.poll(now=5.0)                    # way past the 2s deadline
+    assert a.total_retire_rollbacks == 1
+    assert not victim.drain_requested  # undrained, back in rotation
+    assert fleet.router.parked_flushes == 1
+
+
+def test_spawn_failure_counted_and_rolled_back():
+    fleet, a = make_scaler(autoscale_cooldown_polls=4)
+    fleet.spawn_error = RuntimeError("engine build exploded")
+    fleet.router.pending = 100
+    for i in range(6):                 # born-in-cooldown (4) + streak (2)
+        a.poll(now=0.1 * i)
+    assert a.total_spawn_failures == 1
+    assert a.total_scale_ups == 0
+    assert len(fleet.replicas) == 2
+    assert a._cooldown == 4            # failure also starts a cooldown
+    assert any(e["kind"] == "spawn_failure" for e in a.events)
+
+
+def test_spawn_ids_are_monotone_never_reused():
+    fleet, a = make_scaler()
+    fleet.router.pending = 10
+    a.poll(now=0.0)
+    a.poll(now=0.1)
+    assert {r.replica_id for r in fleet.replicas} == {0, 1, 2}
+    # fade: the spawned replica (highest id, spawned-first ranking)
+    # retires...
+    fleet.router.pending = 0
+    a.poll(now=0.2)
+    a.poll(now=0.3)
+    assert a._retiring == 2
+    next(r for r in fleet.replicas
+         if r.replica_id == 2).state = replica_mod.DRAINED
+    a.poll(now=0.4)
+    assert {r.replica_id for r in fleet.replicas} == {0, 1}
+    # ...and the next surge must NOT resurrect id 2: a retired id's
+    # ledger/store residue (and the fleet's pre-warmed spare pool ids)
+    # assume ids never come back
+    fleet.router.pending = 10
+    a.poll(now=0.5)
+    a.poll(now=0.6)
+    assert {r.replica_id for r in fleet.replicas} == {0, 1, 3}
+
+
+def test_born_in_cooldown_defers_first_decision():
+    fleet, a = make_scaler(autoscale_cooldown_polls=3)
+    for i in range(3):                 # idle fleet, but settling
+        a.poll(now=0.1 * i)
+        assert not any(r.drain_requested for r in fleet.replicas)
+    a.poll(now=0.4)
+    a.poll(now=0.5)                    # hysteresis met after cooldown
+    assert any(r.drain_requested for r in fleet.replicas)
+
+
+def test_preemption_migrates_longest_best_effort_victim():
+    fleet, a = make_scaler(interactive_ttft_target_ms=100.0)
+    hot, cold = fleet.replicas
+    hot.interactive_wait_ms = 500.0
+    hot.residents = [("be-short", 4, "best-effort"),
+                     ("be-long", 40, "best-effort"),
+                     ("std", 99, "standard")]
+    hot.queue = 1                      # keeps the down branch quiet
+    a.poll(now=0.0)
+    assert a.total_preemptions == 1
+    assert hot.migrated == [("be-long", cold.replica_id, "preempt")]
+    ev = [e for e in a.events if e["kind"] == "preempt"]
+    assert ev and ev[0]["request"] == "be-long"
+
+
+def test_preemption_never_touches_protected_classes():
+    fleet, a = make_scaler(interactive_ttft_target_ms=100.0)
+    hot = fleet.replicas[0]
+    hot.interactive_wait_ms = 500.0
+    hot.residents = [("std", 40, "standard"), ("ia", 10, "interactive")]
+    a.poll(now=0.0)
+    assert a.total_preemptions == 0
+    assert hot.migrated == []
+
+
+def test_preemption_needs_a_sibling_and_a_target():
+    # single replica: nowhere to migrate to, so the guard must not fire
+    fleet, a = make_scaler(n=1, interactive_ttft_target_ms=100.0)
+    r = fleet.replicas[0]
+    r.interactive_wait_ms = 500.0
+    r.residents = [("be", 40, "best-effort")]
+    a.poll(now=0.0)
+    assert a.total_preemptions == 0
+    # target disabled (0): never preempts no matter the wait
+    fleet2, a2 = make_scaler(interactive_ttft_target_ms=0.0)
+    fleet2.replicas[0].interactive_wait_ms = 9999.0
+    fleet2.replicas[0].residents = [("be", 40, "best-effort")]
+    a2.poll(now=0.0)
+    assert a2.total_preemptions == 0
+
+
+def test_reset_counters_restarts_cooldown_and_clock():
+    fleet, a = make_scaler(autoscale_cooldown_polls=5)
+    fleet.router.pending = 100
+    for i in range(7):                 # burn cooldown, then scale
+        a.poll(now=0.1 * i)
+    assert a.total_scale_ups == 1
+    a.reset_counters()
+    assert a.total_scale_ups == 0
+    assert list(a.events) == []
+    assert a._cooldown == 5            # measured windows settle first
+
+
+def test_snapshot_shape():
+    fleet, a = make_scaler()
+    snap = a.snapshot()
+    assert snap["enabled"] is True
+    assert snap["replicas"] == 2
+    assert snap["floor"] == 1 and snap["ceiling"] == 4
+    for k in ("scale_ups", "scale_downs", "spawn_failures",
+              "retire_rollbacks", "preemptions", "events"):
+        assert k in snap

@@ -429,12 +429,14 @@ class TestLoadgenRetryAfter:
             self.retry_after_s = retry_after_s
             self.accepted: list = []
 
-        def submit(self, prompt, sampling, on_complete=None):
+        def submit(self, prompt, sampling, on_complete=None,
+                   priority="standard"):
             if self.fail_times > 0:
                 self.fail_times -= 1
                 raise self._exc("saturated", self.retry_after_s)
             req = Request(request_id=f"ok-{len(self.accepted)}",
-                          prompt_tokens=list(prompt), sampling=sampling)
+                          prompt_tokens=list(prompt), sampling=sampling,
+                          priority=priority)
             self.accepted.append(req)
             return req
 
