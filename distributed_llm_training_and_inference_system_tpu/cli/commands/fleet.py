@@ -372,6 +372,23 @@ def migrate(request_id, replica, url):
                    "doubles per consecutive crash.")
 @click.option("--migrate-on-drain/--no-migrate-on-drain", default=True,
               show_default=True)
+@click.option("--store-endpoint", default="",
+              help="Base URL of a `llmctl fleet store` service. This "
+                   "worker demotes evicted prefix pages there and "
+                   "restores store-held pages from it (the networked "
+                   "KV fabric).")
+@click.option("--weights-from-store", is_flag=True, default=False,
+              help="Bootstrap engine weights from the store service "
+                   "instead of a local artifact — a bare host needs "
+                   "only --store-endpoint. The fetch is chunk-CRC'd "
+                   "and (with --weights-spool) resumable across a "
+                   "mid-ship kill.")
+@click.option("--weights-name", default="",
+              help="Checkpoint name in the store (default: --model).")
+@click.option("--weights-spool", default="",
+              help="Directory where fetched weight chunks persist as "
+                   "they arrive; a respawned worker RESUMES its fetch "
+                   "from the verified spool instead of restarting.")
 @click.option("--fault-plan", default="",
               help="JSON FaultPlan for deterministic chaos (testing): "
                    "e.g. '{\"seed\": 5, \"chunk_drop_rate\": 0.2}'.")
@@ -381,7 +398,8 @@ def worker(model_name, artifact, replica_id, role, host, port,
            param_seed, courier_codec, courier_chunk_bytes,
            courier_retries, courier_deadline_ms, courier_backoff_ms,
            courier_backoff_max_ms, ticket_ttl_ms, restart_backoff,
-           migrate_on_drain, fault_plan):
+           migrate_on_drain, store_endpoint, weights_from_store,
+           weights_name, weights_spool, fault_plan):
     """Run ONE fleet replica as its own OS process behind an HTTP front.
 
     The cross-host half of `llmctl serve start --fleet-remote-replicas`:
@@ -423,7 +441,10 @@ def worker(model_name, artifact, replica_id, role, host, port,
         courier_chunk_deadline_ms=courier_deadline_ms,
         courier_retry_backoff_ms=courier_backoff_ms,
         courier_retry_backoff_max_ms=courier_backoff_max_ms,
-        courier_ticket_ttl_ms=ticket_ttl_ms)
+        courier_ticket_ttl_ms=ticket_ttl_ms,
+        kv_store_endpoint=store_endpoint,
+        # the fetch plane is how store-held pages restore locally
+        prefix_fetch=bool(store_endpoint))
     fleet_cfg.validate()
     plan = None
     if fault_plan:
@@ -435,6 +456,29 @@ def worker(model_name, artifact, replica_id, role, host, port,
     if param_seed >= 0:
         from ...models import init as model_init
         params = model_init(model_cfg, jax.random.PRNGKey(param_seed))
+    elif weights_from_store:
+        # bare-host bootstrap: the checkpoint arrives over the same
+        # courier fabric the KV pages ride — chunk-CRC'd, end-to-end
+        # verified, spool-resumable. A store that is down or does not
+        # hold the name fails the BOOT loudly, naming the endpoint.
+        if not store_endpoint:
+            raise click.ClickException(
+                "--weights-from-store needs --store-endpoint")
+        import jax.numpy as jnp
+
+        from ...serve.fleet.weights import WeightCourier, WeightShipError
+        wc = WeightCourier(fleet_cfg, spool_dir=weights_spool)
+        try:
+            tree = wc.fetch(weights_name or model_name)
+        except WeightShipError as e:
+            raise click.ClickException(str(e))
+
+        def _to_jax(node):
+            if isinstance(node, dict):
+                return {k: _to_jax(v) for k, v in node.items()}
+            return jnp.asarray(node)
+
+        params = _to_jax(tree)
     w = FleetWorker(replica_id, model_cfg, serve_cfg,
                     fleet_cfg=fleet_cfg, role=role, params=params,
                     seed=seed, fault_plan=plan)
@@ -541,3 +585,107 @@ def front(model_name, artifact, front_id, host, port, replicas,
             raise click.ClickException(f"bad --fault-plan JSON: {e}")
     run_front(model_cfg, serve_cfg, fleet_cfg,
               front_id=front_id or None, fault_plan=plan)
+
+
+@app.command()
+@click.option("--host", default="127.0.0.1", show_default=True)
+@click.option("--port", default=0, show_default=True, type=int,
+              help="0 binds an ephemeral port; the bound port is "
+                   "printed as 'LLMCTL_STORE_READY port=N'.")
+@click.option("--dram-mb", default=256.0, show_default=True, type=float,
+              help="DRAM ring capacity, in MB of compressed frames "
+                   "(LRU; overflow spills to --dir or drops the "
+                   "oldest).")
+@click.option("--dir", "spill_dir", default="", show_default=True,
+              help="Disk-spill directory (empty = DRAM only).")
+@click.option("--disk-mb", default=1024.0, show_default=True,
+              type=float, help="Disk-spill capacity bound.")
+@click.option("--ttl-ms", default=0.0, show_default=True, type=float,
+              help="Expire entries nobody fetched for this long "
+                   "(0 = keep until capacity pressure evicts).")
+@click.option("--courier-codec", default="none", show_default=True,
+              type=click.Choice(["none", "zlib", "delta-zlib"]),
+              help="Codec newly-admitted frames are encoded with when "
+                   "a client demotes raw pages ('none' stores zlib "
+                   "anyway — a resident tier holding uncompressed "
+                   "frames would waste its ring).")
+@click.option("--courier-chunk-bytes", default=256 * 1024,
+              show_default=True, type=int)
+def store(host, port, dram_mb, spill_dir, disk_mb, ttl_ms,
+          courier_codec, courier_chunk_bytes):
+    """Run the fleet KV store as its own OS process — the networked
+    KV fabric's hub.
+
+    Serves the same tiered DRAM/disk page cache `--fleet-kv-store`
+    embeds in a front, behind HTTP: replicas and fronts DEMOTE
+    already-encoded courier frames here (per-frame CRC verified at
+    admission), fetches replay them byte-identically through the
+    caller's courier receiver, and checkpoints ship through the
+    /store/weights/* surface so bare `--weights-from-store` workers
+    bootstrap over the wire. Loses nothing on client death and no
+    client loses correctness on ITS death — a dead store degrades
+    every caller to plain re-prefill, counted."""
+    from ...config.schema import FleetConfig
+    from ...serve.fleet.store_service import StoreService
+
+    cfg = FleetConfig(
+        replicas=1, prefix_fetch=True, kv_store=True,
+        kv_store_dram_mb=dram_mb, kv_store_dir=spill_dir,
+        kv_store_disk_mb=disk_mb, kv_store_ttl_ms=ttl_ms,
+        courier_codec=courier_codec,
+        courier_chunk_bytes=courier_chunk_bytes)
+    cfg.validate()
+    StoreService(cfg).run_forever(host=host, port=port)
+
+
+@app.command(name="ship-weights")
+@click.option("--store-endpoint", required=True,
+              help="Base URL of the `llmctl fleet store` service.")
+@click.option("--model", "model_name", default="gpt-125m",
+              show_default=True, help="Model template name.")
+@click.option("--artifact", default="",
+              help="Checkpoint dir or exported weights file to ship "
+                   "(empty with --param-seed -1 errors — shipping "
+                   "random weights must be asked for explicitly).")
+@click.option("--name", "weights_name", default="",
+              help="Name to register the checkpoint under (default: "
+                   "the model name).")
+@click.option("--param-seed", default=-1, show_default=True, type=int,
+              help="Ship PRNG-initialised weights from this seed "
+                   "instead of an artifact (cross-process determinism "
+                   "for tests/dryrun).")
+def ship_weights(store_endpoint, model_name, artifact, weights_name,
+                 param_seed):
+    """Register a checkpoint in the store service over the wire.
+
+    One immutable chunked payload under NAME: chunk-CRC'd in flight,
+    end-to-end CRC at rest, upload-RESUMABLE (re-running after an
+    interrupt ships only the chunks the service does not already
+    hold). `llmctl fleet worker --weights-from-store` then bootstraps
+    bare hosts from it — no shared artifact path anywhere."""
+    import jax
+
+    from ...config.presets import get_model_config
+    from ...serve.fleet.weights import WeightCourier, WeightShipError
+
+    model_cfg = get_model_config(model_name)
+    if param_seed >= 0:
+        from ...models import init as model_init
+        params = model_init(model_cfg, jax.random.PRNGKey(param_seed))
+    elif artifact:
+        from ...config.schema import ServeConfig
+        from ...serve.engine import InferenceEngine
+        serve_cfg = ServeConfig(model=model_name, artifact=artifact)
+        params, model_cfg, _ = InferenceEngine._load_params(
+            model_cfg, serve_cfg, 0, serve_cfg.dtype)
+    else:
+        raise click.ClickException(
+            "ship-weights needs --artifact or --param-seed")
+    wc = WeightCourier(endpoint=store_endpoint)
+    try:
+        out = wc.ship(weights_name or model_name, params)
+    except WeightShipError as e:
+        raise click.ClickException(str(e))
+    click.echo(f"weights {out['name']!r} registered: {out['sent']} "
+               f"chunks sent, {out['skipped']} already held "
+               f"({out['total']} total)")

@@ -292,6 +292,14 @@ def app(ctx):
               type=float,
               help="Expire store entries nobody fetched for this long "
                    "(0 = keep until capacity pressure evicts).")
+@click.option("--fleet-kv-store-endpoint", default="", show_default=True,
+              help="Base URL of a standalone `llmctl fleet store` "
+                   "service. The in-proc tiered store is replaced by a "
+                   "networked client speaking the same courier "
+                   "chunk/fetch protocol, so every front and every "
+                   "remote worker resolve ONE logical store — demoted "
+                   "pages survive any single serving process. Requires "
+                   "--fleet-prefix-fetch.")
 @click.option("--fleet-pipeline-min-tokens", default=0, show_default=True,
               type=int,
               help="Pipelined multi-replica prefill: needs-prefill "
@@ -389,6 +397,24 @@ def app(ctx):
               show_default=True, type=int,
               help="Polls to sit out after any scaling action before "
                    "measuring again (0 = no cooldown).")
+@click.option("--fleet-autoscale-spawn", default="", show_default=True,
+              type=click.Choice(["", "engine", "worker"]),
+              help="What a scale-up adds: 'engine' (default when "
+                   "empty) builds an in-proc replica sharing loaded "
+                   "weights; 'worker' spawns a fresh `llmctl fleet "
+                   "worker` OS process whose argv is synthesized from "
+                   "THIS command's flags — no operator command line. "
+                   "With --fleet-kv-store-endpoint the spawned worker "
+                   "bootstraps its weights from the store service "
+                   "(--weights-from-store), so a bare host joins "
+                   "without any shared artifact path.")
+@click.option("--fleet-autoscale-up-free-page-ratio", default=0.0,
+              show_default=True, type=float,
+              help="Also scale UP when some healthy replica's free "
+                   "KV-page fraction stays below this (page "
+                   "starvation: long residents pin the pool while "
+                   "queues look shallow). 0 disables; queue pressure "
+                   "still applies either way.")
 @click.option("--fleet-autoscale-spawn-timeout-s", default=30.0,
               show_default=True, type=float,
               help="How long a spawned `llmctl fleet worker` may take "
@@ -438,6 +464,7 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
           fleet_prefix_fetch_min_pages, fleet_kv_store,
           fleet_kv_store_dram_mb, fleet_kv_store_dir,
           fleet_kv_store_disk_mb, fleet_kv_store_ttl_ms,
+          fleet_kv_store_endpoint,
           fleet_pipeline_min_tokens, fleet_pipeline_max_stages,
           fleet_pipeline_stage_timeout_ms,
           fleet_inventory_ttl_ms,
@@ -449,6 +476,7 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
           fleet_autoscale_down_queue_per_replica,
           fleet_autoscale_hysteresis_polls,
           fleet_autoscale_cooldown_polls,
+          fleet_autoscale_spawn, fleet_autoscale_up_free_page_ratio,
           fleet_autoscale_spawn_timeout_s,
           fleet_priority_headroom_requests,
           fleet_interactive_ttft_target_ms, stream_abort_on_disconnect):
@@ -520,6 +548,7 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
             kv_store_dir=fleet_kv_store_dir,
             kv_store_disk_mb=fleet_kv_store_disk_mb,
             kv_store_ttl_ms=fleet_kv_store_ttl_ms,
+            kv_store_endpoint=fleet_kv_store_endpoint,
             pipeline_prefill_min_tokens=fleet_pipeline_min_tokens,
             pipeline_prefill_max_stages=fleet_pipeline_max_stages,
             pipeline_prefill_stage_timeout_ms=(
@@ -539,6 +568,9 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
                 fleet_autoscale_down_queue_per_replica),
             autoscale_hysteresis_polls=fleet_autoscale_hysteresis_polls,
             autoscale_cooldown_polls=fleet_autoscale_cooldown_polls,
+            autoscale_spawn=fleet_autoscale_spawn,
+            autoscale_up_free_page_ratio=(
+                fleet_autoscale_up_free_page_ratio),
             autoscale_spawn_timeout_s=fleet_autoscale_spawn_timeout_s,
             priority_headroom_requests=fleet_priority_headroom_requests,
             interactive_ttft_target_ms=fleet_interactive_ttft_target_ms)
@@ -584,6 +616,22 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
 
     server = create_server(model_cfg, serve_cfg, fleet_cfg=fleet_cfg,
                            observer=observer)
+    if fleet_cfg is not None and fleet_cfg.kv_store_endpoint \
+            and fleet_cfg.autoscale_spawn == "worker" \
+            and getattr(server, "fleet", None) is not None:
+        # register the loaded checkpoint in the store service up front,
+        # so autoscaler-spawned bare workers (--weights-from-store)
+        # find it there; idempotent + upload-resumable, so a restart of
+        # this front re-ships nothing already held
+        try:
+            shipped = server.fleet.ship_weights()
+            click.echo(f"weights {shipped['name']!r} registered in "
+                       f"store ({shipped['sent']} chunks sent, "
+                       f"{shipped['skipped']} already held)")
+        except Exception as e:
+            raise click.ClickException(
+                f"weight ship to {fleet_cfg.kv_store_endpoint} failed "
+                f"— spawned workers could not bootstrap: {e}")
     click.echo(f"serving {model_name} on {host}:{port} "
                f"(backend={jax.default_backend()}, dtype={dtype}, "
                f"scheduler={scheduler}"

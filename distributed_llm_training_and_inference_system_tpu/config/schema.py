@@ -758,12 +758,19 @@ def parse_fleet_endpoints(value) -> dict[int, str]:
             items.append((rid, url))
     out: dict[int, str] = {}
     for rid, url in items:
-        try:
-            key = int(str(rid).strip())
-        except ValueError:
-            raise ConfigError(
-                f"fleet endpoint replica id must be an integer, "
-                f"got {rid!r}")
+        rid_s = str(rid).strip()
+        if rid_s.lower() == "store":
+            # the networked KV store service rides the endpoint map
+            # under the KV_STORE_OWNER sentinel (-1) — "store=URL" is
+            # the operator spelling (serve/fleet/store_service.py)
+            key = -1
+        else:
+            try:
+                key = int(rid_s)
+            except ValueError:
+                raise ConfigError(
+                    f"fleet endpoint replica id must be an integer "
+                    f"or 'store', got {rid!r}")
         url = str(url).strip().rstrip("/")
         if not url.startswith(("http://", "https://")):
             raise ConfigError(
@@ -996,6 +1003,14 @@ class FleetConfig:
     # entries nobody fetched for this long are expired (0 = keep until
     # capacity pressure evicts them)
     kv_store_ttl_ms: float = 0.0
+    # networked store backend (serve/fleet/store_service.py): base URL
+    # of a standalone `llmctl fleet store` service. When set, this
+    # front/worker uses a StoreClient against that service instead of
+    # an in-proc FleetKVStore — N fronts and every remote worker then
+    # share ONE logical store (demotions upload the already-encoded
+    # frames; fetches replay them locally through the courier
+    # receiver). "" = in-proc store (kv_store=true) or none.
+    kv_store_endpoint: str = ""
     # -- fleet SSE streaming (serve/fleet/streams.py) ------------------------
     # finished stream logs stay replayable (Last-Event-ID reconnect) for
     # this long before the hub GCs them; live logs never expire. 0 keeps
@@ -1060,6 +1075,20 @@ class FleetConfig:
     # how long a spawned worker process gets to print its ready line
     # (LLMCTL_WORKER_READY port=N) before the spawn is rolled back
     autoscale_spawn_timeout_s: float = 30.0
+    # what a scale-up actually spawns: "" / "engine" = an in-proc
+    # engine replica (warm-spare pool); "worker" = a `llmctl fleet
+    # worker` OS process whose argv `llmctl serve start` synthesizes
+    # from its own model/config flags (including --weights-from-store
+    # when kv_store_endpoint is set — a bare host bootstraps weights
+    # over the courier, no shared artifact path needed)
+    autoscale_spawn: str = ""
+    # pool-pressure scale-up signal: when > 0 and the minimum
+    # free-page ratio (pool_free_pages / pool_total_pages, from the
+    # probes) across healthy replicas falls BELOW this, the fleet
+    # scales up under the same hysteresis as queue pressure — KV
+    # capacity exhausts before queues form under long-context load.
+    # 0 disables (queue-depth-only, the PR-16 behavior).
+    autoscale_up_free_page_ratio: float = 0.0
     # -- SLO priority classes (router admission + preemption) ----------------
     # queue slots (out of max_pending) held back from standard and
     # best-effort admission so interactive requests are still admissible
@@ -1204,6 +1233,16 @@ class FleetConfig:
         if self.kv_store_ttl_ms < 0:
             raise ConfigError(
                 "kv_store_ttl_ms must be >= 0 (0 = no expiry)")
+        if self.kv_store_endpoint and not str(
+                self.kv_store_endpoint).startswith(
+                    ("http://", "https://")):
+            raise ConfigError(
+                f"kv_store_endpoint must be an http(s) base URL, got "
+                f"{self.kv_store_endpoint!r}")
+        if self.kv_store_endpoint and not self.prefix_fetch:
+            raise ConfigError(
+                "kv_store_endpoint needs prefix_fetch — the fetch "
+                "plane is how store-held pages restore to a replica")
         if self.state_compact_every < 0:
             raise ConfigError(
                 "state_compact_every must be >= 0 (0 disables journal "
@@ -1238,7 +1277,9 @@ class FleetConfig:
                     "engines is not stateless")
         endpoints = self.endpoint_map()       # raises on malformed entries
         for rid in endpoints:
-            if not 0 <= rid < self.replicas:
+            # -1 is the KV_STORE_OWNER sentinel: the networked store
+            # service's endpoint rides the same map ("store=URL")
+            if rid != -1 and not 0 <= rid < self.replicas:
                 raise ConfigError(
                     f"fleet endpoint names replica {rid} but the fleet "
                     f"has replicas 0..{self.replicas - 1}")
@@ -1287,6 +1328,15 @@ class FleetConfig:
                 "autoscale_cooldown_polls must be >= 0 (0 = no cooldown)")
         if self.autoscale_spawn_timeout_s <= 0:
             raise ConfigError("autoscale_spawn_timeout_s must be > 0")
+        if self.autoscale_spawn not in ("", "engine", "worker"):
+            raise ConfigError(
+                f"unknown autoscale_spawn {self.autoscale_spawn!r} "
+                f"(engine|worker; empty = engine)")
+        if not 0 <= self.autoscale_up_free_page_ratio < 1:
+            raise ConfigError(
+                "autoscale_up_free_page_ratio must be in [0, 1) — it "
+                "is a fraction of the KV pool (0 disables pool-"
+                "pressure scale-up)")
         if self.autoscale and self.fronts > 1:
             raise ConfigError(
                 "autoscale with fronts > 1 is not supported yet — each "

@@ -182,6 +182,26 @@ METRICS: dict[str, MetricSpec] = {
     "llmctl_fleet_kvstore_bytes": MetricSpec(
         COUNTER, "Compressed wire bytes replayed out of the store on "
                  "fetch hits"),
+    # -- networked KV fabric (standalone `llmctl fleet store`) -------------
+    "llmctl_fleet_kvstore_remote_hits": MetricSpec(
+        COUNTER, "Prefix pages replayed from the standalone store "
+                 "SERVICE into this process (client-side count; the "
+                 "service's own hits ride llmctl_fleet_kvstore_hits)"),
+    "llmctl_fleet_kvstore_remote_misses": MetricSpec(
+        COUNTER, "Store-service fetches that served zero pages here "
+                 "(service unreachable, nothing held, or replay failed "
+                 "verification) — degraded to plain prefill"),
+    "llmctl_fleet_weights_chunks": MetricSpec(
+        COUNTER, "Checkpoint chunks moved through the store service by "
+                 "this process's weight courier (ships + fetches; "
+                 "resumed chunks are NOT re-moved)"),
+    "llmctl_fleet_weights_resumes": MetricSpec(
+        COUNTER, "Weight ships/fetches that resumed a partial transfer "
+                 "instead of restarting (upload: seqs the service "
+                 "already held; download: verified spool records)"),
+    "llmctl_fleet_weights_bytes": MetricSpec(
+        COUNTER, "Wire bytes of checkpoint chunks moved through the "
+                 "store service by this process"),
     # -- pipelined multi-replica prefill -----------------------------------
     "llmctl_fleet_pipeline_prefills": MetricSpec(
         COUNTER, "Long prompts split across the prefill pool as a "
@@ -333,6 +353,8 @@ COUNTER_SNAPSHOT_FN = {
     "FleetStreamHub": ("serve/fleet/streams.py", "stats"),
     "FleetFrontTier": ("serve/fleet/front.py", "snapshot"),
     "FleetKVStore": ("serve/fleet/kv_store.py", "snapshot"),
+    "StoreClient": ("serve/fleet/store_service.py", "snapshot"),
+    "WeightCourier": ("serve/fleet/weights.py", "snapshot"),
     "PipelineCoordinator": ("serve/fleet/pipeline.py", "snapshot"),
     "FleetAutoscaler": ("serve/fleet/autoscaler.py", "snapshot"),
 }
@@ -419,6 +441,22 @@ COUNTER_FLOW: tuple[CounterFlow, ...] = (
                 "llmctl_fleet_kvstore_bytes"),
     CounterFlow("FleetKVStore", "total_bytes_stored", "bytes_stored",
                 None),
+    # networked-store client counters -> StoreClient.snapshot() keys
+    # (the duck stand-in for FleetKVStore when kv_store_endpoint is
+    # set; the service's own counters merge into the same section
+    # under the in-proc keys above)
+    CounterFlow("StoreClient", "total_remote_hits", "remote_hits",
+                "llmctl_fleet_kvstore_remote_hits"),
+    CounterFlow("StoreClient", "total_remote_misses", "remote_misses",
+                "llmctl_fleet_kvstore_remote_misses"),
+    # weight-courier counters -> WeightCourier.snapshot() keys (the
+    # supervisor snapshot embeds the "weights" section wholesale)
+    CounterFlow("WeightCourier", "total_chunks", "chunks",
+                "llmctl_fleet_weights_chunks"),
+    CounterFlow("WeightCourier", "total_resumes", "resumes",
+                "llmctl_fleet_weights_resumes"),
+    CounterFlow("WeightCourier", "total_bytes", "bytes",
+                "llmctl_fleet_weights_bytes"),
     # pipelined-prefill counters -> PipelineCoordinator.snapshot() keys
     # (the supervisor snapshot embeds the section wholesale; the
     # Prometheus pump deltas the mapped ones)

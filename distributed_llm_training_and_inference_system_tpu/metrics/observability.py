@@ -255,6 +255,16 @@ class PrometheusExporter:
         self.fleet_kvstore_evictions = mk(
             "llmctl_fleet_kvstore_evictions")
         self.fleet_kvstore_bytes = mk("llmctl_fleet_kvstore_bytes")
+        # networked KV fabric: the standalone-store client's own view
+        # (serve/fleet/store_service.py) + courier weight distribution
+        # (serve/fleet/weights.py)
+        self.fleet_kvstore_remote_hits = mk(
+            "llmctl_fleet_kvstore_remote_hits")
+        self.fleet_kvstore_remote_misses = mk(
+            "llmctl_fleet_kvstore_remote_misses")
+        self.fleet_weights_chunks = mk("llmctl_fleet_weights_chunks")
+        self.fleet_weights_resumes = mk("llmctl_fleet_weights_resumes")
+        self.fleet_weights_bytes = mk("llmctl_fleet_weights_bytes")
         # pipelined multi-replica prefill (serve/fleet/pipeline.py)
         self.fleet_pipeline_prefills = mk(
             "llmctl_fleet_pipeline_prefills")
@@ -489,12 +499,29 @@ class PrometheusExporter:
                 ("misses", self.fleet_kvstore_misses),
                 ("demotions", self.fleet_kvstore_demotions),
                 ("evictions", self.fleet_kvstore_evictions),
-                ("bytes_served", self.fleet_kvstore_bytes)):
+                ("bytes_served", self.fleet_kvstore_bytes),
+                # networked backend only: the client-side replay/miss
+                # counts (the in-proc store never sets these keys)
+                ("remote_hits", self.fleet_kvstore_remote_hits),
+                ("remote_misses", self.fleet_kvstore_remote_misses)):
             total = ks.get(key, 0)
             delta = total - self._last_totals.get(f"fleet_ks_{key}", 0)
             if delta > 0:
                 counter.inc(delta)
             self._last_totals[f"fleet_ks_{key}"] = total
+        # courier weight distribution: chunks/resumes/bytes this
+        # process moved through the store service (supervisor snapshot
+        # "weights" section; running totals like every fleet counter)
+        wt = snap.get("weights", {})
+        for key, counter in (
+                ("chunks", self.fleet_weights_chunks),
+                ("resumes", self.fleet_weights_resumes),
+                ("bytes", self.fleet_weights_bytes)):
+            total = wt.get(key, 0)
+            delta = total - self._last_totals.get(f"fleet_wt_{key}", 0)
+            if delta > 0:
+                counter.inc(delta)
+            self._last_totals[f"fleet_wt_{key}"] = total
         # pipelined multi-replica prefill: counters on running totals,
         # the stage-latency histogram on the bounded recent window gated
         # by the cumulative stage count (same contract as courier

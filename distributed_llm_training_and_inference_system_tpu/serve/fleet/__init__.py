@@ -44,7 +44,10 @@ from .replica import (ROLE_DECODE, ROLE_MIXED, ROLE_PREFILL, EngineReplica,
                       reset_for_requeue)
 from .front import FleetFrontTier
 from .kv_store import KV_STORE_OWNER, FleetKVStore
-from .autoscaler import (FleetAutoscaler, ProcessWorkerSpawner)
+from .store_service import StoreClient, StoreService
+from .weights import WeightCourier, WeightShipError
+from .autoscaler import (FleetAutoscaler, ProcessWorkerSpawner,
+                         synthesize_worker_argv)
 from .router import (FleetRouter, FleetSaturated, normalize_priority,
                      prefix_digest)
 from .state import (FleetStateStore, InMemoryStateStore,
@@ -88,9 +91,13 @@ __all__ = [
     "ReplicaSupervisor",
     "ServeFleet",
     "SharedFileStateStore",
+    "StoreClient",
     "StoreFenced",
+    "StoreService",
     "TransferAborted",
     "TransportError",
+    "WeightCourier",
+    "WeightShipError",
     "build_state_store",
     "build_transport",
     "is_ticket_stub",
@@ -98,6 +105,7 @@ __all__ = [
     "plan_stages",
     "prefix_digest",
     "reset_for_requeue",
+    "synthesize_worker_argv",
     "ticket_stub",
 ]
 
@@ -143,9 +151,27 @@ class ServeFleet:
         # drain-flushed pages here; the router's hint path falls back to
         # it when no live replica covers a prompt; fetches replay the
         # frames through the courier receiver. None = no store tier.
-        self.kv_store = (FleetKVStore(self.fleet_cfg)
-                         if self.fleet_cfg.kv_store else None)
+        # None = no store tier; with `kv_store_endpoint` set the SAME
+        # logical store lives in a separate `llmctl fleet store`
+        # process and a duck-compatible StoreClient (demote_async /
+        # holds / inventory / fetch / snapshot) stands in for it — the
+        # networked KV fabric: every front and every remote worker
+        # resolve ONE store, so pages survive any single serving
+        # process.
+        if getattr(self.fleet_cfg, "kv_store_endpoint", ""):
+            self.kv_store = StoreClient(self.fleet_cfg)
+        elif self.fleet_cfg.kv_store:
+            self.kv_store = FleetKVStore(self.fleet_cfg)
+        else:
+            self.kv_store = None
         self.courier.kv_store = self.kv_store
+        # weight courier (serve/fleet/weights.py): checkpoints ride the
+        # same store fabric as KV pages — `ship_weights()` registers
+        # the loaded params so bare `--weights-from-store` workers can
+        # bootstrap over the wire.
+        self.weight_courier = (
+            WeightCourier(self.fleet_cfg)
+            if getattr(self.fleet_cfg, "kv_store_endpoint", "") else None)
         # replicable front state (serve/fleet/state.py): the stream logs
         # and router ledger live behind this store. The default
         # in-memory store keeps today's single-front behavior
@@ -221,16 +247,31 @@ class ServeFleet:
         for r in self.replicas:
             self._wire_replica(r)
         # elastic autoscaler (serve/fleet/autoscaler.py): scale up/down
-        # from queue pressure + TTFT-guard preemption, driven from the
-        # supervisor poll. None = fixed fleet (today's default).
-        self.autoscaler = (FleetAutoscaler(self, self.fleet_cfg)
+        # from queue pressure (+ KV-pool pressure) + TTFT-guard
+        # preemption, driven from the supervisor poll. None = fixed
+        # fleet (today's default). `autoscale_spawn = "worker"` scales
+        # up with fresh `llmctl fleet worker` OS processes whose argv
+        # is synthesized from THIS process's config — no operator
+        # command line needed.
+        spawner = None
+        if self.fleet_cfg.autoscale and \
+                getattr(self.fleet_cfg, "autoscale_spawn",
+                        "") == "worker":
+            spawner = ProcessWorkerSpawner(
+                synthesize_worker_argv(
+                    self.model_cfg, self.serve_cfg, self.fleet_cfg,
+                    weights_name=self.serve_cfg.model),
+                spawn_timeout_s=self.fleet_cfg
+                .autoscale_spawn_timeout_s)
+        self.autoscaler = (FleetAutoscaler(self, self.fleet_cfg,
+                                           spawner=spawner)
                            if self.fleet_cfg.autoscale else None)
         self.supervisor = ReplicaSupervisor(
             self.replicas, self.router, self.fleet_cfg,
             injector=self.injector, params=params, observer=observer,
             streams=self.streams, store=self.store,
             kv_store=self.kv_store, pipeline=self.pipeline,
-            autoscaler=self.autoscaler)
+            autoscaler=self.autoscaler, weights=self.weight_courier)
         self._supervise = supervise
         # warm-spare pool: in-proc provisioning time IS XLA compile
         # time, and paying it on the supervisor thread mid-burst would
@@ -433,6 +474,26 @@ class ServeFleet:
 
     def _on_request_exit(self, replica_id: int, req: Request) -> None:
         self.router.on_request_exit(replica_id, req)
+
+    def ship_weights(self, name: str = "") -> dict:
+        """Register this fleet's loaded checkpoint in the store service
+        (default name: the model name) so bare hosts — `llmctl fleet
+        worker --weights-from-store`, including autoscaler-spawned ones
+        — bootstrap over the wire instead of a shared artifact path.
+        Idempotent and upload-resumable; raises
+        :class:`~.weights.WeightShipError` naming the endpoint when the
+        service is unreachable."""
+        if self.weight_courier is None:
+            raise RuntimeError(
+                "ship_weights needs kv_store_endpoint — no store "
+                "service is configured for this fleet")
+        if self._params is None:
+            raise RuntimeError(
+                "ship_weights: this front holds no loaded params "
+                "(all replicas remote) — ship from the process that "
+                "loaded the checkpoint, or `llmctl fleet ship-weights`")
+        return self.weight_courier.ship(name or self.serve_cfg.model,
+                                        self._params)
 
     # -- HA front tier seams -------------------------------------------------
 

@@ -65,6 +65,46 @@ PREEMPTIBLE_CLASS = "best-effort"
 PROTECTED_CLASS = "interactive"
 
 
+def synthesize_worker_argv(model_cfg, serve_cfg, fleet_cfg,
+                           weights_name: str = "",
+                           spool_dir: str = "") -> list:
+    """Worker command line synthesized from the serving process's OWN
+    config — ``llmctl serve start --fleet-autoscale-spawn worker``
+    builds its :class:`ProcessWorkerSpawner` from this, so elastic
+    worker scale-up needs no operator-provided argv. Mirrors the flag
+    surface of ``llmctl fleet worker``; ``--replica-id`` and ``--port``
+    are appended per spawn by the spawner. When the fleet has a store
+    service (``kv_store_endpoint``), the spawned worker bootstraps its
+    weights over the wire (``--weights-from-store``) — a bare host
+    needs no shared artifact path."""
+    import sys
+    pkg = __name__.split(".")[0]
+    argv = [sys.executable, "-m", f"{pkg}.cli.main", "fleet", "worker",
+            "--model", str(serve_cfg.model),
+            "--max-batch-size", str(serve_cfg.max_batch_size),
+            "--max-seq-len", str(serve_cfg.max_seq_len),
+            "--kv-block-size", str(serve_cfg.kv_block_size),
+            "--dtype", str(serve_cfg.dtype),
+            "--kv-quantization", str(serve_cfg.kv_quantization),
+            "--courier-codec", str(fleet_cfg.courier_codec),
+            "--courier-chunk-bytes", str(fleet_cfg.courier_chunk_bytes)]
+    if serve_cfg.artifact:
+        argv += ["--artifact", str(serve_cfg.artifact)]
+    if getattr(serve_cfg, "prefill_chunk", 0):
+        argv += ["--prefill-chunk", str(serve_cfg.prefill_chunk)]
+    if getattr(serve_cfg, "speculative", "off") != "off":
+        argv += ["--speculative", str(serve_cfg.speculative),
+                 "--spec-tokens", str(serve_cfg.speculative_tokens)]
+    store_ep = str(getattr(fleet_cfg, "kv_store_endpoint", "") or "")
+    if store_ep:
+        argv += ["--store-endpoint", store_ep, "--weights-from-store"]
+        if weights_name:
+            argv += ["--weights-name", str(weights_name)]
+        if spool_dir:
+            argv += ["--weights-spool", str(spool_dir)]
+    return argv
+
+
 class ProcessWorkerSpawner:
     """Spawns ``llmctl fleet worker`` OS processes for scale-up.
 
@@ -236,16 +276,21 @@ class FleetAutoscaler:
             return
         pending = self.fleet.router.pending_total()
         per = pending / float(len(healthy))
-        if per > self.cfg.autoscale_up_queue_per_replica \
+        queue_pressure = per > self.cfg.autoscale_up_queue_per_replica
+        pool_pressure, min_free = self._pool_pressure(healthy)
+        if (queue_pressure or pool_pressure) \
                 and len(replicas) < self.ceiling():
             self._down_streak = 0
             self._up_streak += 1
             if self._up_streak >= self.cfg.autoscale_hysteresis_polls:
-                self._scale_up()
+                self._scale_up(
+                    reason="queue" if queue_pressure else "pool",
+                    free_page_ratio=min_free)
             return
         idle = [r for r in healthy
                 if r.queue_depth() == 0 and r.active_count() == 0]
         if per < self.cfg.autoscale_down_queue_per_replica and idle \
+                and not pool_pressure \
                 and len(healthy) > self.floor():
             self._up_streak = 0
             self._down_streak += 1
@@ -255,10 +300,41 @@ class FleetAutoscaler:
         self._up_streak = 0
         self._down_streak = 0
 
+    @supervisor_thread
+    def _pool_pressure(self, healthy: list) -> tuple:
+        """KV-pool pressure vote: the MIN free-page ratio across healthy
+        replicas against ``autoscale_up_free_page_ratio``. Queue depth
+        alone misses page starvation — long residents can pin the pool
+        while admission queues stay shallow (every new prompt waits on
+        pages, not slots), so pool pressure feeds scale-up alongside
+        queue pressure and vetoes scale-down. Replicas without a pool
+        surface (stale remote mirrors, test fakes) simply don't vote;
+        0 disables the signal. Returns ``(pressured, min_ratio)``."""
+        thresh = float(getattr(self.cfg, "autoscale_up_free_page_ratio",
+                               0.0) or 0.0)
+        if thresh <= 0.0:
+            return False, None
+        ratios = []
+        for r in healthy:
+            fn = getattr(r, "pool_free_ratio", None)
+            if fn is None:
+                continue
+            try:
+                v = fn()
+            except Exception:
+                continue
+            if v is not None:
+                ratios.append(float(v))
+        if not ratios:
+            return False, None
+        lo = min(ratios)
+        return lo < thresh, round(lo, 4)
+
     # -- scale-up ------------------------------------------------------------
 
     @supervisor_thread
-    def _scale_up(self) -> None:
+    def _scale_up(self, reason: str = "queue",
+                  free_page_ratio=None) -> None:
         self._up_streak = 0
         rid = max(self._next_spawn_id,
                   max((r.replica_id for r in self.fleet.replicas),
@@ -303,11 +379,15 @@ class FleetAutoscaler:
         self._spawned.add(rid)
         self.total_scale_ups += 1
         self._cooldown = int(self.cfg.autoscale_cooldown_polls)
-        self._event("scale_up", rid,
-                    kindof="remote" if endpoint else "engine")
-        logger.info("autoscaler: scaled UP — replica %d joined (%s), "
-                    "fleet now %d", rid,
-                    endpoint or "in-proc", len(self.fleet.replicas))
+        extra = {"kindof": "remote" if endpoint else "engine",
+                 "reason": reason}
+        if free_page_ratio is not None:
+            extra["free_page_ratio"] = free_page_ratio
+        self._event("scale_up", rid, **extra)
+        logger.info("autoscaler: scaled UP — replica %d joined (%s, "
+                    "%s pressure), fleet now %d", rid,
+                    endpoint or "in-proc", reason,
+                    len(self.fleet.replicas))
 
     # -- scale-down ----------------------------------------------------------
 

@@ -51,6 +51,7 @@ import os
 import threading
 import time
 import uuid
+import zlib
 from collections import OrderedDict
 from typing import Optional
 
@@ -262,6 +263,80 @@ class FleetKVStore:
             self.total_bytes_stored += wire
             self._enforce_caps_locked()
         return True
+
+    # -- networked-store seams (serve/fleet/store_service.py) ----------------
+
+    @thread_seam
+    def admit_frames(self, h: bytes, frames: list, manifest: dict,
+                     raw_bytes: int) -> bool:
+        """Admit one page's ALREADY-ENCODED courier frames — the store
+        service's demote path. The frames were encoded once by the
+        demoting front/worker; admitting them verifies each frame CRC
+        (a frame corrupted on the upload wire is a counted rejection,
+        never stored) and never recompresses. Returns True when newly
+        stored, False for duplicates/corruption."""
+        for _seq, _total, crc, data in frames:
+            if zlib.crc32(data) != crc:
+                with self._lock:
+                    self.total_corrupt += 1
+                logger.warning("kv store admit %s rejected: frame CRC "
+                               "mismatch on upload", h.hex())
+                return False
+        wire = sum(len(data) for _s, _t, _c, data in frames)
+        entry = _Entry(list(frames), manifest, wire, int(raw_bytes),
+                       time.monotonic())
+        with self._lock:
+            self._gc_locked(entry.born)
+            if h in self._dram or h in self._disk:
+                self.total_duplicates += 1
+                return False
+            self._dram[h] = entry
+            self.dram_bytes += wire
+            self.total_demotions += 1
+            self.total_bytes_stored += wire
+            self._enforce_caps_locked()
+        return True
+
+    @thread_seam
+    def export_frames(self, hashes: list) -> list:
+        """The store service's fetch path: the longest held prefix of
+        ``hashes`` as ``(hex_hash, manifest, frames, wire_bytes)`` rows,
+        frames byte-identical to what was admitted — the FETCHER replays
+        them through its own CourierReceiver, so verification happens at
+        the destination exactly like a live transfer. Hits and served
+        bytes are counted here (the serving side); an empty result is a
+        counted miss."""
+        out = []
+        for h in hashes:
+            h = bytes(h)
+            now = time.monotonic()
+            with self._lock:
+                self._gc_locked(now)
+                entry = self._dram.get(h)
+                if entry is not None:
+                    self._dram.move_to_end(h)
+                    frames = list(entry.frames)
+                else:
+                    entry = self._disk.get(h)
+                    if entry is None:
+                        break
+                    self._disk.move_to_end(h)
+                    frames = self._load_disk_frames(entry)
+                    if frames is None:
+                        self._disk.pop(h, None)
+                        self.disk_bytes -= entry.wire_bytes
+                        self._unlink(entry.path)
+                        self.total_corrupt += 1
+                        self.total_evictions += 1
+                        break
+                self.total_hits += 1
+                self.total_bytes_served += entry.wire_bytes
+                out.append((h.hex(), entry.manifest, frames,
+                            entry.wire_bytes))
+        if not out:
+            with self._lock:
+                self.total_misses += 1
+        return out
 
     # -- capacity / tiering --------------------------------------------------
 

@@ -476,6 +476,17 @@ class RemoteReplica:
         need = -(-(len(req.context_tokens) + look) // ps)
         return need <= free
 
+    def pool_free_ratio(self):
+        """Probe-stale mirror of the worker's free-pool fraction; None
+        before the first probe or when the worker has no pool facts —
+        an unprobed remote must not vote pool pressure."""
+        with self._lock:
+            total = int(self._cache.get("pool_total_pages", 0) or 0)
+            free = int(self._cache.get("pool_free_pages", 0) or 0)
+        if total <= 0:
+            return None
+        return max(free, 0) / float(total)
+
     def migrations_in_flight(self) -> int:
         return int(self._cache.get("migrations_in_flight", 0))
 

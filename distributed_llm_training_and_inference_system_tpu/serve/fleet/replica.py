@@ -796,10 +796,25 @@ class EngineReplica:
             "restarts": self.restarts,
             "pool_free_pages": (int(kv.free_pages - eng._reserved_pages)
                                 if kv is not None else 0),
+            "pool_total_pages": (int(kv.num_pages)
+                                 if kv is not None else 0),
             "pool_page_size": int(kv.page_size) if kv is not None else 0,
             "pool_lookahead": (int(eng._decode_lookahead)
                                if kv is not None else 0),
         }
+
+    @thread_seam
+    def pool_free_ratio(self):
+        """Free fraction of the KV pool (net of admission reserves), or
+        ``None`` when there is no pool to measure. Lock-free advisory
+        read — the autoscaler's pool-pressure vote, where a stale value
+        costs one poll of hysteresis, never correctness."""
+        eng = self.engine
+        kv = getattr(eng, "kv", None)
+        if kv is None or int(kv.num_pages) <= 0:
+            return None
+        free = max(int(kv.free_pages - eng._reserved_pages), 0)
+        return free / float(kv.num_pages)
 
     @thread_seam
     def request_drain(self) -> None:

@@ -146,6 +146,15 @@ class FleetRouter:
             self._endpoints = self.cfg.endpoint_map()
         except Exception:
             self._endpoints = {}
+        # networked store service: its endpoint joins the map under the
+        # KV_STORE_OWNER sentinel (whether configured as "store=URL" in
+        # fleet_endpoints or as kv_store_endpoint), so store hints are
+        # honorable by REMOTE destinations too — the worker fetches
+        # straight from the service, closing the item-2 skip gap.
+        _store_ep = str(getattr(self.cfg, "kv_store_endpoint", "")
+                        or "").rstrip("/")
+        if _store_ep:
+            self._endpoints.setdefault(KV_STORE_OWNER, _store_ep)
         # inventory TTL cache (PR-7 named gap): > 0 bounds how often the
         # hint path re-reads every replica's prefix-page inventory.
         # Invalidated wholesale on replica teardown/drain/undrain/
@@ -430,15 +439,20 @@ class FleetRouter:
             if c > best_cov or (c == best_cov and best is not None
                                 and rid < best):
                 best, best_cov = rid, c
-        # store fall-back: strictly-better coverage only, in-proc dest
+        # store fall-back: strictly-better coverage only. A remote
+        # destination can only honor the hint when the store is the
+        # NETWORKED service (its endpoint rides the fleet map under
+        # the KV_STORE_OWNER sentinel) — an in-proc store is this
+        # process's heap and unreachable from a worker.
         if KV_STORE_OWNER in invs:
             c = coverage(invs[KV_STORE_OWNER])
             if c > best_cov:
-                if getattr(self.by_id.get(dest_id), "remote", False):
+                if getattr(self.by_id.get(dest_id), "remote", False) \
+                        and not self._endpoints.get(KV_STORE_OWNER):
                     # the store would have won but a remote worker
-                    # cannot reach this process's store tier — counted
-                    # (ROADMAP item-2 gap), hint falls back to the best
-                    # live owner
+                    # cannot reach this process-local store tier —
+                    # counted (the pre-service ROADMAP item-2 gap),
+                    # hint falls back to the best live owner
                     with self._lock:
                         self.total_store_hint_remote_skips += 1
                 else:

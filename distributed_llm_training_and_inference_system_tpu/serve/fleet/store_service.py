@@ -1,0 +1,618 @@
+"""`llmctl fleet store`: the tiered fleet KV store as its own service.
+
+PR 13's :class:`~.kv_store.FleetKVStore` made demoted prefix pages
+outlive any replica's HBM — but only within ONE control-plane process.
+N HA fronts ran N independent stores, remote workers could not reach
+any of them (the counted ``store_hint_remote_skips`` gap), and a
+freshly spawned host still needed a shared artifact path just to load
+weights. Mooncake's (FAST '25 — PAPERS.md) actual claim is stronger:
+the pooled DRAM/SSD KV cache is a *cluster-durable* unit, a service,
+not a per-process cache. This module promotes the store accordingly:
+
+- :class:`StoreService` — an aiohttp process embedding a
+  :class:`FleetKVStore` and speaking the existing courier frame
+  contract: **demote** is an upload of the ALREADY-ENCODED, per-frame
+  CRC'd chunks (encoded once by the demoting front/worker, verified at
+  admission, never recompressed), and **fetch** returns those frames
+  byte-identical for the fetcher to replay through its own shared
+  :class:`CourierReceiver` — the same frame-CRC + end-to-end raw-CRC +
+  decode path every live transfer rides, so a frame corrupted at rest
+  or on the wire is a counted miss at the destination, never wrong KV.
+- :class:`StoreClient` — the front/worker side: a duck pair of
+  ``FleetKVStore`` (``demote_async`` / ``demote`` / ``flush_pending`` /
+  ``inventory`` / ``holds`` / ``fetch`` / ``clear`` / ``snapshot``), so
+  router hints, the eviction demote seam, drain-flush, and the
+  returning-conversation fetch are backend-agnostic: ``ServeFleet``
+  picks the in-proc store or this client purely from
+  ``FleetConfig.kv_store_endpoint``.
+- The store is advertised in ``fleet_endpoints`` under the
+  ``KV_STORE_OWNER`` sentinel (``fleet_endpoints = {"store": url}`` or
+  ``{-1: url}``), so every front and every remote worker resolve ONE
+  logical store; the router stamps store hints for remote destinations
+  too once the sentinel has an endpoint.
+- **Weight distribution** rides the same fabric: the service keeps a
+  named ledger of immutable chunked/CRC'd checkpoint payloads
+  (``/store/weights/*``) with per-chunk upload resume and per-chunk
+  serve counts, so ``llmctl fleet worker --weights-from-store``
+  bootstraps a bare host over the wire and a mid-ship kill RESUMES
+  instead of restarting (serve/fleet/weights.py holds both couriers).
+
+Degrade semantics are unchanged from the in-proc store: an unreachable
+or killed service is a counted remote miss and the destination
+prefills plainly — degraded, never wrong tokens.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.error
+import urllib.request
+import uuid
+import zlib
+from base64 import b64decode, b64encode
+from collections import OrderedDict
+from typing import Optional
+
+from ...analysis.annotations import aiohttp_handler, thread_seam
+from ..kv_cache import concat_page_payloads
+from .kv_store import FleetKVStore, _page_slice
+from .transport import (CODEC_NONE, CODEC_ZLIB, CourierChunk,
+                        encode_payload, make_chunks)
+
+__all__ = ["StoreClient", "StoreService"]
+
+logger = logging.getLogger("llmctl.serve.fleet.store_service")
+
+
+def _frames_to_wire(frames: list) -> list:
+    """(seq, total, crc, data) rows -> JSON-able [seq, total, crc, b64]."""
+    return [[seq, total, crc, b64encode(data).decode()]
+            for seq, total, crc, data in frames]
+
+
+def _frames_from_wire(rows: list) -> list:
+    return [(int(seq), int(total), int(crc), b64decode(data))
+            for seq, total, crc, data in rows]
+
+
+def _post_json(url: str, body: dict,
+               timeout_s: float = 5.0) -> Optional[dict]:
+    """POST JSON, parse JSON. None = unreachable/timeout (the caller
+    degrades); HTTP error bodies are surfaced as answers when they
+    parse."""
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            return json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        try:
+            return json.loads(e.read().decode())
+        except Exception:
+            return {"ok": False, "error": f"HTTP {e.code}"}
+    except Exception as e:            # refused / reset / timeout
+        logger.debug("store POST %s failed: %s", url, e)
+        return None
+
+
+def _get_json(url: str, timeout_s: float = 5.0) -> Optional[dict]:
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            return json.loads(resp.read().decode())
+    except Exception as e:
+        logger.debug("store GET %s failed: %s", url, e)
+        return None
+
+
+class _WeightLedger:
+    """The service-side registry of named, immutable, chunked weight
+    payloads. Uploads resume (``begin`` answers which seqs are already
+    held and verified); every served chunk is counted per seq, so a
+    killed-and-resumed download can prove its ledger balanced — each
+    chunk travelled exactly once."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._names: dict[str, dict] = {}
+
+    @thread_seam
+    def begin(self, name: str, manifest: dict, total: int,
+              nbytes: int) -> dict:
+        with self._lock:
+            rec = self._names.get(name)
+            if rec is None:
+                rec = {"manifest": manifest, "total": int(total),
+                       "nbytes": int(nbytes), "chunks": {},
+                       "served": {}, "born": time.monotonic()}
+                self._names[name] = rec
+            return {"ok": True, "have": sorted(rec["chunks"]),
+                    "total": rec["total"]}
+
+    @thread_seam
+    def put_chunk(self, name: str, chunk: CourierChunk) -> dict:
+        if zlib.crc32(chunk.data) != chunk.crc32:
+            return {"ok": False, "error": "frame CRC mismatch"}
+        with self._lock:
+            rec = self._names.get(name)
+            if rec is None:
+                return {"ok": False,
+                        "error": f"unknown weights name {name!r} "
+                                 f"(begin first)"}
+            duplicate = chunk.seq in rec["chunks"]
+            if not duplicate:
+                rec["chunks"][chunk.seq] = (chunk.crc32, chunk.data)
+            return {"ok": True, "duplicate": duplicate,
+                    "have": len(rec["chunks"]), "total": rec["total"],
+                    "complete": len(rec["chunks"]) >= rec["total"]}
+
+    @thread_seam
+    def status(self, name: str) -> dict:
+        with self._lock:
+            rec = self._names.get(name)
+            if rec is None:
+                return {"ok": False,
+                        "error": f"unknown weights name {name!r}"}
+            return {"ok": True, "name": name,
+                    "manifest": rec["manifest"], "total": rec["total"],
+                    "nbytes": rec["nbytes"],
+                    "have": sorted(rec["chunks"]),
+                    "complete": len(rec["chunks"]) >= rec["total"],
+                    "served": {str(k): v
+                               for k, v in sorted(rec["served"].items())}}
+
+    @thread_seam
+    def take_chunks(self, name: str, seqs: list) -> dict:
+        with self._lock:
+            rec = self._names.get(name)
+            if rec is None:
+                return {"ok": False,
+                        "error": f"unknown weights name {name!r}"}
+            if len(rec["chunks"]) < rec["total"]:
+                return {"ok": False,
+                        "error": f"weights {name!r} incomplete "
+                                 f"({len(rec['chunks'])}/{rec['total']} "
+                                 f"chunks uploaded)"}
+            out = []
+            for seq in seqs:
+                seq = int(seq)
+                held = rec["chunks"].get(seq)
+                if held is None:
+                    return {"ok": False,
+                            "error": f"weights {name!r} has no chunk "
+                                     f"{seq}"}
+                crc, data = held
+                rec["served"][seq] = rec["served"].get(seq, 0) + 1
+                out.append(CourierChunk(
+                    ticket=f"weights-{name}", seq=seq,
+                    total=rec["total"], crc32=crc, data=data,
+                    manifest=rec["manifest"] if seq == 0 else None
+                ).to_wire())
+            return {"ok": True, "chunks": out}
+
+    @thread_seam
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "names": len(self._names),
+                "chunks_held": sum(len(r["chunks"])
+                                   for r in self._names.values()),
+                "chunks_served": sum(sum(r["served"].values())
+                                     for r in self._names.values()),
+                "bytes_held": sum(len(d) for r in self._names.values()
+                                  for _c, d in r["chunks"].values()),
+            }
+
+
+class StoreService:
+    """The standalone store process: one :class:`FleetKVStore` + one
+    :class:`_WeightLedger` behind a small aiohttp front. All handlers
+    are thin — the store's own lock is the concurrency story, exactly
+    as when it lived inside a front."""
+
+    def __init__(self, cfg=None):
+        self.cfg = cfg
+        self.store = FleetKVStore(cfg)
+        self.weights = _WeightLedger()
+
+    # -- RPC bodies (also driven directly by tests) --------------------------
+
+    @aiohttp_handler
+    def demote_wire(self, body: dict) -> dict:
+        try:
+            h = bytes.fromhex(str(body["hash"]))
+            frames = _frames_from_wire(body["frames"])
+            manifest = dict(body["manifest"])
+            raw_bytes = int(body.get("raw_bytes", 0))
+        except (KeyError, TypeError, ValueError):
+            return {"ok": False,
+                    "error": "body must be {hash, manifest, frames, "
+                             "raw_bytes}"}
+        stored = self.store.admit_frames(h, frames, manifest, raw_bytes)
+        return {"ok": True, "stored": bool(stored)}
+
+    @aiohttp_handler
+    def fetch_wire(self, body: dict) -> dict:
+        try:
+            hashes = [bytes.fromhex(h) for h in body.get("hashes", [])]
+        except (TypeError, ValueError):
+            return {"ok": False, "error": "malformed hashes"}
+        if not hashes:
+            return {"ok": False, "error": "body must be {hashes}"}
+        rows = self.store.export_frames(hashes)
+        return {"ok": True,
+                "pages": [{"hash": hx, "manifest": manifest,
+                           "frames": _frames_to_wire(frames)}
+                          for hx, manifest, frames, _w in rows]}
+
+    @aiohttp_handler
+    def inventory_wire(self, body: dict) -> dict:
+        held = self.store.inventory(int(body.get("max_entries", 0) or 0))
+        return {"ok": True, "hashes": [h.hex() for h in held]}
+
+    @aiohttp_handler
+    def status_dict(self) -> dict:
+        return {"ok": True, "kv_store": self.store.snapshot(),
+                "weights": self.weights.snapshot()}
+
+    # -- aiohttp front -------------------------------------------------------
+
+    def build_app(self):
+        from aiohttp import web
+
+        svc = self
+
+        def json_body(handler):
+            async def wrapped(request):
+                try:
+                    body = await request.json()
+                except json.JSONDecodeError:
+                    return web.json_response({"error": "invalid JSON"},
+                                             status=400)
+                return await handler(request, body)
+            return wrapped
+
+        async def demote(request, body):
+            return web.json_response(svc.demote_wire(body))
+
+        async def fetch(request, body):
+            return web.json_response(svc.fetch_wire(body))
+
+        async def inventory(request, body):
+            return web.json_response(svc.inventory_wire(body))
+
+        async def clear(request, body):
+            svc.store.clear()
+            return web.json_response({"ok": True})
+
+        async def status(request):
+            return web.json_response(svc.status_dict())
+
+        async def health(request):
+            return web.json_response({"status": "healthy"})
+
+        async def weights_begin(request, body):
+            try:
+                name = str(body["name"])
+                manifest = dict(body["manifest"])
+                total = int(body["total"])
+                nbytes = int(body.get("nbytes", 0))
+            except (KeyError, TypeError, ValueError):
+                return web.json_response(
+                    {"ok": False, "error": "body must be {name, "
+                                           "manifest, total, nbytes}"},
+                    status=400)
+            return web.json_response(
+                svc.weights.begin(name, manifest, total, nbytes))
+
+        async def weights_chunk(request, body):
+            name = str(body.get("name", ""))
+            try:
+                chunk = CourierChunk.from_wire(body.get("chunk") or {})
+            except Exception:
+                return web.json_response(
+                    {"ok": False,
+                     "error": "body must be {name, chunk: courier "
+                              "chunk frame}"}, status=400)
+            return web.json_response(svc.weights.put_chunk(name, chunk))
+
+        async def weights_status(request):
+            name = request.query.get("name", "")
+            return web.json_response(svc.weights.status(name))
+
+        async def weights_fetch(request, body):
+            name = str(body.get("name", ""))
+            seqs = body.get("seqs") or []
+            return web.json_response(svc.weights.take_chunks(name, seqs))
+
+        app = web.Application(client_max_size=256 * 1024 * 1024)
+        app.router.add_post("/store/demote", json_body(demote))
+        app.router.add_post("/store/fetch", json_body(fetch))
+        app.router.add_post("/store/inventory", json_body(inventory))
+        app.router.add_post("/store/clear", json_body(clear))
+        app.router.add_get("/store/status", status)
+        app.router.add_post("/store/weights/begin",
+                            json_body(weights_begin))
+        app.router.add_post("/store/weights/chunk",
+                            json_body(weights_chunk))
+        app.router.add_get("/store/weights/status", weights_status)
+        app.router.add_post("/store/weights/fetch",
+                            json_body(weights_fetch))
+        app.router.add_get("/health", health)
+        return app
+
+    def run_forever(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        """Serve until killed. Prints exactly one machine-readable ready
+        line (``LLMCTL_STORE_READY port=N``) so a spawning operator or
+        test discovers an ephemeral port; everything else logs to
+        stderr."""
+        import asyncio
+
+        from aiohttp import web
+
+        async def _main():
+            runner = web.AppRunner(self.build_app(), access_log=None)
+            await runner.setup()
+            site = web.TCPSite(runner, host, port)
+            await site.start()
+            bound = runner.addresses[0][1]
+            print(f"LLMCTL_STORE_READY port={bound}", flush=True)
+            logger.info("fleet store service on %s:%d "
+                        "(dram %.0f MB, disk %r)", host, bound,
+                        self.store.dram_capacity / 1e6,
+                        self.store.disk_dir or None)
+            try:
+                while True:
+                    await asyncio.sleep(3600)
+            finally:
+                await runner.cleanup()
+
+        try:
+            asyncio.run(_main())
+        except KeyboardInterrupt:
+            pass
+
+
+class StoreClient:
+    """The front/worker half of the networked store: duck pair of
+    :class:`FleetKVStore`, so everything above it (router hints, the
+    eviction demote seam, drain-flush barriers, the returning-
+    conversation fetch, the supervisor snapshot) is backend-agnostic.
+
+    Demotion mirrors the in-proc store's split: ``demote_async`` queues
+    page REFERENCES and a background worker pays the deflate + the
+    upload POST (the engine thread never blocks on either); ``demote``
+    is the synchronous drain/retire barrier. Pages are encoded ONCE
+    here — the service admits the frames verbatim and every later fetch
+    replays them byte-identical.
+
+    Fetch is pull-mode: the response carries the held frames and THIS
+    process replays them through its own ``CourierReceiver`` — frame
+    CRC, end-to-end raw CRC, decode — so a corrupt or torn answer is a
+    counted miss, never wrong KV. An unreachable service degrades the
+    same way (counted ``remote_misses``; demotions are dropped and cost
+    only a future recompute)."""
+
+    def __init__(self, cfg=None, endpoint: str = ""):
+        self.endpoint = (endpoint
+                         or str(getattr(cfg, "kv_store_endpoint", "")
+                                or "")).rstrip("/")
+        codec = str(getattr(cfg, "courier_codec", CODEC_NONE)
+                    or CODEC_NONE)
+        self.codec = CODEC_ZLIB if codec == CODEC_NONE else codec
+        self.zlib_level = int(getattr(cfg, "courier_zlib_level", -1))
+        self.chunk_bytes = int(getattr(cfg, "courier_chunk_bytes",
+                                       256 * 1024))
+        self.timeout_s = float(getattr(cfg, "prefix_fetch_timeout_s",
+                                       5.0) or 5.0)
+        self._lock = threading.Lock()
+        self._pending: "OrderedDict[bytes, tuple]" = OrderedDict()
+        self._pending_max = 256
+        self._inflight = 0       # pages popped but not yet POSTed
+        self._work = threading.Event()
+        self._encoder: Optional[threading.Thread] = None
+        # the two client-side counters (everything else is served by the
+        # service's own FleetKVStore counters, merged in snapshot())
+        self.total_remote_hits = 0    # pages replayed from the service
+        self.total_remote_misses = 0  # fetches that served zero pages
+        #                               (incl. service unreachable)
+
+    # -- demotion ------------------------------------------------------------
+
+    @thread_seam
+    def demote_async(self, hashes: list, content: dict) -> int:
+        """Queue demoted pages for background encode + upload; the HOT
+        eviction seam (engine thread). Mirrors FleetKVStore.demote_async
+        bound and overflow semantics."""
+        queued = 0
+        try:
+            n = int(content.get("num_pages", 0))
+            with self._lock:
+                for i, h in enumerate(hashes[:n]):
+                    h = bytes(h)
+                    if h in self._pending:
+                        continue
+                    self._pending[h] = (content, i)
+                    queued += 1
+                while len(self._pending) > self._pending_max:
+                    self._pending.popitem(last=False)
+                if queued and (self._encoder is None
+                               or not self._encoder.is_alive()):
+                    self._encoder = threading.Thread(
+                        target=self._encode_loop, daemon=True,
+                        name="llmctl-storeclient-encode")
+                    self._encoder.start()
+            if queued:
+                self._work.set()
+        except Exception:
+            logger.exception("store client async demotion failed; "
+                             "pages dropped")
+        return queued
+
+    def _encode_loop(self) -> None:
+        while True:
+            if not self._work.wait(timeout=5.0):
+                return                        # idle: let the thread die
+            self._work.clear()
+            while True:
+                with self._lock:
+                    if not self._pending:
+                        break
+                    h, (batch, col) = self._pending.popitem(last=False)
+                    self._inflight += 1
+                try:
+                    self._demote_page(h, _page_slice(batch, col))
+                finally:
+                    with self._lock:
+                        self._inflight -= 1
+
+    def flush_pending(self, timeout_s: float = 10.0) -> None:
+        """The drain/retire barrier. Unlike the in-proc store, a popped
+        page is still a network POST away from durable — the barrier
+        must also wait out in-flight uploads."""
+        deadline = time.monotonic() + timeout_s
+        self._work.set()
+        while time.monotonic() < deadline:
+            with self._lock:
+                busy = bool(self._pending) or self._inflight > 0
+            if not busy:
+                return
+            time.sleep(0.002)
+
+    @thread_seam
+    def demote(self, hashes: list, content: dict) -> int:
+        """Synchronous demote — the drain/retire barrier: a retiring
+        replica's inventory must be durably AT THE SERVICE before it
+        leaves rotation. Returns pages newly stored remotely."""
+        stored = 0
+        try:
+            n = int(content.get("num_pages", 0))
+            for i, h in enumerate(hashes[:n]):
+                if self._demote_page(bytes(h), _page_slice(content, i)):
+                    stored += 1
+        except Exception:
+            logger.exception("store client demotion failed; "
+                             "pages dropped")
+        return stored
+
+    def _demote_page(self, h: bytes, page: dict) -> bool:
+        payload = {"prefix": True, "hashes": [h.hex()], "pages": page}
+        manifest, blob = encode_payload(payload, codec=self.codec,
+                                        zlib_level=self.zlib_level)
+        chunks = make_chunks("store", manifest, blob, self.chunk_bytes)
+        body = {"hash": h.hex(), "manifest": manifest,
+                "frames": _frames_to_wire(
+                    [(c.seq, c.total, c.crc32, c.data) for c in chunks]),
+                "raw_bytes": int(manifest["nbytes"])}
+        out = _post_json(f"{self.endpoint}/store/demote", body,
+                         timeout_s=self.timeout_s)
+        if out is None:
+            logger.warning("store service %s unreachable; demoted page "
+                           "%s dropped", self.endpoint, h.hex())
+            return False
+        return bool(out.get("ok")) and bool(out.get("stored"))
+
+    # -- advertising ---------------------------------------------------------
+
+    @thread_seam
+    def inventory(self, max_entries: int = 0) -> list:
+        out = _post_json(f"{self.endpoint}/store/inventory",
+                         {"max_entries": int(max_entries)},
+                         timeout_s=self.timeout_s)
+        if not out or not out.get("ok"):
+            return []
+        try:
+            return [bytes.fromhex(h) for h in out.get("hashes", [])]
+        except (TypeError, ValueError):
+            return []
+
+    @thread_seam
+    def holds(self, h: bytes) -> bool:
+        return bytes(h) in set(self.inventory())
+
+    # -- fetch ---------------------------------------------------------------
+
+    @thread_seam
+    def fetch(self, hashes: list, receiver) -> Optional[dict]:
+        """Pull the longest held prefix of ``hashes`` from the service
+        and replay the returned frames through ``receiver`` — the
+        fetcher-local courier path, so all verification happens HERE.
+        None (counted remote miss) when the service is unreachable,
+        holds nothing, or any replay fails verification."""
+        body = {"hashes": [bytes(h).hex() for h in hashes]}
+        out = _post_json(f"{self.endpoint}/store/fetch", body,
+                         timeout_s=self.timeout_s)
+        served: list = []
+        pages = None
+        for row in (out or {}).get("pages", []):
+            try:
+                hx = str(row["hash"])
+                manifest = dict(row["manifest"])
+                frames = _frames_from_wire(row["frames"])
+            except (KeyError, TypeError, ValueError):
+                break
+            payload = self._replay(hx, frames, manifest, receiver)
+            if payload is None:
+                break
+            got = payload.get("pages")
+            if not isinstance(got, dict):
+                break
+            try:
+                merged = got if pages is None else \
+                    concat_page_payloads(pages, got)
+            except (ValueError, KeyError, TypeError):
+                break
+            pages = merged
+            served.append(hx)
+            with self._lock:
+                self.total_remote_hits += 1
+        if not served:
+            with self._lock:
+                self.total_remote_misses += 1
+            return None
+        return {"hashes": served, "pages": pages}
+
+    def _replay(self, hx: str, frames, manifest, receiver):
+        ticket = f"kvstore-{uuid.uuid4().hex[:16]}"
+        ok = True
+        for seq, total, crc, data in frames:
+            ack = receiver.add_chunk(CourierChunk(
+                ticket=ticket, seq=seq, total=total, crc32=crc,
+                data=data, manifest=manifest if seq == 0 else None))
+            if not ack.get("ok"):
+                ok = False
+                break
+        payload = receiver.take_payload(ticket) if ok else None
+        if payload is None:
+            logger.warning(
+                "store service entry %s failed replay verification; "
+                "fetch degrades to plain prefill", hx)
+        return payload
+
+    # -- wipe / introspection ------------------------------------------------
+
+    @thread_seam
+    def clear(self) -> None:
+        _post_json(f"{self.endpoint}/store/clear", {},
+                   timeout_s=self.timeout_s)
+
+    @thread_seam
+    def snapshot(self) -> dict:
+        """The service's own counters (when reachable) merged with the
+        client-side remote_hits / remote_misses — one section, same
+        keys as the in-proc store, so `fleet status` and the Prometheus
+        pump read both backends identically."""
+        out = _get_json(f"{self.endpoint}/store/status",
+                        timeout_s=self.timeout_s) or {}
+        snap = dict(out.get("kv_store") or {})
+        snap["endpoint"] = self.endpoint
+        snap["reachable"] = bool(out)
+        if "weights" in out:
+            snap["service_weights"] = out["weights"]
+        with self._lock:
+            snap["remote_hits"] = self.total_remote_hits
+            snap["remote_misses"] = self.total_remote_misses
+        return snap

@@ -41,14 +41,19 @@ class ReplicaSupervisor:
                  params=None,
                  observer: Optional[Callable[[str, dict], None]] = None,
                  streams=None, store=None, kv_store=None, pipeline=None,
-                 autoscaler=None):
+                 autoscaler=None, weights=None):
         self.cfg = cfg or FleetConfig()
         self.replicas = replicas
         self.router = router
         self.injector = injector
-        # tiered fleet KV store (serve/fleet/kv_store.py): snapshot
-        # section + `fleet status` line. None = no store tier.
+        # tiered fleet KV store (serve/fleet/kv_store.py) OR its
+        # networked stand-in (store_service.StoreClient — same duck):
+        # snapshot section + `fleet status` line. None = no store tier.
         self.kv_store = kv_store
+        # weight courier (serve/fleet/weights.py): checkpoint-shipping
+        # counters land as the snapshot's "weights" section (feeds
+        # llmctl_fleet_weights_*). None = no store service.
+        self.weights = weights
         # pipelined multi-replica prefill (serve/fleet/pipeline.py):
         # snapshot section + `fleet status` line. None = bare-router
         # unit tests.
@@ -729,6 +734,11 @@ class ReplicaSupervisor:
                 # deltas the mapped ones; feeds llmctl_fleet_kvstore_*)
                 "kv_store": (self.kv_store.snapshot()
                              if self.kv_store is not None else {}),
+                # courier weight distribution: chunks/resumes/bytes
+                # moved through the store service (feeds
+                # llmctl_fleet_weights_*)
+                "weights": (self.weights.snapshot()
+                            if self.weights is not None else {}),
                 "pipeline": (self.pipeline.snapshot()
                              if self.pipeline is not None else {}),
                 # elastic autoscaler: scale/preempt counters + the

@@ -1,0 +1,290 @@
+"""Weight distribution over the courier fabric (`llmctl fleet store`).
+
+The PR-6 gap: a freshly spawned host could join the fleet's control
+plane over plain HTTP, but its ENGINE still needed a shared artifact
+path to load weights — scale-up was only hands-free on hosts that
+already mounted the checkpoint. This module closes it by shipping the
+checkpoint through the same store service the KV pages ride:
+
+- :meth:`WeightCourier.ship` registers a checkpoint under a NAME as one
+  big immutable payload: the param tree is flattened by
+  ``encode_payload`` (the courier's manifest + end-to-end raw CRC) and
+  split by ``make_chunks`` into the same per-frame CRC'd chunks every
+  KV transfer uses, then uploaded chunk-by-chunk. Upload is RESUMABLE:
+  ``/store/weights/begin`` answers which seqs the service already holds
+  verified, and only the rest travel.
+- :meth:`WeightCourier.fetch` bootstraps a bare host: chunks are pulled
+  in bounded batches, CRC-verified, and spooled to local disk as they
+  arrive, so a worker SIGKILL'd mid-ship and respawned with the same
+  spool directory RESUMES from its verified chunks instead of
+  restarting — and the service's per-seq serve ledger stays balanced
+  (each chunk travels exactly once across the kill). Reassembly rides
+  :class:`ChunkReassembler` — per-chunk inflate + the end-to-end raw
+  CRC — so torn spools or a lying service abort the boot loudly; they
+  can never produce a silently-wrong param tree.
+
+Failure semantics differ from KV on purpose: a missing prefix page
+degrades to re-prefill (compute exists elsewhere), but a host without
+weights has NOTHING to degrade to — fetch failures raise, naming the
+endpoint, and the worker refuses to start.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import zlib
+from typing import Optional
+
+import numpy as np
+
+from ...analysis.annotations import thread_seam
+from .store_service import _get_json, _post_json
+from .transport import (CODEC_NONE, CODEC_ZLIB, ChunkCorrupt,
+                        ChunkReassembler, CourierChunk, TransferAborted,
+                        encode_payload, make_chunks)
+
+__all__ = ["WeightCourier", "WeightShipError"]
+
+logger = logging.getLogger("llmctl.serve.fleet.weights")
+
+_FETCH_BATCH = 16      # chunks per /store/weights/fetch POST
+
+
+class WeightShipError(RuntimeError):
+    """A weight ship/fetch against the store service failed terminally
+    (unreachable endpoint, incomplete upload, verification failure).
+    The message always names the endpoint — a worker boot surfacing
+    this tells the operator WHICH store it could not reach."""
+
+
+def _numpy_tree(node):
+    """Param tree -> nested dict of host numpy arrays (the courier
+    payload schema). Device arrays transfer once, here."""
+    if isinstance(node, dict):
+        return {k: _numpy_tree(v) for k, v in node.items()}
+    return np.asarray(node)
+
+
+class WeightCourier:
+    """Both halves of checkpoint movement through the store service.
+    One instance per process; counters are running totals the
+    supervisor snapshot embeds (``weights`` section) and the
+    Prometheus pump deltas."""
+
+    def __init__(self, cfg=None, endpoint: str = "",
+                 spool_dir: str = ""):
+        self.endpoint = (endpoint
+                         or str(getattr(cfg, "kv_store_endpoint", "")
+                                or "")).rstrip("/")
+        codec = str(getattr(cfg, "courier_codec", CODEC_NONE)
+                    or CODEC_NONE)
+        self.codec = CODEC_ZLIB if codec == CODEC_NONE else codec
+        self.zlib_level = int(getattr(cfg, "courier_zlib_level", -1))
+        self.chunk_bytes = int(getattr(cfg, "courier_chunk_bytes",
+                                       256 * 1024))
+        self.timeout_s = float(getattr(cfg, "courier_ship_timeout_s",
+                                       30.0) or 30.0)
+        self.spool_dir = str(spool_dir or "")
+        self._lock = threading.Lock()
+        self.total_chunks = 0    # chunks moved (shipped + fetched)
+        self.total_resumes = 0   # ships/fetches that resumed partials
+        self.total_bytes = 0     # wire bytes moved
+
+    def _bump(self, chunks: int = 0, resumes: int = 0,
+              nbytes: int = 0) -> None:
+        with self._lock:
+            self.total_chunks += chunks
+            self.total_resumes += resumes
+            self.total_bytes += nbytes
+
+    # -- ship (checkpoint -> service) ----------------------------------------
+
+    @thread_seam
+    def ship(self, name: str, params: dict) -> dict:
+        """Register ``params`` under ``name`` in the store service.
+        Encoded once; chunks the service already verified are skipped
+        (upload resume). Idempotent: re-shipping a registered name
+        uploads nothing. Raises :class:`WeightShipError` when the
+        service is unreachable or refuses a chunk."""
+        payload = {"params": _numpy_tree(params)}
+        manifest, blob = encode_payload(payload, codec=self.codec,
+                                        zlib_level=self.zlib_level)
+        chunks = make_chunks(f"weights-{name}", manifest, blob,
+                             self.chunk_bytes)
+        begin = _post_json(
+            f"{self.endpoint}/store/weights/begin",
+            {"name": name, "manifest": manifest, "total": len(chunks),
+             "nbytes": int(manifest["nbytes"])},
+            timeout_s=self.timeout_s)
+        if begin is None or not begin.get("ok"):
+            raise WeightShipError(
+                f"weight ship {name!r}: store service at "
+                f"{self.endpoint} unreachable"
+                + (f" ({begin.get('error')})" if begin else ""))
+        have = set(int(s) for s in begin.get("have", []))
+        if have:
+            self._bump(resumes=1)
+        sent = 0
+        for c in chunks:
+            if c.seq in have:
+                continue
+            ack = _post_json(
+                f"{self.endpoint}/store/weights/chunk",
+                {"name": name, "chunk": c.to_wire()},
+                timeout_s=self.timeout_s)
+            if ack is None or not ack.get("ok"):
+                raise WeightShipError(
+                    f"weight ship {name!r}: chunk {c.seq}/{len(chunks)}"
+                    f" refused by store service at {self.endpoint}"
+                    + (f" ({ack.get('error')})" if ack else ""))
+            sent += 1
+            self._bump(chunks=1, nbytes=len(c.data))
+        logger.info("weights %r shipped to %s: %d/%d chunks sent "
+                    "(%d resumed)", name, self.endpoint, sent,
+                    len(chunks), len(have))
+        return {"name": name, "total": len(chunks), "sent": sent,
+                "skipped": len(have)}
+
+    # -- fetch (service -> bare host) ----------------------------------------
+
+    def _spool_path(self, name: str) -> str:
+        return os.path.join(self.spool_dir, f"{name}.wspool")
+
+    def _spool_load(self, name: str) -> dict[int, bytes]:
+        """Verified chunks from a previous, killed fetch. The spool is
+        a sequence of ``<json header line>\\n<raw bytes>`` records; a
+        torn tail (killed mid-write) is truncated away silently — those
+        chunks simply re-fetch."""
+        out: dict[int, bytes] = {}
+        if not self.spool_dir:
+            return out
+        try:
+            with open(self._spool_path(name), "rb") as fh:
+                while True:
+                    line = fh.readline()
+                    if not line:
+                        break
+                    try:
+                        head = json.loads(line)
+                        seq, crc, size = (int(head["seq"]),
+                                          int(head["crc"]),
+                                          int(head["len"]))
+                    except (ValueError, KeyError, TypeError):
+                        break                      # torn header
+                    data = fh.read(size)
+                    if len(data) != size or zlib.crc32(data) != crc:
+                        break                      # torn/corrupt tail
+                    out[seq] = data
+        except OSError:
+            return {}
+        return out
+
+    def _spool_append(self, fh, chunk: CourierChunk) -> None:
+        if fh is None:
+            return
+        fh.write(json.dumps({"seq": chunk.seq, "crc": chunk.crc32,
+                             "len": len(chunk.data)}).encode() + b"\n")
+        fh.write(chunk.data)
+        fh.flush()
+        os.fsync(fh.fileno())
+
+    @thread_seam
+    def fetch(self, name: str) -> dict:
+        """Pull checkpoint ``name`` from the service and return the
+        decoded param tree. With a spool directory, chunks persist as
+        they arrive and a respawned fetch RESUMES from the verified
+        spool (counted). Raises :class:`WeightShipError` — naming the
+        endpoint — when the service is unreachable, the name unknown or
+        incomplete, or verification fails."""
+        status = _get_json(
+            f"{self.endpoint}/store/weights/status?name={name}",
+            timeout_s=self.timeout_s)
+        if status is None:
+            raise WeightShipError(
+                f"weights fetch {name!r}: store service at "
+                f"{self.endpoint} unreachable")
+        if not status.get("ok") or not status.get("complete"):
+            raise WeightShipError(
+                f"weights fetch {name!r}: store service at "
+                f"{self.endpoint} does not hold a complete payload "
+                f"({status.get('error') or 'incomplete upload'})")
+        total = int(status["total"])
+        manifest = dict(status["manifest"])
+        asm = ChunkReassembler(total)
+        asm.manifest = manifest
+        spooled = self._spool_load(name)
+        for seq, data in spooled.items():
+            if 0 <= seq < total:
+                asm.add(CourierChunk(ticket=f"weights-{name}", seq=seq,
+                                     total=total, crc32=zlib.crc32(data),
+                                     data=data))
+        if spooled:
+            self._bump(resumes=1)
+            logger.info("weights %r fetch resuming: %d/%d chunks "
+                        "already spooled", name, len(spooled), total)
+        fh = None
+        if self.spool_dir:
+            os.makedirs(self.spool_dir, exist_ok=True)
+            fh = open(self._spool_path(name), "ab")
+        try:
+            missing = asm.missing()
+            for i in range(0, len(missing), _FETCH_BATCH):
+                batch = missing[i:i + _FETCH_BATCH]
+                out = _post_json(
+                    f"{self.endpoint}/store/weights/fetch",
+                    {"name": name, "seqs": batch},
+                    timeout_s=self.timeout_s)
+                if out is None or not out.get("ok"):
+                    raise WeightShipError(
+                        f"weights fetch {name!r}: store service at "
+                        f"{self.endpoint} failed serving chunks "
+                        f"{batch[0]}..{batch[-1]}"
+                        + (f" ({out.get('error')})" if out else ""))
+                for wire in out.get("chunks", []):
+                    chunk = CourierChunk.from_wire(wire)
+                    try:
+                        fresh = asm.add(chunk)
+                    except ChunkCorrupt as e:
+                        raise WeightShipError(
+                            f"weights fetch {name!r}: corrupt chunk "
+                            f"from store service at {self.endpoint}: "
+                            f"{e}") from e
+                    if fresh:
+                        self._spool_append(fh, chunk)
+                        self._bump(chunks=1, nbytes=len(chunk.data))
+        finally:
+            if fh is not None:
+                fh.close()
+        try:
+            payload = asm.payload()          # end-to-end raw CRC here
+        except TransferAborted as e:
+            # a torn spool or lying service must abort the BOOT, not
+            # produce wrong weights; wipe the spool so the next attempt
+            # starts clean
+            if self.spool_dir:
+                try:
+                    os.unlink(self._spool_path(name))
+                except OSError:
+                    pass
+            raise WeightShipError(
+                f"weights fetch {name!r}: payload from store service "
+                f"at {self.endpoint} failed verification: {e}") from e
+        params = payload.get("params")
+        if not isinstance(params, dict):
+            raise WeightShipError(
+                f"weights fetch {name!r}: store service at "
+                f"{self.endpoint} returned a non-checkpoint payload")
+        return params
+
+    # -- introspection -------------------------------------------------------
+
+    @thread_seam
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"chunks": self.total_chunks,
+                    "resumes": self.total_resumes,
+                    "bytes": self.total_bytes,
+                    "endpoint": self.endpoint}

@@ -50,7 +50,7 @@ from . import replica as replica_mod
 from .faults import FaultInjector, FaultPlan
 from .remote import request_from_wire, request_to_wire
 from .replica import EngineReplica
-from .transport import (CourierChunk, CourierReceiver,
+from .transport import (KV_STORE_OWNER, CourierChunk, CourierReceiver,
                         HTTPCourierTransport, TransportError,
                         TransportStats)
 from ...analysis.annotations import (aiohttp_handler, engine_thread_only, supervisor_thread)
@@ -94,6 +94,19 @@ class FleetWorker:
         # directly in tests can set it by hand
         self.self_endpoint: Optional[str] = None
         self.replica.prefix_fetcher = self._fetch_prefix
+        # networked KV fabric (serve/fleet/store_service.py): with a
+        # configured store endpoint this worker demotes its evicted /
+        # drain-flushed prefix pages to the SHARED service and honors
+        # KV_STORE_OWNER fetch hints against it — the same store every
+        # front resolves, so a returning conversation landing here
+        # restores pages another replica (or another worker) demoted.
+        self.store_client = None
+        store_ep = str(getattr(self.fleet_cfg, "kv_store_endpoint", "")
+                       or "")
+        if store_ep:
+            from .store_service import StoreClient
+            self.store_client = StoreClient(self.fleet_cfg)
+            self.replica.set_kv_store(self.store_client)
         # fleet SSE streaming: a streaming request's token batches ship
         # to the parent as cursor-tagged outbox entries (tokens are tiny
         # — no courier involved). The outbox deque preserves order, so a
@@ -358,6 +371,13 @@ class FleetWorker:
         out = self.probe_dict()
         out["courier"] = {**self.courier_stats.snapshot(),
                           **self.receiver.stats()}
+        sc = self.store_client
+        if sc is not None:
+            # local counters only — status must stay responsive while
+            # the store service is down (no remote round-trip here)
+            out["kv_store"] = {"endpoint": sc.endpoint,
+                               "remote_hits": sc.total_remote_hits,
+                               "remote_misses": sc.total_remote_misses}
         return out
 
     # -- fleet-global prefix cache -------------------------------------------
@@ -373,6 +393,19 @@ class FleetWorker:
         TransportError-shaped failures as plain exceptions the replica
         counts as aborts."""
         ep = (owner_endpoint or "").rstrip("/")
+        if owner == KV_STORE_OWNER:
+            # the networked store service: pull-mode — the response
+            # carries the held frames and THIS worker replays them
+            # through its own receiver (full CRC/verify path)
+            client = self.store_client
+            if client is None or (ep and client.endpoint != ep):
+                if not ep:
+                    return None
+                from .store_service import StoreClient
+                client = StoreClient(self.fleet_cfg, endpoint=ep)
+                if self.store_client is None:
+                    self.store_client = client
+            return client.fetch(hashes, self.receiver)
         me = self.self_endpoint
         if not ep or not me:
             return None

@@ -129,6 +129,13 @@ FLEET_CONFIG_ERRORS = [
      "autoscale_cooldown_polls must be >= 0"),
     ({"autoscale_spawn_timeout_s": 0.0},
      "autoscale_spawn_timeout_s must be > 0"),
+    ({"autoscale_spawn": "pod"}, "unknown autoscale_spawn"),
+    ({"autoscale_up_free_page_ratio": 1.0},
+     "autoscale_up_free_page_ratio must be in [0, 1)"),
+    ({"kv_store_endpoint": "ftp://store:9400"},
+     "kv_store_endpoint must be an http(s) base URL"),
+    ({"kv_store_endpoint": "http://store:9400", "prefix_fetch": False},
+     "kv_store_endpoint needs prefix_fetch"),
     ({"autoscale": True, "fronts": 2, "state_store": "file",
       "state_store_dir": "/tmp/x", "remote_replicas": "0",
       "replicas": 1, "fleet_endpoints": {0: "http://h:1"}},
@@ -478,3 +485,126 @@ def test_snapshot_shape():
     for k in ("scale_ups", "scale_downs", "spawn_failures",
               "retire_rollbacks", "preemptions", "events"):
         assert k in snap
+
+
+# ---------------------------------------------------------------------------
+# KV-pool pressure: free-page ratio feeds scale-up, vetoes scale-down
+# ---------------------------------------------------------------------------
+
+
+class PooledReplica(FakeReplica):
+    """A FakeReplica with a KV pool surface; `free_ratio` is the
+    fraction of unpinned pages this replica would report."""
+
+    def __init__(self, rid, free_ratio=0.5):
+        super().__init__(rid)
+        self.free_ratio = free_ratio
+
+    def pool_free_ratio(self):
+        return self.free_ratio
+
+
+def make_pooled_scaler(n=2, free_ratio=0.5, **cfg_kw):
+    fleet, a = make_scaler(n=n, **cfg_kw)
+    fleet.replicas = [PooledReplica(i, free_ratio) for i in range(n)]
+    return fleet, a
+
+
+def test_pool_pressure_scales_up_with_reason():
+    # queues are EMPTY — page starvation alone must trigger scale-up
+    fleet, a = make_pooled_scaler(free_ratio=0.05,
+                                  autoscale_up_free_page_ratio=0.1)
+    a.poll(now=0.0)
+    assert len(fleet.replicas) == 2    # hysteresis streak 1 of 2
+    a.poll(now=0.1)
+    assert len(fleet.replicas) == 3
+    [ev] = [e for e in a.events if e["kind"] == "scale_up"]
+    assert ev["reason"] == "pool"
+    assert ev["free_page_ratio"] == 0.05
+
+
+def test_queue_pressure_keeps_reason_queue():
+    fleet, a = make_pooled_scaler(free_ratio=0.9,
+                                  autoscale_up_free_page_ratio=0.1)
+    fleet.router.pending = 10
+    a.poll(now=0.0)
+    a.poll(now=0.1)
+    [ev] = [e for e in a.events if e["kind"] == "scale_up"]
+    assert ev["reason"] == "queue"
+
+
+def test_pool_pressure_vetoes_idle_scale_down():
+    # at ceiling, idle queues, but the pool is starved: retiring a
+    # replica would shrink the page budget under pressure — veto
+    fleet, a = make_pooled_scaler(free_ratio=0.05,
+                                  autoscale_max_replicas=2,
+                                  autoscale_up_free_page_ratio=0.1)
+    for i in range(6):
+        a.poll(now=0.1 * i)
+    assert a.total_scale_downs == 0
+    assert not any(r.drain_requested for r in fleet.replicas)
+    # pressure clears: the usual idle retire proceeds
+    for r in fleet.replicas:
+        r.free_ratio = 0.9
+    a.poll(now=1.0)
+    a.poll(now=1.1)
+    assert fleet.replicas[1].drain_requested
+
+
+def test_pool_votes_use_min_across_replicas():
+    fleet, a = make_pooled_scaler(free_ratio=0.9,
+                                  autoscale_up_free_page_ratio=0.2)
+    fleet.replicas[1].free_ratio = 0.01      # one starved replica
+    a.poll(now=0.0)
+    a.poll(now=0.1)
+    [ev] = [e for e in a.events if e["kind"] == "scale_up"]
+    assert ev["reason"] == "pool" and ev["free_page_ratio"] == 0.01
+
+
+def test_replicas_without_pool_surface_do_not_vote():
+    # plain FakeReplicas have no pool_free_ratio: signal configured but
+    # nobody votes -> no pressure, no scale-up
+    fleet, a = make_scaler(autoscale_up_free_page_ratio=0.99)
+    for i in range(4):
+        a.poll(now=0.1 * i)
+    assert a.total_scale_ups == 0
+
+
+def test_zero_threshold_disables_pool_signal():
+    fleet, a = make_pooled_scaler(free_ratio=0.0)   # default thresh 0
+    for i in range(4):
+        a.poll(now=0.1 * i)
+    assert a.total_scale_ups == 0
+
+
+# ---------------------------------------------------------------------------
+# synthesized worker argv (serve start --fleet-autoscale-spawn worker)
+# ---------------------------------------------------------------------------
+
+
+def test_synthesized_worker_argv_bootstraps_from_store():
+    from types import SimpleNamespace
+
+    serve = SimpleNamespace(model="gpt-test", max_batch_size=4,
+                            max_seq_len=128, kv_block_size=16,
+                            dtype="float32", kv_quantization="none",
+                            artifact="", prefill_chunk=0,
+                            speculative="off", speculative_tokens=4)
+    cfg = FleetConfig(kv_store_endpoint="http://127.0.0.1:9400",
+                      prefix_fetch=True)
+    argv = asc.synthesize_worker_argv(None, serve, cfg,
+                                      weights_name="gpt-test",
+                                      spool_dir="/tmp/spool")
+    assert argv[3:5] == ["fleet", "worker"]
+    s = " ".join(argv)
+    assert "--model gpt-test" in s
+    assert "--store-endpoint http://127.0.0.1:9400" in s
+    assert "--weights-from-store" in s
+    assert "--weights-name gpt-test" in s
+    assert "--weights-spool /tmp/spool" in s
+    assert "--artifact" not in s       # a bare host needs no shared path
+    # no store endpoint: classic argv, no bootstrap flags
+    plain = asc.synthesize_worker_argv(None, serve, FleetConfig())
+    assert "--weights-from-store" not in " ".join(plain)
+    # --replica-id/--port stay with the spawner, appended per spawn
+    assert "--replica-id" not in s and "--port" not in s

@@ -1,0 +1,465 @@
+"""Networked KV fabric: the standalone `llmctl fleet store` service
+(serve/fleet/store_service.py) and the weight courier riding the same
+fabric (serve/fleet/weights.py).
+
+The contract under test:
+
+- StoreClient is a duck pair of FleetKVStore: demote (sync + async)
+  POSTs pre-encoded, per-frame-CRC'd courier frames; fetch is
+  pull-mode — the service answers with the held frames and the CLIENT
+  replays them through its own CourierReceiver, so all verification
+  happens at the destination and a torn answer is a counted miss,
+  never wrong KV;
+- an unreachable service degrades everywhere: demotions drop (cost =
+  a future recompute), fetches are counted remote misses, snapshot
+  still answers (reachable=False) — nothing above the duck blocks;
+- weights ship as one big immutable chunked payload: uploads resume
+  (begin answers held seqs), downloads resume from a local fsync'd
+  spool after a mid-ship kill — chunks NEVER travel twice, proven by
+  the service's per-seq serve ledger balancing to exactly one;
+- a bare host that cannot reach the store fails its BOOT loudly,
+  naming the endpoint — weights have nothing to degrade to.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from distributed_llm_training_and_inference_system_tpu.config import (
+    get_model_config)
+from distributed_llm_training_and_inference_system_tpu.config.schema import (
+    FleetConfig)
+from distributed_llm_training_and_inference_system_tpu.serve.fleet import (
+    weights as wmod)
+from distributed_llm_training_and_inference_system_tpu.serve.fleet.store_service import (  # noqa: E501
+    StoreClient, StoreService, _WeightLedger)
+from distributed_llm_training_and_inference_system_tpu.serve.fleet.transport import (  # noqa: E501
+    CODEC_ZLIB, CourierChunk, CourierReceiver, encode_payload,
+    make_chunks)
+from distributed_llm_training_and_inference_system_tpu.serve.fleet.weights import (  # noqa: E501
+    WeightCourier, WeightShipError)
+from distributed_llm_training_and_inference_system_tpu.serve.kv_cache import (
+    prefix_page_hashes)
+
+PS = 8
+HOT = [7, 3, 9, 1, 4, 8, 2, 6] * 4            # 32 tokens = 4 full pages
+
+# a dead-on-arrival endpoint: port 9 (discard) is never an aiohttp site
+DEAD = "http://127.0.0.1:9"
+
+
+@pytest.fixture(scope="module")
+def model_cfg():
+    return get_model_config("gpt-test")
+
+
+def stamped_payload(model_cfg, n_pages=4, seed=0):
+    rng = np.random.default_rng(seed)
+    shape = (model_cfg.num_layers, n_pages, model_cfg.num_kv_heads, PS,
+             model_cfg.head_dim)
+    return {"k": rng.random(shape, np.float32),
+            "v": rng.random(shape, np.float32), "num_pages": n_pages}
+
+
+def store_cfg(**kw):
+    base = dict(replicas=1, kv_store=True, prefix_fetch=True,
+                courier_chunk_bytes=1024)
+    base.update(kw)
+    cfg = FleetConfig(**base)
+    cfg.validate()
+    return cfg
+
+
+def tiny_params(seed=0, n=4096):
+    """A param tree whose zlib'd blob spans MANY 1 KiB chunks (random
+    floats barely compress), so resume/kill tests have room to tear."""
+    rng = np.random.default_rng(seed)
+    return {"wte": {"embedding": rng.standard_normal(n).astype(
+        np.float32)},
+        "head": {"w": rng.standard_normal(n // 4).astype(np.float32)}}
+
+
+def params_equal(a, b):
+    assert set(a) == set(b)
+    for k, v in a.items():
+        if isinstance(v, dict):
+            params_equal(v, b[k])
+        else:
+            np.testing.assert_array_equal(np.asarray(v),
+                                          np.asarray(b[k]))
+
+
+class Harness:
+    """StoreService hosted on a background-thread asyncio loop — the
+    in-process stand-in for `llmctl fleet store`, killable mid-test."""
+
+    def __init__(self, cfg=None):
+        import asyncio
+
+        from aiohttp import web
+        self.svc = StoreService(cfg or store_cfg())
+        self.loop = asyncio.new_event_loop()
+        started = threading.Event()
+        state = {}
+
+        def run():
+            asyncio.set_event_loop(self.loop)
+
+            async def main():
+                runner = web.AppRunner(self.svc.build_app(),
+                                       access_log=None)
+                await runner.setup()
+                site = web.TCPSite(runner, "127.0.0.1", 0)
+                await site.start()
+                state["port"] = runner.addresses[0][1]
+                state["runner"] = runner
+                started.set()
+
+            self.loop.run_until_complete(main())
+            self.loop.run_forever()
+
+        self.thread = threading.Thread(target=run, daemon=True)
+        self.thread.start()
+        assert started.wait(timeout=30)
+        self.runner = state["runner"]
+        self.endpoint = f"http://127.0.0.1:{state['port']}"
+        self._dead = False
+
+    def kill(self):
+        """SIGKILL stand-in: the socket closes, in-flight requests
+        die; the client must degrade, not hang or corrupt."""
+        if self._dead:
+            return
+        self._dead = True
+        import asyncio
+        asyncio.run_coroutine_threadsafe(
+            self.runner.cleanup(), self.loop).result(timeout=10)
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self.thread.join(timeout=5)
+
+
+@pytest.fixture()
+def harness():
+    h = Harness()
+    yield h
+    h.kill()
+
+
+# ---------------------------------------------------------------------------
+# service-side weight ledger (no sockets)
+# ---------------------------------------------------------------------------
+
+
+class TestWeightLedger:
+    def _chunks(self, n_chunks=4):
+        payload = {"params": tiny_params(n=n_chunks * 300)}
+        manifest, blob = encode_payload(payload, codec=CODEC_ZLIB)
+        return make_chunks("weights-t", manifest, blob, 1024)
+
+    def test_begin_answers_held_seqs(self):
+        led = _WeightLedger()
+        chunks = self._chunks()
+        total = len(chunks)
+        assert led.begin("t", chunks[0].manifest, total,
+                         100)["have"] == []
+        led.put_chunk("t", chunks[0])
+        # re-begin (a resumed ship) sees the verified chunk
+        again = led.begin("t", chunks[0].manifest, total, 100)
+        assert again["have"] == [0] and again["total"] == total
+
+    def test_corrupt_chunk_refused(self):
+        led = _WeightLedger()
+        chunks = self._chunks()
+        led.begin("t", chunks[0].manifest, len(chunks), 100)
+        bad = CourierChunk(ticket=chunks[0].ticket, seq=0,
+                           total=chunks[0].total,
+                           crc32=chunks[0].crc32 ^ 1,
+                           data=chunks[0].data)
+        out = led.put_chunk("t", bad)
+        assert not out["ok"] and "CRC" in out["error"]
+        assert led.begin("t", chunks[0].manifest,
+                         len(chunks), 100)["have"] == []
+
+    def test_chunk_without_begin_refused(self):
+        led = _WeightLedger()
+        out = led.put_chunk("ghost", self._chunks()[0])
+        assert not out["ok"] and "begin first" in out["error"]
+
+    def test_take_refuses_incomplete_and_counts_served(self):
+        led = _WeightLedger()
+        chunks = self._chunks()
+        led.begin("t", chunks[0].manifest, len(chunks), 100)
+        for c in chunks[:-1]:
+            led.put_chunk("t", c)
+        out = led.take_chunks("t", [0])
+        assert not out["ok"] and "incomplete" in out["error"]
+        led.put_chunk("t", chunks[-1])
+        assert led.take_chunks("t", [0, 1])["ok"]
+        assert led.take_chunks("t", [0])["ok"]
+        served = led.status("t")["served"]
+        assert served["0"] == 2 and served["1"] == 1
+
+
+# ---------------------------------------------------------------------------
+# KV pages over the wire: StoreClient <-> StoreService
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.socket
+class TestNetworkedKVStore:
+    def test_demote_fetch_round_trip(self, harness, model_cfg):
+        sc = StoreClient(store_cfg(), endpoint=harness.endpoint)
+        hashes = prefix_page_hashes(HOT, PS)
+        payload = stamped_payload(model_cfg)
+        assert sc.demote(hashes, payload) == 4
+        assert sc.holds(hashes[0])
+        assert sc.inventory() == hashes
+        out = sc.fetch(hashes, CourierReceiver())
+        assert out is not None
+        assert [bytes.fromhex(h) for h in out["hashes"]] == hashes
+        assert out["pages"]["num_pages"] == 4
+        np.testing.assert_allclose(out["pages"]["k"], payload["k"])
+        np.testing.assert_allclose(out["pages"]["v"], payload["v"])
+        assert sc.total_remote_hits == 4
+        # the service's own store counted the same traffic
+        svc_snap = harness.svc.store.snapshot()
+        assert svc_snap["demotions"] == 4 and svc_snap["hits"] == 4
+        # client snapshot merges service counters with its own
+        snap = sc.snapshot()
+        assert snap["reachable"] and snap["remote_hits"] == 4
+        assert snap["endpoint"] == harness.endpoint
+        assert snap["demotions"] == 4
+
+    def test_async_demote_drains_through_flush(self, harness,
+                                               model_cfg):
+        sc = StoreClient(store_cfg(), endpoint=harness.endpoint)
+        hashes = prefix_page_hashes(HOT, PS)
+        payload = stamped_payload(model_cfg, seed=3)
+        assert sc.demote_async(hashes, payload) == 4
+        assert sc.flush_pending(timeout_s=30.0) is None   # duck: None
+        assert sc.inventory() == hashes
+        out = sc.fetch(hashes, CourierReceiver())
+        assert out is not None and len(out["hashes"]) == 4
+
+    def test_unknown_prefix_is_counted_remote_miss(self, harness):
+        sc = StoreClient(store_cfg(), endpoint=harness.endpoint)
+        assert sc.fetch([b"z" * 16], CourierReceiver()) is None
+        assert sc.total_remote_misses == 1
+
+    def test_store_killed_mid_conversation_degrades_counted(
+            self, model_cfg):
+        """Chaos arm 1: the store dies between a warm fetch and the
+        returning conversation. The second fetch is a counted remote
+        miss + None — the caller's plain-prefill path, never a hang,
+        never garbage KV."""
+        h = Harness()
+        sc = StoreClient(store_cfg(prefix_fetch_timeout_s=2.0),
+                         endpoint=h.endpoint)
+        hashes = prefix_page_hashes(HOT, PS)
+        sc.demote(hashes, stamped_payload(model_cfg))
+        assert sc.fetch(hashes, CourierReceiver()) is not None
+        h.kill()
+        assert sc.fetch(hashes, CourierReceiver()) is None
+        assert sc.total_remote_misses == 1
+        assert sc.total_remote_hits == 4          # from before the kill
+        # demotions drop (not raise) and snapshot still answers
+        assert sc.demote(hashes, stamped_payload(model_cfg)) == 0
+        snap = sc.snapshot()
+        assert snap["reachable"] is False
+        assert snap["remote_misses"] == 1
+
+    def test_dead_endpoint_from_the_start(self, model_cfg):
+        sc = StoreClient(store_cfg(prefix_fetch_timeout_s=2.0),
+                         endpoint=DEAD)
+        hashes = prefix_page_hashes(HOT, PS)
+        assert sc.demote(hashes, stamped_payload(model_cfg)) == 0
+        assert sc.fetch(hashes, CourierReceiver()) is None
+        assert sc.total_remote_misses == 1
+        assert sc.inventory() == [] and not sc.holds(hashes[0])
+
+
+# ---------------------------------------------------------------------------
+# weights over the same fabric
+# ---------------------------------------------------------------------------
+
+
+class SimKill(BaseException):
+    """A mid-ship SIGKILL stand-in: tears through fetch/ship exactly
+    where a real kill would, without taking the test process down."""
+
+
+@pytest.mark.socket
+class TestWeightCourier:
+    def test_ship_fetch_round_trip_and_idempotent_reship(
+            self, harness, tmp_path):
+        wc = WeightCourier(store_cfg(), endpoint=harness.endpoint)
+        params = tiny_params()
+        rc = wc.ship("gpt-test", params)
+        assert rc["total"] > 4 and rc["sent"] == rc["total"]
+        assert rc["skipped"] == 0
+        assert wc.total_chunks == rc["total"] and wc.total_bytes > 0
+        # re-ship of a registered name uploads NOTHING
+        rc2 = wc.ship("gpt-test", params)
+        assert rc2["sent"] == 0 and rc2["skipped"] == rc2["total"]
+        # a bare host pulls the identical tree
+        dl = WeightCourier(endpoint=harness.endpoint,
+                           spool_dir=str(tmp_path))
+        params_equal(dl.fetch("gpt-test"), params)
+        assert dl.total_chunks == rc["total"]
+        snap = dl.snapshot()
+        assert snap["chunks"] == rc["total"] and snap["resumes"] == 0
+        assert snap["endpoint"] == harness.endpoint
+
+    def test_upload_killed_mid_ship_resumes(self, harness,
+                                            monkeypatch):
+        wc = WeightCourier(store_cfg(), endpoint=harness.endpoint)
+        real = wmod._post_json
+        calls = {"chunk_posts": 0}
+
+        def dying(url, body, timeout_s=5.0):
+            if url.endswith("/store/weights/chunk"):
+                calls["chunk_posts"] += 1
+                if calls["chunk_posts"] > 3:
+                    raise SimKill()
+            return real(url, body, timeout_s=timeout_s)
+
+        monkeypatch.setattr(wmod, "_post_json", dying)
+        params = tiny_params(seed=1)
+        with pytest.raises(SimKill):
+            wc.ship("resume-up", params)
+        monkeypatch.setattr(wmod, "_post_json", real)
+        # a fresh courier (the respawned process) resumes: the 3
+        # verified chunks never travel again
+        wc2 = WeightCourier(store_cfg(), endpoint=harness.endpoint)
+        rc = wc2.ship("resume-up", params)
+        assert rc["skipped"] == 3
+        assert rc["sent"] == rc["total"] - 3
+        assert wc2.total_resumes == 1
+
+    def test_download_killed_mid_ship_resumes_ledger_balanced(
+            self, harness, tmp_path, monkeypatch):
+        """Chaos arm 2: worker SIGKILL'd mid-weight-ship. The respawn
+        (same spool dir) RESUMES from the fsync'd spool — counted, and
+        proven by the service ledger: every seq served exactly once
+        across the kill."""
+        up = WeightCourier(store_cfg(), endpoint=harness.endpoint)
+        params = tiny_params(seed=2)
+        total = up.ship("resume-dl", params)["total"]
+        assert total > 8
+        monkeypatch.setattr(wmod, "_FETCH_BATCH", 4)
+        real = wmod._post_json
+        calls = {"fetch_posts": 0}
+
+        def dying(url, body, timeout_s=5.0):
+            if url.endswith("/store/weights/fetch"):
+                calls["fetch_posts"] += 1
+                if calls["fetch_posts"] > 2:
+                    raise SimKill()
+            return real(url, body, timeout_s=timeout_s)
+
+        monkeypatch.setattr(wmod, "_post_json", dying)
+        dl = WeightCourier(endpoint=harness.endpoint,
+                           spool_dir=str(tmp_path))
+        with pytest.raises(SimKill):
+            dl.fetch("resume-dl")
+        assert dl.total_chunks == 8               # 2 batches spooled
+        monkeypatch.setattr(wmod, "_post_json", real)
+        # the respawned worker: same spool, fresh courier
+        dl2 = WeightCourier(endpoint=harness.endpoint,
+                            spool_dir=str(tmp_path))
+        params_equal(dl2.fetch("resume-dl"), params)
+        assert dl2.total_resumes == 1             # resumed, not restarted
+        assert dl2.total_chunks == total - 8      # spooled never re-pulled
+        served = harness.svc.weights.status("resume-dl")["served"]
+        assert sorted(int(s) for s in served) == list(range(total))
+        assert set(served.values()) == {1}        # balanced: once each
+
+    def test_torn_spool_refetches_only_torn_tail(self, harness,
+                                                 tmp_path):
+        up = WeightCourier(store_cfg(), endpoint=harness.endpoint)
+        params = tiny_params(seed=4)
+        total = up.ship("torn", params)["total"]
+        dl = WeightCourier(endpoint=harness.endpoint,
+                           spool_dir=str(tmp_path))
+        params_equal(dl.fetch("torn"), params)
+        # tear the spool mid-record (a kill mid-write): the intact
+        # prefix resumes, the torn tail silently re-fetches
+        spool = tmp_path / "torn.wspool"
+        spool.write_bytes(spool.read_bytes()[:-10])
+        dl2 = WeightCourier(endpoint=harness.endpoint,
+                            spool_dir=str(tmp_path))
+        params_equal(dl2.fetch("torn"), params)
+        assert dl2.total_resumes == 1
+        assert 1 <= dl2.total_chunks < total
+
+    def test_unreachable_store_names_endpoint(self):
+        wc = WeightCourier(endpoint=DEAD)
+        with pytest.raises(WeightShipError, match=DEAD):
+            wc.fetch("gpt-test")
+        with pytest.raises(WeightShipError, match=DEAD):
+            wc.ship("gpt-test", tiny_params(n=64))
+
+    def test_unknown_or_incomplete_name_refuses_boot(self, harness):
+        wc = WeightCourier(endpoint=harness.endpoint)
+        with pytest.raises(WeightShipError, match="ghost"):
+            wc.fetch("ghost")
+        # a half-uploaded checkpoint refuses the boot too
+        payload = {"params": tiny_params(seed=5)}
+        manifest, blob = encode_payload(payload, codec=CODEC_ZLIB)
+        chunks = make_chunks("weights-half", manifest, blob, 1024)
+        harness.svc.weights.begin("half", manifest, len(chunks),
+                                  int(manifest["nbytes"]))
+        harness.svc.weights.put_chunk("half", chunks[0])
+        with pytest.raises(WeightShipError, match="incomplete"):
+            wc.fetch("half")
+
+
+# ---------------------------------------------------------------------------
+# worker boot + supervisor surfaces
+# ---------------------------------------------------------------------------
+
+
+class TestBootSurfaces:
+    def test_worker_weights_from_store_needs_endpoint(self):
+        from click.testing import CliRunner
+
+        from distributed_llm_training_and_inference_system_tpu.cli.main import (  # noqa: E501
+            main as cli)
+        res = CliRunner().invoke(
+            cli, ["fleet", "worker", "--model", "gpt-test",
+                  "--weights-from-store"])
+        assert res.exit_code != 0
+        assert "--weights-from-store needs --store-endpoint" \
+            in res.output
+
+    @pytest.mark.socket
+    def test_worker_boot_against_dead_store_names_endpoint(self):
+        from click.testing import CliRunner
+
+        from distributed_llm_training_and_inference_system_tpu.cli.main import (  # noqa: E501
+            main as cli)
+        res = CliRunner().invoke(
+            cli, ["fleet", "worker", "--model", "gpt-test",
+                  "--store-endpoint", DEAD, "--weights-from-store"])
+        assert res.exit_code != 0
+        assert DEAD in res.output and "unreachable" in res.output
+
+    def test_supervisor_snapshot_embeds_weights_section(self):
+        from test_fleet_disagg import RoleFake
+
+        from distributed_llm_training_and_inference_system_tpu.serve.fleet.router import (  # noqa: E501
+            FleetRouter)
+        from distributed_llm_training_and_inference_system_tpu.serve.fleet.supervisor import (  # noqa: E501
+            ReplicaSupervisor)
+        cfg = FleetConfig(replicas=1, affinity_prefix_tokens=0)
+        reps = [RoleFake(0)]
+        wc = WeightCourier(endpoint=DEAD)
+        sup = ReplicaSupervisor(reps, FleetRouter(reps, cfg), cfg,
+                                weights=wc)
+        snap = sup.snapshot()
+        assert snap["weights"] == {"chunks": 0, "resumes": 0,
+                                   "bytes": 0, "endpoint": DEAD}
+        # no courier (in-proc fleets): section present, empty
+        sup2 = ReplicaSupervisor(reps, FleetRouter(reps, cfg), cfg)
+        assert sup2.snapshot()["weights"] == {}
