@@ -223,6 +223,19 @@ def status(url, as_json):
             f"({ks.get('spills', 0)} spills, "
             f"{ks.get('corrupt', 0)} corrupt) "
             f"[{ks.get('codec', '?')}]")
+    if ks and len(ks.get("endpoints") or []) > 1:
+        # replicated store tier: member reachability (the client's
+        # health view) + the failover counters
+        reach = ks.get("members") or {}
+        console.print(
+            "store tier: "
+            + ", ".join(f"{ep} {'up' if ok else 'DOWN'}"
+                        for ep, ok in reach.items())
+            + f" | {ks.get('retries', 0)} retries, "
+              f"{ks.get('failovers', 0)} failovers, "
+              f"{ks.get('hedges', 0)} hedged fetches, "
+              f"{ks.get('fenced_rejects', 0)} fenced rejects, "
+              f"{ks.get('sync_pulls', 0)} anti-entropy pulls")
     cour = snap.get("courier")
     if cour and (cour.get("transfers") or cour.get("aborts")
                  or cour.get("in_flight") or cour.get("expired")):
@@ -377,6 +390,12 @@ def migrate(request_id, replica, url):
                    "worker demotes evicted prefix pages there and "
                    "restores store-held pages from it (the networked "
                    "KV fabric).")
+@click.option("--store-endpoints", default="",
+              help="Comma-separated member URLs of a REPLICATED store "
+                   "tier (overrides --store-endpoint). The worker's "
+                   "store client retries transient errors, rotates to "
+                   "a survivor when a member dies, and fans demotions "
+                   "out to the write-ack floor.")
 @click.option("--weights-from-store", is_flag=True, default=False,
               help="Bootstrap engine weights from the store service "
                    "instead of a local artifact — a bare host needs "
@@ -398,8 +417,8 @@ def worker(model_name, artifact, replica_id, role, host, port,
            param_seed, courier_codec, courier_chunk_bytes,
            courier_retries, courier_deadline_ms, courier_backoff_ms,
            courier_backoff_max_ms, ticket_ttl_ms, restart_backoff,
-           migrate_on_drain, store_endpoint, weights_from_store,
-           weights_name, weights_spool, fault_plan):
+           migrate_on_drain, store_endpoint, store_endpoints,
+           weights_from_store, weights_name, weights_spool, fault_plan):
     """Run ONE fleet replica as its own OS process behind an HTTP front.
 
     The cross-host half of `llmctl serve start --fleet-remote-replicas`:
@@ -443,8 +462,9 @@ def worker(model_name, artifact, replica_id, role, host, port,
         courier_retry_backoff_max_ms=courier_backoff_max_ms,
         courier_ticket_ttl_ms=ticket_ttl_ms,
         kv_store_endpoint=store_endpoint,
+        kv_store_endpoints=store_endpoints,
         # the fetch plane is how store-held pages restore locally
-        prefix_fetch=bool(store_endpoint))
+        prefix_fetch=bool(store_endpoint or store_endpoints))
     fleet_cfg.validate()
     plan = None
     if fault_plan:
@@ -461,9 +481,10 @@ def worker(model_name, artifact, replica_id, role, host, port,
         # courier fabric the KV pages ride — chunk-CRC'd, end-to-end
         # verified, spool-resumable. A store that is down or does not
         # hold the name fails the BOOT loudly, naming the endpoint.
-        if not store_endpoint:
+        if not (store_endpoint or store_endpoints):
             raise click.ClickException(
-                "--weights-from-store needs --store-endpoint")
+                "--weights-from-store needs --store-endpoint or "
+                "--store-endpoints")
         import jax.numpy as jnp
 
         from ...serve.fleet.weights import WeightCourier, WeightShipError
@@ -611,8 +632,29 @@ def front(model_name, artifact, front_id, host, port, replicas,
                    "frames would waste its ring).")
 @click.option("--courier-chunk-bytes", default=256 * 1024,
               show_default=True, type=int)
+@click.option("--member-id", default="",
+              help="This process's stable id in a REPLICATED store "
+                   "tier (with --membership-dir). Attaching bumps the "
+                   "tier epoch; a fenced or stale incarnation's "
+                   "uploads are refused with a FATAL ack.")
+@click.option("--membership-dir", default="",
+              help="Shared directory holding the tier's fenced member "
+                   "registry (every member must see the same path — "
+                   "the SharedFileStateStore idiom). Members discover "
+                   "each other's endpoints through it, so anti-entropy "
+                   "needs no static --peer list.")
+@click.option("--peer", "peers", multiple=True,
+              help="Static peer member URL to anti-entropy against "
+                   "(repeatable; usually unnecessary — the membership "
+                   "registry advertises endpoints).")
+@click.option("--sync-interval-ms", default=1000.0, show_default=True,
+              type=float,
+              help="Anti-entropy cadence: how often this member diffs "
+                   "a peer's inventory and pulls what it lacks "
+                   "(un-counted in the hit/serve ledgers).")
 def store(host, port, dram_mb, spill_dir, disk_mb, ttl_ms,
-          courier_codec, courier_chunk_bytes):
+          courier_codec, courier_chunk_bytes, member_id,
+          membership_dir, peers, sync_interval_ms):
     """Run the fleet KV store as its own OS process — the networked
     KV fabric's hub.
 
@@ -635,12 +677,25 @@ def store(host, port, dram_mb, spill_dir, disk_mb, ttl_ms,
         courier_codec=courier_codec,
         courier_chunk_bytes=courier_chunk_bytes)
     cfg.validate()
-    StoreService(cfg).run_forever(host=host, port=port)
+    # warm=False: the disk-tier scan happens behind the /health
+    # readiness gate (503 "starting" until the frame index is warm) —
+    # spawners poll that instead of sleeping
+    StoreService(cfg, member_id=member_id,
+                 membership_dir=membership_dir, peers=list(peers),
+                 sync_interval_s=sync_interval_ms / 1e3,
+                 warm=False).run_forever(host=host, port=port)
 
 
 @app.command(name="ship-weights")
 @click.option("--store-endpoint", required=True,
-              help="Base URL of the `llmctl fleet store` service.")
+              help="Base URL of the `llmctl fleet store` service — "
+                   "comma-separated member URLs for a replicated tier "
+                   "(the ship fans out to every live member).")
+@click.option("--write-ack", default=0, show_default=True, type=int,
+              help="How many members must hold the complete payload "
+                   "before the ship succeeds (0 = ALL live members — "
+                   "the operator default: a ship that silently leaves "
+                   "a member bare should fail loudly).")
 @click.option("--model", "model_name", default="gpt-125m",
               show_default=True, help="Model template name.")
 @click.option("--artifact", default="",
@@ -654,8 +709,8 @@ def store(host, port, dram_mb, spill_dir, disk_mb, ttl_ms,
               help="Ship PRNG-initialised weights from this seed "
                    "instead of an artifact (cross-process determinism "
                    "for tests/dryrun).")
-def ship_weights(store_endpoint, model_name, artifact, weights_name,
-                 param_seed):
+def ship_weights(store_endpoint, write_ack, model_name, artifact,
+                 weights_name, param_seed):
     """Register a checkpoint in the store service over the wire.
 
     One immutable chunked payload under NAME: chunk-CRC'd in flight,
@@ -681,11 +736,12 @@ def ship_weights(store_endpoint, model_name, artifact, weights_name,
     else:
         raise click.ClickException(
             "ship-weights needs --artifact or --param-seed")
-    wc = WeightCourier(endpoint=store_endpoint)
+    wc = WeightCourier(endpoint=store_endpoint, write_ack=write_ack)
     try:
         out = wc.ship(weights_name or model_name, params)
     except WeightShipError as e:
         raise click.ClickException(str(e))
-    click.echo(f"weights {out['name']!r} registered: {out['sent']} "
-               f"chunks sent, {out['skipped']} already held "
+    click.echo(f"weights {out['name']!r} registered on "
+               f"{out['members']} member(s): {out['sent']} chunks "
+               f"sent, {out['skipped']} already held "
                f"({out['total']} total)")

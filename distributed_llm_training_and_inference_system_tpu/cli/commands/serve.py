@@ -300,6 +300,37 @@ def app(ctx):
                    "remote worker resolve ONE logical store — demoted "
                    "pages survive any single serving process. Requires "
                    "--fleet-prefix-fetch.")
+@click.option("--fleet-kv-store-endpoints", default="",
+              show_default=True,
+              help="Comma-separated member URLs of a REPLICATED store "
+                   "tier (overrides --fleet-kv-store-endpoint): N "
+                   "`llmctl fleet store` processes behind the one "
+                   "logical store. Demotions fan out to the write-ack "
+                   "floor, fetches fail over to survivors, and "
+                   "anti-entropy reconciles a rejoining member. "
+                   "Requires --fleet-prefix-fetch.")
+@click.option("--fleet-kv-store-retry-max", default=2, show_default=True,
+              type=int,
+              help="Transient-error retries (connection refused/reset) "
+                   "per store RPC before the member is rotated past — "
+                   "nothing is counted a miss until the budget is "
+                   "spent on every member.")
+@click.option("--fleet-kv-store-retry-backoff-ms", default=10.0,
+              show_default=True, type=float,
+              help="First retry delay for store RPCs; doubles per "
+                   "retry.")
+@click.option("--fleet-kv-store-write-ack", default=1, show_default=True,
+              type=int,
+              help="Store members that must acknowledge a demotion "
+                   "synchronously before it counts as stored; the "
+                   "remaining live members are mirrored "
+                   "asynchronously.")
+@click.option("--fleet-kv-store-hedge-ms", default=0.0, show_default=True,
+              type=float,
+              help="Hedged store fetches: when the first member has "
+                   "not answered within this window, race a second "
+                   "live member and take whichever answers first "
+                   "(0 disables).")
 @click.option("--fleet-pipeline-min-tokens", default=0, show_default=True,
               type=int,
               help="Pipelined multi-replica prefill: needs-prefill "
@@ -464,7 +495,9 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
           fleet_prefix_fetch_min_pages, fleet_kv_store,
           fleet_kv_store_dram_mb, fleet_kv_store_dir,
           fleet_kv_store_disk_mb, fleet_kv_store_ttl_ms,
-          fleet_kv_store_endpoint,
+          fleet_kv_store_endpoint, fleet_kv_store_endpoints,
+          fleet_kv_store_retry_max, fleet_kv_store_retry_backoff_ms,
+          fleet_kv_store_write_ack, fleet_kv_store_hedge_ms,
           fleet_pipeline_min_tokens, fleet_pipeline_max_stages,
           fleet_pipeline_stage_timeout_ms,
           fleet_inventory_ttl_ms,
@@ -549,6 +582,11 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
             kv_store_disk_mb=fleet_kv_store_disk_mb,
             kv_store_ttl_ms=fleet_kv_store_ttl_ms,
             kv_store_endpoint=fleet_kv_store_endpoint,
+            kv_store_endpoints=fleet_kv_store_endpoints,
+            kv_store_retry_max=fleet_kv_store_retry_max,
+            kv_store_retry_backoff_ms=fleet_kv_store_retry_backoff_ms,
+            kv_store_write_ack=fleet_kv_store_write_ack,
+            kv_store_hedge_ms=fleet_kv_store_hedge_ms,
             pipeline_prefill_min_tokens=fleet_pipeline_min_tokens,
             pipeline_prefill_max_stages=fleet_pipeline_max_stages,
             pipeline_prefill_stage_timeout_ms=(
@@ -616,7 +654,7 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
 
     server = create_server(model_cfg, serve_cfg, fleet_cfg=fleet_cfg,
                            observer=observer)
-    if fleet_cfg is not None and fleet_cfg.kv_store_endpoint \
+    if fleet_cfg is not None and fleet_cfg.kv_store_endpoint_list() \
             and fleet_cfg.autoscale_spawn == "worker" \
             and getattr(server, "fleet", None) is not None:
         # register the loaded checkpoint in the store service up front,
@@ -630,7 +668,8 @@ def start(model_name, artifact, host, port, max_batch_size, max_seq_len,
                        f"{shipped['skipped']} already held)")
         except Exception as e:
             raise click.ClickException(
-                f"weight ship to {fleet_cfg.kv_store_endpoint} failed "
+                f"weight ship to "
+                f"{','.join(fleet_cfg.kv_store_endpoint_list())} failed "
                 f"— spawned workers could not bootstrap: {e}")
     click.echo(f"serving {model_name} on {host}:{port} "
                f"(backend={jax.default_backend()}, dtype={dtype}, "

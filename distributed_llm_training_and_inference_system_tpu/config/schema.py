@@ -1011,6 +1011,34 @@ class FleetConfig:
     # frames; fetches replay them locally through the courier
     # receiver). "" = in-proc store (kv_store=true) or none.
     kv_store_endpoint: str = ""
+    # -- replicated store tier (serve/fleet/store_tier.py) -------------------
+    # comma-separated member URLs of a REPLICATED store tier: N
+    # `llmctl fleet store` processes behind the one logical
+    # KV_STORE_OWNER. Demotions/retire-flushes/ship-weights replicate
+    # to every live member (kv_store_write_ack of them synchronously,
+    # the rest async-mirrored) and the client fails over across members
+    # on fetch — a SIGKILLed member costs zero counted misses while a
+    # survivor holds the pages. Overrides kv_store_endpoint when set.
+    kv_store_endpoints: str = ""
+    # transient-error budget BEFORE a store RPC failure is surfaced:
+    # each member gets up to this many retries with doubling backoff
+    # (first wait kv_store_retry_backoff_ms) on connection
+    # refused/reset/timeout; only after every live member exhausts its
+    # budget does a fetch count a remote miss. Applies in single-store
+    # mode too (the PR-16 behavior was miss-on-first-refusal).
+    kv_store_retry_max: int = 2
+    kv_store_retry_backoff_ms: float = 10.0
+    # write-ack floor: a demotion/retire-flush/weight ship is
+    # acknowledged once this many members durably hold it; remaining
+    # live members are mirrored in the background. Must be <= the
+    # member count; raise it to the member count for synchronous full
+    # replication (what the chaos dryrun uses so a kill can never lose
+    # the only copy).
+    kv_store_write_ack: int = 1
+    # hedged fetch: > 0 races a second member when the first has not
+    # answered within this many ms (tail-latency insurance, Mooncake's
+    # "fetch from any holder"); 0 disables hedging.
+    kv_store_hedge_ms: float = 0.0
     # -- fleet SSE streaming (serve/fleet/streams.py) ------------------------
     # finished stream logs stay replayable (Last-Event-ID reconnect) for
     # this long before the hub GCs them; live logs never expire. 0 keeps
@@ -1108,6 +1136,18 @@ class FleetConfig:
     def endpoint_map(self) -> dict[int, str]:
         """Normalized {replica_id: base_url} courier endpoint map."""
         return parse_fleet_endpoints(self.fleet_endpoints)
+
+    def kv_store_endpoint_list(self) -> list:
+        """Ordered store-tier member URLs: ``kv_store_endpoints``
+        (comma-separated) when set, else the single
+        ``kv_store_endpoint``, else empty. The first entry is the
+        preferred member; clients rotate from it on failure."""
+        eps = [e.strip().rstrip("/")
+               for e in str(self.kv_store_endpoints or "").split(",")
+               if e.strip()]
+        if not eps and self.kv_store_endpoint:
+            eps = [str(self.kv_store_endpoint).rstrip("/")]
+        return eps
 
     def remote_replica_ids(self) -> set[int]:
         """Replica ids fronted by a remote `llmctl fleet worker`."""
@@ -1243,6 +1283,34 @@ class FleetConfig:
             raise ConfigError(
                 "kv_store_endpoint needs prefix_fetch — the fetch "
                 "plane is how store-held pages restore to a replica")
+        members = self.kv_store_endpoint_list()
+        for ep in ([] if not self.kv_store_endpoints else members):
+            if not ep.startswith(("http://", "https://")):
+                raise ConfigError(
+                    f"kv_store_endpoints entries must be http(s) base "
+                    f"URLs, got {ep!r}")
+        if self.kv_store_endpoints and not self.prefix_fetch:
+            raise ConfigError(
+                "kv_store_endpoints needs prefix_fetch — the fetch "
+                "plane is how store-held pages restore to a replica")
+        if self.kv_store_retry_max < 0:
+            raise ConfigError(
+                "kv_store_retry_max must be >= 0 (0 = fail on the "
+                "first refusal, the PR-16 behavior)")
+        if self.kv_store_retry_backoff_ms < 0:
+            raise ConfigError("kv_store_retry_backoff_ms must be >= 0")
+        if self.kv_store_hedge_ms < 0:
+            raise ConfigError(
+                "kv_store_hedge_ms must be >= 0 (0 disables hedged "
+                "fetches)")
+        if self.kv_store_write_ack < 1:
+            raise ConfigError(
+                "kv_store_write_ack must be >= 1 (at least one member "
+                "must durably hold a write before it is acknowledged)")
+        if members and self.kv_store_write_ack > len(members):
+            raise ConfigError(
+                f"kv_store_write_ack ({self.kv_store_write_ack}) "
+                f"exceeds the store-tier member count ({len(members)})")
         if self.state_compact_every < 0:
             raise ConfigError(
                 "state_compact_every must be >= 0 (0 disables journal "

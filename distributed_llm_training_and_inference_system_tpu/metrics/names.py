@@ -191,6 +191,26 @@ METRICS: dict[str, MetricSpec] = {
         COUNTER, "Store-service fetches that served zero pages here "
                  "(service unreachable, nothing held, or replay failed "
                  "verification) — degraded to plain prefill"),
+    # -- replicated store tier (N members, one KV_STORE_OWNER) -------------
+    "llmctl_fleet_kvstore_retry": MetricSpec(
+        COUNTER, "Store-service RPC retries on transient errors "
+                 "(connection refused/reset) before anything was "
+                 "counted a miss — bounded, doubling backoff"),
+    "llmctl_fleet_kvstore_failovers": MetricSpec(
+        COUNTER, "Store RPCs answered by a member other than the "
+                 "first one tried (health-gated endpoint rotation "
+                 "after a member died or partitioned)"),
+    "llmctl_fleet_kvstore_hedges": MetricSpec(
+        COUNTER, "Hedged store fetches fired: a second member raced "
+                 "because the first was slow past the hedge window"),
+    "llmctl_fleet_kvstore_fenced_rejects": MetricSpec(
+        COUNTER, "Writes refused by this store member with a FATAL "
+                 "ack because it is fenced or a stale incarnation "
+                 "(the zombie rule — never silently admitted)"),
+    "llmctl_fleet_kvstore_sync_pulls": MetricSpec(
+        COUNTER, "Entries (KV frames + weight chunks) this store "
+                 "member pulled from peers during anti-entropy "
+                 "reconciliation (un-counted in hit/serve ledgers)"),
     "llmctl_fleet_weights_chunks": MetricSpec(
         COUNTER, "Checkpoint chunks moved through the store service by "
                  "this process's weight courier (ships + fetches; "
@@ -354,6 +374,7 @@ COUNTER_SNAPSHOT_FN = {
     "FleetFrontTier": ("serve/fleet/front.py", "snapshot"),
     "FleetKVStore": ("serve/fleet/kv_store.py", "snapshot"),
     "StoreClient": ("serve/fleet/store_service.py", "snapshot"),
+    "StoreService": ("serve/fleet/store_service.py", "status_dict"),
     "WeightCourier": ("serve/fleet/weights.py", "snapshot"),
     "PipelineCoordinator": ("serve/fleet/pipeline.py", "snapshot"),
     "FleetAutoscaler": ("serve/fleet/autoscaler.py", "snapshot"),
@@ -449,12 +470,27 @@ COUNTER_FLOW: tuple[CounterFlow, ...] = (
                 "llmctl_fleet_kvstore_remote_hits"),
     CounterFlow("StoreClient", "total_remote_misses", "remote_misses",
                 "llmctl_fleet_kvstore_remote_misses"),
+    CounterFlow("StoreClient", "total_retries", "retries",
+                "llmctl_fleet_kvstore_retry"),
+    CounterFlow("StoreClient", "total_failovers", "failovers",
+                "llmctl_fleet_kvstore_failovers"),
+    CounterFlow("StoreClient", "total_hedges", "hedges",
+                "llmctl_fleet_kvstore_hedges"),
+    # replicated-tier service counters -> StoreService.status_dict()
+    # kv_store-section keys (scraped off each member's /store/status)
+    CounterFlow("StoreService", "total_fenced_rejects", "fenced_rejects",
+                "llmctl_fleet_kvstore_fenced_rejects"),
+    CounterFlow("StoreService", "total_sync_pulls", "sync_pulls",
+                "llmctl_fleet_kvstore_sync_pulls"),
+    CounterFlow("StoreService", "total_sync_rounds", "sync_rounds",
+                None),
     # weight-courier counters -> WeightCourier.snapshot() keys (the
     # supervisor snapshot embeds the "weights" section wholesale)
     CounterFlow("WeightCourier", "total_chunks", "chunks",
                 "llmctl_fleet_weights_chunks"),
     CounterFlow("WeightCourier", "total_resumes", "resumes",
                 "llmctl_fleet_weights_resumes"),
+    CounterFlow("WeightCourier", "total_failovers", "failovers", None),
     CounterFlow("WeightCourier", "total_bytes", "bytes",
                 "llmctl_fleet_weights_bytes"),
     # pipelined-prefill counters -> PipelineCoordinator.snapshot() keys

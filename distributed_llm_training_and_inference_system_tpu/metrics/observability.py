@@ -262,6 +262,16 @@ class PrometheusExporter:
             "llmctl_fleet_kvstore_remote_hits")
         self.fleet_kvstore_remote_misses = mk(
             "llmctl_fleet_kvstore_remote_misses")
+        # replicated store tier (serve/fleet/store_tier.py): client
+        # failover + member fencing/anti-entropy
+        self.fleet_kvstore_retry = mk("llmctl_fleet_kvstore_retry")
+        self.fleet_kvstore_failovers = mk(
+            "llmctl_fleet_kvstore_failovers")
+        self.fleet_kvstore_hedges = mk("llmctl_fleet_kvstore_hedges")
+        self.fleet_kvstore_fenced_rejects = mk(
+            "llmctl_fleet_kvstore_fenced_rejects")
+        self.fleet_kvstore_sync_pulls = mk(
+            "llmctl_fleet_kvstore_sync_pulls")
         self.fleet_weights_chunks = mk("llmctl_fleet_weights_chunks")
         self.fleet_weights_resumes = mk("llmctl_fleet_weights_resumes")
         self.fleet_weights_bytes = mk("llmctl_fleet_weights_bytes")
@@ -503,7 +513,15 @@ class PrometheusExporter:
                 # networked backend only: the client-side replay/miss
                 # counts (the in-proc store never sets these keys)
                 ("remote_hits", self.fleet_kvstore_remote_hits),
-                ("remote_misses", self.fleet_kvstore_remote_misses)):
+                ("remote_misses", self.fleet_kvstore_remote_misses),
+                # replicated tier: client failover counters plus the
+                # member-side fencing/anti-entropy counts (the latter
+                # appear when this process scrapes a member's status)
+                ("retries", self.fleet_kvstore_retry),
+                ("failovers", self.fleet_kvstore_failovers),
+                ("hedges", self.fleet_kvstore_hedges),
+                ("fenced_rejects", self.fleet_kvstore_fenced_rejects),
+                ("sync_pulls", self.fleet_kvstore_sync_pulls)):
             total = ks.get(key, 0)
             delta = total - self._last_totals.get(f"fleet_ks_{key}", 0)
             if delta > 0:

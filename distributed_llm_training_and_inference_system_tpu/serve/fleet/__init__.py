@@ -151,15 +151,21 @@ class ServeFleet:
         # drain-flushed pages here; the router's hint path falls back to
         # it when no live replica covers a prompt; fetches replay the
         # frames through the courier receiver. None = no store tier.
-        # None = no store tier; with `kv_store_endpoint` set the SAME
-        # logical store lives in a separate `llmctl fleet store`
-        # process and a duck-compatible StoreClient (demote_async /
-        # holds / inventory / fetch / snapshot) stands in for it — the
+        # None = no store tier; with `kv_store_endpoint` (or the
+        # replicated `kv_store_endpoints` list) set the SAME logical
+        # store lives in separate `llmctl fleet store` process(es) and
+        # a duck-compatible StoreClient (demote_async / holds /
+        # inventory / fetch / snapshot) stands in for it — the
         # networked KV fabric: every front and every remote worker
         # resolve ONE store, so pages survive any single serving
-        # process.
-        if getattr(self.fleet_cfg, "kv_store_endpoint", ""):
-            self.kv_store = StoreClient(self.fleet_cfg)
+        # process — and with N members behind the one KV_STORE_OWNER,
+        # any single STORE process too (failover + write fan-out live
+        # in the client; the injector seeds store kill/partition
+        # chaos).
+        store_eps = self.fleet_cfg.kv_store_endpoint_list()
+        if store_eps:
+            self.kv_store = StoreClient(self.fleet_cfg,
+                                        injector=self.injector)
         elif self.fleet_cfg.kv_store:
             self.kv_store = FleetKVStore(self.fleet_cfg)
         else:
@@ -170,8 +176,8 @@ class ServeFleet:
         # the loaded params so bare `--weights-from-store` workers can
         # bootstrap over the wire.
         self.weight_courier = (
-            WeightCourier(self.fleet_cfg)
-            if getattr(self.fleet_cfg, "kv_store_endpoint", "") else None)
+            WeightCourier(self.fleet_cfg, injector=self.injector)
+            if store_eps else None)
         # replicable front state (serve/fleet/state.py): the stream logs
         # and router ledger live behind this store. The default
         # in-memory store keeps today's single-front behavior
@@ -262,7 +268,8 @@ class ServeFleet:
                     self.model_cfg, self.serve_cfg, self.fleet_cfg,
                     weights_name=self.serve_cfg.model),
                 spawn_timeout_s=self.fleet_cfg
-                .autoscale_spawn_timeout_s)
+                .autoscale_spawn_timeout_s,
+                store_endpoints=store_eps)
         self.autoscaler = (FleetAutoscaler(self, self.fleet_cfg,
                                            spawner=spawner)
                            if self.fleet_cfg.autoscale else None)

@@ -95,9 +95,18 @@ def synthesize_worker_argv(model_cfg, serve_cfg, fleet_cfg,
     if getattr(serve_cfg, "speculative", "off") != "off":
         argv += ["--speculative", str(serve_cfg.speculative),
                  "--spec-tokens", str(serve_cfg.speculative_tokens)]
-    store_ep = str(getattr(fleet_cfg, "kv_store_endpoint", "") or "")
-    if store_ep:
-        argv += ["--store-endpoint", store_ep, "--weights-from-store"]
+    lister = getattr(fleet_cfg, "kv_store_endpoint_list", None)
+    store_eps = (list(lister()) if callable(lister) else
+                 ([str(fleet_cfg.kv_store_endpoint)]
+                  if getattr(fleet_cfg, "kv_store_endpoint", "") else []))
+    if store_eps:
+        # the whole member list travels: a spawned worker must survive
+        # the same store death the parent does
+        if len(store_eps) > 1:
+            argv += ["--store-endpoints", ",".join(store_eps)]
+        else:
+            argv += ["--store-endpoint", store_eps[0]]
+        argv += ["--weights-from-store"]
         if weights_name:
             argv += ["--weights-name", str(weights_name)]
         if spool_dir:
@@ -120,14 +129,27 @@ class ProcessWorkerSpawner:
     READY_RE = re.compile(r"LLMCTL_WORKER_READY port=(\d+)")
 
     def __init__(self, argv_base: list, host: str = "127.0.0.1",
-                 spawn_timeout_s: float = 30.0):
+                 spawn_timeout_s: float = 30.0, store_endpoints=()):
         self.argv_base = list(argv_base)
         self.host = host
         self.spawn_timeout_s = float(spawn_timeout_s)
+        # store tier the spawned worker will bootstrap from: spawn()
+        # gates on its readiness (/health leaving 503 "starting")
+        # instead of letting the worker burn its spawn timeout against
+        # a store still scanning its disk tier
+        self.store_endpoints = list(store_endpoints or ())
         self._procs: dict[int, object] = {}
 
     def spawn(self, replica_id: int) -> Optional[str]:
         import subprocess
+        if self.store_endpoints:
+            from .store_tier import wait_store_ready
+            if not wait_store_ready(self.store_endpoints,
+                                    timeout_s=self.spawn_timeout_s):
+                logger.warning(
+                    "worker %d not spawned: store tier %s never became "
+                    "ready", replica_id, ",".join(self.store_endpoints))
+                return None
         argv = self.argv_base + ["--replica-id", str(replica_id),
                                  "--port", "0"]
         try:

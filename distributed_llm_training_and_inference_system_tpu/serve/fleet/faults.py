@@ -122,6 +122,29 @@ class FaultPlan:
     front_stall_ms: float = 200.0
     front_fault_lo_s: float = 0.5
     front_fault_hi_s: float = 3.0
+    # HA store tier faults (serve/fleet/store_tier.py): kill (SIGKILL)
+    # or stall (SIGSTOP, SIGCONT after `store_stall_ms`) the store
+    # member process at `store_*_member` once, `store_*_after_s`
+    # seconds after the tier starts — delivered by whoever babysits the
+    # member processes via ``store_faults_due``, exactly the front-tier
+    # pattern. after_s <= 0 draws from the seeded stream (seed+3) in
+    # [store_fault_lo_s, store_fault_hi_s). `store_partition_member`
+    # black-holes a member from THIS process's store clients:
+    # ``on_store_rpc`` passes the first `store_partition_after_calls`
+    # RPCs to that member through, then blocks the next
+    # `store_partition_count` (-1 = forever) — the client sees
+    # connection-refused, exercising retry/rotation without any real
+    # process dying.
+    store_kill_member: Optional[int] = None
+    store_kill_after_s: float = 0.0
+    store_stall_member: Optional[int] = None
+    store_stall_after_s: float = 0.0
+    store_stall_ms: float = 200.0
+    store_partition_member: Optional[int] = None
+    store_partition_count: int = 0
+    store_partition_after_calls: int = 0
+    store_fault_lo_s: float = 0.5
+    store_fault_hi_s: float = 3.0
 
 
 class FaultInjector:
@@ -168,6 +191,26 @@ class FaultInjector:
         self._front_stall_at = (_front_at(p.front_stall_after_s)
                                 if p.front_stall_front is not None
                                 else None)
+        # store-fault state: its own stream (seed+3) so store draws
+        # never alias front draws; partition consumption mirrors the
+        # RPC blackhole
+        store_rng = np.random.default_rng(p.seed + 3)
+
+        def _store_at(after_s: float) -> float:
+            if after_s > 0:
+                return after_s
+            return float(store_rng.uniform(
+                p.store_fault_lo_s,
+                max(p.store_fault_hi_s, p.store_fault_lo_s + 1e-3)))
+
+        self._store_kill_at = (_store_at(p.store_kill_after_s)
+                               if p.store_kill_member is not None
+                               else None)
+        self._store_stall_at = (_store_at(p.store_stall_after_s)
+                                if p.store_stall_member is not None
+                                else None)
+        self._store_calls: dict[int, int] = {}
+        self._store_partition_left = p.store_partition_count
 
     @property
     def wants_request_ids(self) -> bool:
@@ -303,6 +346,48 @@ class FaultInjector:
                             float(p.front_stall_ms)))
                 self._front_stall_at = None
         return due
+
+    def store_faults_due(self, elapsed_s: float) -> list[tuple]:
+        """Called by whoever babysits the store member processes with
+        the seconds since the tier started. Returns the store faults
+        now due, each at most once, as ``("kill", member_index)`` /
+        ``("stall", member_index, stall_ms)`` tuples — the babysitter
+        delivers the signals (SIGKILL / SIGSTOP+SIGCONT)."""
+        due: list[tuple] = []
+        p = self.plan
+        with self._lock:
+            if self._store_kill_at is not None \
+                    and elapsed_s >= self._store_kill_at:
+                due.append(("kill", int(p.store_kill_member)))
+                self._store_kill_at = None
+            if self._store_stall_at is not None \
+                    and elapsed_s >= self._store_stall_at:
+                due.append(("stall", int(p.store_stall_member),
+                            float(p.store_stall_ms)))
+                self._store_stall_at = None
+        return due
+
+    def on_store_rpc(self, member_index: int) -> bool:
+        """Called by StoreClient/WeightCourier before each RPC to store
+        member ``member_index``. Returns True when the injected
+        partition blocks this call (the client treats it as connection
+        refused). The first `store_partition_after_calls` RPCs to the
+        member pass through — a partition that begins MID-transfer —
+        then `store_partition_count` calls block (-1 = forever)."""
+        p = self.plan
+        if p.store_partition_member is None \
+                or member_index != p.store_partition_member:
+            return False
+        with self._lock:
+            n = self._store_calls.get(member_index, 0)
+            self._store_calls[member_index] = n + 1
+            if n < p.store_partition_after_calls:
+                return False
+            if self._store_partition_left == 0:
+                return False
+            if self._store_partition_left > 0:
+                self._store_partition_left -= 1
+            return True
 
     def steps_taken(self, replica_id: int) -> int:
         with self._lock:

@@ -298,14 +298,16 @@ class FleetKVStore:
         return True
 
     @thread_seam
-    def export_frames(self, hashes: list) -> list:
+    def export_frames(self, hashes: list, count: bool = True) -> list:
         """The store service's fetch path: the longest held prefix of
         ``hashes`` as ``(hex_hash, manifest, frames, wire_bytes)`` rows,
         frames byte-identical to what was admitted — the FETCHER replays
         them through its own CourierReceiver, so verification happens at
         the destination exactly like a live transfer. Hits and served
         bytes are counted here (the serving side); an empty result is a
-        counted miss."""
+        counted miss. ``count=False`` is the anti-entropy path — a peer
+        reconciling its holdings must not pollute the client-traffic
+        hit/miss ledger."""
         out = []
         for h in hashes:
             h = bytes(h)
@@ -329,14 +331,64 @@ class FleetKVStore:
                         self.total_corrupt += 1
                         self.total_evictions += 1
                         break
-                self.total_hits += 1
-                self.total_bytes_served += entry.wire_bytes
+                if count:
+                    self.total_hits += 1
+                    self.total_bytes_served += entry.wire_bytes
                 out.append((h.hex(), entry.manifest, frames,
                             entry.wire_bytes))
-        if not out:
+        if not out and count:
             with self._lock:
                 self.total_misses += 1
         return out
+
+    @thread_seam
+    def scan_disk(self) -> int:
+        """Index pre-existing spill files (``{hash}.kvf``) under
+        ``kv_store_dir`` — the store service's warm-up: a member
+        restarted over its old directory re-advertises everything it
+        spilled before dying, and anti-entropy only has to pull the
+        DRAM-tier delta. Headers are parsed (a torn header file is
+        unlinked, counted corrupt); frame DATA stays on disk and is
+        CRC-checked at replay like any spilled entry. Returns how many
+        entries were newly indexed."""
+        if not self.disk_dir:
+            return 0
+        try:
+            names = sorted(os.listdir(self.disk_dir))
+        except OSError:
+            return 0
+        indexed = 0
+        for fname in names:
+            if not fname.endswith(".kvf"):
+                continue
+            path = os.path.join(self.disk_dir, fname)
+            try:
+                h = bytes.fromhex(fname[:-4])
+            except ValueError:
+                continue
+            try:
+                with open(path, "rb") as fh:
+                    header = json.loads(fh.readline())
+                manifest = dict(header["manifest"])
+                wire = int(header["wire_bytes"])
+                raw = int(header.get("raw_bytes", 0))
+            except (OSError, ValueError, KeyError, TypeError):
+                self._unlink(path)
+                with self._lock:
+                    self.total_corrupt += 1
+                continue
+            with self._lock:
+                if h in self._dram or h in self._disk:
+                    continue
+                self._disk[h] = _Entry(None, manifest, wire, raw,
+                                       time.monotonic(), path=path)
+                self.disk_bytes += wire
+                self._enforce_caps_locked()
+                indexed += 1
+        if indexed:
+            logger.info("kv store disk scan: %d spilled entries "
+                        "re-indexed from %s", indexed, self.disk_dir)
+        return indexed
 
     # -- capacity / tiering --------------------------------------------------
 

@@ -148,13 +148,20 @@ class FleetRouter:
             self._endpoints = {}
         # networked store service: its endpoint joins the map under the
         # KV_STORE_OWNER sentinel (whether configured as "store=URL" in
-        # fleet_endpoints or as kv_store_endpoint), so store hints are
+        # fleet_endpoints, as kv_store_endpoint, or as the replicated
+        # kv_store_endpoints member list — the FIRST member is
+        # advertised; a worker whose own member list contains it fetches
+        # through its own failover-capable client), so store hints are
         # honorable by REMOTE destinations too — the worker fetches
         # straight from the service, closing the item-2 skip gap.
-        _store_ep = str(getattr(self.cfg, "kv_store_endpoint", "")
-                        or "").rstrip("/")
-        if _store_ep:
-            self._endpoints.setdefault(KV_STORE_OWNER, _store_ep)
+        if hasattr(self.cfg, "kv_store_endpoint_list"):
+            _store_eps = self.cfg.kv_store_endpoint_list()
+        else:
+            _ep = str(getattr(self.cfg, "kv_store_endpoint", "")
+                      or "").rstrip("/")
+            _store_eps = [_ep] if _ep else []
+        if _store_eps:
+            self._endpoints.setdefault(KV_STORE_OWNER, _store_eps[0])
         # inventory TTL cache (PR-7 named gap): > 0 bounds how often the
         # hint path re-reads every replica's prefix-page inventory.
         # Invalidated wholesale on replica teardown/drain/undrain/

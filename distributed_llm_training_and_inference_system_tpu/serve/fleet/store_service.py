@@ -40,6 +40,28 @@ not a per-process cache. This module promotes the store accordingly:
 Degrade semantics are unchanged from the in-proc store: an unreachable
 or killed service is a counted remote miss and the destination
 prefills plainly — degraded, never wrong tokens.
+
+This PR grows both halves into a REPLICATED tier (serve/fleet/
+store_tier.py holds the membership + health machinery):
+
+- A :class:`StoreService` may join an epoch-fenced membership registry
+  (``--member-id`` + ``--membership-dir``): writes from a fenced or
+  stale-epoch incarnation are refused with a FATAL ack (``{"ok":
+  false, "fatal": true}``) — never silently admitted — and a
+  background anti-entropy loop reconciles holdings by entry digest
+  against the registry-discovered peers (un-counted pulls, so the
+  hit/miss and per-seq serve ledgers stay pure client traffic).
+- :class:`StoreClient` fans writes out to every live member
+  (``kv_store_write_ack`` synchronously, the rest async-mirrored on
+  the encode thread) and grows fetch failover: bounded
+  retry-with-doubling-backoff on transient errors, health-gated
+  endpoint rotation, and optional hedged fetches racing two members —
+  a dead member is zero counted misses while a survivor holds the
+  pages.
+- ``/health`` is a readiness gate: 503 ``{"status": "starting"}``
+  until the disk tier is scanned and the frame index warm (503
+  ``{"status": "fenced"}`` after fencing), so spawners wait on it
+  instead of sleeping.
 """
 
 from __future__ import annotations
@@ -59,6 +81,7 @@ from typing import Optional
 from ...analysis.annotations import aiohttp_handler, thread_seam
 from ..kv_cache import concat_page_payloads
 from .kv_store import FleetKVStore, _page_slice
+from .store_tier import EndpointSet, StoreMembership, parse_endpoint_spec
 from .transport import (CODEC_NONE, CODEC_ZLIB, CourierChunk,
                         encode_payload, make_chunks)
 
@@ -121,14 +144,27 @@ class _WeightLedger:
 
     @thread_seam
     def begin(self, name: str, manifest: dict, total: int,
-              nbytes: int) -> dict:
+              nbytes: int, shards: Optional[dict] = None,
+              chunk_bytes: int = 0) -> dict:
+        """``shards`` is the optional per-shard chunk manifest
+        ({top-level param name: {"seq_lo", "seq_hi", "byte_lo",
+        "byte_hi"}}) the shipper computed from the payload's
+        sorted-path layout — a tp>1 bootstrap fetches only its shards'
+        seq ranges instead of the whole checkpoint."""
         with self._lock:
             rec = self._names.get(name)
             if rec is None:
                 rec = {"manifest": manifest, "total": int(total),
                        "nbytes": int(nbytes), "chunks": {},
-                       "served": {}, "born": time.monotonic()}
+                       "served": {}, "born": time.monotonic(),
+                       "shards": dict(shards or {}),
+                       "chunk_bytes": int(chunk_bytes)}
                 self._names[name] = rec
+            elif shards and not rec.get("shards"):
+                # a re-ship from a newer courier backfills the shard
+                # map on a payload begun without one
+                rec["shards"] = dict(shards)
+                rec["chunk_bytes"] = int(chunk_bytes)
             return {"ok": True, "have": sorted(rec["chunks"]),
                     "total": rec["total"]}
 
@@ -161,6 +197,8 @@ class _WeightLedger:
                     "nbytes": rec["nbytes"],
                     "have": sorted(rec["chunks"]),
                     "complete": len(rec["chunks"]) >= rec["total"],
+                    "shards": rec.get("shards") or {},
+                    "chunk_bytes": int(rec.get("chunk_bytes", 0)),
                     "served": {str(k): v
                                for k, v in sorted(rec["served"].items())}}
 
@@ -194,6 +232,41 @@ class _WeightLedger:
             return {"ok": True, "chunks": out}
 
     @thread_seam
+    def names(self) -> dict:
+        """{name: {"total", "have", "complete"}} — the anti-entropy
+        diff surface (what a rejoining peer compares before pulling)."""
+        with self._lock:
+            return {name: {"total": rec["total"],
+                           "have": sorted(rec["chunks"]),
+                           "complete": (len(rec["chunks"])
+                                        >= rec["total"])}
+                    for name, rec in self._names.items()}
+
+    @thread_seam
+    def peek_chunks(self, name: str, seqs: list) -> dict:
+        """Anti-entropy chunk export: like :meth:`take_chunks` but
+        UN-COUNTED (the per-seq serve ledger must stay a record of
+        client downloads only) and tolerant of an incomplete payload —
+        a peer reconciles whatever verified chunks this member holds."""
+        with self._lock:
+            rec = self._names.get(name)
+            if rec is None:
+                return {"ok": False,
+                        "error": f"unknown weights name {name!r}"}
+            out = []
+            for seq in seqs:
+                held = rec["chunks"].get(int(seq))
+                if held is None:
+                    continue
+                crc, data = held
+                out.append(CourierChunk(
+                    ticket=f"weights-{name}", seq=int(seq),
+                    total=rec["total"], crc32=crc, data=data,
+                    manifest=rec["manifest"] if int(seq) == 0 else None
+                ).to_wire())
+            return {"ok": True, "chunks": out}
+
+    @thread_seam
     def snapshot(self) -> dict:
         with self._lock:
             return {
@@ -211,17 +284,201 @@ class StoreService:
     """The standalone store process: one :class:`FleetKVStore` + one
     :class:`_WeightLedger` behind a small aiohttp front. All handlers
     are thin — the store's own lock is the concurrency story, exactly
-    as when it lived inside a front."""
+    as when it lived inside a front.
 
-    def __init__(self, cfg=None):
+    With a ``member_id`` + ``membership_dir`` the process is one member
+    of a REPLICATED tier: it attaches to the epoch-fenced registry
+    (recording its endpoint, so peers discover each other with no
+    static list), heartbeats it, refuses writes with a FATAL ack once
+    fenced or superseded, and runs background anti-entropy — pulling
+    entries it lacks (by digest) and weight chunks it lacks (by seq)
+    from live peers over the ordinary frame contract, un-counted."""
+
+    def __init__(self, cfg=None, member_id: str = "",
+                 membership_dir: str = "", peers=(),
+                 sync_interval_s: float = 1.0, warm: bool = True):
         self.cfg = cfg
         self.store = FleetKVStore(cfg)
         self.weights = _WeightLedger()
+        self.member_id = str(member_id or "")
+        self.peers = parse_endpoint_spec(peers)
+        self.sync_interval_s = float(sync_interval_s)
+        self.endpoint = ""         # advertised after bind (run_forever)
+        self.membership: Optional[StoreMembership] = None
+        if self.member_id and membership_dir:
+            self.membership = StoreMembership(membership_dir,
+                                              self.member_id)
+        self._tier_lock = threading.Lock()
+        self._ready = threading.Event()
+        self._stop = threading.Event()
+        # tier counters (snapshotted by status_dict; they ride the
+        # kv_store section so the client merge / supervisor snapshot /
+        # Prometheus pump read them like any store counter)
+        self.total_fenced_rejects = 0  # writes refused w/ a FATAL ack
+        self.total_sync_pulls = 0      # entries+chunks anti-entropy
+        #                                pulled from peers
+        self.total_sync_rounds = 0     # completed anti-entropy rounds
+        if warm:
+            self.warm()
+
+    # -- readiness / fencing -------------------------------------------------
+
+    def warm(self) -> None:
+        """Scan the disk tier into the frame index, then open the
+        readiness gate (``/health`` 200). A restarted member re-serves
+        everything it spilled before dying; anti-entropy only has to
+        pull the DRAM-tier delta."""
+        self.store.scan_disk()
+        self._ready.set()
+
+    @property
+    def ready(self) -> bool:
+        return self._ready.is_set()
+
+    def _write_guard(self) -> Optional[str]:
+        """None = admit; else the FATAL refusal reason (fenced zombie /
+        stale incarnation). Counted — a zombie whose uploads vanish
+        silently is exactly the bug fencing exists to prevent."""
+        if self.membership is None:
+            return None
+        reason = self.membership.guard_write()
+        if reason is not None:
+            with self._tier_lock:
+                self.total_fenced_rejects += 1
+            logger.warning("store write refused: %s", reason)
+        return reason
+
+    # -- anti-entropy --------------------------------------------------------
+
+    def _sync_peers(self) -> list:
+        peers = list(self.peers)
+        if self.membership is not None:
+            for ep in self.membership.peer_endpoints():
+                if ep and ep != self.endpoint and ep not in peers:
+                    peers.append(ep)
+        return [p for p in peers if p != self.endpoint]
+
+    @thread_seam
+    def sync_once(self, timeout_s: float = 5.0) -> dict:
+        """One anti-entropy round: for each live peer, diff its KV
+        inventory and weight-chunk holdings against ours and pull what
+        we lack — single-hash un-counted fetches (``count: false``)
+        admitted through the same CRC-verified path as a client upload,
+        and ``/store/weights/sync`` chunk peeks that leave the per-seq
+        serve ledger untouched. A fenced member does not sync (its
+        admissions would be writes)."""
+        stats = {"peers": 0, "kv_pulled": 0, "chunks_pulled": 0}
+        if self.membership is not None \
+                and self.membership.guard_write() is not None:
+            return stats
+        for peer in self._sync_peers():
+            inv = _post_json(f"{peer}/store/inventory",
+                             {"max_entries": 0}, timeout_s=timeout_s)
+            if inv is None or not inv.get("ok"):
+                continue
+            stats["peers"] += 1
+            try:
+                theirs = [bytes.fromhex(h)
+                          for h in inv.get("hashes", [])]
+            except (TypeError, ValueError):
+                theirs = []
+            for h in theirs:
+                if self.store.holds(h):
+                    continue
+                out = _post_json(f"{peer}/store/fetch",
+                                 {"hashes": [h.hex()], "count": False},
+                                 timeout_s=timeout_s)
+                for row in (out or {}).get("pages", []):
+                    try:
+                        got_h = bytes.fromhex(str(row["hash"]))
+                        frames = _frames_from_wire(row["frames"])
+                        manifest = dict(row["manifest"])
+                    except (KeyError, TypeError, ValueError):
+                        continue
+                    raw = int(manifest.get("nbytes", 0))
+                    if self.store.admit_frames(got_h, frames, manifest,
+                                               raw):
+                        stats["kv_pulled"] += 1
+            stats["chunks_pulled"] += self._sync_weights(peer,
+                                                         timeout_s)
+        with self._tier_lock:
+            self.total_sync_pulls += (stats["kv_pulled"]
+                                      + stats["chunks_pulled"])
+            self.total_sync_rounds += 1
+        if stats["kv_pulled"] or stats["chunks_pulled"]:
+            logger.info("anti-entropy: pulled %d kv entries, %d weight "
+                        "chunks from %d peers", stats["kv_pulled"],
+                        stats["chunks_pulled"], stats["peers"])
+        return stats
+
+    def _sync_weights(self, peer: str, timeout_s: float) -> int:
+        names = _get_json(f"{peer}/store/weights/names",
+                          timeout_s=timeout_s)
+        if names is None or not names.get("ok"):
+            return 0
+        pulled = 0
+        mine = self.weights.names()
+        for name, info in (names.get("names") or {}).items():
+            their_have = set(int(s) for s in info.get("have", []))
+            local = mine.get(name)
+            my_have = set(int(s) for s in (local or {}).get("have", []))
+            want = sorted(their_have - my_have)
+            if not want:
+                continue
+            if local is None:
+                st = _get_json(
+                    f"{peer}/store/weights/status?name={name}",
+                    timeout_s=timeout_s)
+                if st is None or not st.get("ok"):
+                    continue
+                self.weights.begin(
+                    name, dict(st["manifest"]), int(st["total"]),
+                    int(st.get("nbytes", 0)),
+                    shards=st.get("shards") or None,
+                    chunk_bytes=int(st.get("chunk_bytes", 0)))
+            for i in range(0, len(want), 64):
+                out = _post_json(f"{peer}/store/weights/sync",
+                                 {"name": name,
+                                  "seqs": want[i:i + 64]},
+                                 timeout_s=timeout_s)
+                for wire in (out or {}).get("chunks", []):
+                    try:
+                        chunk = CourierChunk.from_wire(wire)
+                    except Exception:
+                        continue
+                    ack = self.weights.put_chunk(name, chunk)
+                    if ack.get("ok") and not ack.get("duplicate"):
+                        pulled += 1
+        return pulled
+
+    def _sync_loop(self) -> None:
+        while not self._stop.wait(self.sync_interval_s):
+            if not self._ready.is_set():
+                continue
+            try:
+                self.sync_once()
+            except Exception:
+                logger.exception("anti-entropy round failed (retried "
+                                 "next interval)")
+
+    def _heartbeat_loop(self) -> None:
+        assert self.membership is not None
+        interval = max(self.membership.expiry_s / 3.0, 0.05)
+        while not self._stop.wait(interval):
+            try:
+                self.membership.heartbeat(
+                    {"endpoint": self.endpoint,
+                     "ready": self._ready.is_set()})
+            except Exception:
+                logger.exception("membership heartbeat failed")
 
     # -- RPC bodies (also driven directly by tests) --------------------------
 
     @aiohttp_handler
     def demote_wire(self, body: dict) -> dict:
+        guard = self._write_guard()
+        if guard is not None:
+            return {"ok": False, "fatal": True, "error": guard}
         try:
             h = bytes.fromhex(str(body["hash"]))
             frames = _frames_from_wire(body["frames"])
@@ -242,7 +499,8 @@ class StoreService:
             return {"ok": False, "error": "malformed hashes"}
         if not hashes:
             return {"ok": False, "error": "body must be {hashes}"}
-        rows = self.store.export_frames(hashes)
+        rows = self.store.export_frames(
+            hashes, count=bool(body.get("count", True)))
         return {"ok": True,
                 "pages": [{"hash": hx, "manifest": manifest,
                            "frames": _frames_to_wire(frames)}
@@ -255,8 +513,20 @@ class StoreService:
 
     @aiohttp_handler
     def status_dict(self) -> dict:
-        return {"ok": True, "kv_store": self.store.snapshot(),
-                "weights": self.weights.snapshot()}
+        snap = self.store.snapshot()
+        with self._tier_lock:
+            snap["fenced_rejects"] = self.total_fenced_rejects
+            snap["sync_pulls"] = self.total_sync_pulls
+            snap["sync_rounds"] = self.total_sync_rounds
+        out = {"ok": True, "kv_store": snap,
+               "weights": self.weights.snapshot()}
+        if self.membership is not None:
+            out["member"] = {
+                "id": self.member_id, "epoch": self.membership.epoch,
+                "fenced": self.membership.is_fenced(),
+                "ready": self._ready.is_set()}
+            out["members"] = self.membership.members_view()
+        return out
 
     # -- aiohttp front -------------------------------------------------------
 
@@ -285,6 +555,10 @@ class StoreService:
             return web.json_response(svc.inventory_wire(body))
 
         async def clear(request, body):
+            guard = svc._write_guard()
+            if guard is not None:
+                return web.json_response(
+                    {"ok": False, "fatal": True, "error": guard})
             svc.store.clear()
             return web.json_response({"ok": True})
 
@@ -292,9 +566,25 @@ class StoreService:
             return web.json_response(svc.status_dict())
 
         async def health(request):
-            return web.json_response({"status": "healthy"})
+            # the readiness gate: starting (disk tier not yet scanned)
+            # and fenced members answer 503 so health-gated clients and
+            # waiting spawners skip them
+            if not svc._ready.is_set():
+                return web.json_response({"status": "starting"},
+                                         status=503)
+            if svc.membership is not None and svc.membership.is_fenced():
+                return web.json_response({"status": "fenced"},
+                                         status=503)
+            return web.json_response(
+                {"status": "healthy", "member": svc.member_id,
+                 "epoch": (svc.membership.epoch
+                           if svc.membership is not None else 0)})
 
         async def weights_begin(request, body):
+            guard = svc._write_guard()
+            if guard is not None:
+                return web.json_response(
+                    {"ok": False, "fatal": True, "error": guard})
             try:
                 name = str(body["name"])
                 manifest = dict(body["manifest"])
@@ -306,9 +596,16 @@ class StoreService:
                                            "manifest, total, nbytes}"},
                     status=400)
             return web.json_response(
-                svc.weights.begin(name, manifest, total, nbytes))
+                svc.weights.begin(
+                    name, manifest, total, nbytes,
+                    shards=body.get("shards") or None,
+                    chunk_bytes=int(body.get("chunk_bytes", 0) or 0)))
 
         async def weights_chunk(request, body):
+            guard = svc._write_guard()
+            if guard is not None:
+                return web.json_response(
+                    {"ok": False, "fatal": True, "error": guard})
             name = str(body.get("name", ""))
             try:
                 chunk = CourierChunk.from_wire(body.get("chunk") or {})
@@ -323,10 +620,19 @@ class StoreService:
             name = request.query.get("name", "")
             return web.json_response(svc.weights.status(name))
 
+        async def weights_names(request):
+            return web.json_response({"ok": True,
+                                      "names": svc.weights.names()})
+
         async def weights_fetch(request, body):
             name = str(body.get("name", ""))
             seqs = body.get("seqs") or []
             return web.json_response(svc.weights.take_chunks(name, seqs))
+
+        async def weights_sync(request, body):
+            name = str(body.get("name", ""))
+            seqs = body.get("seqs") or []
+            return web.json_response(svc.weights.peek_chunks(name, seqs))
 
         app = web.Application(client_max_size=256 * 1024 * 1024)
         app.router.add_post("/store/demote", json_body(demote))
@@ -339,8 +645,11 @@ class StoreService:
         app.router.add_post("/store/weights/chunk",
                             json_body(weights_chunk))
         app.router.add_get("/store/weights/status", weights_status)
+        app.router.add_get("/store/weights/names", weights_names)
         app.router.add_post("/store/weights/fetch",
                             json_body(weights_fetch))
+        app.router.add_post("/store/weights/sync",
+                            json_body(weights_sync))
         app.router.add_get("/health", health)
         return app
 
@@ -359,15 +668,32 @@ class StoreService:
             site = web.TCPSite(runner, host, port)
             await site.start()
             bound = runner.addresses[0][1]
+            self.endpoint = f"http://{host}:{bound}"
+            if self.membership is not None:
+                self.membership.attach({"endpoint": self.endpoint})
+                threading.Thread(target=self._heartbeat_loop,
+                                 daemon=True,
+                                 name="llmctl-store-heartbeat").start()
+            if self.membership is not None or self.peers:
+                threading.Thread(target=self._sync_loop, daemon=True,
+                                 name="llmctl-store-sync").start()
+            # the READY line announces the PORT only; /health stays 503
+            # {"status": "starting"} until the warm thread finishes the
+            # disk scan — spawners poll that gate, never sleep
             print(f"LLMCTL_STORE_READY port={bound}", flush=True)
+            if not self._ready.is_set():
+                threading.Thread(target=self.warm, daemon=True,
+                                 name="llmctl-store-warm").start()
             logger.info("fleet store service on %s:%d "
-                        "(dram %.0f MB, disk %r)", host, bound,
-                        self.store.dram_capacity / 1e6,
-                        self.store.disk_dir or None)
+                        "(dram %.0f MB, disk %r, member %r)", host,
+                        bound, self.store.dram_capacity / 1e6,
+                        self.store.disk_dir or None,
+                        self.member_id or None)
             try:
                 while True:
                     await asyncio.sleep(3600)
             finally:
+                self._stop.set()
                 await runner.cleanup()
 
         try:
@@ -396,10 +722,15 @@ class StoreClient:
     same way (counted ``remote_misses``; demotions are dropped and cost
     only a future recompute)."""
 
-    def __init__(self, cfg=None, endpoint: str = ""):
-        self.endpoint = (endpoint
-                         or str(getattr(cfg, "kv_store_endpoint", "")
-                                or "")).rstrip("/")
+    def __init__(self, cfg=None, endpoint: str = "", injector=None):
+        eps = parse_endpoint_spec(endpoint)
+        if not eps and cfg is not None:
+            lister = getattr(cfg, "kv_store_endpoint_list", None)
+            eps = (list(lister()) if callable(lister)
+                   else parse_endpoint_spec(
+                       getattr(cfg, "kv_store_endpoint", "")))
+        self._eps = EndpointSet(eps)
+        self.endpoint = eps[0] if eps else ""
         codec = str(getattr(cfg, "courier_codec", CODEC_NONE)
                     or CODEC_NONE)
         self.codec = CODEC_ZLIB if codec == CODEC_NONE else codec
@@ -408,17 +739,138 @@ class StoreClient:
                                        256 * 1024))
         self.timeout_s = float(getattr(cfg, "prefix_fetch_timeout_s",
                                        5.0) or 5.0)
+        # transient-error budget: each member gets retry_max retries
+        # with doubling backoff before the client rotates past it
+        self.retry_max = int(getattr(cfg, "kv_store_retry_max", 2))
+        self.retry_backoff_s = float(getattr(
+            cfg, "kv_store_retry_backoff_ms", 10.0) or 0.0) / 1e3
+        self.write_ack = int(getattr(cfg, "kv_store_write_ack", 1))
+        self.hedge_s = float(getattr(cfg, "kv_store_hedge_ms", 0.0)
+                             or 0.0) / 1e3
+        # seeded store partition verbs (FaultPlan.store_partition_*)
+        # enter here: a partitioned member looks connection-refused
+        self.injector = injector
         self._lock = threading.Lock()
         self._pending: "OrderedDict[bytes, tuple]" = OrderedDict()
         self._pending_max = 256
         self._inflight = 0       # pages popped but not yet POSTed
+        # async mirror backlog: (endpoint, path, body) uploads owed to
+        # members beyond the write-ack floor, paid on the encode thread
+        self._mirror: list = []
         self._work = threading.Event()
         self._encoder: Optional[threading.Thread] = None
-        # the two client-side counters (everything else is served by the
+        # client-side counters (everything else is served by the
         # service's own FleetKVStore counters, merged in snapshot())
         self.total_remote_hits = 0    # pages replayed from the service
         self.total_remote_misses = 0  # fetches that served zero pages
-        #                               (incl. service unreachable)
+        #                               after every member was tried
+        self.total_retries = 0        # transient-error RPC retries
+        self.total_failovers = 0      # RPCs answered by a non-first
+        #                               member after rotation
+        self.total_hedges = 0         # hedged fetches fired
+
+    @property
+    def endpoints(self) -> list:
+        """Ordered member URLs this client rotates through."""
+        return list(self._eps.endpoints)
+
+    # -- tier transport ------------------------------------------------------
+
+    def _post_member(self, ep: str, path: str,
+                     body: dict) -> Optional[dict]:
+        if self.injector is not None:
+            try:
+                idx = self._eps.endpoints.index(ep)
+            except ValueError:
+                idx = -1
+            if idx >= 0 and self.injector.on_store_rpc(idx):
+                return None          # injected partition: looks refused
+        return _post_json(f"{ep}{path}", body, timeout_s=self.timeout_s)
+
+    def _attempt(self, ep: str, path: str,
+                 body: dict) -> Optional[dict]:
+        """One member, full transient budget: up to ``retry_max``
+        retries with doubling backoff (counted) before giving up on
+        this endpoint."""
+        backoff = self.retry_backoff_s
+        for attempt in range(self.retry_max + 1):
+            if attempt:
+                with self._lock:
+                    self.total_retries += 1
+                time.sleep(backoff)
+                backoff *= 2
+            out = self._post_member(ep, path, body)
+            if out is not None:
+                return out
+        return None
+
+    def _rpc(self, path: str, body: dict) -> tuple:
+        """Health-gated rotation: try each live member with its full
+        retry budget; a member that exhausts it (or answers a FATAL
+        fenced ack) is cooled down and the next member tried. Returns
+        ``(answer, endpoint)`` — ``(None, "")`` only after EVERY member
+        failed."""
+        rotated = False
+        for ep in self._eps.live():
+            out = self._attempt(ep, path, body)
+            if out is None:
+                self._eps.mark_down(ep)
+                rotated = True
+                continue
+            if out.get("fatal"):
+                # fenced member: rotate past it, never retry the write
+                self._eps.mark_down(ep)
+                rotated = True
+                continue
+            self._eps.mark_up(ep)
+            if rotated:
+                with self._lock:
+                    self.total_failovers += 1
+            return out, ep
+        return None, ""
+
+    def _hedged_fetch_rpc(self, body: dict) -> tuple:
+        """Race two members when the first is slow: fire the preferred
+        member, wait ``hedge_s``, then fire the next live member and
+        take whichever answers first. Falls back to the ordinary
+        retry/rotation path when hedging is off, only one member is
+        live, or both racers lose."""
+        live = self._eps.live()
+        if self.hedge_s <= 0 or len(live) < 2:
+            return self._rpc("/store/fetch", body)
+        box: dict = {"done": 0}
+        cond = threading.Condition()
+
+        def race(ep):
+            out = self._post_member(ep, "/store/fetch", body)
+            with cond:
+                if out is not None and not out.get("fatal") \
+                        and "out" not in box:
+                    box["out"], box["ep"] = out, ep
+                box["done"] += 1
+                cond.notify_all()
+
+        threading.Thread(target=race, args=(live[0],),
+                         daemon=True).start()
+        with cond:
+            cond.wait_for(lambda: "out" in box or box["done"] >= 1,
+                          timeout=self.hedge_s)
+            slow = "out" not in box and box["done"] < 1
+        if slow:
+            with self._lock:
+                self.total_hedges += 1
+            threading.Thread(target=race, args=(live[1],),
+                             daemon=True).start()
+            with cond:
+                cond.wait_for(lambda: "out" in box or box["done"] >= 2,
+                              timeout=self.timeout_s)
+        if "out" in box:
+            self._eps.mark_up(box["ep"])
+            if box["ep"] != live[0]:
+                with self._lock:
+                    self.total_failovers += 1
+            return box["out"], box["ep"]
+        return self._rpc("/store/fetch", body)
 
     # -- demotion ------------------------------------------------------------
 
@@ -459,27 +911,53 @@ class StoreClient:
             self._work.clear()
             while True:
                 with self._lock:
-                    if not self._pending:
+                    if self._pending:
+                        job = ("page",
+                               *self._pending.popitem(last=False))
+                    elif self._mirror:
+                        job = ("mirror", self._mirror.pop(0))
+                    else:
                         break
-                    h, (batch, col) = self._pending.popitem(last=False)
                     self._inflight += 1
                 try:
-                    self._demote_page(h, _page_slice(batch, col))
+                    if job[0] == "page":
+                        _kind, h, (batch, col) = job
+                        self._demote_page(h, _page_slice(batch, col))
+                    else:
+                        # async mirror beyond the write-ack floor:
+                        # best-effort — a dropped mirror upload is
+                        # healed by the tier's anti-entropy
+                        ep, path, body = job[1]
+                        self._attempt(ep, path, body)
                 finally:
                     with self._lock:
                         self._inflight -= 1
 
+    def _queue_mirror(self, ep: str, path: str, body: dict) -> None:
+        with self._lock:
+            self._mirror.append((ep, path, body))
+            if self._encoder is None or not self._encoder.is_alive():
+                self._encoder = threading.Thread(
+                    target=self._encode_loop, daemon=True,
+                    name="llmctl-storeclient-encode")
+                self._encoder.start()
+        self._work.set()
+
     def flush_pending(self, timeout_s: float = 10.0) -> None:
         """The drain/retire barrier. Unlike the in-proc store, a popped
         page is still a network POST away from durable — the barrier
-        must also wait out in-flight uploads."""
+        must also wait out in-flight uploads AND the async mirror
+        backlog (a retire immediately followed by a member kill must
+        find every live member holding the flushed pages)."""
         deadline = time.monotonic() + timeout_s
         self._work.set()
         while time.monotonic() < deadline:
             with self._lock:
-                busy = bool(self._pending) or self._inflight > 0
+                busy = (bool(self._pending) or bool(self._mirror)
+                        or self._inflight > 0)
             if not busy:
                 return
+            self._work.set()
             time.sleep(0.002)
 
     @thread_seam
@@ -507,27 +985,68 @@ class StoreClient:
                 "frames": _frames_to_wire(
                     [(c.seq, c.total, c.crc32, c.data) for c in chunks]),
                 "raw_bytes": int(manifest["nbytes"])}
-        out = _post_json(f"{self.endpoint}/store/demote", body,
-                         timeout_s=self.timeout_s)
-        if out is None:
-            logger.warning("store service %s unreachable; demoted page "
-                           "%s dropped", self.endpoint, h.hex())
-            return False
-        return bool(out.get("ok")) and bool(out.get("stored"))
+        # fan-out: the write-ack floor synchronously, the remaining
+        # live members async-mirrored; a FATAL (fenced) ack skips that
+        # member entirely — its admission would be a zombie write
+        live = self._eps.live()
+        want = max(1, min(self.write_ack, len(live)))
+        acks = 0
+        stored = False
+        for ep in live:
+            if acks >= want:
+                self._queue_mirror(ep, "/store/demote", body)
+                continue
+            out = self._attempt(ep, "/store/demote", body)
+            if out is None:
+                self._eps.mark_down(ep)
+                logger.warning("store member %s unreachable; demoted "
+                               "page %s not mirrored there", ep,
+                               h.hex())
+                continue
+            if out.get("fatal"):
+                logger.warning("store member %s refused page %s with a "
+                               "FATAL ack: %s", ep, h.hex(),
+                               out.get("error"))
+                continue
+            if out.get("ok"):
+                acks += 1
+                self._eps.mark_up(ep)
+                stored = stored or bool(out.get("stored"))
+        if acks == 0:
+            logger.warning("no store member acknowledged demoted page "
+                           "%s; dropped", h.hex())
+        return stored and acks > 0
 
     # -- advertising ---------------------------------------------------------
 
     @thread_seam
     def inventory(self, max_entries: int = 0) -> list:
-        out = _post_json(f"{self.endpoint}/store/inventory",
-                         {"max_entries": int(max_entries)},
-                         timeout_s=self.timeout_s)
-        if not out or not out.get("ok"):
+        """Union of the live members' holdings (any member holding an
+        entry can serve the fetch, so the router's hint surface is the
+        tier's union, not one member's view)."""
+        seen: "OrderedDict[bytes, bool]" = OrderedDict()
+        answered = False
+        for ep in self._eps.live():
+            out = self._attempt(ep, "/store/inventory",
+                                {"max_entries": int(max_entries)})
+            if out is None:
+                self._eps.mark_down(ep)
+                continue
+            if not out.get("ok"):
+                continue
+            answered = True
+            self._eps.mark_up(ep)
+            try:
+                for hx in out.get("hashes", []):
+                    seen.setdefault(bytes.fromhex(hx), True)
+            except (TypeError, ValueError):
+                continue
+        if not answered:
             return []
-        try:
-            return [bytes.fromhex(h) for h in out.get("hashes", [])]
-        except (TypeError, ValueError):
-            return []
+        keys = list(seen)
+        if max_entries > 0:
+            keys = keys[-max_entries:]
+        return keys
 
     @thread_seam
     def holds(self, h: bytes) -> bool:
@@ -540,11 +1059,29 @@ class StoreClient:
         """Pull the longest held prefix of ``hashes`` from the service
         and replay the returned frames through ``receiver`` — the
         fetcher-local courier path, so all verification happens HERE.
-        None (counted remote miss) when the service is unreachable,
-        holds nothing, or any replay fails verification."""
+        None (counted remote miss) only after EVERY live member was
+        tried — transient errors retry with backoff, a dead member
+        rotates to a survivor, and (``kv_store_hedge_ms``) a slow
+        member races a second one."""
         body = {"hashes": [bytes(h).hex() for h in hashes]}
-        out = _post_json(f"{self.endpoint}/store/fetch", body,
-                         timeout_s=self.timeout_s)
+        out, ep = self._hedged_fetch_rpc(body)
+        # an ANSWERING member that holds nothing is not the end of the
+        # story in a tier: another member may hold the pages (e.g. a
+        # freshly rejoined member that has not finished anti-entropy)
+        if out is not None and not (out.get("pages") or []) \
+                and len(self._eps) > 1:
+            for alt in self._eps.live():
+                if alt == ep:
+                    continue
+                alt_out = self._attempt(alt, "/store/fetch", body)
+                if alt_out is None:
+                    self._eps.mark_down(alt)
+                    continue
+                if alt_out.get("pages"):
+                    out = alt_out
+                    with self._lock:
+                        self.total_failovers += 1
+                    break
         served: list = []
         pages = None
         for row in (out or {}).get("pages", []):
@@ -596,23 +1133,36 @@ class StoreClient:
 
     @thread_seam
     def clear(self) -> None:
-        _post_json(f"{self.endpoint}/store/clear", {},
-                   timeout_s=self.timeout_s)
+        for ep in self._eps.live():
+            self._attempt(ep, "/store/clear", {})
 
     @thread_seam
     def snapshot(self) -> dict:
-        """The service's own counters (when reachable) merged with the
-        client-side remote_hits / remote_misses — one section, same
-        keys as the in-proc store, so `fleet status` and the Prometheus
-        pump read both backends identically."""
-        out = _get_json(f"{self.endpoint}/store/status",
-                        timeout_s=self.timeout_s) or {}
+        """The first answering member's counters merged with the
+        client-side tier counters — one section, same keys as the
+        in-proc store, so `fleet status` and the Prometheus pump read
+        both backends identically. ``members`` maps every configured
+        endpoint to its health-gate view."""
+        out = {}
+        for ep in self._eps.live():
+            got = _get_json(f"{ep}/store/status",
+                            timeout_s=self.timeout_s)
+            if got:
+                out = got
+                self._eps.mark_up(ep)
+                break
+            self._eps.mark_down(ep)
         snap = dict(out.get("kv_store") or {})
         snap["endpoint"] = self.endpoint
+        snap["endpoints"] = list(self._eps.endpoints)
+        snap["members"] = self._eps.reachable_map()
         snap["reachable"] = bool(out)
         if "weights" in out:
             snap["service_weights"] = out["weights"]
         with self._lock:
             snap["remote_hits"] = self.total_remote_hits
             snap["remote_misses"] = self.total_remote_misses
+            snap["retries"] = self.total_retries
+            snap["failovers"] = self.total_failovers
+            snap["hedges"] = self.total_hedges
         return snap

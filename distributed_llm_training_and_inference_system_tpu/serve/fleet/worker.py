@@ -101,11 +101,16 @@ class FleetWorker:
         # front resolves, so a returning conversation landing here
         # restores pages another replica (or another worker) demoted.
         self.store_client = None
-        store_ep = str(getattr(self.fleet_cfg, "kv_store_endpoint", "")
-                       or "")
-        if store_ep:
+        store_eps = self.fleet_cfg.kv_store_endpoint_list() \
+            if hasattr(self.fleet_cfg, "kv_store_endpoint_list") \
+            else ([str(getattr(self.fleet_cfg, "kv_store_endpoint", "")
+                       or "")] if getattr(self.fleet_cfg,
+                                          "kv_store_endpoint", "")
+                  else [])
+        if store_eps:
             from .store_service import StoreClient
-            self.store_client = StoreClient(self.fleet_cfg)
+            self.store_client = StoreClient(self.fleet_cfg,
+                                            injector=self.injector)
             self.replica.set_kv_store(self.store_client)
         # fleet SSE streaming: a streaming request's token batches ship
         # to the parent as cursor-tagged outbox entries (tokens are tiny
@@ -376,8 +381,12 @@ class FleetWorker:
             # local counters only — status must stay responsive while
             # the store service is down (no remote round-trip here)
             out["kv_store"] = {"endpoint": sc.endpoint,
+                               "endpoints": sc.endpoints,
                                "remote_hits": sc.total_remote_hits,
-                               "remote_misses": sc.total_remote_misses}
+                               "remote_misses": sc.total_remote_misses,
+                               "retries": sc.total_retries,
+                               "failovers": sc.total_failovers,
+                               "hedges": sc.total_hedges}
         return out
 
     # -- fleet-global prefix cache -------------------------------------------
@@ -398,11 +407,12 @@ class FleetWorker:
             # carries the held frames and THIS worker replays them
             # through its own receiver (full CRC/verify path)
             client = self.store_client
-            if client is None or (ep and client.endpoint != ep):
+            if client is None or (ep and ep not in client.endpoints):
                 if not ep:
                     return None
                 from .store_service import StoreClient
-                client = StoreClient(self.fleet_cfg, endpoint=ep)
+                client = StoreClient(self.fleet_cfg, endpoint=ep,
+                                     injector=self.injector)
                 if self.store_client is None:
                     self.store_client = client
             return client.fetch(hashes, self.receiver)

@@ -136,6 +136,19 @@ FLEET_CONFIG_ERRORS = [
      "kv_store_endpoint must be an http(s) base URL"),
     ({"kv_store_endpoint": "http://store:9400", "prefix_fetch": False},
      "kv_store_endpoint needs prefix_fetch"),
+    ({"kv_store_endpoints": "http://a:1,ftp://b:2", "prefix_fetch": True},
+     "kv_store_endpoints entries must be http(s) base URLs"),
+    ({"kv_store_endpoints": "http://a:1,http://b:2",
+      "prefix_fetch": False},
+     "kv_store_endpoints needs prefix_fetch"),
+    ({"kv_store_retry_max": -1}, "kv_store_retry_max must be >= 0"),
+    ({"kv_store_retry_backoff_ms": -1.0},
+     "kv_store_retry_backoff_ms must be >= 0"),
+    ({"kv_store_hedge_ms": -1.0}, "kv_store_hedge_ms must be >= 0"),
+    ({"kv_store_write_ack": 0}, "kv_store_write_ack must be >= 1"),
+    ({"kv_store_endpoints": "http://a:1", "prefix_fetch": True,
+      "kv_store_write_ack": 2},
+     "exceeds the store-tier member count"),
     ({"autoscale": True, "fronts": 2, "state_store": "file",
       "state_store_dir": "/tmp/x", "remote_replicas": "0",
       "replicas": 1, "fleet_endpoints": {0: "http://h:1"}},

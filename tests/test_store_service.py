@@ -459,7 +459,8 @@ class TestBootSurfaces:
                                 weights=wc)
         snap = sup.snapshot()
         assert snap["weights"] == {"chunks": 0, "resumes": 0,
-                                   "bytes": 0, "endpoint": DEAD}
+                                   "bytes": 0, "failovers": 0,
+                                   "endpoint": DEAD}
         # no courier (in-proc fleets): section present, empty
         sup2 = ReplicaSupervisor(reps, FleetRouter(reps, cfg), cfg)
         assert sup2.snapshot()["weights"] == {}

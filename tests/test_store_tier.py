@@ -1,0 +1,772 @@
+"""Replicated, fenced fleet store tier (serve/fleet/store_tier.py +
+the tier halves of store_service.py / weights.py).
+
+The contract under test:
+
+- the store conformance surface (demote/fetch round trips, TTL,
+  unknown-hash miss, duplicate idempotency) holds IDENTICALLY across
+  all three impls: the in-proc FleetKVStore, a single StoreService
+  behind a StoreClient, and a replicated two-member tier;
+- membership is epoch-fenced in the SharedFileStateStore idiom: attach
+  bumps the epoch, a fenced or superseded (zombie) incarnation's
+  writes are refused with a FATAL ack — counted, never silently
+  admitted — and re-attaching under the same id clears the fence;
+- the client survives a member death: bounded retry-with-doubling-
+  backoff on transient errors (counted) before ANYTHING is a miss,
+  health-gated rotation to a survivor (counted failovers), hedged
+  fetches racing a second member when the first is slow, and write
+  fan-out to the write-ack floor with async mirroring beyond it;
+- anti-entropy converges a rejoining member's holdings (KV frames by
+  digest, weight chunks by seq) WITHOUT touching the hit/serve
+  ledgers — those stay a record of client traffic only;
+- weights fail over mid-download with the combined per-seq serve
+  ledger still balanced (each chunk served exactly once ACROSS
+  members), and the per-shard chunk manifest lets a tp>1 bootstrap
+  fetch only its shards;
+- the readiness gate: /health answers 503 {"status": "starting"}
+  until the disk tier is scanned, and wait_store_ready blocks on it.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_llm_training_and_inference_system_tpu.config import (
+    get_model_config)
+from distributed_llm_training_and_inference_system_tpu.config.schema import (
+    ConfigError, FleetConfig)
+from distributed_llm_training_and_inference_system_tpu.serve.fleet import (
+    store_service as smod)
+from distributed_llm_training_and_inference_system_tpu.serve.fleet import (
+    weights as wmod)
+from distributed_llm_training_and_inference_system_tpu.serve.fleet.faults import (  # noqa: E501
+    FaultInjector, FaultPlan)
+from distributed_llm_training_and_inference_system_tpu.serve.fleet.kv_store import (  # noqa: E501
+    FleetKVStore)
+from distributed_llm_training_and_inference_system_tpu.serve.fleet.store_service import (  # noqa: E501
+    StoreClient, StoreService)
+from distributed_llm_training_and_inference_system_tpu.serve.fleet.store_tier import (  # noqa: E501
+    EndpointSet, StoreMembership, parse_endpoint_spec, wait_store_ready)
+from distributed_llm_training_and_inference_system_tpu.serve.fleet.transport import (  # noqa: E501
+    CourierChunk, CourierReceiver)
+from distributed_llm_training_and_inference_system_tpu.serve.fleet.weights import (  # noqa: E501
+    WeightCourier, WeightShipError)
+from distributed_llm_training_and_inference_system_tpu.serve.kv_cache import (
+    prefix_page_hashes)
+
+PS = 8
+HOT = [7, 3, 9, 1, 4, 8, 2, 6] * 4            # 32 tokens = 4 full pages
+EP_A = "http://store-a:1"
+EP_B = "http://store-b:1"
+
+
+@pytest.fixture(scope="module")
+def model_cfg():
+    return get_model_config("gpt-test")
+
+
+def stamped_payload(model_cfg, n_pages=4, seed=0):
+    rng = np.random.default_rng(seed)
+    shape = (model_cfg.num_layers, n_pages, model_cfg.num_kv_heads, PS,
+             model_cfg.head_dim)
+    return {"k": rng.random(shape, np.float32),
+            "v": rng.random(shape, np.float32), "num_pages": n_pages}
+
+
+def store_cfg(**kw):
+    base = dict(replicas=1, kv_store=True, prefix_fetch=True,
+                courier_chunk_bytes=1024,
+                kv_store_retry_backoff_ms=1.0)
+    base.update(kw)
+    cfg = FleetConfig(**base)
+    cfg.validate()
+    return cfg
+
+
+def tiny_params(seed=0, n=4096):
+    rng = np.random.default_rng(seed)
+    return {"wte": {"embedding": rng.standard_normal(n).astype(
+        np.float32)},
+        "head": {"w": rng.standard_normal(n // 4).astype(np.float32)}}
+
+
+def params_equal(a, b):
+    assert set(a) == set(b)
+    for k, v in a.items():
+        if isinstance(v, dict):
+            params_equal(v, b[k])
+        else:
+            np.testing.assert_array_equal(np.asarray(v),
+                                          np.asarray(b[k]))
+
+
+class FakeWire:
+    """In-proc stand-in for the store tier's HTTP surface: fake member
+    URLs route straight to StoreService instances, with a JSON
+    round-trip for wire fidelity. A member in ``down`` answers like a
+    refused connection (None) — the SIGKILL stand-in."""
+
+    def __init__(self):
+        self.services: dict = {}
+        self.down: set = set()
+        self.delay_s: dict = {}      # per-endpoint slowness (hedging)
+        self.posts: list = []        # (endpoint, path) log
+
+    def add(self, ep, svc):
+        self.services[ep] = svc
+        svc.endpoint = ep
+
+    def _route(self, url):
+        for ep, svc in self.services.items():
+            if url.startswith(ep + "/"):
+                return ep, svc, url[len(ep):]
+        return None, None, None
+
+    @staticmethod
+    def _json(out):
+        return json.loads(json.dumps(out))
+
+    def post(self, url, body, timeout_s=5.0):
+        ep, svc, path = self._route(url)
+        self.posts.append((ep, path))
+        if svc is None or ep in self.down:
+            return None
+        if self.delay_s.get(ep):
+            time.sleep(self.delay_s[ep])
+        body = self._json(body)
+        if path == "/store/demote":
+            return self._json(svc.demote_wire(body))
+        if path == "/store/fetch":
+            return self._json(svc.fetch_wire(body))
+        if path == "/store/inventory":
+            return self._json(svc.inventory_wire(body))
+        if path == "/store/clear":
+            guard = svc._write_guard()
+            if guard is not None:
+                return {"ok": False, "fatal": True, "error": guard}
+            svc.store.clear()
+            return {"ok": True}
+        if path == "/store/weights/begin":
+            guard = svc._write_guard()
+            if guard is not None:
+                return {"ok": False, "fatal": True, "error": guard}
+            return self._json(svc.weights.begin(
+                str(body["name"]), dict(body["manifest"]),
+                int(body["total"]), int(body.get("nbytes", 0)),
+                shards=body.get("shards") or None,
+                chunk_bytes=int(body.get("chunk_bytes", 0) or 0)))
+        if path == "/store/weights/chunk":
+            guard = svc._write_guard()
+            if guard is not None:
+                return {"ok": False, "fatal": True, "error": guard}
+            chunk = CourierChunk.from_wire(body["chunk"])
+            return self._json(svc.weights.put_chunk(
+                str(body["name"]), chunk))
+        if path == "/store/weights/fetch":
+            return self._json(svc.weights.take_chunks(
+                str(body["name"]), body.get("seqs") or []))
+        if path == "/store/weights/sync":
+            return self._json(svc.weights.peek_chunks(
+                str(body["name"]), body.get("seqs") or []))
+        raise AssertionError(f"unrouted POST {path}")
+
+    def get(self, url, timeout_s=5.0):
+        ep, svc, path = self._route(url)
+        if svc is None or ep in self.down:
+            return None
+        if self.delay_s.get(ep):
+            time.sleep(self.delay_s[ep])
+        if path == "/store/status":
+            return self._json(svc.status_dict())
+        if path.startswith("/store/weights/status"):
+            name = path.split("name=", 1)[1] if "name=" in path else ""
+            return self._json(svc.weights.status(name))
+        if path == "/store/weights/names":
+            return self._json({"ok": True, "names": svc.weights.names()})
+        raise AssertionError(f"unrouted GET {path}")
+
+
+@pytest.fixture()
+def wire(monkeypatch):
+    w = FakeWire()
+    monkeypatch.setattr(smod, "_post_json", w.post)
+    monkeypatch.setattr(smod, "_get_json", w.get)
+    monkeypatch.setattr(wmod, "_post_json", w.post)
+    monkeypatch.setattr(wmod, "_get_json", w.get)
+    return w
+
+
+def two_member_tier(wire, **cfg_kw):
+    a = StoreService(store_cfg())
+    b = StoreService(store_cfg())
+    wire.add(EP_A, a)
+    wire.add(EP_B, b)
+    cfg = store_cfg(kv_store_endpoints=f"{EP_A},{EP_B}", **cfg_kw)
+    return a, b, StoreClient(cfg)
+
+
+# ---------------------------------------------------------------------------
+# endpoint parsing + health view
+# ---------------------------------------------------------------------------
+
+
+class TestEndpointSet:
+    def test_parse_endpoint_spec(self):
+        assert parse_endpoint_spec(" http://a/ , http://b ,") == \
+            ["http://a", "http://b"]
+        assert parse_endpoint_spec(["http://a/"]) == ["http://a"]
+        assert parse_endpoint_spec("") == []
+
+    def test_rotation_and_cooldown(self):
+        es = EndpointSet([EP_A, EP_B], cooldown_s=0.05)
+        assert es.live() == [EP_A, EP_B]
+        es.mark_down(EP_A)
+        assert es.live() == [EP_B]
+        assert es.reachable_map() == {EP_A: False, EP_B: True}
+        time.sleep(0.06)                    # cooldown expires: retried
+        assert es.live() == [EP_A, EP_B]
+
+    def test_desperation_when_all_down(self):
+        es = EndpointSet([EP_A, EP_B], cooldown_s=60.0)
+        es.mark_down(EP_A)
+        es.mark_down(EP_B)
+        assert es.live() == [EP_A, EP_B]    # beats refusing to try
+        es.mark_up(EP_B)
+        assert es.live() == [EP_B]
+
+    def test_write_ack_above_member_count_rejected(self):
+        with pytest.raises(ConfigError, match="write_ack"):
+            store_cfg(kv_store_endpoints=f"{EP_A},{EP_B}",
+                      kv_store_write_ack=3)
+
+
+# ---------------------------------------------------------------------------
+# epoch-fenced membership registry
+# ---------------------------------------------------------------------------
+
+
+class TestMembership:
+    def test_attach_bumps_epoch_and_records_endpoint(self, tmp_path):
+        m0 = StoreMembership(str(tmp_path), "s0")
+        m1 = StoreMembership(str(tmp_path), "s1")
+        assert m0.attach({"endpoint": EP_A}) == 1
+        assert m1.attach({"endpoint": EP_B}) == 2
+        view = m0.members_view()
+        assert view["s0"]["endpoint"] == EP_A and view["s0"]["alive"]
+        assert m0.peer_endpoints() == [EP_B]
+        assert m1.peer_endpoints() == [EP_A]
+
+    def test_fence_refuses_writes_until_reattach(self, tmp_path):
+        m = StoreMembership(str(tmp_path), "s0")
+        m.attach()
+        assert m.guard_write() is None
+        # any process sharing the dir can fence (the operator's verb)
+        assert StoreMembership(str(tmp_path), "x").fence("s0")
+        assert m.is_fenced()
+        assert "fenced" in m.guard_write()
+        assert not m.members_view()["s0"]["alive"]
+        # a NEW incarnation re-using the id clears the fence
+        m.attach()
+        assert m.guard_write() is None
+
+    def test_stale_incarnation_is_a_zombie(self, tmp_path):
+        old = StoreMembership(str(tmp_path), "s0")
+        old.attach()
+        fresh = StoreMembership(str(tmp_path), "s0")
+        fresh.attach()                      # supersedes `old`
+        assert "stale" in old.guard_write()
+        assert fresh.guard_write() is None
+
+    def test_expiry_marks_member_dead(self, tmp_path):
+        m = StoreMembership(str(tmp_path), "s0", expiry_s=0.05)
+        m.attach()
+        time.sleep(0.08)
+        assert not m.members_view()["s0"]["alive"]
+        m.heartbeat()
+        assert m.members_view()["s0"]["alive"]
+
+
+# ---------------------------------------------------------------------------
+# conformance: one contract, three impls
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(params=["inproc", "service", "tier"])
+def backend(request, wire):
+    """The store duck under each backing: the test body never knows
+    which — that IS the conformance claim."""
+    def build(**cfg_kw):
+        if request.param == "inproc":
+            return FleetKVStore(store_cfg(**cfg_kw))
+        if request.param == "service":
+            wire.add(EP_A, StoreService(store_cfg(**cfg_kw)))
+            return StoreClient(store_cfg(kv_store_endpoints=EP_A,
+                                         **cfg_kw))
+        wire.add(EP_A, StoreService(store_cfg(**cfg_kw)))
+        wire.add(EP_B, StoreService(store_cfg(**cfg_kw)))
+        return StoreClient(store_cfg(
+            kv_store_endpoints=f"{EP_A},{EP_B}", kv_store_write_ack=2,
+            **cfg_kw))
+    return build
+
+
+class TestConformance:
+    def test_demote_fetch_round_trip(self, backend, model_cfg):
+        store = backend()
+        hashes = prefix_page_hashes(HOT, PS)
+        payload = stamped_payload(model_cfg)
+        assert store.demote(hashes, payload) == 4
+        assert store.holds(hashes[0])
+        assert store.inventory() == hashes
+        out = store.fetch(hashes, CourierReceiver())
+        assert out is not None and out["pages"]["num_pages"] == 4
+        np.testing.assert_allclose(out["pages"]["k"], payload["k"])
+        np.testing.assert_allclose(out["pages"]["v"], payload["v"])
+
+    def test_duplicate_demotion_idempotent(self, backend, model_cfg):
+        store = backend()
+        hashes = prefix_page_hashes(HOT, PS)
+        payload = stamped_payload(model_cfg, seed=1)
+        store.demote(hashes, payload)
+        store.demote(hashes, payload)       # re-demotion stores nothing
+        assert store.inventory() == hashes  # no duplicate entries
+        out = store.fetch(hashes, CourierReceiver())
+        np.testing.assert_allclose(out["pages"]["k"], payload["k"])
+
+    def test_unknown_hash_is_a_miss(self, backend):
+        store = backend()
+        assert store.fetch([b"z" * 16], CourierReceiver()) is None
+
+    def test_ttl_expiry(self, backend, model_cfg):
+        store = backend(kv_store_ttl_ms=20.0)
+        hashes = prefix_page_hashes(HOT, PS)
+        store.demote(hashes, stamped_payload(model_cfg, seed=2))
+        time.sleep(0.05)
+        assert store.fetch(hashes, CourierReceiver()) is None
+
+    def test_async_demote_drains_through_flush(self, backend,
+                                               model_cfg):
+        store = backend()
+        hashes = prefix_page_hashes(HOT, PS)
+        store.demote_async(hashes, stamped_payload(model_cfg, seed=3))
+        store.flush_pending(timeout_s=30.0)
+        assert store.inventory() == hashes
+
+
+# ---------------------------------------------------------------------------
+# client failover: retries, rotation, hedging, fan-out
+# ---------------------------------------------------------------------------
+
+
+class TestClientFailover:
+    def test_transient_error_retried_before_miss(self, wire,
+                                                 monkeypatch,
+                                                 model_cfg):
+        """Satellite: single-store mode hardening — a flaky connection
+        is retried (counted) and never surfaces as a remote miss."""
+        wire.add(EP_A, StoreService(store_cfg()))
+        sc = StoreClient(store_cfg(kv_store_endpoints=EP_A))
+        hashes = prefix_page_hashes(HOT, PS)
+        sc.demote(hashes, stamped_payload(model_cfg))
+        real = wire.post
+        state = {"dropped": 0}
+
+        def flaky(url, body, timeout_s=5.0):
+            if url.endswith("/store/fetch") and state["dropped"] < 2:
+                state["dropped"] += 1
+                return None                 # connection reset
+            return real(url, body, timeout_s=timeout_s)
+
+        monkeypatch.setattr(smod, "_post_json", flaky)
+        out = sc.fetch(hashes, CourierReceiver())
+        assert out is not None and len(out["hashes"]) == 4
+        assert sc.total_retries >= 2
+        assert sc.total_remote_misses == 0
+
+    def test_member_death_fails_over_zero_misses(self, wire,
+                                                 model_cfg):
+        """The tentpole acceptance shape: both members hold the pages
+        (write_ack=2), the primary dies, the returning fetch restores
+        from the survivor with ZERO counted misses."""
+        a, b, sc = two_member_tier(wire, kv_store_write_ack=2)
+        hashes = prefix_page_hashes(HOT, PS)
+        payload = stamped_payload(model_cfg, seed=4)
+        assert sc.demote(hashes, payload) == 4
+        assert a.store.snapshot()["demotions"] == 4
+        assert b.store.snapshot()["demotions"] == 4
+        wire.down.add(EP_A)                 # SIGKILL the primary
+        out = sc.fetch(hashes, CourierReceiver())
+        assert out is not None and len(out["hashes"]) == 4
+        np.testing.assert_allclose(out["pages"]["k"], payload["k"])
+        assert sc.total_remote_misses == 0
+        assert sc.total_remote_hits == 4
+        assert sc.total_failovers >= 1 and sc.total_retries >= 1
+
+    def test_all_members_dead_is_one_counted_miss(self, wire,
+                                                  model_cfg):
+        a, b, sc = two_member_tier(wire, kv_store_write_ack=2)
+        hashes = prefix_page_hashes(HOT, PS)
+        sc.demote(hashes, stamped_payload(model_cfg))
+        wire.down.update({EP_A, EP_B})
+        assert sc.fetch(hashes, CourierReceiver()) is None
+        assert sc.total_remote_misses == 1
+        snap = sc.snapshot()
+        assert snap["reachable"] is False
+
+    def test_hedged_fetch_races_second_member(self, wire, model_cfg):
+        a, b, sc = two_member_tier(wire, kv_store_write_ack=2,
+                                   kv_store_hedge_ms=5.0)
+        hashes = prefix_page_hashes(HOT, PS)
+        sc.demote(hashes, stamped_payload(model_cfg, seed=5))
+        wire.delay_s[EP_A] = 0.2            # slow, not dead
+        out = sc.fetch(hashes, CourierReceiver())
+        assert out is not None and len(out["hashes"]) == 4
+        assert sc.total_hedges >= 1
+        assert sc.total_remote_misses == 0
+
+    def test_write_ack_floor_with_async_mirror(self, wire, model_cfg):
+        """write_ack=1: one member acks synchronously; the other is
+        mirrored on the encode thread — after the flush barrier BOTH
+        hold every page."""
+        a, b, sc = two_member_tier(wire, kv_store_write_ack=1)
+        hashes = prefix_page_hashes(HOT, PS)
+        assert sc.demote(hashes, stamped_payload(model_cfg, seed=6)) == 4
+        sc.flush_pending(timeout_s=30.0)
+        assert a.store.inventory() == hashes
+        assert b.store.inventory() == hashes
+
+    def test_injected_partition_blocks_member(self, wire, model_cfg):
+        """FaultPlan store verbs: the seeded partition makes member 0
+        look connection-refused from THIS client only."""
+        inj = FaultInjector(FaultPlan(store_partition_member=0,
+                                      store_partition_count=-1))
+        a = StoreService(store_cfg())
+        b = StoreService(store_cfg())
+        wire.add(EP_A, a)
+        wire.add(EP_B, b)
+        sc = StoreClient(store_cfg(
+            kv_store_endpoints=f"{EP_A},{EP_B}"), injector=inj)
+        hashes = prefix_page_hashes(HOT, PS)
+        assert sc.demote(hashes, stamped_payload(model_cfg, seed=7)) == 4
+        assert a.store.snapshot()["demotions"] == 0   # partitioned off
+        assert b.store.snapshot()["demotions"] == 4
+        assert sc.fetch(hashes, CourierReceiver()) is not None
+        assert sc.total_remote_misses == 0
+
+    def test_store_faults_due_fire_once(self):
+        inj = FaultInjector(FaultPlan(store_kill_member=1,
+                                      store_kill_after_s=0.5))
+        assert inj.store_faults_due(0.1) == []
+        assert inj.store_faults_due(0.6) == [("kill", 1)]
+        assert inj.store_faults_due(9.9) == []        # consumed
+
+
+# ---------------------------------------------------------------------------
+# fencing at the service: the zombie rule
+# ---------------------------------------------------------------------------
+
+
+class TestFencing:
+    def test_fenced_member_upload_refused_fatal_and_counted(
+            self, wire, tmp_path, model_cfg):
+        b = StoreService(store_cfg(), member_id="s1",
+                         membership_dir=str(tmp_path))
+        b.membership.attach({"endpoint": EP_B})
+        wire.add(EP_B, b)
+        StoreMembership(str(tmp_path), "ctl").fence("s1")
+        ack = b.demote_wire({"hash": "00" * 16})
+        assert ack == {"ok": False, "fatal": True,
+                       "error": ack["error"]}
+        assert "fenced" in ack["error"]
+        assert b.total_fenced_rejects == 1
+        assert b.status_dict()["kv_store"]["fenced_rejects"] == 1
+
+    def test_client_skips_fenced_member_no_mirror(self, wire,
+                                                  tmp_path,
+                                                  model_cfg):
+        """A FATAL ack is never retried or mirrored — the fenced member
+        must not receive the page through a back door."""
+        a = StoreService(store_cfg())
+        b = StoreService(store_cfg(), member_id="s1",
+                         membership_dir=str(tmp_path))
+        b.membership.attach({"endpoint": EP_B})
+        wire.add(EP_A, a)
+        wire.add(EP_B, b)
+        StoreMembership(str(tmp_path), "ctl").fence("s1")
+        sc = StoreClient(store_cfg(
+            kv_store_endpoints=f"{EP_A},{EP_B}", kv_store_write_ack=2))
+        hashes = prefix_page_hashes(HOT, PS)
+        assert sc.demote(hashes, stamped_payload(model_cfg)) == 4
+        sc.flush_pending(timeout_s=30.0)
+        assert a.store.inventory() == hashes
+        assert b.store.inventory() == []
+        assert b.total_fenced_rejects >= 4
+
+    def test_zombie_incarnation_refused_after_replacement(
+            self, wire, tmp_path):
+        old = StoreService(store_cfg(), member_id="s0",
+                           membership_dir=str(tmp_path))
+        old.membership.attach({"endpoint": EP_A})
+        fresh = StoreService(store_cfg(), member_id="s0",
+                             membership_dir=str(tmp_path))
+        fresh.membership.attach({"endpoint": EP_B})
+        ack = old.demote_wire({"hash": "00" * 16})
+        assert ack.get("fatal") and "stale" in ack["error"]
+        assert fresh._write_guard() is None
+
+
+# ---------------------------------------------------------------------------
+# anti-entropy: rejoin converges, ledgers untouched
+# ---------------------------------------------------------------------------
+
+
+class TestAntiEntropy:
+    def test_rejoined_member_converges_kv_and_weights(self, wire,
+                                                      model_cfg):
+        a = StoreService(store_cfg())
+        wire.add(EP_A, a)
+        sc = StoreClient(store_cfg(kv_store_endpoints=EP_A))
+        hashes = prefix_page_hashes(HOT, PS)
+        payload = stamped_payload(model_cfg, seed=8)
+        sc.demote(hashes, payload)
+        wc = WeightCourier(store_cfg(), endpoint=EP_A)
+        total = wc.ship("conv", tiny_params(seed=8))["total"]
+        hits_before = a.store.snapshot()["hits"]
+        # the rejoining member: empty, knows A as a static peer
+        b = StoreService(store_cfg(), peers=[EP_A])
+        wire.add(EP_B, b)
+        stats = b.sync_once()
+        assert stats["kv_pulled"] == 4
+        assert stats["chunks_pulled"] == total
+        assert b.store.inventory() == a.store.inventory()
+        assert b.weights.names()["conv"]["complete"]
+        assert b.total_sync_pulls == 4 + total
+        # the ledgers record CLIENT traffic only: A's hit count did
+        # not move and nothing was marked served by the sync
+        assert a.store.snapshot()["hits"] == hits_before
+        assert not any(a.weights.status("conv")["served"].values())
+        # convergence is idempotent
+        assert b.sync_once()["kv_pulled"] == 0
+        # and the converged member actually SERVES: fetch from B alone
+        sc2 = StoreClient(store_cfg(kv_store_endpoints=EP_B))
+        out = sc2.fetch(hashes, CourierReceiver())
+        np.testing.assert_allclose(out["pages"]["k"], payload["k"])
+
+    def test_fenced_member_does_not_sync(self, wire, tmp_path,
+                                         model_cfg):
+        a = StoreService(store_cfg())
+        wire.add(EP_A, a)
+        StoreClient(store_cfg(kv_store_endpoints=EP_A)).demote(
+            prefix_page_hashes(HOT, PS), stamped_payload(model_cfg))
+        b = StoreService(store_cfg(), member_id="s1",
+                         membership_dir=str(tmp_path), peers=[EP_A])
+        b.membership.attach({"endpoint": EP_B})
+        StoreMembership(str(tmp_path), "ctl").fence("s1")
+        assert b.sync_once() == {"peers": 0, "kv_pulled": 0,
+                                 "chunks_pulled": 0}
+        assert b.store.inventory() == []
+
+
+# ---------------------------------------------------------------------------
+# weights over the tier
+# ---------------------------------------------------------------------------
+
+
+class TestWeightsTier:
+    def test_ship_fans_out_to_all_members(self, wire):
+        a, b, _ = two_member_tier(wire)
+        wc = WeightCourier(store_cfg(),
+                           endpoint=f"{EP_A},{EP_B}", write_ack=0)
+        params = tiny_params(seed=10)
+        rc = wc.ship("fan", params)
+        assert rc["members"] == 2
+        assert a.weights.names()["fan"]["complete"]
+        assert b.weights.names()["fan"]["complete"]
+
+    def test_ship_write_ack_floor(self, wire):
+        a, b, _ = two_member_tier(wire)
+        wire.down.add(EP_B)
+        params = tiny_params(seed=11)
+        # 0 = ALL live members must take it: one dead member fails loud
+        wc_all = WeightCourier(store_cfg(),
+                               endpoint=f"{EP_A},{EP_B}", write_ack=0)
+        with pytest.raises(WeightShipError, match="1/2"):
+            wc_all.ship("floor", params)
+        # floor 1: the survivor suffices, the failure is counted
+        wc_one = WeightCourier(store_cfg(),
+                               endpoint=f"{EP_A},{EP_B}", write_ack=1)
+        rc = wc_one.ship("floor", params)
+        assert rc["members"] == 1 and wc_one.total_failovers == 1
+
+    def test_mid_download_failover_ledger_balanced(self, wire,
+                                                   tmp_path,
+                                                   monkeypatch):
+        """The acceptance bullet: a weight download killed mid-ship
+        completes against the survivor, and the COMBINED per-seq serve
+        ledger across members balances — every chunk served exactly
+        once, no re-pulls, no gaps."""
+        a, b, _ = two_member_tier(wire)
+        up = WeightCourier(store_cfg(),
+                           endpoint=f"{EP_A},{EP_B}", write_ack=0)
+        params = tiny_params(seed=12)
+        total = up.ship("ha", params)["total"]
+        assert total > 8
+        monkeypatch.setattr(wmod, "_FETCH_BATCH", 4)
+        real = wire.post
+        state = {"batches": 0}
+
+        def dying(url, body, timeout_s=5.0):
+            if url.startswith(EP_A) and \
+                    url.endswith("/store/weights/fetch"):
+                state["batches"] += 1
+                if state["batches"] > 2:
+                    wire.down.add(EP_A)     # the member dies NOW
+            return real(url, body, timeout_s=timeout_s)
+
+        monkeypatch.setattr(wmod, "_post_json", dying)
+        dl = WeightCourier(store_cfg(), endpoint=f"{EP_A},{EP_B}",
+                           spool_dir=str(tmp_path))
+        params_equal(dl.fetch("ha"), params)
+        assert dl.total_failovers >= 1
+        served_a = a.weights.status("ha")["served"]
+        served_b = b.weights.status("ha")["served"]
+        combined = {int(s): served_a.get(s, 0) + served_b.get(s, 0)
+                    for s in set(served_a) | set(served_b)}
+        assert sorted(combined) == list(range(total))
+        assert set(combined.values()) == {1}
+        assert served_a and served_b        # both actually served
+
+    def test_shard_manifest_and_partial_fetch(self, wire):
+        a, b, _ = two_member_tier(wire)
+        wc = WeightCourier(store_cfg(),
+                           endpoint=f"{EP_A},{EP_B}", write_ack=0)
+        params = tiny_params(seed=13)
+        total = wc.ship("tp", params)["total"]
+        st = a.weights.status("tp")
+        assert set(st["shards"]) == {"head", "wte"}
+        for sm in st["shards"].values():
+            assert sm["seq_lo"] < sm["seq_hi"] <= total
+            assert sm["byte_lo"] < sm["byte_hi"]
+        # a tp worker pulls ONLY its shard's covering chunks
+        dl = WeightCourier(store_cfg(), endpoint=f"{EP_A},{EP_B}")
+        part = dl.fetch("tp", shards=["head"])
+        assert set(part) == {"head"}
+        params_equal(part["head"], params["head"])
+        assert dl.total_chunks < total
+        # unknown shard refuses the boot loudly
+        with pytest.raises(WeightShipError, match="ghost"):
+            dl.fetch("tp", shards=["ghost"])
+
+    def test_fetch_rotates_past_member_missing_the_name(self, wire):
+        """A freshly rejoined member that has not anti-entropied the
+        checkpoint yet must not fail the boot — the client rotates to
+        a member that holds it complete."""
+        a, b, _ = two_member_tier(wire)
+        WeightCourier(store_cfg(), endpoint=EP_B).ship(
+            "late", tiny_params(seed=14))
+        dl = WeightCourier(store_cfg(), endpoint=f"{EP_A},{EP_B}")
+        params_equal(dl.fetch("late"), tiny_params(seed=14))
+
+
+# ---------------------------------------------------------------------------
+# readiness gate + disk rescan
+# ---------------------------------------------------------------------------
+
+
+def _spilled_store_dir(tmp_path, model_cfg, seed=20):
+    """A disk tier left behind by a dead member: demote under a
+    too-small DRAM ring so frames spill. The LAST admitted frame stays
+    in DRAM — lost with the process — so only the spilled PREFIX
+    survives a rebirth (the prefix property the fetch path needs)."""
+    cfg = store_cfg(kv_store_dram_mb=0.001,
+                    kv_store_dir=str(tmp_path / "spill"))
+    st = FleetKVStore(cfg)
+    hashes = prefix_page_hashes(HOT, PS)
+    payload = stamped_payload(model_cfg, seed=seed)
+    st.demote(hashes, payload)
+    spilled = st.snapshot()["disk_entries"]
+    assert 0 < spilled < len(hashes)
+    return cfg, hashes[:spilled], payload
+
+
+class TestReadinessAndRescan:
+    def test_scan_disk_reindexes_spilled_frames(self, tmp_path,
+                                                model_cfg):
+        cfg, hashes, payload = _spilled_store_dir(tmp_path, model_cfg)
+        reborn = FleetKVStore(cfg)
+        assert not reborn.holds(hashes[0])
+        assert reborn.scan_disk() == len(hashes)
+        out = reborn.fetch(hashes, CourierReceiver())
+        np.testing.assert_allclose(out["pages"]["k"],
+                                   payload["k"][:, :len(hashes)])
+
+    def test_scan_disk_drops_garbage_files(self, tmp_path, model_cfg):
+        cfg, hashes, _ = _spilled_store_dir(tmp_path, model_cfg)
+        junk = tmp_path / "spill" / ("ff" * 16 + ".kvf")
+        junk.write_bytes(b"not a frame file")
+        reborn = FleetKVStore(cfg)
+        assert reborn.scan_disk() == len(hashes)
+        assert not junk.exists()            # unlinked, counted
+        assert reborn.snapshot()["corrupt"] == 1
+
+    @pytest.mark.socket
+    def test_health_gate_starting_until_warm(self, tmp_path,
+                                             model_cfg):
+        import urllib.error
+        import urllib.request
+
+        from aiohttp import web
+        cfg, hashes, payload = _spilled_store_dir(tmp_path, model_cfg)
+        svc = StoreService(cfg, warm=False)
+        assert not svc.ready
+        loop_box = {}
+        started = threading.Event()
+
+        def run():
+            import asyncio
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            loop_box["loop"] = loop
+
+            async def main():
+                runner = web.AppRunner(svc.build_app(),
+                                       access_log=None)
+                await runner.setup()
+                site = web.TCPSite(runner, "127.0.0.1", 0)
+                await site.start()
+                loop_box["port"] = runner.addresses[0][1]
+                loop_box["runner"] = runner
+                started.set()
+
+            loop.run_until_complete(main())
+            loop.run_forever()
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        assert started.wait(timeout=30)
+        ep = f"http://127.0.0.1:{loop_box['port']}"
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(f"{ep}/health", timeout=5.0)
+            assert exc.value.code == 503
+            assert json.loads(exc.value.read().decode()) == \
+                {"status": "starting"}
+            assert not wait_store_ready([ep], timeout_s=0.2)
+            svc.warm()                      # the disk scan completes
+            assert wait_store_ready([ep], timeout_s=5.0)
+            # the reborn member serves its spilled pages over the wire
+            sc = StoreClient(store_cfg(kv_store_endpoints=ep))
+            out = sc.fetch(hashes, CourierReceiver())
+            np.testing.assert_allclose(
+                out["pages"]["k"], payload["k"][:, :len(hashes)])
+        finally:
+            import asyncio
+            asyncio.run_coroutine_threadsafe(
+                loop_box["runner"].cleanup(),
+                loop_box["loop"]).result(timeout=10)
+            loop_box["loop"].call_soon_threadsafe(
+                loop_box["loop"].stop)
+            t.join(timeout=5)
